@@ -1,0 +1,42 @@
+"""ray_tpu — a TPU-native distributed AI framework.
+
+Brand-new implementation with the capabilities of the Ray reference
+(task/actor/object runtime, placement groups, Train/Tune/Data/Serve/RLlib/LLM
+libraries), re-designed TPU-first: intra-slice parallelism is expressed via
+JAX/XLA (pjit + shard_map over a device mesh, Pallas kernels for hot ops) and
+the actor runtime coordinates hosts and slices.
+
+Public core API mirrors the reference's `ray` module surface
+(python/ray/_private/worker.py): init/shutdown/remote/get/put/wait/kill/
+cancel/get_actor/nodes/cluster_resources/...
+"""
+from ._version import __version__
+from . import exceptions
+from .core.api import (
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    get_runtime_context,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    timeline,
+    wait,
+)
+from .core.ref import ObjectRef
+from .core.actor import ActorHandle
+
+__all__ = [
+    "__version__", "exceptions", "init", "shutdown", "is_initialized",
+    "remote", "get", "put", "wait", "kill", "cancel", "get_actor",
+    "get_runtime_context", "nodes", "cluster_resources",
+    "available_resources", "timeline", "ObjectRef", "ActorHandle", "util",
+]
+
+from . import util  # noqa: E402  (needs the names above)

@@ -1,0 +1,7 @@
+"""Shadowing sitecustomize for spawned CPU workers.
+
+The image's real sitecustomize imports jax + the axon TPU PJRT plugin at
+interpreter start (~1.8s on one core). Worker processes that will never touch
+the TPU skip it by having this empty module earlier on PYTHONPATH; TPU-flagged
+workers (Runtime._spawn_worker_locked tpu=True) keep the real one.
+"""

@@ -1,0 +1,157 @@
+"""Actor API: @ray_tpu.remote classes, handles, and method submission.
+
+Reference parity: python/ray/actor.py (ActorClass ~:1100, method submission
+:1729) with the GCS-side lifecycle living in core/runtime.py. Handles are
+picklable and can be passed to tasks/other actors; calls route through the
+head for ordering (reference analog: ActorTaskSubmitter sequence numbers,
+transport/actor_task_submitter.h:49).
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Any
+
+import cloudpickle
+
+from .ids import ActorID, ObjectID, TaskID
+from .ref import ObjectRef
+from .remote_function import prepare_args, resolve_strategy
+from .task_spec import ActorSpec, TaskSpec, validate_resources
+
+_DEFAULT_ACTOR_OPTS = dict(
+    num_cpus=0.0, num_tpus=0.0, resources=None, name=None,
+    max_restarts=0, max_task_retries=0, max_concurrency=1,
+    lifetime=None, scheduling_strategy="DEFAULT", placement_group=None,
+    placement_group_bundle_index=-1, _node_id=None, _node_soft=False,
+)
+
+
+def _runtime():
+    from . import runtime as rt
+    r = rt.get_runtime_if_exists()
+    if r is None:
+        raise RuntimeError("ray_tpu.init() must be called first")
+    return r
+
+
+class ActorClass:
+    def __init__(self, cls, opts: dict):
+        self._cls = cls
+        self._opts = {**_DEFAULT_ACTOR_OPTS, **opts}
+        self._blob: bytes | None = None
+        self._cid: str | None = None
+        self.__name__ = getattr(cls, "__name__", "Actor")
+
+    def options(self, **kwargs) -> "ActorClass":
+        bad = set(kwargs) - set(_DEFAULT_ACTOR_OPTS)
+        if bad:
+            raise ValueError(f"unknown actor options: {sorted(bad)}")
+        ac = ActorClass(self._cls, {**self._opts, **kwargs})
+        ac._blob, ac._cid = self._blob, self._cid
+        return ac
+
+    def remote(self, *args, **kwargs) -> "ActorHandle":
+        rt = _runtime()
+        if self._blob is None:
+            self._blob = cloudpickle.dumps(self._cls)
+            self._cid = "cls_" + hashlib.sha1(self._blob).hexdigest()[:16]
+        rt.register_function(self._cid, self._blob)
+        o = self._opts
+        blob, deps = prepare_args(rt, args, kwargs)
+        res = validate_resources({
+            "CPU": o["num_cpus"], "TPU": o["num_tpus"],
+            **(o["resources"] or {})})
+        strat = resolve_strategy(o)
+        aid = ActorID.from_random()
+        ready_oid = ObjectID.from_random()
+        spec = ActorSpec(
+            actor_id=aid,
+            class_id=self._cid,
+            name=o["name"] or self.__name__,
+            args_blob=blob,
+            dep_oids=deps,
+            resources=res,
+            max_restarts=o["max_restarts"],
+            max_task_retries=o["max_task_retries"],
+            max_concurrency=o["max_concurrency"],
+            pg_id=strat["pg_id"],
+            pg_bundle_index=strat["pg_bundle_index"],
+            node_affinity=strat["node_affinity"],
+            node_affinity_soft=strat["node_affinity_soft"],
+            named=o["name"],
+            ready_oid=ready_oid,
+        )
+        rt.create_actor(spec)
+        methods = sorted(
+            m for m in dir(self._cls)
+            if callable(getattr(self._cls, m, None)) and not m.startswith("__"))
+        return ActorHandle(aid, self.__name__, methods,
+                           o["max_task_retries"], ready_oid)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"actor class {self.__name__} cannot be instantiated directly; "
+            f"use {self.__name__}.remote()")
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def options(self, num_returns: int = 1, **_ignored) -> "ActorMethod":
+        return ActorMethod(self._handle, self._name, num_returns)
+
+    def remote(self, *args, **kwargs):
+        rt = _runtime()
+        blob, deps = prepare_args(rt, args, kwargs)
+        h = self._handle
+        nret = self._num_returns
+        spec = TaskSpec(
+            task_id=TaskID.from_random(),
+            func_id="",
+            name=f"{h._class_name}.{self._name}",
+            args_blob=blob,
+            dep_oids=deps,
+            return_ids=[ObjectID.from_random() for _ in range(nret)],
+            resources={},
+            retries_left=max(0, h._max_task_retries),
+            actor_id=h._actor_id,
+            method_name=self._name,
+        )
+        refs = rt.submit_actor_task_spec(spec)
+        if nret == 0:
+            return None
+        return refs[0] if nret == 1 else refs
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, class_name: str,
+                 methods: list[str], max_task_retries: int,
+                 ready_oid: ObjectID | None = None):
+        self._actor_id = actor_id
+        self._class_name = class_name
+        self._methods = methods
+        self._max_task_retries = max_task_retries
+        self._ready_oid = ready_oid
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if self._methods and name not in self._methods:
+            raise AttributeError(
+                f"actor {self._class_name} has no method {name!r}")
+        return ActorMethod(self, name)
+
+    def __ray_ready__(self) -> ObjectRef:
+        """Ref that resolves when the actor's __init__ finished."""
+        return ObjectRef(self._ready_oid)
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._class_name,
+                              self._methods, self._max_task_retries,
+                              self._ready_oid))

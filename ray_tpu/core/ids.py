@@ -1,0 +1,66 @@
+"""Binary IDs for objects, tasks, actors, nodes and jobs.
+
+Reference parity: src/ray/common/id.h defines Job/Task/Object/Actor/NodeID as
+fixed-width binary ids. We use 16 random bytes for everything (no embedded
+task-index structure — ownership metadata lives in the driver's object
+directory instead, see core/runtime.py).
+"""
+from __future__ import annotations
+
+import os
+
+
+class BaseID:
+    __slots__ = ("_bytes",)
+    SIZE = 16
+
+    def __init__(self, binary: bytes):
+        if len(binary) != self.SIZE:
+            raise ValueError(f"{type(self).__name__} must be {self.SIZE} bytes")
+        self._bytes = binary
+
+    @classmethod
+    def from_random(cls):
+        return cls(os.urandom(cls.SIZE))
+
+    def binary(self) -> bytes:
+        return self._bytes
+
+    def hex(self) -> str:
+        return self._bytes.hex()
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._bytes == self._bytes
+
+    def __hash__(self):
+        return hash((type(self).__name__, self._bytes))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.hex()[:12]})"
+
+    def __reduce__(self):
+        return (type(self), (self._bytes,))
+
+
+class ObjectID(BaseID):
+    pass
+
+
+class TaskID(BaseID):
+    pass
+
+
+class ActorID(BaseID):
+    pass
+
+
+class NodeID(BaseID):
+    pass
+
+
+class JobID(BaseID):
+    pass
+
+
+class PlacementGroupID(BaseID):
+    pass

@@ -1,0 +1,36 @@
+"""Lazy build of the native object store shared library.
+
+The reference ships prebuilt bazel binaries (src/ray/object_manager/plasma);
+here we compile on first import and cache next to the source. g++ is in the
+image; the build takes <2s.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "objstore.cc")
+_LIB = os.path.join(_DIR, "libobjstore.so")
+_lock = threading.Lock()
+
+
+def ensure_built() -> str:
+    """Compile objstore.cc -> libobjstore.so if missing or stale."""
+    with _lock:
+        if (
+            not os.path.exists(_LIB)
+            or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+        ):
+            tmp = _LIB + ".tmp"
+            subprocess.run(
+                [
+                    "g++", "-O2", "-g", "-shared", "-fPIC", "-std=c++17",
+                    "-o", tmp, _SRC, "-lpthread",
+                ],
+                check=True,
+                capture_output=True,
+            )
+            os.replace(tmp, _LIB)
+    return _LIB

@@ -1,0 +1,401 @@
+// objstore.cc — shared-memory immutable object store (plasma-equivalent).
+//
+// TPU-native re-design of the reference's per-node object store
+// (reference: src/ray/object_manager/plasma/store.h:55, object_store.cc,
+// eviction_policy.h). Unlike plasma's socket-server architecture (clients talk
+// to the store over a unix socket with fd-passing, plasma/client.h), this store
+// is a *single file-backed mmap region shared by all processes on the node*,
+// with a process-shared robust mutex + condvar in the header. Rationale: on a
+// TPU host the heavy data plane (gradients/activations) lives inside XLA
+// programs on-device; the host object store serves control payloads, dataset
+// blocks and checkpoints, so a lock-based shm design is simpler and has lower
+// latency than a socket protocol (no round trip, no fd passing).
+//
+// Features (parity targets):
+//   - create/seal/get/contains/delete/acquire/release  (plasma client.h ops)
+//   - blocking Get with timeout via pthread condvar     (plasma store.h:55 wait)
+//   - LRU eviction of sealed, unreferenced objects      (eviction_policy.h)
+//   - first-fit free-list allocator with coalescing     (dlmalloc.cc stand-in)
+//   - robust-mutex crash recovery (owner dies holding lock)
+//
+// Exposed as a C ABI consumed from Python via ctypes (no pybind11 in image).
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <ctime>
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x7452617954505530ULL;  // "tRayTPU0"
+constexpr uint32_t kIdSize = 16;
+
+enum ObjState : int32_t {
+  kFree = 0,      // entry slot unused
+  kCreated = 1,   // allocated, writer filling
+  kSealed = 2,    // immutable, readable
+};
+
+struct ObjEntry {
+  uint8_t id[kIdSize];
+  uint64_t offset;   // payload offset from region base
+  uint64_t size;
+  int32_t state;
+  int32_t refcnt;    // pins against eviction
+  uint64_t lru_tick;
+};
+
+// Free block header, stored inside the heap region itself.
+struct FreeBlock {
+  uint64_t size;        // total block size incl. nothing (just span)
+  uint64_t next;        // offset of next free block, 0 = end
+};
+
+struct Header {
+  uint64_t magic;
+  uint64_t capacity;        // total file size
+  uint64_t heap_off;        // where the allocatable heap begins
+  uint64_t heap_size;
+  uint32_t max_entries;
+  uint32_t pad0;
+  pthread_mutex_t mutex;
+  pthread_cond_t cond;
+  uint64_t lru_counter;
+  uint64_t free_head;       // offset of first free block (0 = none)
+  uint64_t bytes_in_use;
+  uint64_t num_objects;
+  uint64_t evictions;       // stat: count of evicted objects
+  // ObjEntry table follows, then heap.
+};
+
+struct Handle {
+  int fd;
+  uint8_t* base;
+  Header* hdr;
+  ObjEntry* entries;
+};
+
+inline ObjEntry* entry_table(Header* h) {
+  return reinterpret_cast<ObjEntry*>(reinterpret_cast<uint8_t*>(h) + sizeof(Header));
+}
+
+inline uint64_t align8(uint64_t v) { return (v + 7) & ~7ULL; }
+
+void lock(Handle* h) {
+  int rc = pthread_mutex_lock(&h->hdr->mutex);
+  if (rc == EOWNERDEAD) {
+    // A process died holding the lock; state may be torn but entries are
+    // updated with care (state flag written last on create), so recover.
+    pthread_mutex_consistent(&h->hdr->mutex);
+  }
+}
+
+void unlock(Handle* h) { pthread_mutex_unlock(&h->hdr->mutex); }
+
+ObjEntry* find(Handle* h, const uint8_t* id) {
+  // Linear-probed open addressing over the entry table, hashed by id prefix.
+  Header* hdr = h->hdr;
+  uint64_t hash;
+  memcpy(&hash, id, 8);
+  uint32_t n = hdr->max_entries;
+  for (uint32_t i = 0; i < n; i++) {
+    ObjEntry* e = &h->entries[(hash + i) % n];
+    if (e->state != kFree && memcmp(e->id, id, kIdSize) == 0) return e;
+  }
+  return nullptr;
+}
+
+ObjEntry* find_slot(Handle* h, const uint8_t* id) {
+  Header* hdr = h->hdr;
+  uint64_t hash;
+  memcpy(&hash, id, 8);
+  uint32_t n = hdr->max_entries;
+  for (uint32_t i = 0; i < n; i++) {
+    ObjEntry* e = &h->entries[(hash + i) % n];
+    if (e->state == kFree) return e;
+    if (memcmp(e->id, id, kIdSize) == 0) return nullptr;  // exists
+  }
+  return nullptr;  // table full
+}
+
+// First-fit allocation from the free list. Each allocated block carries an
+// 8-byte span header (the actual block size, including absorbed remainders
+// too small to split off) so dealloc always returns the exact span —
+// otherwise absorbed tails would leak permanently. Returns the *payload*
+// offset (block + 8) or 0 on failure.
+uint64_t alloc(Handle* h, uint64_t size) {
+  uint64_t want = align8(size) + 8;
+  if (want < sizeof(FreeBlock)) want = sizeof(FreeBlock);
+  Header* hdr = h->hdr;
+  uint64_t prev = 0, cur = hdr->free_head;
+  while (cur) {
+    FreeBlock* fb = reinterpret_cast<FreeBlock*>(h->base + cur);
+    if (fb->size >= want) {
+      uint64_t span = want;
+      uint64_t remain = fb->size - want;
+      if (remain >= sizeof(FreeBlock) + 64) {
+        // split: keep tail as free block
+        uint64_t tail_off = cur + want;
+        FreeBlock* tail = reinterpret_cast<FreeBlock*>(h->base + tail_off);
+        tail->size = remain;
+        tail->next = fb->next;
+        if (prev) reinterpret_cast<FreeBlock*>(h->base + prev)->next = tail_off;
+        else hdr->free_head = tail_off;
+      } else {
+        span = fb->size;  // absorb remainder
+        if (prev) reinterpret_cast<FreeBlock*>(h->base + prev)->next = fb->next;
+        else hdr->free_head = fb->next;
+      }
+      hdr->bytes_in_use += span;
+      *reinterpret_cast<uint64_t*>(h->base + cur) = span;
+      return cur + 8;
+    }
+    prev = cur;
+    cur = fb->next;
+  }
+  return 0;
+}
+
+// Return an allocated block (by payload offset) to the free list, coalescing
+// with neighbours (list kept sorted by offset so coalescing is O(1) at the
+// insertion point).
+void dealloc(Handle* h, uint64_t payload_off) {
+  uint64_t off = payload_off - 8;
+  uint64_t size = *reinterpret_cast<uint64_t*>(h->base + off);
+  Header* hdr = h->hdr;
+  hdr->bytes_in_use -= size;
+  uint64_t prev = 0, cur = hdr->free_head;
+  while (cur && cur < off) {
+    prev = cur;
+    cur = reinterpret_cast<FreeBlock*>(h->base + cur)->next;
+  }
+  FreeBlock* nb = reinterpret_cast<FreeBlock*>(h->base + off);
+  nb->size = size;
+  nb->next = cur;
+  if (prev) {
+    FreeBlock* pb = reinterpret_cast<FreeBlock*>(h->base + prev);
+    pb->next = off;
+    if (prev + pb->size == off) {  // coalesce with prev
+      pb->size += nb->size;
+      pb->next = nb->next;
+      nb = pb;
+      off = prev;
+    }
+  } else {
+    hdr->free_head = off;
+  }
+  if (nb->next && off + nb->size == nb->next) {  // coalesce with next
+    FreeBlock* xb = reinterpret_cast<FreeBlock*>(h->base + nb->next);
+    nb->size += xb->size;
+    nb->next = xb->next;
+  }
+}
+
+// Evict sealed refcnt==0 objects in LRU order until `need` bytes could fit.
+// Caller holds lock. Returns true if anything was evicted.
+bool evict_lru(Handle* h, uint64_t need) {
+  Header* hdr = h->hdr;
+  bool any = false;
+  while (true) {
+    // Check if a block of `need` is plausible: conservative — try alloc.
+    uint64_t off = alloc(h, need);
+    if (off) { dealloc(h, off); return true; }
+    // find LRU evictable
+    ObjEntry* victim = nullptr;
+    for (uint32_t i = 0; i < hdr->max_entries; i++) {
+      ObjEntry* e = &h->entries[i];
+      if (e->state == kSealed && e->refcnt == 0) {
+        if (!victim || e->lru_tick < victim->lru_tick) victim = e;
+      }
+    }
+    if (!victim) return any;
+    dealloc(h, victim->offset);
+    victim->state = kFree;
+    hdr->num_objects--;
+    hdr->evictions++;
+    any = true;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create a new store region backing file at `path` with `capacity` bytes and
+// room for `max_entries` objects. Returns handle or nullptr.
+void* os_store_create(const char* path, uint64_t capacity, uint32_t max_entries) {
+  int fd = open(path, O_RDWR | O_CREAT | O_TRUNC, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, (off_t)capacity) != 0) { close(fd); return nullptr; }
+  uint8_t* base = (uint8_t*)mmap(nullptr, capacity, PROT_READ | PROT_WRITE,
+                                 MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) { close(fd); return nullptr; }
+  Header* hdr = reinterpret_cast<Header*>(base);
+  memset(hdr, 0, sizeof(Header));
+  hdr->capacity = capacity;
+  hdr->max_entries = max_entries;
+  uint64_t table_bytes = align8((uint64_t)max_entries * sizeof(ObjEntry));
+  hdr->heap_off = align8(sizeof(Header) + table_bytes);
+  hdr->heap_size = capacity - hdr->heap_off;
+  memset(entry_table(hdr), 0, table_bytes);
+
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&hdr->mutex, &ma);
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_condattr_setclock(&ca, CLOCK_MONOTONIC);
+  pthread_cond_init(&hdr->cond, &ca);
+
+  // one big free block spanning the heap
+  FreeBlock* fb = reinterpret_cast<FreeBlock*>(base + hdr->heap_off);
+  fb->size = hdr->heap_size;
+  fb->next = 0;
+  hdr->free_head = hdr->heap_off;
+  hdr->magic = kMagic;  // written last: attachers spin on this
+
+  Handle* h = new Handle{fd, base, hdr, entry_table(hdr)};
+  return h;
+}
+
+void* os_store_attach(const char* path) {
+  int fd = open(path, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) { close(fd); return nullptr; }
+  uint8_t* base = (uint8_t*)mmap(nullptr, st.st_size, PROT_READ | PROT_WRITE,
+                                 MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) { close(fd); return nullptr; }
+  Header* hdr = reinterpret_cast<Header*>(base);
+  if (hdr->magic != kMagic) { munmap(base, st.st_size); close(fd); return nullptr; }
+  Handle* h = new Handle{fd, base, hdr, entry_table(hdr)};
+  return h;
+}
+
+void os_store_close(void* hv) {
+  Handle* h = reinterpret_cast<Handle*>(hv);
+  munmap(h->base, h->hdr->capacity);
+  close(h->fd);
+  delete h;
+}
+
+// Allocate an object buffer. Returns payload offset (>0), 0 if out of memory
+// after eviction, or UINT64_MAX if the id already exists.
+uint64_t os_create(void* hv, const uint8_t* id, uint64_t size) {
+  Handle* h = reinterpret_cast<Handle*>(hv);
+  lock(h);
+  if (find(h, id)) { unlock(h); return UINT64_MAX; }
+  uint64_t off = alloc(h, size);
+  if (!off) {
+    evict_lru(h, size);
+    off = alloc(h, size);
+  }
+  if (!off) { unlock(h); return 0; }
+  ObjEntry* e = find_slot(h, id);
+  if (!e) { dealloc(h, off); unlock(h); return 0; }
+  memcpy(e->id, id, kIdSize);
+  e->offset = off;
+  e->size = size;
+  e->refcnt = 1;  // creator holds a pin until seal
+  e->lru_tick = ++h->hdr->lru_counter;
+  e->state = kCreated;
+  h->hdr->num_objects++;
+  unlock(h);
+  return off;
+}
+
+int os_seal(void* hv, const uint8_t* id) {
+  Handle* h = reinterpret_cast<Handle*>(hv);
+  lock(h);
+  ObjEntry* e = find(h, id);
+  if (!e || e->state != kCreated) { unlock(h); return -1; }
+  e->state = kSealed;
+  e->refcnt -= 1;  // drop creator pin
+  pthread_cond_broadcast(&h->hdr->cond);
+  unlock(h);
+  return 0;
+}
+
+// Blocking get: waits up to timeout_ms for the object to be sealed.
+// On success pins the object (caller must os_release) and fills offset/size.
+// Returns 0 ok, -1 timeout, -2 would-block (timeout_ms == 0 and not present).
+int os_get(void* hv, const uint8_t* id, int64_t timeout_ms,
+           uint64_t* offset, uint64_t* size) {
+  Handle* h = reinterpret_cast<Handle*>(hv);
+  struct timespec deadline;
+  clock_gettime(CLOCK_MONOTONIC, &deadline);
+  deadline.tv_sec += timeout_ms / 1000;
+  deadline.tv_nsec += (timeout_ms % 1000) * 1000000L;
+  if (deadline.tv_nsec >= 1000000000L) { deadline.tv_sec++; deadline.tv_nsec -= 1000000000L; }
+  lock(h);
+  while (true) {
+    ObjEntry* e = find(h, id);
+    if (e && e->state == kSealed) {
+      e->refcnt++;
+      e->lru_tick = ++h->hdr->lru_counter;
+      *offset = e->offset;
+      *size = e->size;
+      unlock(h);
+      return 0;
+    }
+    if (timeout_ms == 0) { unlock(h); return -2; }
+    int rc = pthread_cond_timedwait(&h->hdr->cond, &h->hdr->mutex, &deadline);
+    if (rc == ETIMEDOUT) { unlock(h); return -1; }
+    if (rc == EOWNERDEAD) pthread_mutex_consistent(&h->hdr->mutex);
+  }
+}
+
+int os_contains(void* hv, const uint8_t* id) {
+  Handle* h = reinterpret_cast<Handle*>(hv);
+  lock(h);
+  ObjEntry* e = find(h, id);
+  int r = (e && e->state == kSealed) ? 1 : 0;
+  unlock(h);
+  return r;
+}
+
+void os_release(void* hv, const uint8_t* id) {
+  Handle* h = reinterpret_cast<Handle*>(hv);
+  lock(h);
+  ObjEntry* e = find(h, id);
+  if (e && e->refcnt > 0) e->refcnt--;
+  unlock(h);
+}
+
+// Delete an object (abort an unsealed create or free a sealed object).
+// Objects pinned by readers are deleted lazily: marked unreferenced-sealed and
+// reclaimed by eviction; here we only free immediately when refcnt hits 0.
+int os_delete(void* hv, const uint8_t* id) {
+  Handle* h = reinterpret_cast<Handle*>(hv);
+  lock(h);
+  ObjEntry* e = find(h, id);
+  if (!e) { unlock(h); return -1; }
+  if (e->refcnt <= (e->state == kCreated ? 1 : 0)) {
+    dealloc(h, e->offset);
+    e->state = kFree;
+    h->hdr->num_objects--;
+  } else {
+    // readers still hold it: make it evictable as soon as they release
+    e->lru_tick = 0;
+    e->state = kSealed;
+  }
+  unlock(h);
+  return 0;
+}
+
+uint64_t os_capacity(void* hv) { return reinterpret_cast<Handle*>(hv)->hdr->heap_size; }
+uint64_t os_bytes_in_use(void* hv) { return reinterpret_cast<Handle*>(hv)->hdr->bytes_in_use; }
+uint64_t os_num_objects(void* hv) { return reinterpret_cast<Handle*>(hv)->hdr->num_objects; }
+uint64_t os_evictions(void* hv) { return reinterpret_cast<Handle*>(hv)->hdr->evictions; }
+
+}  // extern "C"

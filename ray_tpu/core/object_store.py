@@ -1,0 +1,217 @@
+"""Python client for the native shared-memory object store.
+
+Reference parity: python side of plasma (reference:
+src/ray/object_manager/plasma/client.h + the flatbuffer protocol plasma.fbs).
+Our design has no store server process — every process mmaps the same region
+and synchronizes through a process-shared robust mutex (see
+native/objstore.cc for rationale). Payloads are framed as:
+
+    [1B flags][4B n_bufs][8B pickle_len][pickle bytes][(8B len, raw bytes)*]
+
+where out-of-band pickle-5 buffers carry numpy/jax arrays without an extra
+copy on the serialize side (reference analog: _private/serialization.py:123
+zero-copy numpy handling).
+"""
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import pickle
+import struct
+from typing import Any, Optional
+
+import cloudpickle
+
+from .ids import ObjectID
+from .native.build import ensure_built
+
+_FLAG_NORMAL = 0
+_FLAG_EXCEPTION = 1
+
+_HEADER = struct.Struct("<BxxxIQ")  # flags, n_bufs, pickle_len
+
+
+class ObjectStoreFullError(MemoryError):
+    pass
+
+
+class ObjectLostError(Exception):
+    """Object was evicted and is no longer in the store (lineage needed)."""
+
+
+class GetTimeoutError(TimeoutError):
+    pass
+
+
+def _load_lib() -> ctypes.CDLL:
+    lib = ctypes.CDLL(ensure_built())
+    lib.os_store_create.restype = ctypes.c_void_p
+    lib.os_store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32]
+    lib.os_store_attach.restype = ctypes.c_void_p
+    lib.os_store_attach.argtypes = [ctypes.c_char_p]
+    lib.os_store_close.argtypes = [ctypes.c_void_p]
+    lib.os_create.restype = ctypes.c_uint64
+    lib.os_create.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+    lib.os_seal.restype = ctypes.c_int
+    lib.os_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.os_get.restype = ctypes.c_int
+    lib.os_get.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.os_contains.restype = ctypes.c_int
+    lib.os_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.os_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.os_delete.restype = ctypes.c_int
+    lib.os_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    for fn in ("os_capacity", "os_bytes_in_use", "os_num_objects", "os_evictions"):
+        getattr(lib, fn).restype = ctypes.c_uint64
+        getattr(lib, fn).argtypes = [ctypes.c_void_p]
+    return lib
+
+
+class SharedObjectStore:
+    """One per process; created by the head (driver), attached by workers."""
+
+    def __init__(self, path: str, capacity: int = 0, max_entries: int = 65536,
+                 create: bool = False):
+        self._lib = _load_lib()
+        self.path = path
+        if create:
+            self._h = self._lib.os_store_create(path.encode(), capacity, max_entries)
+        else:
+            self._h = self._lib.os_store_attach(path.encode())
+        if not self._h:
+            raise RuntimeError(f"failed to open object store at {path}")
+        self._fd = os.open(path, os.O_RDWR)
+        size = os.fstat(self._fd).st_size
+        self._mm = mmap.mmap(self._fd, size)
+        self._view = memoryview(self._mm)
+        self._owner = create
+
+    # -- raw byte-level API ------------------------------------------------
+
+    def _handle(self):
+        h = self._h
+        if h is None:
+            raise RuntimeError("object store is closed")
+        return h
+
+    def create_raw(self, oid: ObjectID, size: int) -> memoryview:
+        off = self._lib.os_create(self._handle(), oid.binary(), size)
+        if off == 2**64 - 1:
+            raise FileExistsError(f"object {oid} already exists")
+        if off == 0:
+            raise ObjectStoreFullError(
+                f"object store full ({self.bytes_in_use()}/{self.capacity()} "
+                f"bytes in use) while allocating {size} bytes")
+        return self._view[off:off + size]
+
+    def seal(self, oid: ObjectID) -> None:
+        if self._lib.os_seal(self._handle(), oid.binary()) != 0:
+            raise RuntimeError(f"seal failed for {oid}")
+
+    def get_raw(self, oid: ObjectID, timeout_ms: int = -1) -> Optional[memoryview]:
+        """Pin + return the payload view, or None on timeout. Caller must
+        release(oid) when done with the view."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        if timeout_ms < 0:
+            timeout_ms = 2**31  # ~24 days; effectively infinite
+        rc = self._lib.os_get(self._handle(), oid.binary(), timeout_ms,
+                              ctypes.byref(off), ctypes.byref(size))
+        if rc != 0:
+            return None
+        return self._view[off.value:off.value + size.value]
+
+    def release(self, oid: ObjectID) -> None:
+        self._lib.os_release(self._handle(), oid.binary())
+
+    def contains(self, oid: ObjectID) -> bool:
+        return bool(self._lib.os_contains(self._handle(), oid.binary()))
+
+    def delete(self, oid: ObjectID) -> None:
+        self._lib.os_delete(self._handle(), oid.binary())
+
+    # -- object-level API --------------------------------------------------
+
+    def put(self, oid: ObjectID, value: Any, is_exception: bool = False) -> int:
+        """Serialize `value` into the store under `oid`. Returns payload size."""
+        buffers: list[pickle.PickleBuffer] = []
+        payload = cloudpickle.dumps(value, protocol=5, buffer_callback=buffers.append)
+        raws = [b.raw() for b in buffers]
+        total = _HEADER.size + len(payload) + sum(8 + len(r) for r in raws)
+        buf = self.create_raw(oid, total)
+        flags = _FLAG_EXCEPTION if is_exception else _FLAG_NORMAL
+        _HEADER.pack_into(buf, 0, flags, len(raws), len(payload))
+        pos = _HEADER.size
+        buf[pos:pos + len(payload)] = payload
+        pos += len(payload)
+        for r in raws:
+            struct.pack_into("<Q", buf, pos, len(r))
+            pos += 8
+            buf[pos:pos + len(r)] = r
+            pos += len(r)
+        del buf
+        self.seal(oid)
+        return total
+
+    def get(self, oid: ObjectID, timeout_ms: int = -1) -> Any:
+        """Deserialize the object. Raises GetTimeoutError on timeout and
+        re-raises stored exceptions."""
+        view = self.get_raw(oid, timeout_ms)
+        if view is None:
+            raise GetTimeoutError(f"timed out waiting for {oid}")
+        try:
+            flags, n_bufs, plen = _HEADER.unpack_from(view, 0)
+            pos = _HEADER.size
+            payload = bytes(view[pos:pos + plen])
+            pos += plen
+            bufs = []
+            for _ in range(n_bufs):
+                (blen,) = struct.unpack_from("<Q", view, pos)
+                pos += 8
+                # copy out: the view is only pinned while we hold the refcount
+                bufs.append(bytes(view[pos:pos + blen]))
+                pos += blen
+            value = pickle.loads(payload, buffers=bufs)
+        finally:
+            del view
+            self.release(oid)
+        if flags == _FLAG_EXCEPTION:
+            raise value
+        return value
+
+    # -- stats -------------------------------------------------------------
+
+    def capacity(self) -> int:
+        return self._lib.os_capacity(self._handle())
+
+    def bytes_in_use(self) -> int:
+        return self._lib.os_bytes_in_use(self._handle())
+
+    def num_objects(self) -> int:
+        return self._lib.os_num_objects(self._handle())
+
+    def evictions(self) -> int:
+        return self._lib.os_evictions(self._handle())
+
+    def close(self, unlink: bool = False) -> None:
+        if self._h:
+            h, self._h = self._h, None  # new calls now fail cleanly
+            # let in-flight os_get slices (<=200ms waits) drain before unmap
+            import time
+            time.sleep(0.25)
+            try:
+                self._view.release()
+                self._mm.close()
+            except BufferError:
+                pass  # a reader still holds a view; leak the map, not a SEGV
+            os.close(self._fd)
+            self._lib.os_store_close(h)
+            if unlink and self._owner:
+                try:
+                    os.unlink(self.path)
+                except OSError:
+                    pass

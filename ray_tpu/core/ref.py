@@ -1,0 +1,84 @@
+"""ObjectRef — a future handle to a value in the object store.
+
+Reference parity: python/ray/_raylet.pyx ObjectRef + ownership semantics from
+src/ray/core_worker/reference_count.h:73. Ownership here is simplified: the
+head process (driver) is the owner of all object metadata (the directory in
+core/runtime.py); the payload lives in the node-shared memory store. Lineage
+(the producing TaskSpec) is kept by the head until the object is pinned or
+freed, enabling reconstruction after eviction — the analog of
+object_recovery_manager.h:43.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from .ids import ObjectID
+
+_pending_runtime = None
+
+
+def _get_runtime():
+    from . import runtime as rt
+    r = rt.get_runtime_if_exists()
+    if r is None:
+        raise RuntimeError("ray_tpu.init() has not been called")
+    return r
+
+
+class ObjectRef:
+    __slots__ = ("_id", "__weakref__")
+
+    def __init__(self, oid: ObjectID):
+        self._id = oid
+
+    def id(self) -> ObjectID:
+        return self._id
+
+    def binary(self) -> bytes:
+        return self._id.binary()
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __repr__(self):
+        return f"ObjectRef({self.hex()[:16]})"
+
+    def __reduce__(self):
+        return (_deserialize_ref, (self._id.binary(),))
+
+    # Allow `await ref` inside async actors.
+    def __await__(self):
+        from .api import get as _get
+        import asyncio
+
+        def _resolve():
+            return _get(self)
+
+        loop = asyncio.get_event_loop()
+        return loop.run_in_executor(None, _resolve).__await__()
+
+    def future(self):
+        import concurrent.futures
+
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def _resolve():
+            from .api import get as _get
+            try:
+                fut.set_result(_get(self))
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        import threading
+        threading.Thread(target=_resolve, daemon=True).start()
+        return fut
+
+
+def _deserialize_ref(binary: bytes) -> ObjectRef:
+    return ObjectRef(ObjectID(binary))

@@ -1,0 +1,135 @@
+"""@ray_tpu.remote functions.
+
+Reference parity: python/ray/remote_function.py:484 (RemoteFunction._remote)
+— options handling, lazy pickling of the function, arg inlining vs
+put-in-store threshold, and submission through the runtime.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Any
+
+import cloudpickle
+import numpy as np
+
+from .ids import ObjectID, TaskID
+from .ref import ObjectRef
+from .task_spec import TaskSpec, validate_resources
+
+# args bigger than this are moved to the object store instead of riding the
+# control-plane socket (reference: RayConfig max_direct_call_object_size)
+INLINE_ARG_LIMIT = 100_000
+
+_DEFAULT_TASK_OPTS = dict(
+    num_cpus=1.0, num_tpus=0.0, resources=None, num_returns=1,
+    max_retries=3, retry_exceptions=False, name=None,
+    scheduling_strategy="DEFAULT", placement_group=None,
+    placement_group_bundle_index=-1, _node_id=None, _node_soft=False,
+)
+
+
+def _runtime():
+    from . import runtime as rt
+    r = rt.get_runtime_if_exists()
+    if r is None:
+        raise RuntimeError(
+            "ray_tpu.init() must be called before using .remote()")
+    return r
+
+
+def prepare_args(rt, args: tuple, kwargs: dict):
+    """Replace large array-like args with store refs; collect top-level refs
+    as scheduling dependencies."""
+    def conv(a):
+        if isinstance(a, np.ndarray) and a.nbytes > INLINE_ARG_LIMIT:
+            return rt.put(a, pin=False)
+        return a
+
+    args = tuple(conv(a) for a in args)
+    kwargs = {k: conv(v) for k, v in kwargs.items()}
+    deps = [a.id() for a in args if isinstance(a, ObjectRef)]
+    deps += [v.id() for v in kwargs.values() if isinstance(v, ObjectRef)]
+    blob = cloudpickle.dumps((args, kwargs))
+    return blob, deps
+
+
+def resolve_strategy(opts: dict) -> dict:
+    """Translate scheduling_strategy objects into spec fields."""
+    from ..util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy, PlacementGroupSchedulingStrategy)
+    out = dict(pg_id=None, pg_bundle_index=-1, node_affinity=None,
+               node_affinity_soft=False, scheduling_strategy="DEFAULT")
+    strat = opts.get("scheduling_strategy")
+    if isinstance(strat, PlacementGroupSchedulingStrategy):
+        out["pg_id"] = strat.placement_group.id
+        out["pg_bundle_index"] = strat.placement_group_bundle_index
+    elif isinstance(strat, NodeAffinitySchedulingStrategy):
+        out["node_affinity"] = bytes.fromhex(strat.node_id)
+        out["node_affinity_soft"] = strat.soft
+    elif strat in ("DEFAULT", "SPREAD", None):
+        out["scheduling_strategy"] = strat or "DEFAULT"
+    else:
+        raise ValueError(f"unknown scheduling strategy {strat!r}")
+    if opts.get("placement_group") is not None:
+        pg = opts["placement_group"]
+        out["pg_id"] = pg.id
+        out["pg_bundle_index"] = opts.get("placement_group_bundle_index", -1)
+    if opts.get("_node_id") is not None:
+        out["node_affinity"] = bytes.fromhex(opts["_node_id"])
+        out["node_affinity_soft"] = opts.get("_node_soft", False)
+    return out
+
+
+class RemoteFunction:
+    def __init__(self, fn, opts: dict):
+        self._fn = fn
+        self._opts = {**_DEFAULT_TASK_OPTS, **opts}
+        self._blob: bytes | None = None
+        self._fid: str | None = None
+        self.__name__ = getattr(fn, "__name__", "remote_fn")
+
+    def options(self, **kwargs) -> "RemoteFunction":
+        bad = set(kwargs) - set(_DEFAULT_TASK_OPTS)
+        if bad:
+            raise ValueError(f"unknown options: {sorted(bad)}")
+        rf = RemoteFunction(self._fn, {**self._opts, **kwargs})
+        rf._blob, rf._fid = self._blob, self._fid
+        return rf
+
+    def _ensure_registered(self, rt):
+        if self._blob is None:
+            self._blob = cloudpickle.dumps(self._fn)
+            self._fid = hashlib.sha1(self._blob).hexdigest()[:16]
+        rt.register_function(self._fid, self._blob)
+
+    def remote(self, *args, **kwargs) -> Any:
+        rt = _runtime()
+        self._ensure_registered(rt)
+        o = self._opts
+        blob, deps = prepare_args(rt, args, kwargs)
+        res = validate_resources({
+            "CPU": o["num_cpus"], "TPU": o["num_tpus"],
+            **(o["resources"] or {})})
+        strat = resolve_strategy(o)
+        nret = o["num_returns"]
+        spec = TaskSpec(
+            task_id=TaskID.from_random(),
+            func_id=self._fid,
+            name=o["name"] or self.__name__,
+            args_blob=blob,
+            dep_oids=deps,
+            return_ids=[ObjectID.from_random() for _ in range(nret)],
+            resources=res,
+            retries_left=max(0, o["max_retries"]),
+            retry_exceptions=bool(o["retry_exceptions"]),
+            **strat,
+        )
+        refs = rt.submit_task(spec)
+        if nret == 0:
+            return None
+        return refs[0] if nret == 1 else refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"remote function {self.__name__} cannot be called directly; "
+            f"use {self.__name__}.remote()")

@@ -1,0 +1,75 @@
+"""Task and actor specifications exchanged between driver and workers.
+
+Reference parity: TaskSpecification (src/ray/common/task/task_spec.h, built
+from common.proto TaskSpec). We use plain dataclasses over the pickle-based
+connection transport instead of protobuf — the head process and workers share
+a Python version, and the hot path (arg payloads) bypasses these structs via
+the shared-memory store.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+from .ids import ActorID, ObjectID, PlacementGroupID, TaskID
+
+
+@dataclasses.dataclass
+class TaskSpec:
+    task_id: TaskID
+    func_id: str                       # registry key (hash of pickled fn)
+    name: str                          # human-readable, for errors/events
+    args_blob: bytes                   # cloudpickle((args, kwargs)), refs by-ref
+    dep_oids: list[ObjectID]           # top-level ObjectRef args to resolve
+    return_ids: list[ObjectID]
+    resources: dict[str, float]
+    retries_left: int = 0
+    retry_exceptions: bool = False
+    # actor-task fields
+    actor_id: Optional[ActorID] = None
+    method_name: Optional[str] = None
+    seq_no: int = 0                    # per-actor submission order
+    # placement
+    pg_id: Optional[PlacementGroupID] = None
+    pg_bundle_index: int = -1
+    node_affinity: Optional[bytes] = None   # NodeID binary, soft=false only
+    node_affinity_soft: bool = False
+    scheduling_strategy: str = "DEFAULT"    # DEFAULT | SPREAD
+    owner: str = "driver"              # "driver" or worker-id hex
+
+    @property
+    def is_actor_task(self) -> bool:
+        return self.actor_id is not None and self.method_name is not None
+
+
+@dataclasses.dataclass
+class ActorSpec:
+    actor_id: ActorID
+    class_id: str                      # registry key for the pickled class
+    name: str
+    args_blob: bytes
+    dep_oids: list[ObjectID]
+    resources: dict[str, float]
+    max_restarts: int = 0
+    max_task_retries: int = 0
+    max_concurrency: int = 1
+    pg_id: Optional[PlacementGroupID] = None
+    pg_bundle_index: int = -1
+    node_affinity: Optional[bytes] = None
+    node_affinity_soft: bool = False
+    named: Optional[str] = None        # ray.get_actor() name
+    # creation-readiness object: resolves when the actor __init__ finished
+    ready_oid: Optional[ObjectID] = None
+
+
+def validate_resources(res: dict[str, float]) -> dict[str, float]:
+    out = {}
+    for k, v in res.items():
+        if v is None:
+            continue
+        v = float(v)
+        if v < 0:
+            raise ValueError(f"resource {k!r} must be >= 0, got {v}")
+        if v:
+            out[k] = v
+    return out

@@ -1,0 +1,387 @@
+"""Worker process: executes tasks and hosts actors.
+
+Reference parity: the worker side of the core worker (reference:
+src/ray/core_worker/core_worker.h:166 task-execution loop, the Python hot
+loop _raylet.pyx:2103 execute_task_with_cancellation_handler, and the actor
+scheduling queues of transport/task_receiver.h:50 +
+concurrency_group_manager.h). Differences from the reference, by design:
+
+  - results are written straight into the node-shared mmap store and the head
+    is notified with a tiny `done` message — no return-value RPC hop;
+  - actor method ordering comes from head routing order + a single executor
+    thread (max_concurrency=1), a thread pool for threaded actors, or an
+    asyncio loop for async actors;
+  - blocked-worker CPU release (`blocked`/`unblocked` messages) mirrors the
+    reference's logic that returns a lease's resources while the worker waits
+    in `ray.get` (raylet/local_task_manager.h).
+
+Entry point: `python -m ray_tpu.core.worker` with RTPU_* env vars set by
+Runtime._spawn_worker_locked.
+"""
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import ctypes
+import os
+import sys
+import threading
+import time
+import traceback
+from multiprocessing.connection import Client
+
+import cloudpickle
+
+from .. import exceptions as exc
+from .ids import ObjectID
+from .object_store import GetTimeoutError as StoreTimeout
+from .object_store import SharedObjectStore
+from .ref import ObjectRef
+from .task_spec import ActorSpec, TaskSpec
+from . import runtime as rt_mod
+
+
+class WorkerRuntime:
+    """Worker-side implementation of the runtime interface used by the public
+    API (`ray_tpu.get/put/wait/...` called *inside* a task or actor)."""
+
+    def __init__(self, store: SharedObjectStore, conn, wid: str):
+        self.store = store
+        self.conn = conn
+        self.wid = wid
+        self.send_lock = threading.Lock()
+        self.func_registry: dict[str, object] = {}
+        self._sent_fids: set[str] = set()
+        self.current_task_name = ""
+
+    # -- messaging ---------------------------------------------------------
+
+    def send(self, msg):
+        with self.send_lock:
+            self.conn.send(msg)
+
+    def _ship_func(self, fid: str, blob: bytes):
+        if fid not in self._sent_fids:
+            self.send({"t": "func_def", "fid": fid, "blob": blob})
+            self._sent_fids.add(fid)
+
+    def register_function(self, fid: str, blob: bytes):
+        self.func_registry.setdefault(fid, cloudpickle.loads(blob))
+        self._ship_func(fid, blob)
+
+    # -- object API --------------------------------------------------------
+
+    def put(self, value, pin: bool = False):
+        oid = ObjectID.from_random()
+        self.store.put(oid, value)
+        self.send({"t": "put", "oid": oid})
+        return ObjectRef(oid)
+
+    def get(self, refs, timeout: float | None = None):
+        single = isinstance(refs, ObjectRef)
+        ref_list = [refs] if single else list(refs)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out = []
+        blocked = False
+        try:
+            for r in ref_list:
+                out.append(self._get_one(r.id(), deadline,
+                                         lambda: self._block(True)))
+        finally:
+            self._block(False)
+        return out[0] if single else out
+
+    _did_block = False
+
+    def _block(self, flag: bool):
+        if flag and not self._did_block:
+            self._did_block = True
+            self.send({"t": "blocked"})
+        elif not flag and self._did_block:
+            self._did_block = False
+            self.send({"t": "unblocked"})
+
+    def _get_one(self, oid: ObjectID, deadline, on_wait):
+        first = True
+        while True:
+            slice_ms = 200
+            if deadline is not None:
+                remain = deadline - time.monotonic()
+                if remain <= 0:
+                    raise exc.GetTimeoutError(f"timed out waiting for {oid}")
+                slice_ms = max(1, min(slice_ms, int(remain * 1000)))
+            try:
+                return self.store.get(oid, timeout_ms=slice_ms)
+            except StoreTimeout:
+                if first:
+                    on_wait()
+                    self.send({"t": "ensure", "oids": [oid.binary()]})
+                    first = False
+                continue
+            except exc.RayTaskError as e:
+                raise e.as_instanceof_cause() from None
+
+    def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        ref_list = list(refs)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        ready, pending = [], list(ref_list)
+        notified = False
+        while True:
+            still = []
+            for r in pending:
+                (ready if self.store.contains(r.id()) else still).append(r)
+            pending = still
+            if len(ready) >= num_returns or not pending:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            if not notified:
+                self.send({"t": "ensure",
+                           "oids": [r.id().binary() for r in pending]})
+                notified = True
+            time.sleep(0.002)
+        return ready, pending
+
+    # -- task/actor API ----------------------------------------------------
+
+    def submit_task(self, spec: TaskSpec):
+        spec.owner = self.wid
+        self.send({"t": "submit", "spec": spec})
+        return [ObjectRef(o) for o in spec.return_ids]
+
+    def create_actor(self, spec: ActorSpec):
+        self.send({"t": "create_actor", "spec": spec})
+
+    def submit_actor_task_spec(self, spec: TaskSpec):
+        spec.owner = self.wid
+        self.send({"t": "actor_call", "spec": spec})
+        return [ObjectRef(o) for o in spec.return_ids]
+
+    def kill_actor(self, actor_id, no_restart=True):
+        self.send({"t": "kill_actor", "actor_id": actor_id.binary(),
+                   "no_restart": no_restart})
+
+    def cancel(self, ref, force=False, recursive=True):
+        self.send({"t": "cancel", "oid": ref.id().binary(), "force": force})
+
+    def get_actor_by_name(self, name):
+        raise NotImplementedError(
+            "get_actor() inside workers lands in round 2 (needs an RPC "
+            "round-trip to the head); pass actor handles as args instead")
+
+    def create_placement_group(self, bundles, strategy, name=""):
+        raise NotImplementedError(
+            "placement groups can only be created from the driver")
+
+    def remove_placement_group(self, pg_id):
+        raise NotImplementedError
+
+    def cluster_resources(self):
+        return {}
+
+    def available_resources(self):
+        return {}
+
+    def node_table(self):
+        return []
+
+    def timeline(self):
+        return []
+
+    def shutdown(self):
+        pass
+
+
+class WorkerLoop:
+    def __init__(self):
+        store_path = os.environ["RTPU_STORE_PATH"]
+        addr = os.environ["RTPU_HEAD_ADDR"]
+        authkey = bytes.fromhex(os.environ["RTPU_AUTHKEY"])
+        self.wid = os.environ["RTPU_WORKER_ID"]
+        self.store = SharedObjectStore(store_path)
+        self.conn = Client(addr, "AF_UNIX", authkey=authkey)
+        self.rt = WorkerRuntime(self.store, self.conn, self.wid)
+        rt_mod.set_runtime(self.rt)
+        self.actor_instance = None
+        self.actor_spec: ActorSpec | None = None
+        self.executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="task-exec")
+        self.actor_pool: concurrent.futures.ThreadPoolExecutor | None = None
+        self.aio_loop: asyncio.AbstractEventLoop | None = None
+        self._exec_tid: int | None = None
+        self._current_task_id = None
+        self._cancel_lock = threading.Lock()
+
+    # -- arg resolution ----------------------------------------------------
+
+    def _resolve_args(self, blob: bytes):
+        args, kwargs = cloudpickle.loads(blob)
+        args = [self.rt.get(a) if isinstance(a, ObjectRef) else a
+                for a in args]
+        kwargs = {k: (self.rt.get(v) if isinstance(v, ObjectRef) else v)
+                  for k, v in kwargs.items()}
+        return args, kwargs
+
+    # -- execution ---------------------------------------------------------
+
+    def _store_returns(self, spec: TaskSpec, result):
+        n = len(spec.return_ids)
+        if n == 0:
+            return
+        if n == 1:
+            vals = [result]
+        else:
+            vals = list(result)
+            if len(vals) != n:
+                raise ValueError(
+                    f"task {spec.name} declared num_returns={n} but returned "
+                    f"{len(vals)} values")
+        for oid, v in zip(spec.return_ids, vals):
+            try:
+                self.store.put(oid, v)
+            except FileExistsError:
+                pass  # retry re-executed an already-stored return
+
+    def _run_task(self, spec: TaskSpec):
+        self._current_task_id = spec.task_id
+        self.rt.current_task_name = spec.name
+        t0 = time.time()
+        try:
+            fn = self.rt.func_registry[spec.func_id]
+            args, kwargs = self._resolve_args(spec.args_blob)
+            result = fn(*args, **kwargs)
+            self._store_returns(spec, result)
+            ok, err, retryable = True, None, False
+        except BaseException as e:  # noqa: BLE001
+            ok = False
+            retryable = spec.retries_left > 0 and (
+                spec.retry_exceptions or isinstance(e, exc.WorkerCrashedError))
+            err = "".join(traceback.format_exception_only(type(e), e)).strip()
+            if not retryable:
+                werr = e if isinstance(e, exc.RayError) else exc.RayTaskError(
+                    spec.name, e)
+                for oid in spec.return_ids:
+                    try:
+                        self.store.delete(oid)
+                        self.store.put(oid, werr, is_exception=True)
+                    except Exception:
+                        pass
+        finally:
+            self._current_task_id = None
+        self.rt._did_block = False
+        self.rt.send({"t": "done", "task_id": spec.task_id, "ok": ok,
+                      "err": err, "retryable": retryable, "name": spec.name,
+                      "dur": time.time() - t0})
+
+    def _run_actor_create(self, spec: ActorSpec):
+        try:
+            cls = self.rt.func_registry[spec.class_id]
+            args, kwargs = self._resolve_args(spec.args_blob)
+            self.actor_instance = cls(*args, **kwargs)
+            self.actor_spec = spec
+            if spec.max_concurrency > 1:
+                self.actor_pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=spec.max_concurrency,
+                    thread_name_prefix="actor-exec")
+            if any(asyncio.iscoroutinefunction(getattr(cls, m, None))
+                   for m in dir(cls) if not m.startswith("__")):
+                self.aio_loop = asyncio.new_event_loop()
+                threading.Thread(target=self.aio_loop.run_forever,
+                                 daemon=True, name="actor-aio").start()
+            self.rt.send({"t": "actor_ready", "actor_id": spec.actor_id,
+                          "ok": True})
+        except BaseException as e:  # noqa: BLE001
+            tb = traceback.format_exc()
+            self.rt.send({"t": "actor_ready", "actor_id": spec.actor_id,
+                          "ok": False, "err": tb})
+
+    def _run_actor_task(self, spec: TaskSpec):
+        t0 = time.time()
+        try:
+            method = getattr(self.actor_instance, spec.method_name)
+            args, kwargs = self._resolve_args(spec.args_blob)
+            if asyncio.iscoroutinefunction(method):
+                fut = asyncio.run_coroutine_threadsafe(
+                    method(*args, **kwargs), self.aio_loop)
+                result = fut.result()
+            else:
+                result = method(*args, **kwargs)
+            self._store_returns(spec, result)
+            ok, err = True, None
+        except BaseException as e:  # noqa: BLE001
+            ok = False
+            err = "".join(traceback.format_exception_only(type(e), e)).strip()
+            werr = e if isinstance(e, exc.RayError) else exc.RayTaskError(
+                spec.name, e)
+            for oid in spec.return_ids:
+                try:
+                    self.store.delete(oid)
+                    self.store.put(oid, werr, is_exception=True)
+                except Exception:
+                    pass
+        self.rt.send({"t": "done", "task_id": spec.task_id, "ok": ok,
+                      "err": err, "retryable": False, "name": spec.name,
+                      "dur": time.time() - t0})
+
+    def _cancel_current(self, task_id):
+        """Best-effort cooperative cancel: raise TaskCancelledError inside the
+        executor thread (reference analog: the KeyboardInterrupt raised by
+        _raylet.pyx execute_task_with_cancellation_handler)."""
+        with self._cancel_lock:
+            if self._current_task_id != task_id or self._exec_tid is None:
+                return
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(self._exec_tid),
+                ctypes.py_object(exc.TaskCancelledError))
+
+    def _exec_wrapper(self, fn, *a):
+        self._exec_tid = threading.get_ident()
+        fn(*a)
+
+    def run(self):
+        self.conn.send({"t": "register", "wid": self.wid, "pid": os.getpid()})
+        while True:
+            try:
+                msg = self.conn.recv()
+            except (EOFError, OSError):
+                return
+            t = msg["t"]
+            if t == "func":
+                self.rt.func_registry[msg["fid"]] = cloudpickle.loads(
+                    msg["blob"])
+                self.rt._sent_fids.add(msg["fid"])
+            elif t == "task":
+                self.executor.submit(self._exec_wrapper, self._run_task,
+                                     msg["spec"])
+            elif t == "actor_create":
+                self.executor.submit(self._exec_wrapper,
+                                     self._run_actor_create, msg["spec"])
+            elif t == "actor_task":
+                pool = self.actor_pool or self.executor
+                if self.aio_loop is not None and asyncio.iscoroutinefunction(
+                        getattr(type(self.actor_instance),
+                                msg["spec"].method_name, None)):
+                    # async methods run concurrently on the loop; dispatch
+                    # from a shim thread so the recv loop never blocks
+                    threading.Thread(target=self._run_actor_task,
+                                     args=(msg["spec"],), daemon=True).start()
+                else:
+                    pool.submit(self._exec_wrapper, self._run_actor_task,
+                                msg["spec"])
+            elif t == "cancel":
+                self._cancel_current(msg["task_id"])
+            elif t == "exit":
+                os._exit(0)
+
+
+def main():
+    loop = WorkerLoop()
+    try:
+        loop.run()
+    except Exception:
+        traceback.print_exc()
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,16 @@
+"""ray_tpu.util — placement groups, scheduling strategies, collectives.
+
+Reference parity: python/ray/util/.
+"""
+from .placement_group import (
+    PlacementGroup,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+from . import scheduling_strategies
+
+__all__ = [
+    "PlacementGroup", "placement_group", "placement_group_table",
+    "remove_placement_group", "scheduling_strategies",
+]

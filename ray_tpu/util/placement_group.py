@@ -1,0 +1,107 @@
+"""Placement groups: gang resource reservation.
+
+Reference parity: python/ray/util/placement_group.py:42 (PlacementGroup),
+:146 (placement_group factory); server side gcs_placement_group_mgr.h:232.
+TPU-specific role (SURVEY.md §2.4): bundles are how whole TPU slices (ICI
+domains) get reserved for SPMD worker gangs — a bundle of {"TPU": n} pins n
+chips on one host, and STRICT_SPREAD lays a multi-host gang across hosts.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from ..core.ids import PlacementGroupID
+
+
+def _runtime():
+    from ..core import runtime as rt
+    r = rt.get_runtime_if_exists()
+    if r is None:
+        raise RuntimeError("ray_tpu.init() has not been called")
+    return r
+
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+class PlacementGroup:
+    def __init__(self, state):
+        self._state = state
+
+    @property
+    def id(self) -> PlacementGroupID:
+        return self._state.pg_id
+
+    @property
+    def bundle_specs(self) -> list[dict]:
+        return [dict(b.resources) for b in self._state.bundles]
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self._state.bundles)
+
+    def ready(self):
+        """ObjectRef that resolves when all bundles are reserved (reference:
+        PlacementGroup.ready, util/placement_group.py:70)."""
+        rt = _runtime()
+        from ..core.ids import ObjectID
+        from ..core.object_store import SharedObjectStore  # noqa: F401
+        from ..core.ref import ObjectRef
+        from ..core.runtime import DirEntry, READY, Runtime
+        state = self._state
+        pg_hex = state.pg_id.hex()  # handles aren't picklable; resolve to id
+        if isinstance(rt, Runtime):
+            oid = ObjectID.from_random()
+
+            def _waiter():
+                state.ready_event.wait()
+                rt.store.put(oid, pg_hex)
+                with rt.lock:
+                    rt.directory[oid] = DirEntry(READY)
+            threading.Thread(target=_waiter, daemon=True).start()
+            return ObjectRef(oid)
+        return rt.put(pg_hex)
+
+    def wait(self, timeout_seconds: float = 30) -> bool:
+        return self._state.ready_event.wait(timeout=timeout_seconds)
+
+    def __reduce__(self):
+        raise TypeError(
+            "PlacementGroup handles cannot be pickled in round 1; "
+            "pass bundle indices instead")
+
+
+def placement_group(bundles: list[dict[str, float]],
+                    strategy: str = "PACK",
+                    name: str = "",
+                    lifetime: Optional[str] = None) -> PlacementGroup:
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(f"strategy must be one of {VALID_STRATEGIES}")
+    if not bundles:
+        raise ValueError("at least one bundle is required")
+    for b in bundles:
+        if not b or any(v < 0 for v in b.values()):
+            raise ValueError(f"invalid bundle {b}")
+    state = _runtime().create_placement_group(
+        [dict(b) for b in bundles], strategy, name)
+    return PlacementGroup(state)
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    _runtime().remove_placement_group(pg.id)
+
+
+def placement_group_table() -> dict:
+    rt = _runtime()
+    out = {}
+    for pg_id, st in getattr(rt, "pgs", {}).items():
+        out[pg_id.hex()] = {
+            "name": st.name, "strategy": st.strategy, "state": st.state,
+            "bundles": {i: dict(b.resources)
+                        for i, b in enumerate(st.bundles)},
+            "bundle_nodes": {i: (b.node_id.hex() if b.node_id else None)
+                             for i, b in enumerate(st.bundles)},
+        }
+    return out

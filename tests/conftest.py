@@ -1,0 +1,44 @@
+"""Test harness configuration.
+
+Reference parity: python/ray/tests/conftest.py (ray_start_regular :588,
+ray_start_cluster :678, shutdown_only :505). TPU-specific (SURVEY.md §4.3):
+tests run on a virtual 8-device CPU mesh via
+XLA_FLAGS=--xla_force_host_platform_device_count=8 — the analog of
+cluster_utils.Cluster for collective/pjit tests.
+"""
+import os
+
+# Must happen before any jax import anywhere in the test process.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ray_start_regular():
+    import ray_tpu as ray
+    if ray.is_initialized():
+        ray.shutdown()
+    ray.init(num_cpus=2, object_store_memory=256 * 1024 * 1024)
+    yield ray
+    ray.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    from ray_tpu.cluster_utils import Cluster
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    yield cluster
+    cluster.shutdown()
+
+
+@pytest.fixture
+def shutdown_only():
+    import ray_tpu as ray
+    yield ray
+    if ray.is_initialized():
+        ray.shutdown()

@@ -1,0 +1,310 @@
+"""Core task/actor/object API tests.
+
+Reference parity model: python/ray/tests/test_basic.py, test_actor.py — the
+same behaviors (task chaining, error propagation, num_returns, wait,
+actors, nesting, handle passing) exercised against the TPU-build runtime.
+"""
+import time
+
+import numpy as np
+import pytest
+
+
+def test_simple_task(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote
+    def add(a, b):
+        return a + b
+
+    assert ray.get(add.remote(1, 2)) == 3
+
+
+def test_task_chaining_and_deps(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote
+    def inc(x):
+        return x + 1
+
+    ref = inc.remote(0)
+    for _ in range(5):
+        ref = inc.remote(ref)
+    assert ray.get(ref) == 6
+
+
+def test_put_get(ray_start_regular):
+    ray = ray_start_regular
+    arr = np.random.rand(64, 64)
+    ref = ray.put(arr)
+    assert np.allclose(ray.get(ref), arr)
+
+
+def test_large_array_args_via_store(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote
+    def mean(x):
+        return float(x.mean())
+
+    arr = np.ones((512, 512))  # 2 MiB > inline limit
+    assert ray.get(mean.remote(arr)) == 1.0
+
+
+def test_error_propagation(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote(max_retries=0)
+    def boom():
+        raise ValueError("kaboom")
+
+    with pytest.raises(ValueError):
+        ray.get(boom.remote())
+
+
+def test_error_propagates_through_deps(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote(max_retries=0)
+    def boom():
+        raise RuntimeError("first")
+
+    @ray.remote
+    def consume(x):
+        return x
+
+    with pytest.raises(Exception):
+        ray.get(consume.remote(boom.remote()))
+
+
+def test_num_returns(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray.get([a, b, c]) == [1, 2, 3]
+
+
+def test_wait(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote
+    def slow(t):
+        time.sleep(t)
+        return t
+
+    refs = [slow.remote(0.05), slow.remote(5.0)]
+    ready, pending = ray.wait(refs, num_returns=1, timeout=3.0)
+    assert len(ready) == 1 and len(pending) == 1
+    assert ray.get(ready[0]) == 0.05
+
+
+def test_get_timeout(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote
+    def forever():
+        time.sleep(60)
+
+    with pytest.raises(ray.exceptions.GetTimeoutError):
+        ray.get(forever.remote(), timeout=0.2)
+
+
+def test_actor_basics(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.v = start
+
+        def inc(self, n=1):
+            self.v += n
+            return self.v
+
+    c = Counter.remote(5)
+    assert ray.get([c.inc.remote() for _ in range(3)]) == [6, 7, 8]
+
+
+def test_actor_method_ordering(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote
+    class Log:
+        def __init__(self):
+            self.items = []
+
+        def append(self, x):
+            self.items.append(x)
+
+        def get(self):
+            return self.items
+
+    log = Log.remote()
+    for i in range(20):
+        log.append.remote(i)
+    assert ray.get(log.get.remote()) == list(range(20))
+
+
+def test_actor_error(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote
+    class Bad:
+        def fail(self):
+            raise IndexError("nope")
+
+        def ok(self):
+            return 1
+
+    b = Bad.remote()
+    with pytest.raises(IndexError):
+        ray.get(b.fail.remote())
+    # actor survives method errors
+    assert ray.get(b.ok.remote()) == 1
+
+
+def test_actor_init_failure(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote
+    class Broken:
+        def __init__(self):
+            raise RuntimeError("bad init")
+
+        def m(self):
+            return 1
+
+    b = Broken.remote()
+    with pytest.raises(ray.exceptions.RayError):
+        ray.get(b.m.remote(), timeout=30)
+
+
+def test_nested_tasks(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote
+    def inner(x):
+        return x * 2
+
+    @ray.remote(num_cpus=0)
+    def outer(x):
+        return ray.get(inner.remote(x)) + 1
+
+    assert ray.get(outer.remote(10)) == 21
+
+
+def test_actor_handle_passing(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote
+    class Store:
+        def __init__(self):
+            self.v = {}
+
+        def set(self, k, v):
+            self.v[k] = v
+
+        def get(self, k):
+            return self.v.get(k)
+
+    @ray.remote(num_cpus=0)
+    def writer(store):
+        ray.get(store.set.remote("k", 42))
+        return True
+
+    s = Store.remote()
+    assert ray.get(writer.remote(s))
+    assert ray.get(s.get.remote("k")) == 42
+
+
+def test_kill_actor(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote
+    class A:
+        def m(self):
+            return 1
+
+    a = A.remote()
+    assert ray.get(a.m.remote()) == 1
+    ray.kill(a)
+    with pytest.raises(ray.exceptions.RayError):
+        ray.get(a.m.remote(), timeout=30)
+
+
+def test_named_actor(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote
+    class Named:
+        def who(self):
+            return "me"
+
+    Named.options(name="the-one").remote()
+    h = ray.get_actor("the-one")
+    assert ray.get(h.who.remote()) == "me"
+
+
+def test_cancel_pending_task(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote
+    def block(t):
+        time.sleep(t)
+        return t
+
+    # saturate both CPUs, then queue one more and cancel it
+    running = [block.remote(3) for _ in range(2)]
+    victim = block.remote(0)
+    time.sleep(0.3)
+    ray.cancel(victim)
+    with pytest.raises(ray.exceptions.RayError):
+        ray.get(victim, timeout=10)
+    ray.get(running)  # the others complete
+
+
+def test_zero_cpu_tasks_oversubscribe(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote(num_cpus=0)
+    def free():
+        return 1
+
+    assert sum(ray.get([free.remote() for _ in range(4)])) == 4
+
+
+def test_runtime_context(ray_start_regular):
+    ray = ray_start_regular
+    ctx = ray.get_runtime_context()
+    assert ctx.get_job_id()
+    assert ctx.get_node_id()
+
+
+def test_cluster_and_available_resources(ray_start_regular):
+    ray = ray_start_regular
+    assert ray.cluster_resources()["CPU"] == 2.0
+    time.sleep(0.2)
+    assert ray.available_resources()["CPU"] == 2.0
+
+
+def test_local_mode(shutdown_only):
+    ray = shutdown_only
+    ray.init(local_mode=True)
+
+    @ray.remote
+    def f(x):
+        return x + 1
+
+    assert ray.get(f.remote(1)) == 2
+
+    @ray.remote
+    class C:
+        def m(self):
+            return "local"
+
+    c = C.remote()
+    assert ray.get(c.m.remote()) == "local"

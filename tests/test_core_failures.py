@@ -1,0 +1,161 @@
+"""Fault-tolerance tests: worker crash retry, actor restart, node loss,
+lineage reconstruction.
+
+Reference parity model: python/ray/tests/test_actor_failures.py,
+test_failure*.py, test_actor_lineage_reconstruction.py; chaos utilities
+_private/test_utils.py (RayletKiller :1438).
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+
+def test_task_retry_on_worker_crash(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote(max_retries=2)
+    def flaky(path):
+        # crash the whole worker process the first time
+        if not os.path.exists(path):
+            open(path, "w").close()
+            os._exit(1)
+        return "recovered"
+
+    marker = f"/tmp/rtpu_flaky_{os.getpid()}"
+    if os.path.exists(marker):
+        os.unlink(marker)
+    try:
+        assert ray.get(flaky.remote(marker), timeout=60) == "recovered"
+    finally:
+        if os.path.exists(marker):
+            os.unlink(marker)
+
+
+def test_task_no_retry_exhausted(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote(max_retries=0)
+    def die():
+        os._exit(1)
+
+    with pytest.raises(ray.exceptions.WorkerCrashedError):
+        ray.get(die.remote(), timeout=60)
+
+
+def test_retry_exceptions(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote(max_retries=3, retry_exceptions=True)
+    def sometimes(path):
+        n = int(open(path).read()) if os.path.exists(path) else 0
+        open(path, "w").write(str(n + 1))
+        if n < 2:
+            raise RuntimeError(f"attempt {n}")
+        return n
+
+    marker = f"/tmp/rtpu_retry_{os.getpid()}"
+    if os.path.exists(marker):
+        os.unlink(marker)
+    try:
+        assert ray.get(sometimes.remote(marker), timeout=60) == 2
+    finally:
+        if os.path.exists(marker):
+            os.unlink(marker)
+
+
+def test_actor_restart(ray_start_regular):
+    ray = ray_start_regular
+
+    # max_task_retries=0: the crashing call itself errors out, but the actor
+    # restarts and serves subsequent calls (reference semantics: max_restarts
+    # restarts the process; only max_task_retries>0 replays the failed call)
+    @ray.remote(max_restarts=1, max_task_retries=0)
+    class Phoenix:
+        def __init__(self):
+            self.n = 0
+
+        def crash(self):
+            os._exit(1)
+
+        def alive(self):
+            return True
+
+    p = Phoenix.remote()
+    assert ray.get(p.alive.remote(), timeout=30)
+    try:
+        ray.get(p.crash.remote(), timeout=30)
+    except ray.exceptions.RayError:
+        pass
+    # restarted actor serves again
+    assert ray.get(p.alive.remote(), timeout=60)
+
+
+def test_actor_no_restart_dies(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote(max_restarts=0)
+    class Mortal:
+        def crash(self):
+            os._exit(1)
+
+        def alive(self):
+            return True
+
+    m = Mortal.remote()
+    assert ray.get(m.alive.remote(), timeout=30)
+    with pytest.raises(ray.exceptions.RayError):
+        ray.get(m.crash.remote(), timeout=30)
+    with pytest.raises(ray.exceptions.RayError):
+        ray.get(m.alive.remote(), timeout=30)
+
+
+def test_node_removal_retries_tasks(ray_start_cluster):
+    cluster = ray_start_cluster
+    import ray_tpu as ray
+    node = cluster.add_node(num_cpus=2, resources={"side": 2})
+
+    @ray.remote(num_cpus=1, resources={"side": 1}, max_retries=2)
+    def slow_on_side():
+        time.sleep(1.5)
+        return "done"
+
+    refs = [slow_on_side.remote() for _ in range(2)]
+    time.sleep(0.8)  # let them start on the side node
+    cluster.remove_node(node)
+    # after node death the tasks cannot be re-placed (resource only existed
+    # there) — re-add capacity and they should finish via retry
+    cluster.add_node(num_cpus=2, resources={"side": 2})
+    assert ray.get(refs, timeout=90) == ["done", "done"]
+
+
+def test_lineage_reconstruction_after_eviction(shutdown_only):
+    ray = shutdown_only
+    # tiny store so produced objects get evicted
+    ray.init(num_cpus=2, object_store_memory=24 * 1024 * 1024)
+
+    @ray.remote
+    def produce(i):
+        return np.full(4 * 1024 * 1024, i, dtype=np.uint8)  # 4 MiB
+
+    refs = [produce.remote(i) for i in range(8)]  # 32 MiB total > store
+    # wait for all to have run once
+    for i, r in enumerate(refs):
+        pass
+    time.sleep(0.1)
+    # early results were evicted; get() must re-execute via lineage
+    first = ray.get(refs[0], timeout=120)
+    assert first[0] == 0
+    last = ray.get(refs[-1], timeout=120)
+    assert last[0] == 7
+
+
+def test_put_objects_not_reconstructable(shutdown_only):
+    ray = shutdown_only
+    ray.init(num_cpus=1, object_store_memory=24 * 1024 * 1024)
+    ref = ray.put(np.zeros(1024, dtype=np.uint8))
+    # pinned puts survive pressure
+    pressure = [ray.put(np.zeros(2 * 1024 * 1024, dtype=np.uint8))
+                for _ in range(4)]
+    assert ray.get(ref) is not None

@@ -1,0 +1,97 @@
+"""Shared-memory object store unit tests.
+
+Reference parity model: src/ray/object_manager/plasma tests
+(object_store_test, eviction_policy semantics).
+"""
+import numpy as np
+import pytest
+
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_store import (
+    GetTimeoutError,
+    ObjectStoreFullError,
+    SharedObjectStore,
+)
+
+
+@pytest.fixture
+def store(tmp_path):
+    s = SharedObjectStore(str(tmp_path / "store"), capacity=32 * 1024 * 1024,
+                          create=True)
+    yield s
+    s.close(unlink=True)
+
+
+def test_put_get_roundtrip(store):
+    oid = ObjectID.from_random()
+    val = {"x": np.arange(100), "y": [1, "two", 3.0]}
+    store.put(oid, val)
+    out = store.get(oid)
+    assert np.array_equal(out["x"], val["x"])
+    assert out["y"] == val["y"]
+
+
+def test_exception_payload(store):
+    oid = ObjectID.from_random()
+    store.put(oid, KeyError("missing"), is_exception=True)
+    with pytest.raises(KeyError):
+        store.get(oid)
+
+
+def test_get_timeout(store):
+    with pytest.raises(GetTimeoutError):
+        store.get(ObjectID.from_random(), timeout_ms=50)
+
+
+def test_contains_delete(store):
+    oid = ObjectID.from_random()
+    store.put(oid, 42)
+    assert store.contains(oid)
+    store.delete(oid)
+    assert not store.contains(oid)
+
+
+def test_duplicate_create_rejected(store):
+    oid = ObjectID.from_random()
+    store.put(oid, 1)
+    with pytest.raises(FileExistsError):
+        store.create_raw(oid, 10)
+
+
+def test_lru_eviction_under_pressure(store):
+    ids = []
+    for _ in range(40):  # 40 MiB into a 32 MiB store
+        oid = ObjectID.from_random()
+        store.put(oid, np.zeros(1024 * 1024, dtype=np.uint8))
+        ids.append(oid)
+    assert store.evictions() > 0
+    assert store.contains(ids[-1])          # most recent survives
+    assert not store.contains(ids[0])       # oldest evicted
+
+
+def test_pinned_objects_survive_eviction(store):
+    pinned = ObjectID.from_random()
+    store.put(pinned, np.zeros(1024 * 1024, dtype=np.uint8))
+    assert store.get_raw(pinned, timeout_ms=0) is not None  # pin
+    for _ in range(40):
+        store.put(ObjectID.from_random(),
+                  np.zeros(1024 * 1024, dtype=np.uint8))
+    assert store.contains(pinned)
+    store.release(pinned)
+
+
+def test_store_full_with_pins_raises(store):
+    keep = []
+    with pytest.raises(ObjectStoreFullError):
+        for _ in range(40):
+            oid = ObjectID.from_random()
+            store.put(oid, np.zeros(2 * 1024 * 1024, dtype=np.uint8))
+            assert store.get_raw(oid, timeout_ms=0) is not None
+            keep.append(oid)
+
+
+def test_zero_length_and_odd_sizes(store):
+    for n in (0, 1, 7, 8, 9, 4095, 4097):
+        oid = ObjectID.from_random()
+        store.put(oid, b"x" * n)
+        assert store.get(oid) == b"x" * n

@@ -1,0 +1,103 @@
+"""Placement group tests.
+
+Reference parity model: python/ray/tests/test_placement_group*.py —
+strategies, bundle reservation, scheduling into bundles, removal.
+"""
+import pytest
+
+import ray_tpu as ray
+from ray_tpu.util import placement_group, remove_placement_group
+from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+
+def test_pg_pack_reserves_resources(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert pg.wait(timeout_seconds=10)
+    avail = ray.available_resources()
+    assert avail.get("CPU", 0) == 1.0  # 3 total - 2 reserved
+
+
+def test_pg_strict_spread_needs_nodes(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1)
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    assert pg.wait(timeout_seconds=10)
+    from ray_tpu.util.placement_group import placement_group_table
+    tbl = placement_group_table()[pg.id.hex()]
+    nodes = set(tbl["bundle_nodes"].values())
+    assert len(nodes) == 2  # two distinct nodes
+
+
+def test_pg_strict_pack_infeasible_stays_pending(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1)
+    pg = placement_group([{"CPU": 2}], strategy="STRICT_PACK")
+    assert not pg.wait(timeout_seconds=1)
+
+
+def test_task_in_placement_group(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2, resources={"pgres": 2})
+    pg = placement_group([{"CPU": 1, "pgres": 1}], strategy="PACK")
+    assert pg.wait(timeout_seconds=10)
+
+    @ray.remote(num_cpus=1, resources={"pgres": 1})
+    def where():
+        return "in-bundle"
+
+    ref = where.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=0)).remote()
+    assert ray.get(ref, timeout=60) == "in-bundle"
+
+
+def test_actor_in_placement_group(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert pg.wait(timeout_seconds=10)
+
+    @ray.remote(num_cpus=1)
+    class W:
+        def ping(self):
+            return "pong"
+
+    actors = [
+        W.options(scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=i)).remote()
+        for i in range(2)
+    ]
+    assert ray.get([a.ping.remote() for a in actors],
+                   timeout=60) == ["pong", "pong"]
+
+
+def test_remove_pg_returns_resources(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    pg = placement_group([{"CPU": 2}], strategy="PACK")
+    assert pg.wait(timeout_seconds=10)
+    before = ray.available_resources().get("CPU", 0)
+    remove_placement_group(pg)
+    import time
+    time.sleep(0.2)
+    after = ray.available_resources().get("CPU", 0)
+    assert after == before + 2
+
+
+def test_pg_reschedules_after_node_loss(ray_start_cluster):
+    cluster = ray_start_cluster
+    n1 = cluster.add_node(num_cpus=4, resources={"big": 4})
+    pg = placement_group([{"CPU": 2, "big": 2}], strategy="PACK")
+    assert pg.wait(timeout_seconds=10)
+    cluster.remove_node(n1)
+    cluster.add_node(num_cpus=4, resources={"big": 4})
+    assert pg.wait(timeout_seconds=30)
+
+
+def test_invalid_pg_args(ray_start_regular):
+    with pytest.raises(ValueError):
+        placement_group([], strategy="PACK")
+    with pytest.raises(ValueError):
+        placement_group([{"CPU": 1}], strategy="DIAGONAL")
