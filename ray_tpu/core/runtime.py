@@ -300,6 +300,47 @@ class Runtime:
         elif t == "cancel":
             self.cancel(ObjectRef(ObjectID(msg["oid"])),
                         force=msg.get("force", False))
+        elif t == "rpc":
+            # Handled off-thread: rpcs like pg_wait block, and this recv loop
+            # must keep draining the worker's other messages.
+            threading.Thread(target=self._handle_worker_rpc, args=(msg,),
+                             daemon=True).start()
+
+    # Worker→head request/reply: the reply value is written into the shared
+    # store at a worker-chosen oid (reference analog: the CoreWorkerService /
+    # GCS RPCs workers issue for name resolution and cluster state,
+    # gcs_client/accessor.h — here the shm store doubles as the reply channel).
+    _RPC_METHODS = ("get_actor_by_name", "cluster_resources",
+                    "available_resources", "node_table", "pg_wait",
+                    "create_placement_group_rpc", "remove_placement_group_rpc")
+
+    def _handle_worker_rpc(self, msg: dict):
+        oid = ObjectID(msg["reply_oid"])
+        try:
+            m = msg["m"]
+            if m not in self._RPC_METHODS:
+                raise ValueError(f"unknown rpc {m!r}")
+            result = getattr(self, m)(*msg.get("args", ()))
+            self.store.put(oid, ("ok", result))
+        except BaseException as e:  # noqa: BLE001 — reply with any failure
+            self.store.put(oid, ("err", e))
+        # No directory entry: the worker polls the store directly and deletes
+        # the reply once read, so the head never tracks these oids.
+
+    def create_placement_group_rpc(self, bundles, strategy, name=""):
+        pg = self.create_placement_group(bundles, strategy, name)
+        return (pg.pg_id, [dict(b.resources) for b in pg.bundles])
+
+    def remove_placement_group_rpc(self, pg_id):
+        self.remove_placement_group(pg_id)
+        return None
+
+    def pg_wait(self, pg_id, timeout: float = 30.0) -> bool:
+        with self.lock:
+            pg = self.pgs.get(pg_id)
+        if pg is None:
+            raise ValueError(f"no placement group {pg_id}")
+        return pg.ready_event.wait(timeout=timeout)
 
     # ------------------------------------------------------------------ #
     # worker pool (reference: raylet/worker_pool.h:283)
@@ -411,6 +452,14 @@ class Runtime:
         if pin:
             # keep a refcount so LRU eviction never drops a live ray.put()
             self.store.get_raw(oid, timeout_ms=0)
+        with self.lock:
+            self.directory[oid] = DirEntry(READY)
+        return ObjectRef(oid)
+
+    def put_at(self, oid: ObjectID, value: Any,
+               is_exception: bool = False) -> ObjectRef:
+        """Write `value` under a pre-allocated id (deferred-resolution refs)."""
+        self.store.put(oid, value, is_exception=is_exception)
         with self.lock:
             self.directory[oid] = DirEntry(READY)
         return ObjectRef(oid)
@@ -1291,6 +1340,12 @@ class LocalModeRuntime:
         ref_list = [refs] if single else list(refs)
         out = []
         for r in ref_list:
+            # deferred refs (e.g. pg.ready()) resolve from a waiter thread
+            deadline = None if timeout is None else time.monotonic() + timeout
+            while r.id() not in self.objects:
+                if deadline is not None and time.monotonic() > deadline:
+                    raise exc.GetTimeoutError(f"timed out on {r.id()}")
+                time.sleep(0.001)
             st, v = self.objects[r.id()]
             if st == "err":
                 raise v.as_instanceof_cause() if isinstance(
@@ -1340,6 +1395,13 @@ class LocalModeRuntime:
 
     def remove_placement_group(self, pg_id):
         pass
+
+    def pg_wait(self, pg_id, timeout: float = 30.0) -> bool:
+        return True  # local-mode PGs are always immediately "reserved"
+
+    def put_at(self, oid, value, is_exception: bool = False):
+        self.objects[oid] = ("err" if is_exception else "ok", value)
+        return ObjectRef(oid)
 
     def shutdown(self):
         global _runtime

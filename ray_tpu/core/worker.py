@@ -77,6 +77,11 @@ class WorkerRuntime:
         self.send({"t": "put", "oid": oid})
         return ObjectRef(oid)
 
+    def put_at(self, oid: ObjectID, value, is_exception: bool = False):
+        self.store.put(oid, value, is_exception=is_exception)
+        self.send({"t": "put", "oid": oid})
+        return ObjectRef(oid)
+
     def get(self, refs, timeout: float | None = None):
         single = isinstance(refs, ObjectRef)
         ref_list = [refs] if single else list(refs)
@@ -164,26 +169,50 @@ class WorkerRuntime:
     def cancel(self, ref, force=False, recursive=True):
         self.send({"t": "cancel", "oid": ref.id().binary(), "force": force})
 
+    # -- head RPCs (reply lands in the shared store, see Runtime
+    # _handle_worker_rpc) ---------------------------------------------------
+
+    def _rpc(self, method: str, *args, timeout: float = 30.0):
+        reply = ObjectID.from_random()
+        self.send({"t": "rpc", "m": method, "args": args,
+                   "reply_oid": reply.binary()})
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                status, payload = self.store.get(reply, timeout_ms=100)
+                break
+            except StoreTimeout:
+                if time.monotonic() > deadline:
+                    raise exc.GetTimeoutError(
+                        f"head rpc {method} timed out") from None
+        self.store.delete(reply)
+        if status == "err":
+            raise payload
+        return payload
+
     def get_actor_by_name(self, name):
-        raise NotImplementedError(
-            "get_actor() inside workers lands in round 2 (needs an RPC "
-            "round-trip to the head); pass actor handles as args instead")
+        return self._rpc("get_actor_by_name", name)
 
     def create_placement_group(self, bundles, strategy, name=""):
-        raise NotImplementedError(
-            "placement groups can only be created from the driver")
+        from ..util.placement_group import PlacementGroup
+        pg_id, specs = self._rpc("create_placement_group_rpc",
+                                 bundles, strategy, name)
+        return PlacementGroup(pg_id, specs)
 
     def remove_placement_group(self, pg_id):
-        raise NotImplementedError
+        self._rpc("remove_placement_group_rpc", pg_id)
+
+    def pg_wait(self, pg_id, timeout: float = 30.0) -> bool:
+        return self._rpc("pg_wait", pg_id, timeout, timeout=timeout + 10.0)
 
     def cluster_resources(self):
-        return {}
+        return self._rpc("cluster_resources")
 
     def available_resources(self):
-        return {}
+        return self._rpc("available_resources")
 
     def node_table(self):
-        return []
+        return self._rpc("node_table")
 
     def timeline(self):
         return []

@@ -1,0 +1,44 @@
+"""ray_tpu.parallel — TPU-native parallelism substrate.
+
+This package is the TPU-first replacement for the reference's accelerator
+communication stack (ray.util.collective NCCL/Gloo groups —
+python/ray/util/collective/collective.py:150 — and torch-NCCL process groups
+set up by Train, train/torch/config.py:115). The design inversion (SURVEY.md
+§7): inside a slice the XLA compiler owns communication, so parallelism is
+expressed as shardings over a `jax.sharding.Mesh` and `jax.lax` collectives
+inside compiled programs; the actor runtime only coordinates hosts/slices.
+
+Modules:
+  mesh        — device-mesh construction, axis conventions, TPU topology
+  sharding    — logical-axis rules → NamedSharding, constraint helpers
+  collective  — actor-level collective groups (control-plane; the reference
+                API surface of ray.util.collective) implemented over the
+                object store, plus in-program XLA collective helpers
+  ring        — sequence/context parallelism: ring attention and Ulysses
+                all-to-all re-sharding (absent from the reference, SURVEY §5.7)
+"""
+import importlib
+
+# Lazy (PEP 562) so that `import ray_tpu` (which every worker process does)
+# doesn't pay the jax import; only code that actually touches meshes does.
+_EXPORTS = {
+    "MeshSpec": "mesh", "build_mesh": "mesh", "get_mesh": "mesh",
+    "use_mesh": "mesh", "tpu_topology": "mesh", "TpuTopology": "mesh",
+    "LOGICAL_AXIS_RULES": "sharding", "logical_sharding": "sharding",
+    "logical_spec": "sharding", "named_sharding": "sharding",
+    "shard_pytree": "sharding", "constrain": "sharding",
+    "ring_attention": "ring", "ulysses_attention": "ring",
+    "ring_attention_sharded": "ring", "ulysses_attention_sharded": "ring",
+}
+_MODULES = ("mesh", "sharding", "collective", "ring")
+
+__all__ = list(_EXPORTS) + list(_MODULES)
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        mod = importlib.import_module(f".{_EXPORTS[name]}", __name__)
+        return getattr(mod, name)
+    if name in _MODULES:
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

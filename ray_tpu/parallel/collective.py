@@ -1,0 +1,348 @@
+"""Actor-level collective communication groups.
+
+Reference parity: python/ray/util/collective/collective.py (API surface:
+init_collective_group :150, allreduce :295, allgather :460, reducescatter
+:509, send :568, recv :631) with its NCCL/Gloo backends replaced by two
+TPU-native paths:
+
+* **In-program collectives** — the hot path. Gradient/activation traffic
+  rides XLA collectives (`jax.lax.psum/all_gather/ppermute/...`) compiled
+  over the mesh (see parallel.ring for the sequence-parallel patterns). No
+  runtime involvement at all; this module is NOT that path.
+* **"shm" backend (this module)** — control-plane collectives *between
+  actors/tasks* (parameter broadcast at init, metric reduction, rendezvous,
+  cross-slice weight shuttling). Implemented over the shared-memory object
+  store via a named rendezvous actor, the role Gloo plays in the reference's
+  CPU backend (gloo_collective_group.py).
+
+Semantics differ from the reference in one deliberate way: reference
+collectives mutate torch tensors in place; jax arrays are immutable, so every
+op here *returns* the result.
+
+All ranks of a group must issue collectives in the same order (same
+requirement as NCCL); ops are matched by per-group sequence number.
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+
+class ReduceOp:
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+
+
+_REDUCERS = {
+    ReduceOp.SUM: lambda parts: np.sum(parts, axis=0),
+    ReduceOp.PRODUCT: lambda parts: np.prod(parts, axis=0),
+    ReduceOp.MIN: lambda parts: np.min(parts, axis=0),
+    ReduceOp.MAX: lambda parts: np.max(parts, axis=0),
+}
+
+_COORD_PREFIX = "rtpu:collective:"
+
+
+class _Rendezvous:
+    """Named async actor that matches collective ops across ranks.
+
+    Async methods let all ranks' calls interleave on one asyncio loop
+    (worker-side async actor execution), so a rank can park in `await` until
+    the op completes — one round-trip per collective.
+    """
+
+    def __init__(self, world_size: int):
+        self.world = world_size
+        self.epoch = 0
+        self._pending: dict[str, dict] = {}
+        self._joining: dict = {"ranks": set(), "event": asyncio.Event(),
+                               "result": None}
+
+    def world_size(self) -> int:
+        return self.world
+
+    async def join(self, rank: int) -> int:
+        """Barrier that admits a (re-)initializing group generation.
+
+        Completes when all `world` ranks have joined; returns a fresh epoch
+        that namespaces all op keys, so a restarted rank that re-inits
+        together with the surviving ranks gets aligned sequence numbers and
+        stale entries from the previous epoch are dropped.
+        """
+        j = self._joining
+        j["ranks"].add(rank)
+        if len(j["ranks"]) == self.world:
+            self.epoch += 1
+            self._pending.clear()
+            j["result"] = self.epoch
+            j["event"].set()
+            self._joining = {"ranks": set(), "event": asyncio.Event(),
+                             "result": None}
+        await j["event"].wait()
+        return j["result"]
+
+    def _entry(self, key: str, world: int) -> dict:
+        e = self._pending.get(key)
+        if e is None:
+            e = {"parts": {}, "event": asyncio.Event(), "result": None,
+                 "fetched": 0, "world": world}
+            self._pending[key] = e
+        return e
+
+    async def _finish(self, key: str, e: dict, my: Any):
+        await e["event"].wait()
+        result = e["result"] if my is None else my(e)
+        e["fetched"] += 1
+        if e["fetched"] >= e["world"]:
+            del self._pending[key]
+        return result
+
+    async def gather_op(self, key: str, rank: int, payload, kind: str,
+                        op: str = ReduceOp.SUM, src: int = 0):
+        """allreduce / allgather / reducescatter / broadcast / barrier."""
+        e = self._entry(key, self.world)
+        e["parts"][rank] = payload
+        if len(e["parts"]) == e["world"]:
+            parts = [e["parts"][r] for r in range(e["world"])]
+            if kind == "allreduce":
+                e["result"] = _REDUCERS[op](parts)
+            elif kind == "allgather":
+                e["result"] = parts
+            elif kind == "reducescatter":
+                e["result"] = _REDUCERS[op](parts)
+            elif kind == "broadcast":
+                e["result"] = e["parts"][src]
+            elif kind == "barrier":
+                e["result"] = True
+            else:
+                raise ValueError(f"unknown collective kind {kind!r}")
+            e["event"].set()
+        if kind == "reducescatter":
+            world = e["world"]
+
+            def my(e):
+                full = e["result"]
+                chunk = len(full) // world
+                return full[rank * chunk:(rank + 1) * chunk]
+            return await self._finish(key, e, my)
+        return await self._finish(key, e, None)
+
+    async def p2p_send(self, key: str, payload):
+        e = self._entry(key, 2)
+        e["result"] = payload
+        e["event"].set()
+        e["fetched"] += 1  # sender never fetches
+        if e["fetched"] >= 2:
+            del self._pending[key]
+
+    async def p2p_recv(self, key: str):
+        e = self._entry(key, 2)
+        return await self._finish(key, e, None)
+
+
+class _GroupState:
+    def __init__(self, name: str, handle, rank: int, world: int, epoch: int):
+        self.name = name
+        self.handle = handle
+        self.rank = rank
+        self.world = world
+        self.epoch = epoch
+        self.seq = 0
+        self.p2p_seq: dict[tuple[int, int], int] = {}
+
+    def next_key(self, kind: str) -> str:
+        self.seq += 1
+        return f"e{self.epoch}:{kind}:{self.seq}"
+
+    def next_p2p_key(self, src: int, dst: int) -> str:
+        n = self.p2p_seq.get((src, dst), 0) + 1
+        self.p2p_seq[(src, dst)] = n
+        return f"e{self.epoch}:p2p:{src}:{dst}:{n}"
+
+
+_groups: dict[str, _GroupState] = {}
+
+
+def _ray():
+    import ray_tpu
+    return ray_tpu
+
+
+def _coordinator_actor(name: str, world_size: int, rank: int,
+                       timeout: float = 60.0):
+    """Rank 0 creates (or resets) the named rendezvous actor; others poll."""
+    ray = _ray()
+    actor_name = _COORD_PREFIX + name
+    if rank == 0:
+        try:
+            h = ray.get_actor(actor_name)
+            # Reusing a live group name: join it (no state reset — other
+            # ranks may already have posted their init barrier). Changing
+            # world size requires destroy_collective_group first.
+            if ray.get(h.world_size.remote()) != world_size:
+                raise RuntimeError(
+                    f"collective group {name!r} already exists with a "
+                    f"different world size; destroy_collective_group first")
+            return h
+        except ValueError:
+            pass
+        cls = ray.remote(_Rendezvous)
+        deadline = time.monotonic() + 5.0
+        while True:
+            try:
+                return cls.options(name=actor_name,
+                                   max_concurrency=256).remote(world_size)
+            except ValueError:
+                # name still registered to a just-killed predecessor
+                # (destroy → re-init race); cleared on its death event
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.05)
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            return ray.get_actor(actor_name)
+        except ValueError:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"collective group {name!r}: rank 0 never created the "
+                    f"rendezvous actor") from None
+            time.sleep(0.05)
+
+
+def init_collective_group(world_size: int, rank: int,
+                          backend: str = "shm",
+                          group_name: str = "default") -> None:
+    """Join this process to a collective group (reference:
+    collective.py:150). Must be called by every rank, any order."""
+    if backend not in ("shm", "xla"):
+        raise ValueError(f"backend must be 'shm' or 'xla', got {backend!r}")
+    if not 0 <= rank < world_size:
+        raise ValueError(f"rank {rank} out of range for world {world_size}")
+    handle = _coordinator_actor(group_name, world_size, rank)
+    epoch = _ray().get(handle.join.remote(rank))  # barrier: all ranks joined
+    _groups[group_name] = _GroupState(group_name, handle, rank, world_size,
+                                      epoch)
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    """Tear down the group's rendezvous actor. Callable from any rank or from
+    a non-member driver that set the group up via create_collective_group."""
+    ray = _ray()
+    _groups.pop(group_name, None)
+    try:
+        ray.kill(ray.get_actor(_COORD_PREFIX + group_name))
+    except Exception:
+        pass
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    return group_name in _groups
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _group(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _group(group_name).world
+
+
+def _group(name: str) -> _GroupState:
+    st = _groups.get(name)
+    if st is None:
+        raise RuntimeError(
+            f"collective group {name!r} is not initialized in this process; "
+            "call init_collective_group() first")
+    return st
+
+
+def _to_host(tensor) -> np.ndarray:
+    return np.asarray(tensor)
+
+
+def _collective(kind: str, tensor, group_name: str, op: str = ReduceOp.SUM,
+                src: int = 0):
+    ray = _ray()
+    st = _group(group_name)
+    key = st.next_key(kind)
+    payload = None if tensor is None else _to_host(tensor)
+    return ray.get(st.handle.gather_op.remote(
+        key, st.rank, payload, kind, op, src))
+
+
+def allreduce(tensor, group_name: str = "default",
+              op: str = ReduceOp.SUM):
+    """Reduce across all ranks; returns the reduced array
+    (reference: collective.py:295)."""
+    return _collective("allreduce", tensor, group_name, op)
+
+
+def allgather(tensor, group_name: str = "default") -> list:
+    """Returns list of every rank's tensor, ordered by rank
+    (reference: collective.py:460)."""
+    return _collective("allgather", tensor, group_name)
+
+
+def reducescatter(tensor, group_name: str = "default",
+                  op: str = ReduceOp.SUM):
+    """Reduce then scatter along axis 0: rank r gets the r-th 1/world chunk
+    (reference: collective.py:509)."""
+    st = _group(group_name)
+    t = _to_host(tensor)
+    if t.shape[0] % st.world:
+        raise ValueError(
+            f"reducescatter dim0 {t.shape[0]} not divisible by world "
+            f"{st.world}")
+    return _collective("reducescatter", t, group_name, op)
+
+
+def broadcast(tensor, src_rank: int = 0,
+              group_name: str = "default"):
+    """Every rank gets src_rank's tensor (reference: collective.py:403)."""
+    return _collective("broadcast", tensor, group_name, src=src_rank)
+
+
+def barrier(group_name: str = "default") -> None:
+    """Block until every rank arrives (reference: collective.py:683)."""
+    _collective("barrier", None, group_name)
+
+
+def send(tensor, dst_rank: int, group_name: str = "default") -> None:
+    """Point-to-point send (reference: collective.py:568)."""
+    ray = _ray()
+    st = _group(group_name)
+    if dst_rank == st.rank:
+        raise ValueError("cannot send to self")
+    key = st.next_p2p_key(st.rank, dst_rank)
+    ray.get(st.handle.p2p_send.remote(key, _to_host(tensor)))
+
+
+def recv(src_rank: int, group_name: str = "default"):
+    """Point-to-point receive; returns the array (reference:
+    collective.py:631 — reference writes into a passed tensor instead)."""
+    ray = _ray()
+    st = _group(group_name)
+    if src_rank == st.rank:
+        raise ValueError("cannot recv from self")
+    key = st.next_p2p_key(src_rank, st.rank)
+    return ray.get(st.handle.p2p_recv.remote(key))
+
+
+def create_collective_group(actors, world_size: int, ranks: list[int],
+                            backend: str = "shm",
+                            group_name: str = "default"):
+    """Driver-side declarative setup (reference: collective.py:210): tells
+    each actor to join the group via its `init_collective_group` method or a
+    generic __ray_call__ if it has one."""
+    ray = _ray()
+    refs = []
+    for actor, rank in zip(actors, ranks):
+        refs.append(actor.init_collective_group.remote(
+            world_size, rank, backend, group_name))
+    return ray.get(refs)

@@ -2,6 +2,8 @@
 
 Reference parity: python/ray/util/.
 """
+import importlib
+
 from .placement_group import (
     PlacementGroup,
     placement_group,
@@ -12,5 +14,13 @@ from . import scheduling_strategies
 
 __all__ = [
     "PlacementGroup", "placement_group", "placement_group_table",
-    "remove_placement_group", "scheduling_strategies",
+    "remove_placement_group", "scheduling_strategies", "collective",
 ]
+
+
+def __getattr__(name):
+    # Lazy (PEP 562): keep `import ray_tpu` light for worker startup —
+    # collective pulls in numpy and the parallel package.
+    if name == "collective":
+        return importlib.import_module(".collective", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

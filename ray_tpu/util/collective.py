@@ -1,0 +1,28 @@
+"""`ray_tpu.util.collective` — API-parity alias for the reference import path
+`ray.util.collective.collective` (python/ray/util/collective/collective.py).
+Implementation lives in ray_tpu.parallel.collective (SURVEY.md §5.8: NCCL/Gloo
+replaced by XLA in-program collectives + an object-store rendezvous backend).
+"""
+from ..parallel.collective import (  # noqa: F401
+    ReduceOp,
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    create_collective_group,
+    destroy_collective_group,
+    get_collective_group_size,
+    get_rank,
+    init_collective_group,
+    is_group_initialized,
+    recv,
+    reducescatter,
+    send,
+)
+
+__all__ = [
+    "ReduceOp", "init_collective_group", "destroy_collective_group",
+    "is_group_initialized", "get_rank", "get_collective_group_size",
+    "allreduce", "allgather", "reducescatter", "broadcast", "barrier",
+    "send", "recv", "create_collective_group",
+]

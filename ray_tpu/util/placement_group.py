@@ -5,14 +5,18 @@ Reference parity: python/ray/util/placement_group.py:42 (PlacementGroup),
 TPU-specific role (SURVEY.md §2.4): bundles are how whole TPU slices (ICI
 domains) get reserved for SPMD worker gangs — a bundle of {"TPU": n} pins n
 chips on one host, and STRICT_SPREAD lays a multi-host gang across hosts.
+
+Handles are id-based and picklable (reference: PlacementGroup carries only
+its id, util/placement_group.py:55), so they can be created from the driver
+*or* from inside an actor (e.g. a Train controller) and passed around.
 """
 from __future__ import annotations
 
 import threading
-import time
 from typing import Optional
 
-from ..core.ids import PlacementGroupID
+from ..core.ids import ObjectID, PlacementGroupID
+from ..core.ref import ObjectRef
 
 
 def _runtime():
@@ -27,50 +31,55 @@ VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
 
 
 class PlacementGroup:
-    def __init__(self, state):
-        self._state = state
+    def __init__(self, pg_id: PlacementGroupID, bundle_specs: list[dict]):
+        self._pg_id = pg_id
+        self._bundle_specs = [dict(b) for b in bundle_specs]
 
     @property
     def id(self) -> PlacementGroupID:
-        return self._state.pg_id
+        return self._pg_id
 
     @property
     def bundle_specs(self) -> list[dict]:
-        return [dict(b.resources) for b in self._state.bundles]
+        return [dict(b) for b in self._bundle_specs]
 
     @property
     def bundle_count(self) -> int:
-        return len(self._state.bundles)
+        return len(self._bundle_specs)
 
-    def ready(self):
-        """ObjectRef that resolves when all bundles are reserved (reference:
-        PlacementGroup.ready, util/placement_group.py:70)."""
+    def ready(self) -> ObjectRef:
+        """ObjectRef that resolves (to the pg id hex) once all bundles are
+        reserved (reference: PlacementGroup.ready, util/placement_group.py:70).
+        """
         rt = _runtime()
-        from ..core.ids import ObjectID
-        from ..core.object_store import SharedObjectStore  # noqa: F401
-        from ..core.ref import ObjectRef
-        from ..core.runtime import DirEntry, READY, Runtime
-        state = self._state
-        pg_hex = state.pg_id.hex()  # handles aren't picklable; resolve to id
-        if isinstance(rt, Runtime):
-            oid = ObjectID.from_random()
+        oid = ObjectID.from_random()
+        pg_id, pg_hex = self._pg_id, self._pg_id.hex()
 
-            def _waiter():
-                state.ready_event.wait()
-                rt.store.put(oid, pg_hex)
-                with rt.lock:
-                    rt.directory[oid] = DirEntry(READY)
-            threading.Thread(target=_waiter, daemon=True).start()
-            return ObjectRef(oid)
-        return rt.put(pg_hex)
+        def _waiter():
+            try:
+                ok = rt.pg_wait(pg_id, timeout=24 * 3600.0)
+                if ok:
+                    rt.put_at(oid, pg_hex)
+                else:
+                    rt.put_at(oid, TimeoutError(
+                        f"placement group {pg_hex} never ready"),
+                        is_exception=True)
+            except BaseException as e:  # noqa: BLE001 — resolve, never hang
+                try:
+                    rt.put_at(oid, e, is_exception=True)
+                except BaseException:
+                    pass
+        threading.Thread(target=_waiter, daemon=True).start()
+        return ObjectRef(oid)
 
     def wait(self, timeout_seconds: float = 30) -> bool:
-        return self._state.ready_event.wait(timeout=timeout_seconds)
+        return _runtime().pg_wait(self._pg_id, timeout=timeout_seconds)
 
     def __reduce__(self):
-        raise TypeError(
-            "PlacementGroup handles cannot be pickled in round 1; "
-            "pass bundle indices instead")
+        return (PlacementGroup, (self._pg_id, self._bundle_specs))
+
+    def __repr__(self):
+        return f"PlacementGroup({self._pg_id.hex()[:12]}, {self._bundle_specs})"
 
 
 def placement_group(bundles: list[dict[str, float]],
@@ -84,9 +93,14 @@ def placement_group(bundles: list[dict[str, float]],
     for b in bundles:
         if not b or any(v < 0 for v in b.values()):
             raise ValueError(f"invalid bundle {b}")
-    state = _runtime().create_placement_group(
+    rt = _runtime()
+    result = rt.create_placement_group(
         [dict(b) for b in bundles], strategy, name)
-    return PlacementGroup(state)
+    if isinstance(result, PlacementGroup):  # worker: head rpc wraps already
+        return result
+    # driver / local mode: direct call returns the internal state
+    return PlacementGroup(result.pg_id,
+                          [dict(b.resources) for b in result.bundles])
 
 
 def remove_placement_group(pg: PlacementGroup) -> None:

@@ -18,6 +18,19 @@ if "xla_force_host_platform_device_count" not in _flags:
 import pytest  # noqa: E402
 
 
+def _force_cpu_platform():
+    # The axon TPU plugin's sitecustomize calls
+    # jax.config.update("jax_platforms", "axon,cpu") at import, overriding the
+    # JAX_PLATFORMS env var. Re-override after import so tests run on the
+    # virtual 8-device CPU mesh.
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    return jax
+
+
+_force_cpu_platform()
+
+
 @pytest.fixture
 def ray_start_regular():
     import ray_tpu as ray

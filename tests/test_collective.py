@@ -1,0 +1,102 @@
+"""Actor-level collective group tests (reference parity:
+python/ray/util/collective — API of collective.py:150+, here over the shm
+rendezvous backend instead of NCCL/Gloo)."""
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def ray(ray_start_regular):
+    return ray_start_regular
+
+
+def _make_workers(ray, world):
+    @ray.remote
+    class Rank:
+        def __init__(self, rank, world):
+            self.rank, self.world = rank, world
+
+        def init_collective_group(self, world, rank, backend, group):
+            from ray_tpu.util import collective as col
+            col.init_collective_group(world, rank, backend, group)
+            return rank
+
+        def do_allreduce(self, group):
+            from ray_tpu.util import collective as col
+            return col.allreduce(np.full((4,), col.get_rank(group) + 1.0),
+                                 group)
+
+        def do_allgather(self, group):
+            from ray_tpu.util import collective as col
+            return col.allgather(np.array([self.rank]), group)
+
+        def do_reducescatter(self, group):
+            from ray_tpu.util import collective as col
+            return col.reducescatter(
+                np.arange(self.world * 2, dtype=np.float64), group)
+
+        def do_broadcast(self, group):
+            from ray_tpu.util import collective as col
+            return col.broadcast(np.array([self.rank * 10.0]), 1, group)
+
+        def do_p2p(self, group):
+            from ray_tpu.util import collective as col
+            if self.rank == 0:
+                col.send(np.array([42.0]), 1, group)
+                return None
+            return col.recv(0, group)
+
+        def rank_info(self, group):
+            from ray_tpu.util import collective as col
+            return (col.get_rank(group), col.get_collective_group_size(group))
+
+    return [Rank.remote(r, world) for r in range(world)]
+
+
+def test_collective_group_ops(ray):
+    from ray_tpu.util import collective as col
+    world = 2
+    actors = _make_workers(ray, world)
+    group = "g1"
+    col.create_collective_group(actors, world, list(range(world)),
+                                backend="shm", group_name=group)
+
+    out = ray.get([a.do_allreduce.remote(group) for a in actors])
+    np.testing.assert_allclose(out[0], np.full((4,), 3.0))
+    np.testing.assert_allclose(out[0], out[1])
+
+    gathered = ray.get([a.do_allgather.remote(group) for a in actors])
+    assert [int(g[0]) for g in gathered[0]] == [0, 1]
+
+    rs = ray.get([a.do_reducescatter.remote(group) for a in actors])
+    # each rank contributes arange(4)*1 -> sum = [0,2,4,6]; rank r gets chunk r
+    np.testing.assert_allclose(rs[0], [0.0, 2.0])
+    np.testing.assert_allclose(rs[1], [4.0, 6.0])
+
+    bc = ray.get([a.do_broadcast.remote(group) for a in actors])
+    np.testing.assert_allclose(bc[0], [10.0])
+    np.testing.assert_allclose(bc[1], [10.0])
+
+    p2p = ray.get([a.do_p2p.remote(group) for a in actors])
+    np.testing.assert_allclose(p2p[1], [42.0])
+
+    infos = ray.get([a.rank_info.remote(group) for a in actors])
+    assert infos == [(0, 2), (1, 2)]
+
+
+def test_driver_participates(ray):
+    """The driver itself can be a rank (reference allows this via
+    init_collective_group in the driver process)."""
+    from ray_tpu.util import collective as col
+    world = 2
+    (actor,) = _make_workers(ray, 1)
+
+    ref = actor.init_collective_group.remote(world, 1, "shm", "g2")
+    col.init_collective_group(world, 0, "shm", "g2")
+    ray.get(ref)
+    ref = actor.do_allreduce.remote("g2")
+    mine = col.allreduce(np.full((4,), 1.0), "g2")
+    theirs = ray.get(ref)
+    np.testing.assert_allclose(mine, np.full((4,), 3.0))
+    np.testing.assert_allclose(theirs, mine)
+    col.destroy_collective_group("g2")
