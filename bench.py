@@ -1,0 +1,89 @@
+"""Headline benchmark: llama training-step MFU on the attached accelerator.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline: BASELINE.json north star — Llama-family ≥45% MFU on v5e (the
+reference has no checked-in ML perf numbers, SURVEY.md §6). vs_baseline is
+achieved_MFU / 0.45 on TPU.
+
+Sized for one v5e chip (16 GiB HBM): ~315M-param llama, bf16 weights, f32
+adam moments, batch 8 × seq 1024, remat on.
+"""
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _model_and_batch(on_tpu: bool):
+    from ray_tpu.models import llama
+    if on_tpu:
+        cfg = llama.LlamaConfig(
+            vocab_size=32000, dim=1024, n_layers=16, n_heads=16,
+            n_kv_heads=8, mlp_dim=4096, max_seq_len=1024,
+            dtype=jnp.bfloat16, remat=True, use_flash=True,
+            attn_block_q=512, attn_block_k=512)
+        batch, seq = 8, 1024
+    else:  # CPU smoke configuration — numbers are not meaningful
+        cfg = llama.llama_tiny(n_layers=2, dim=64, mlp_dim=128,
+                               max_seq_len=128)
+        batch, seq = 2, 128
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab_size,
+                                         (batch, seq + 1)), jnp.int32)
+    return cfg, tokens
+
+
+def main():
+    import optax
+    from ray_tpu.models import llama
+    from ray_tpu.parallel.mesh import tpu_topology
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    topo = tpu_topology([dev])
+    cfg, tokens = _model_and_batch(on_tpu)
+    batch, seqp1 = tokens.shape
+    seq = seqp1 - 1
+
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    opt = optax.adamw(3e-4, weight_decay=0.0)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def train_step(params, opt_state, tokens):
+        def loss_fn(p):
+            logits = llama.apply(p, tokens[:, :-1], cfg)
+            return llama.cross_entropy_loss(logits, tokens[:, 1:])
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    # warmup (compile) + 2 stabilization steps; float() forces a full sync —
+    # on the remote-relay TPU platform block_until_ready alone does not
+    for _ in range(3):
+        params, opt_state, loss = train_step(params, opt_state, tokens)
+    float(loss)
+
+    n_steps = 10 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        params, opt_state, loss = train_step(params, opt_state, tokens)
+    loss_v = float(loss)  # sync point inside the timed region
+    dt = (time.perf_counter() - t0) / n_steps
+
+    tokens_per_step = batch * seq
+    flops_per_step = cfg.flops_per_token(seq) * tokens_per_step
+    mfu = flops_per_step / dt / topo.peak_flops_bf16
+    print(json.dumps({
+        "metric": "llama_train_mfu",
+        "value": round(float(mfu), 4),
+        "unit": f"fraction_of_peak_bf16 ({topo.generation}, "
+                f"{tokens_per_step / dt:.0f} tok/s, loss={loss_v:.3f})",
+        "vs_baseline": round(float(mfu) / 0.45, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -174,6 +174,7 @@ class Runtime:
         self.named_actors: dict[str, ActorID] = {}
         self.pgs: dict[PlacementGroupID, PlacementGroupState] = {}
         self.pending: deque[TaskSpec] = deque()
+        self._abandoned_rpcs: set[ObjectID] = set()
         # timeline events, bounded so a long-lived driver doesn't grow
         # without limit (lineage-entry pruning is round-2 work: needs
         # distributed ObjectRef refcounting before DirEntries can be freed)
@@ -305,6 +306,18 @@ class Runtime:
             # must keep draining the worker's other messages.
             threading.Thread(target=self._handle_worker_rpc, args=(msg,),
                              daemon=True).start()
+        elif t == "rpc_abandon":
+            # Worker timed out waiting for a reply. Mark abandoned FIRST,
+            # then reclaim if already written — this order closes the race
+            # with the rpc thread's put-then-check (one side always sees the
+            # other's write).
+            oid = ObjectID(msg["reply_oid"])
+            with self.lock:
+                self._abandoned_rpcs.add(oid)
+            if self.store.contains(oid):
+                with self.lock:
+                    self._abandoned_rpcs.discard(oid)
+                self.store.delete(oid)
 
     # Worker→head request/reply: the reply value is written into the shared
     # store at a worker-chosen oid (reference analog: the CoreWorkerService /
@@ -323,9 +336,19 @@ class Runtime:
             result = getattr(self, m)(*msg.get("args", ()))
             self.store.put(oid, ("ok", result))
         except BaseException as e:  # noqa: BLE001 — reply with any failure
-            self.store.put(oid, ("err", e))
+            try:
+                self.store.put(oid, ("err", e))
+            except BaseException:  # unpicklable exception/result
+                self.store.put(oid, ("err", RuntimeError(
+                    f"rpc {msg.get('m')} failed with unpicklable error: "
+                    f"{type(e).__name__}: {e!r}")))
         # No directory entry: the worker polls the store directly and deletes
-        # the reply once read, so the head never tracks these oids.
+        # the reply once read. If the worker already gave up, reclaim now.
+        with self.lock:
+            abandoned = oid in self._abandoned_rpcs
+            self._abandoned_rpcs.discard(oid)
+        if abandoned:
+            self.store.delete(oid)
 
     def create_placement_group_rpc(self, bundles, strategy, name=""):
         pg = self.create_placement_group(bundles, strategy, name)
@@ -340,7 +363,9 @@ class Runtime:
             pg = self.pgs.get(pg_id)
         if pg is None:
             raise ValueError(f"no placement group {pg_id}")
-        return pg.ready_event.wait(timeout=timeout)
+        # removal sets ready_event to wake waiters; only 'created' is ready
+        ok = pg.ready_event.wait(timeout=timeout)
+        return ok and pg.state == "created"
 
     # ------------------------------------------------------------------ #
     # worker pool (reference: raylet/worker_pool.h:283)
@@ -447,14 +472,15 @@ class Runtime:
     # ------------------------------------------------------------------ #
 
     def put(self, value: Any, pin: bool = True) -> ObjectRef:
-        oid = ObjectID.from_random()
-        self.store.put(oid, value)
+        ref = self.put_at(ObjectID.from_random(), value)
         if pin:
             # keep a refcount so LRU eviction never drops a live ray.put()
-            self.store.get_raw(oid, timeout_ms=0)
-        with self.lock:
-            self.directory[oid] = DirEntry(READY)
-        return ObjectRef(oid)
+            self.store.get_raw(ref.id(), timeout_ms=0)
+        return ref
+
+    def expect(self, oid: ObjectID) -> None:
+        """No-op: deferred oids need no pre-registration in the shared-store
+        runtimes (get() already blocks). LocalModeRuntime overrides."""
 
     def put_at(self, oid: ObjectID, value: Any,
                is_exception: bool = False) -> ObjectRef:
@@ -1041,6 +1067,7 @@ class Runtime:
                             n.resources_avail[k] = \
                                 n.resources_avail.get(k, 0) + v
             pg.state = "removed"
+            pg.ready_event.set()  # wake pg_wait-ers; they check state
             self._schedule_locked()
 
     # ------------------------------------------------------------------ #
@@ -1340,12 +1367,17 @@ class LocalModeRuntime:
         ref_list = [refs] if single else list(refs)
         out = []
         for r in ref_list:
-            # deferred refs (e.g. pg.ready()) resolve from a waiter thread
+            # deferred refs (pg.ready() — pre-registered via expect()) are
+            # resolved by a waiter thread; anything else is synchronous in
+            # local mode, so an unknown oid is an immediate error
             deadline = None if timeout is None else time.monotonic() + timeout
-            while r.id() not in self.objects:
+            while self.objects.get(r.id(), (None,))[0] == "pending":
                 if deadline is not None and time.monotonic() > deadline:
                     raise exc.GetTimeoutError(f"timed out on {r.id()}")
                 time.sleep(0.001)
+            if r.id() not in self.objects:
+                raise exc.ObjectLostError(
+                    f"object {r.id()} does not exist in local mode")
             st, v = self.objects[r.id()]
             if st == "err":
                 raise v.as_instanceof_cause() if isinstance(
@@ -1398,6 +1430,11 @@ class LocalModeRuntime:
 
     def pg_wait(self, pg_id, timeout: float = 30.0) -> bool:
         return True  # local-mode PGs are always immediately "reserved"
+
+    def expect(self, oid):
+        """Register an oid a background waiter will put_at shortly, so get()
+        blocks on it instead of failing fast on an unknown oid."""
+        self.objects.setdefault(oid, ("pending", None))
 
     def put_at(self, oid, value, is_exception: bool = False):
         self.objects[oid] = ("err" if is_exception else "ok", value)

@@ -72,10 +72,10 @@ class WorkerRuntime:
     # -- object API --------------------------------------------------------
 
     def put(self, value, pin: bool = False):
-        oid = ObjectID.from_random()
-        self.store.put(oid, value)
-        self.send({"t": "put", "oid": oid})
-        return ObjectRef(oid)
+        return self.put_at(ObjectID.from_random(), value)
+
+    def expect(self, oid):
+        """No-op (see Runtime.expect)."""
 
     def put_at(self, oid: ObjectID, value, is_exception: bool = False):
         self.store.put(oid, value, is_exception=is_exception)
@@ -183,6 +183,9 @@ class WorkerRuntime:
                 break
             except StoreTimeout:
                 if time.monotonic() > deadline:
+                    # let the head reclaim the reply if it lands later
+                    self.send({"t": "rpc_abandon",
+                               "reply_oid": reply.binary()})
                     raise exc.GetTimeoutError(
                         f"head rpc {method} timed out") from None
         self.store.delete(reply)

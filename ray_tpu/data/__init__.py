@@ -1,0 +1,30 @@
+"""ray_tpu.data — lazy, streaming distributed datasets.
+
+Reference parity: python/ray/data (Dataset dataset.py, logical plans
+_internal/logical/interfaces/logical_plan.py:10, streaming executor
+_internal/execution/streaming_executor.py:52, read_api.py). Same shape here:
+a Dataset is a lazy logical plan over blocks (pyarrow Tables in the shared
+object store); execution fuses map chains into single tasks and streams
+blocks through the gang (executor.py). The TPU-facing surface is
+`iter_batches(batch_format="numpy")` feeding jax device_put, and
+`streaming_split(n)` shards for Train worker gangs.
+"""
+from .context import DataContext
+from .dataset import DataIterator, Dataset, Schema
+from .read_api import (
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,  # noqa: A001 — reference API name (ray.data.range)
+    read_csv,
+    read_json,
+    read_parquet,
+    read_text,
+)
+
+__all__ = [
+    "DataContext", "Dataset", "DataIterator", "Schema", "from_arrow",
+    "from_items", "from_numpy", "from_pandas", "range", "read_csv",
+    "read_json", "read_parquet", "read_text",
+]

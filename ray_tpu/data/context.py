@@ -1,0 +1,23 @@
+"""Execution-context knobs (reference parity: python/ray/data/context.py
+DataContext — a process-wide singleton of tunables)."""
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class DataContext:
+    target_max_block_size: int = 128 * 1024 * 1024
+    target_min_block_size: int = 1 * 1024 * 1024
+    default_batch_size: int = 256
+    # max concurrently in-flight block tasks per executing dataset
+    max_tasks_in_flight: int = 16
+    read_default_num_blocks: int = 8
+
+    _instance = None
+
+    @classmethod
+    def get_current(cls) -> "DataContext":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance

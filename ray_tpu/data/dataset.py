@@ -1,0 +1,338 @@
+"""Dataset: the lazy user-facing handle over a logical plan.
+
+Reference parity: python/ray/data/dataset.py (map/map_batches/filter/
+flat_map, iter_batches :4661, streaming_split :1731, groupby, sort, limit,
+take, count, schema, union, zip, repartition, random_shuffle, write_*,
+materialize). `iter_jax_batches` replaces iter_torch_batches as the
+accelerator hand-off (device_put onto the current mesh's batch sharding).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+import numpy as np
+
+from . import block as B
+from .context import DataContext
+from .executor import (
+    BlockMeta,
+    BlockOp,
+    Exchange,
+    Executor,
+    InputData,
+    LogicalOp,
+    Read,
+    iter_blocks,
+)
+
+
+class Schema:
+    def __init__(self, arrow_schema):
+        self._schema = arrow_schema
+
+    @property
+    def names(self) -> list[str]:
+        return list(self._schema.names)
+
+    @property
+    def types(self) -> list:
+        return list(self._schema.types)
+
+    def __repr__(self):
+        cols = ", ".join(f"{n}: {t}" for n, t in
+                         zip(self._schema.names, self._schema.types))
+        return f"Schema({cols})"
+
+
+# -- block-op builders (top-level for cheap pickling) -----------------------
+
+def _map_batches_block(fn, batch_format, batch):
+    out = fn(B.format_batch(batch, batch_format))
+    return B.from_batch(out)
+
+
+def _map_rows_block(fn, batch):
+    return B.from_items([fn(r) for r in B.to_rows(batch)])
+
+
+def _flat_map_block(fn, batch):
+    out = []
+    for r in B.to_rows(batch):
+        out.extend(fn(r))
+    return B.from_items(out)
+
+
+def _filter_block(fn, batch):
+    keep = np.fromiter((bool(fn(r)) for r in B.to_rows(batch)),
+                       dtype=bool, count=batch.num_rows)
+    return batch.take(np.nonzero(keep)[0])
+
+
+def _select_block(cols, batch):
+    return batch.select(cols)
+
+
+def _drop_block(cols, batch):
+    return batch.drop_columns(cols)
+
+
+def _rename_block(mapping, batch):
+    return batch.rename_columns(
+        [mapping.get(n, n) for n in batch.column_names])
+
+
+def _add_column_block(name, fn, batch):
+    col = fn(B.format_batch(batch, "pandas"))
+    return batch.append_column(name, B.from_batch({name: np.asarray(col)})
+                               .column(name))
+
+
+def _write_block(path_template, fmt, index, batch):
+    import pyarrow.csv as pcsv
+    import pyarrow.parquet as pq
+    import pyarrow.json  # noqa: F401
+    path = path_template.format(i=index)
+    if fmt == "parquet":
+        pq.write_table(batch, path)
+    elif fmt == "csv":
+        pcsv.write_csv(batch, path)
+    elif fmt == "json":
+        batch.to_pandas().to_json(path, orient="records", lines=True)
+    return path
+
+
+class Dataset:
+    """Lazy distributed dataset (reference: dataset.py Dataset)."""
+
+    def __init__(self, plan: LogicalOp, ctx: Optional[DataContext] = None):
+        self._plan = plan
+        self._ctx = ctx or DataContext.get_current()
+        self._cached: Optional[list[tuple[Any, BlockMeta]]] = None
+
+    # -- transforms (lazy) ------------------------------------------------
+
+    def _block_op(self, fn, name) -> "Dataset":
+        return Dataset(BlockOp(self._plan, fn, name), self._ctx)
+
+    def map_batches(self, fn: Callable, *, batch_format: str = "numpy",
+                    **_ignored) -> "Dataset":
+        return self._block_op(
+            functools.partial(_map_batches_block, fn, batch_format),
+            "MapBatches")
+
+    def map(self, fn: Callable[[dict], dict]) -> "Dataset":
+        return self._block_op(functools.partial(_map_rows_block, fn), "Map")
+
+    def flat_map(self, fn: Callable[[dict], list]) -> "Dataset":
+        return self._block_op(functools.partial(_flat_map_block, fn),
+                              "FlatMap")
+
+    def filter(self, fn: Callable[[dict], bool]) -> "Dataset":
+        return self._block_op(functools.partial(_filter_block, fn), "Filter")
+
+    def select_columns(self, cols: list[str]) -> "Dataset":
+        return self._block_op(functools.partial(_select_block, cols),
+                              "Select")
+
+    def drop_columns(self, cols: list[str]) -> "Dataset":
+        return self._block_op(functools.partial(_drop_block, cols), "Drop")
+
+    def rename_columns(self, mapping: dict[str, str]) -> "Dataset":
+        return self._block_op(functools.partial(_rename_block, mapping),
+                              "Rename")
+
+    def add_column(self, name: str, fn: Callable) -> "Dataset":
+        return self._block_op(functools.partial(_add_column_block, name, fn),
+                              "AddColumn")
+
+    def limit(self, n: int) -> "Dataset":
+        return Dataset(Exchange([self._plan], "limit", n=n), self._ctx)
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return Dataset(Exchange([self._plan], "repartition", n=num_blocks),
+                       self._ctx)
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        return Dataset(Exchange([self._plan], "shuffle", n=None,
+                                seed=seed or 0), self._ctx)
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        return Dataset(Exchange([self._plan], "sort", key=key,
+                                descending=descending), self._ctx)
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        return Dataset(Exchange([self._plan, *(o._plan for o in others)],
+                                "union"), self._ctx)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        return Dataset(Exchange([self._plan, other._plan], "zip"), self._ctx)
+
+    def groupby(self, key: str) -> "GroupedData":
+        from .grouped import GroupedData
+        return GroupedData(self, key)
+
+    # -- execution --------------------------------------------------------
+
+    def _execute(self) -> list[tuple[Any, BlockMeta]]:
+        if self._cached is None:
+            self._cached = Executor(self._ctx).execute(self._plan)
+        return self._cached
+
+    def materialize(self) -> "Dataset":
+        pairs = self._execute()
+        out = Dataset(InputData(pairs), self._ctx)
+        out._cached = pairs
+        return out
+
+    def count(self) -> int:
+        return sum(m.rows for _, m in self._execute())
+
+    def size_bytes(self) -> int:
+        return sum(m.bytes for _, m in self._execute())
+
+    def num_blocks(self) -> int:
+        return len(self._execute())
+
+    def schema(self) -> Optional[Schema]:
+        pairs = self._execute()
+        if not pairs:
+            return None
+        import ray_tpu
+        return Schema(ray_tpu.get(pairs[0][0]).schema)
+
+    def take(self, n: int = 20) -> list[dict]:
+        out: list[dict] = []
+        for blk in iter_blocks(self._execute()):
+            for row in B.to_rows(blk):
+                out.append(row)
+                if len(out) >= n:
+                    return out
+        return out
+
+    def take_all(self) -> list[dict]:
+        return [r for blk in iter_blocks(self._execute())
+                for r in B.to_rows(blk)]
+
+    def show(self, n: int = 20) -> None:
+        for row in self.take(n):
+            print(row)
+
+    # -- iteration --------------------------------------------------------
+
+    def iter_rows(self) -> Iterator[dict]:
+        for blk in iter_blocks(self._execute()):
+            yield from B.to_rows(blk)
+
+    def iter_batches(self, *, batch_size: Optional[int] = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False) -> Iterator:
+        return DataIterator(self._execute()).iter_batches(
+            batch_size=batch_size, batch_format=batch_format,
+            drop_last=drop_last)
+
+    def iter_jax_batches(self, *, batch_size: int = 256,
+                         drop_last: bool = True, sharding=None) -> Iterator:
+        return DataIterator(self._execute()).iter_jax_batches(
+            batch_size=batch_size, drop_last=drop_last, sharding=sharding)
+
+    def streaming_split(self, n: int) -> list["DataIterator"]:
+        """n iterators over disjoint block subsets, one per Train worker
+        (reference: dataset.py:1731)."""
+        pairs = self._execute()
+        return [DataIterator(pairs[i::n]) for i in range(n)]
+
+    def split(self, n: int) -> list["Dataset"]:
+        pairs = self._execute()
+        return [Dataset(InputData(pairs[i::n]), self._ctx) for i in range(n)]
+
+    # -- writes -----------------------------------------------------------
+
+    def _write(self, path: str, fmt: str, ext: str) -> list[str]:
+        import os
+        import ray_tpu
+        os.makedirs(path, exist_ok=True)
+        tmpl = os.path.join(path, f"part-{{i:05d}}.{ext}")
+        write = ray_tpu.remote(_write_block)
+        refs = [write.remote(tmpl, fmt, i, ref)
+                for i, (ref, _) in enumerate(self._execute())]
+        return ray_tpu.get(refs)
+
+    def write_parquet(self, path: str) -> list[str]:
+        return self._write(path, "parquet", "parquet")
+
+    def write_csv(self, path: str) -> list[str]:
+        return self._write(path, "csv", "csv")
+
+    def write_json(self, path: str) -> list[str]:
+        return self._write(path, "json", "json")
+
+    def stats(self) -> str:
+        pairs = self._execute()
+        return (f"Dataset: {len(pairs)} blocks, {self.count()} rows, "
+                f"{self.size_bytes()} bytes")
+
+    def __repr__(self):
+        return f"Dataset(plan={self._plan!r})"
+
+
+class DataIterator:
+    """Streams batches from a block list (reference:
+    data/iterator.py DataIterator; iter_torch_batches -> iter_jax_batches)."""
+
+    def __init__(self, pairs: list[tuple[Any, BlockMeta]]):
+        self._pairs = pairs
+
+    def count(self) -> int:
+        return sum(m.rows for _, m in self._pairs)
+
+    def iter_blocks(self) -> Iterator[B.Block]:
+        return iter_blocks(self._pairs)
+
+    def iter_rows(self) -> Iterator[dict]:
+        for blk in self.iter_blocks():
+            yield from B.to_rows(blk)
+
+    def iter_batches(self, *, batch_size: Optional[int] = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False) -> Iterator:
+        carry: Optional[B.Block] = None
+        for blk in self.iter_blocks():
+            if carry is not None and carry.num_rows:
+                blk = B.concat([carry, blk])
+                carry = None
+            if batch_size is None:
+                yield B.format_batch(blk, batch_format)
+                continue
+            start = 0
+            while blk.num_rows - start >= batch_size:
+                yield B.format_batch(
+                    B.slice_block(blk, start, start + batch_size),
+                    batch_format)
+                start += batch_size
+            if start < blk.num_rows:
+                carry = B.slice_block(blk, start, blk.num_rows)
+        if carry is not None and carry.num_rows and not drop_last:
+            yield B.format_batch(carry, batch_format)
+
+    def iter_jax_batches(self, *, batch_size: int = 256,
+                         drop_last: bool = True,
+                         sharding=None) -> Iterator:
+        """numpy batches -> jax arrays, device_put with `sharding` (or the
+        current mesh's batch sharding when inside parallel.use_mesh)."""
+        import jax
+        if sharding is None:
+            from ..parallel.mesh import get_mesh
+            from ..parallel.sharding import batch_spec
+            mesh = get_mesh()
+            if mesh is not None:
+                from jax.sharding import NamedSharding
+                sharding = NamedSharding(mesh, batch_spec(mesh))
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy",
+                                       drop_last=drop_last):
+            if sharding is not None:
+                yield {k: jax.device_put(v, sharding)
+                       for k, v in batch.items()}
+            else:
+                yield {k: jax.numpy.asarray(v) for k, v in batch.items()}

@@ -1,0 +1,350 @@
+"""Logical plan + executor: fused block tasks over the runtime.
+
+Reference parity: the logical/physical plan split of
+python/ray/data/_internal/logical/ (LogicalPlan interfaces logical_plan.py:10)
+and the streaming executor (streaming_executor.py:52). Scoped to one design
+idea for round 1: every op is either
+
+* a **block op** — pure fn(Block) -> Block. Chains of block ops FUSE into a
+  single remote task per block (the reference's OperatorFusionRule,
+  _internal/logical/rules/operator_fusion.py), so map/filter/flat_map
+  pipelines cost one task per block; or
+* an **exchange** — an all-to-all boundary (shuffle, repartition, sort,
+  groupby) implemented as map-partition + reduce tasks.
+
+Execution yields (block_ref, meta) pairs; block payloads stay in the shm
+object store and stream to consumers via per-block ray.get.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Iterator, Optional
+
+import numpy as np
+
+from . import block as B
+from .context import DataContext
+
+
+@dataclasses.dataclass
+class BlockMeta:
+    rows: int
+    bytes: int
+
+
+class LogicalOp:
+    """Node in the lazy plan DAG."""
+
+    def __init__(self, name: str, inputs: list["LogicalOp"]):
+        self.name = name
+        self.inputs = inputs
+
+    def __repr__(self):
+        return f"{self.name}({', '.join(map(repr, self.inputs))})"
+
+
+class InputData(LogicalOp):
+    def __init__(self, refs_and_meta: list[tuple]):
+        super().__init__("InputData", [])
+        self.refs_and_meta = refs_and_meta
+
+
+class Read(LogicalOp):
+    """One task per read callable (reference: planner/plan_read_op.py)."""
+
+    def __init__(self, read_tasks: list[Callable[[], B.Block]], name="Read"):
+        super().__init__(name, [])
+        self.read_tasks = read_tasks
+
+
+class BlockOp(LogicalOp):
+    """Fusable fn(Block)->Block (map_batches/map/filter/flat_map/project)."""
+
+    def __init__(self, input_op: LogicalOp, fn: Callable[[B.Block], B.Block],
+                 name: str):
+        super().__init__(name, [input_op])
+        self.fn = fn
+
+
+class Exchange(LogicalOp):
+    """All-to-all boundary. kind in {repartition, shuffle, sort, groupby,
+    limit, union, zip}; args carried per kind."""
+
+    def __init__(self, inputs: list[LogicalOp], kind: str, **kwargs):
+        super().__init__(f"Exchange[{kind}]", inputs)
+        self.kind = kind
+        self.kwargs = kwargs
+
+
+# ---------------------------------------------------------------------------
+# Remote task bodies (top-level so cloudpickle ships them cheaply)
+# ---------------------------------------------------------------------------
+
+def _run_fused(fns, block):
+    for fn in fns:
+        block = fn(block)
+    return block, BlockMeta(B.num_rows(block), B.size_bytes(block))
+
+
+def _run_read(read_fn, fns):
+    block = read_fn()
+    return _run_fused(fns, block)
+
+
+def _split_for_exchange(block, n_out, shuffle, seed):
+    """Map side of an exchange: partition rows into n_out slices."""
+    rows = B.num_rows(block)
+    if shuffle:
+        rng = np.random.RandomState(seed)
+        idx = rng.permutation(rows)
+        block = block.take(idx)
+    # contiguous split keeps arrow slicing zero-copy
+    bounds = np.linspace(0, rows, n_out + 1).astype(int)
+    return tuple(B.slice_block(block, bounds[i], bounds[i + 1])
+                 for i in range(n_out))
+
+
+def _combine_partition(shuffle, seed, *parts):
+    out = B.concat(list(parts))
+    if shuffle:
+        rng = np.random.RandomState(seed)
+        out = out.take(rng.permutation(B.num_rows(out)))
+    return out, BlockMeta(B.num_rows(out), B.size_bytes(out))
+
+
+def _sort_and_partition(block, key, descending, boundaries):
+    """Sort-map: locally sort, then split at the sampled boundaries."""
+    order = "descending" if descending else "ascending"
+    block = block.sort_by([(key, order)])
+    col = B.column_to_numpy(block.column(key))
+    if descending:
+        cuts = len(col) - np.searchsorted(col[::-1], boundaries, side="left")
+    else:
+        cuts = np.searchsorted(col, boundaries, side="right")
+    bounds = [0] + list(cuts) + [len(col)]
+    return tuple(B.slice_block(block, bounds[i], bounds[i + 1])
+                 for i in range(len(bounds) - 1))
+
+
+def _merge_sorted(key, descending, *parts):
+    out = B.concat(list(parts))
+    order = "descending" if descending else "ascending"
+    out = out.sort_by([(key, order)])
+    return out, BlockMeta(B.num_rows(out), B.size_bytes(out))
+
+
+def _sample_block(block, key, n):
+    col = B.column_to_numpy(block.column(key))
+    if len(col) == 0:
+        return np.array([])
+    idx = np.random.RandomState(0).randint(0, len(col), min(n, len(col)))
+    return col[idx]
+
+
+def _stable_hash(x) -> int:
+    # Python's str hash is per-process randomized (PYTHONHASHSEED); block
+    # tasks run in different workers, so partitioning must use a stable hash
+    import zlib
+    return zlib.crc32(repr(x).encode())
+
+
+def _hash_partition(block, key, n_out):
+    if B.num_rows(block) == 0:
+        empty = block
+        return tuple(empty for _ in range(n_out))
+    col = B.column_to_numpy(block.column(key))
+    hashes = np.array([_stable_hash(x) % n_out for x in col])
+    return tuple(block.take(np.nonzero(hashes == i)[0])
+                 for i in range(n_out))
+
+
+def _slice_task(block, start, end):
+    out = B.slice_block(block, start, end)
+    return out, BlockMeta(B.num_rows(out), B.size_bytes(out))
+
+
+# ---------------------------------------------------------------------------
+# Executor
+# ---------------------------------------------------------------------------
+
+def _ray():
+    import ray_tpu
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    return ray_tpu
+
+
+class Executor:
+    """Executes a logical plan bottom-up, fusing BlockOp chains."""
+
+    def __init__(self, ctx: Optional[DataContext] = None):
+        self.ctx = ctx or DataContext.get_current()
+
+    def execute(self, op: LogicalOp) -> list[tuple[Any, BlockMeta]]:
+        """Returns [(block_ref, meta)] — metas are concrete."""
+        ray = _ray()
+        fused: list[Callable] = []
+        node = op
+        # peel fusable block ops off the top of the chain
+        chain: list[BlockOp] = []
+        while isinstance(node, BlockOp):
+            chain.append(node)
+            node = node.inputs[0]
+        fused = [c.fn for c in reversed(chain)]
+
+        remote_fused = ray.remote(_run_fused).options(num_returns=2)
+        if isinstance(node, Read):
+            remote_read = ray.remote(_run_read).options(num_returns=2)
+            out = [remote_read.remote(rt, fused) for rt in node.read_tasks]
+            return self._resolve(out)
+        if isinstance(node, InputData):
+            base = node.refs_and_meta
+            if not fused:
+                return list(base)
+            out = [remote_fused.remote(fused, ref) for ref, _ in base]
+            return self._resolve(out)
+        if isinstance(node, Exchange):
+            base = self._execute_exchange(node)
+            if not fused:
+                return base
+            out = [remote_fused.remote(fused, ref) for ref, _ in base]
+            return self._resolve(out)
+        raise TypeError(f"cannot execute {node!r}")
+
+    def _resolve(self, pairs) -> list[tuple[Any, BlockMeta]]:
+        ray = _ray()
+        return [(block_ref, ray.get(meta_ref))
+                for block_ref, meta_ref in pairs]
+
+    # -- exchanges --------------------------------------------------------
+
+    def _execute_exchange(self, node: Exchange):
+        ray = _ray()
+        kind = node.kwargs
+        k = node.kind
+        if k == "union":
+            out = []
+            for parent in node.inputs:
+                out.extend(self.execute(parent))
+            return out
+        upstream = self.execute(node.inputs[0])
+        if k == "limit":
+            return self._limit(upstream, kind["n"])
+        if k == "repartition" or k == "shuffle":
+            shuffle = (k == "shuffle")
+            n_out = kind.get("n") or max(1, len(upstream))
+            seed = kind.get("seed") or 0
+            split = ray.remote(_split_for_exchange).options(
+                num_returns=n_out)
+            parts = [split.remote(ref, n_out, shuffle, seed + i)
+                     for i, (ref, _) in enumerate(upstream)]
+            parts = [p if isinstance(p, list) else [p] for p in parts]
+            combine = ray.remote(_combine_partition).options(num_returns=2)
+            out = [combine.remote(shuffle, seed + 1000 + j,
+                                  *[parts[i][j] for i in range(len(parts))])
+                   for j in range(n_out)]
+            return self._resolve(out)
+        if k == "sort":
+            return self._sort(upstream, kind["key"], kind["descending"])
+        if k == "groupby":
+            return self._groupby(upstream, kind["key"], kind["agg_fn"])
+        if k == "zip":
+            return self._zip(upstream, self.execute(node.inputs[1]))
+        raise ValueError(f"unknown exchange {k!r}")
+
+    def _limit(self, upstream, n: int):
+        ray = _ray()
+        out, have = [], 0
+        for ref, meta in upstream:
+            if have >= n:
+                break
+            take = min(meta.rows, n - have)
+            if take == meta.rows:
+                out.append((ref, meta))
+            else:
+                sl = ray.remote(_slice_task).options(num_returns=2)
+                b, m = sl.remote(ref, 0, take)
+                out.append((b, ray.get(m)))
+            have += take
+        return out
+
+    def _sort(self, upstream, key: str, descending: bool):
+        ray = _ray()
+        if not upstream:
+            return upstream
+        n_out = len(upstream)
+        sampler = ray.remote(_sample_block)
+        samples = np.concatenate(ray.get(
+            [sampler.remote(ref, key, 20) for ref, _ in upstream]))
+        if len(samples) == 0:
+            return upstream
+        qs = np.linspace(0, 100, n_out + 1)[1:-1]
+        boundaries = np.percentile(samples, qs) if len(qs) else np.array([])
+        if descending:
+            boundaries = boundaries[::-1]
+        part = ray.remote(_sort_and_partition).options(num_returns=n_out)
+        parts = [part.remote(ref, key, descending, boundaries)
+                 for ref, _ in upstream]
+        parts = [p if isinstance(p, list) else [p] for p in parts]
+        merge = ray.remote(_merge_sorted).options(num_returns=2)
+        out = [merge.remote(key, descending,
+                            *[parts[i][j] for i in range(len(parts))])
+               for j in range(n_out)]
+        return self._resolve(out)
+
+    def _groupby(self, upstream, key: str, agg_fn):
+        ray = _ray()
+        n_out = max(1, len(upstream))
+        part = ray.remote(_hash_partition).options(num_returns=n_out)
+        parts = [part.remote(ref, key, n_out) for ref, _ in upstream]
+        parts = [p if isinstance(p, list) else [p] for p in parts]
+
+        def _agg_partition(kname, fn, *blocks):
+            import pandas as pd
+            df = B.concat(list(blocks)).to_pandas()
+            if len(df) == 0:
+                out = df
+            else:
+                # agg_fn returns a final frame including the key column
+                out = fn(df.groupby(kname, sort=True))
+            tbl = B.from_batch(out)
+            return tbl, BlockMeta(B.num_rows(tbl), B.size_bytes(tbl))
+
+        agg = ray.remote(_agg_partition).options(num_returns=2)
+        out = [agg.remote(key, agg_fn,
+                          *[parts[i][j] for i in range(len(parts))])
+               for j in range(n_out)]
+        return self._resolve(out)
+
+    def _zip(self, left, right):
+        """Align row ranges then column-concat (reference: zip operator)."""
+        ray = _ray()
+        lrows = sum(m.rows for _, m in left)
+        rrows = sum(m.rows for _, m in right)
+        if lrows != rrows:
+            raise ValueError(f"zip requires equal row counts ({lrows} vs "
+                             f"{rrows})")
+
+        def _fetch_concat(*blocks):
+            return B.concat(list(blocks))
+
+        def _zip_all(lb, rb):
+            import pyarrow as pa
+            cols = {**{n: lb.column(n) for n in lb.column_names},
+                    **{n: rb.column(n) for n in rb.column_names}}
+            tbl = pa.table(cols)
+            return tbl, BlockMeta(B.num_rows(tbl), B.size_bytes(tbl))
+
+        cat = ray.remote(_fetch_concat)
+        z = ray.remote(_zip_all).options(num_returns=2)
+        lref = cat.remote(*[r for r, _ in left])
+        rref = cat.remote(*[r for r, _ in right])
+        b, m = z.remote(lref, rref)
+        return [(b, ray.get(m))]
+
+
+def iter_blocks(pairs) -> Iterator[B.Block]:
+    """Stream concrete blocks in order (tasks run ahead concurrently)."""
+    ray = _ray()
+    for ref, _ in pairs:
+        yield ray.get(ref)

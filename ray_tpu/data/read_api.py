@@ -1,0 +1,139 @@
+"""Dataset creation (reference parity: python/ray/data/read_api.py —
+range/from_items/from_numpy/from_pandas/from_arrow and file readers; file
+reads become one read task per file/fragment executed as runtime tasks)."""
+from __future__ import annotations
+
+import builtins
+import functools
+import glob as globmod
+import os
+from typing import Optional
+
+import numpy as np
+
+from . import block as B
+from .context import DataContext
+from .dataset import Dataset
+from .executor import InputData, Read
+
+
+def _n_blocks(n: Optional[int]) -> int:
+    return n or DataContext.get_current().read_default_num_blocks
+
+
+# -- in-memory sources ------------------------------------------------------
+
+def _range_task(start, stop):
+    return B.from_batch({"id": np.arange(start, stop, dtype=np.int64)})
+
+
+def range(n: int, *, override_num_blocks: Optional[int] = None) -> Dataset:  # noqa: A001
+    k = min(_n_blocks(override_num_blocks), max(1, n))
+    bounds = np.linspace(0, n, k + 1).astype(int)
+    tasks = [functools.partial(_range_task, bounds[i], bounds[i + 1])
+             for i in builtins.range(k)]
+    return Dataset(Read(tasks, name="ReadRange"))
+
+
+def from_items(items: list, *,
+               override_num_blocks: Optional[int] = None) -> Dataset:
+    k = min(_n_blocks(override_num_blocks), max(1, len(items)))
+    bounds = np.linspace(0, len(items), k + 1).astype(int)
+    tasks = [functools.partial(B.from_items, items[bounds[i]:bounds[i + 1]])
+             for i in builtins.range(k)]
+    return Dataset(Read(tasks, name="FromItems"))
+
+
+def from_numpy(arr: np.ndarray, column: str = B.TENSOR_COLUMN,
+               *, override_num_blocks: Optional[int] = None) -> Dataset:
+    k = min(_n_blocks(override_num_blocks), max(1, len(arr)))
+    chunks = np.array_split(arr, k)
+    tasks = [functools.partial(B.from_numpy, c, column) for c in chunks]
+    return Dataset(Read(tasks, name="FromNumpy"))
+
+
+def from_pandas(df) -> Dataset:
+    import pyarrow as pa
+    tbl = pa.Table.from_pandas(df, preserve_index=False)
+    return from_arrow(tbl)
+
+
+def from_arrow(table) -> Dataset:
+    import ray_tpu
+    from .executor import BlockMeta
+    ref = ray_tpu.put(table)
+    return Dataset(InputData(
+        [(ref, BlockMeta(table.num_rows, table.nbytes))]))
+
+
+# -- file sources -----------------------------------------------------------
+
+def _expand_paths(paths) -> list[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(
+                f for f in globmod.glob(os.path.join(p, "**", "*"),
+                                        recursive=True)
+                if os.path.isfile(f)))
+        elif any(c in p for c in "*?["):
+            out.extend(sorted(globmod.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths!r}")
+    return out
+
+
+def _read_parquet_task(path):
+    import pyarrow.parquet as pq
+    return pq.read_table(path)
+
+
+def _read_csv_task(path):
+    import pyarrow.csv as pcsv
+    return pcsv.read_csv(path)
+
+
+def _read_json_task(path):
+    import pandas as pd
+    import pyarrow as pa
+    df = pd.read_json(path, lines=path.endswith((".jsonl", ".ndjson"))
+                      or _is_jsonl(path))
+    return pa.Table.from_pandas(df, preserve_index=False)
+
+
+def _is_jsonl(path) -> bool:
+    with open(path, "rb") as f:
+        head = f.read(4096).lstrip()
+    return not head.startswith(b"[")
+
+
+def _read_text_task(path):
+    with open(path) as f:
+        lines = [ln.rstrip("\n") for ln in f]
+    return B.from_batch({"text": lines})
+
+
+def _file_dataset(paths, task_fn, name) -> Dataset:
+    files = _expand_paths(paths)
+    return Dataset(Read([functools.partial(task_fn, f) for f in files],
+                        name=name))
+
+
+def read_parquet(paths, **_ignored) -> Dataset:
+    return _file_dataset(paths, _read_parquet_task, "ReadParquet")
+
+
+def read_csv(paths, **_ignored) -> Dataset:
+    return _file_dataset(paths, _read_csv_task, "ReadCSV")
+
+
+def read_json(paths, **_ignored) -> Dataset:
+    return _file_dataset(paths, _read_json_task, "ReadJSON")
+
+
+def read_text(paths, **_ignored) -> Dataset:
+    return _file_dataset(paths, _read_text_task, "ReadText")

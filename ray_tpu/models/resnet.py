@@ -1,0 +1,107 @@
+"""ResNet (v1.5) in flax.linen — the vision model for BASELINE config 1
+(ResNet-18 / CIFAR-10 single-host training).
+
+Follows the models/ contract: `init/apply/logical_axes` wrappers around a
+linen Module so the trainer treats every model family uniformly. Convs stay
+NHWC (XLA's native TPU layout); batch norm uses running stats carried in a
+separate `state` collection.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: tuple = (2, 2, 2, 2)      # resnet-18
+    num_classes: int = 10
+    num_filters: int = 64
+    dtype: Any = jnp.float32
+    small_images: bool = True              # CIFAR stem (3x3, no maxpool)
+
+
+def resnet18(**kw) -> ResNetConfig:
+    return ResNetConfig(**kw)
+
+
+def resnet50(**kw) -> ResNetConfig:
+    return ResNetConfig(stage_sizes=(3, 4, 6, 3), **kw)
+
+
+class ResidualBlock(nn.Module):
+    filters: int
+    strides: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, dtype=self.dtype)
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        residual = x
+        y = conv(self.filters, (3, 3), (self.strides, self.strides))(x)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters, (3, 3))(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters, (1, 1),
+                            (self.strides, self.strides))(residual)
+            residual = norm()(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    cfg: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cfg = self.cfg
+        conv = partial(nn.Conv, use_bias=False, dtype=cfg.dtype)
+        if cfg.small_images:
+            x = conv(cfg.num_filters, (3, 3))(x)
+        else:
+            x = conv(cfg.num_filters, (7, 7), (2, 2))(x)
+        x = nn.relu(nn.BatchNorm(use_running_average=not train,
+                                 momentum=0.9, dtype=cfg.dtype)(x))
+        if not cfg.small_images:
+            x = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")
+        for i, n_blocks in enumerate(cfg.stage_sizes):
+            for j in range(n_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = ResidualBlock(cfg.num_filters * 2 ** i, strides,
+                                  cfg.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32)(x)
+
+
+def init(rng: jax.Array, cfg: ResNetConfig,
+         input_shape: Sequence[int] = (1, 32, 32, 3)) -> dict:
+    """Returns {'params': ..., 'batch_stats': ...}."""
+    model = ResNet(cfg)
+    return model.init(rng, jnp.zeros(input_shape, cfg.dtype), train=True)
+
+
+def apply(variables: dict, images: jax.Array, cfg: ResNetConfig,
+          train: bool = False):
+    """Inference/eval forward -> logits [B, num_classes]."""
+    return ResNet(cfg).apply(variables, images, train=False)
+
+
+def apply_train(variables: dict, images: jax.Array, cfg: ResNetConfig):
+    """Training forward -> (logits, updated batch_stats)."""
+    logits, new_state = ResNet(cfg).apply(
+        variables, images, train=True, mutable=["batch_stats"])
+    return logits, new_state
+
+
+def logical_axes(variables: dict) -> dict:
+    """Conv/dense kernels replicate under pure DP; batch-parallel training
+    needs no param sharding (they fit one chip)."""
+    return jax.tree.map(lambda _: (None,), variables,
+                        is_leaf=lambda x: hasattr(x, "shape"))

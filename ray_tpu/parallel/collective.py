@@ -179,6 +179,7 @@ def _coordinator_actor(name: str, world_size: int, rank: int,
     ray = _ray()
     actor_name = _COORD_PREFIX + name
     if rank == 0:
+        from .. import exceptions as exc
         try:
             h = ray.get_actor(actor_name)
             # Reusing a live group name: join it (no state reset — other
@@ -190,7 +191,10 @@ def _coordinator_actor(name: str, world_size: int, rank: int,
                     f"different world size; destroy_collective_group first")
             return h
         except ValueError:
-            pass
+            pass  # no such actor: create below
+        except exc.ActorDiedError:
+            pass  # stale registration of a just-destroyed coordinator:
+            # fall through to create (its retry loop waits out the name)
         cls = ray.remote(_Rendezvous)
         deadline = time.monotonic() + 5.0
         while True:
@@ -217,15 +221,35 @@ def _coordinator_actor(name: str, world_size: int, rank: int,
 
 def init_collective_group(world_size: int, rank: int,
                           backend: str = "shm",
-                          group_name: str = "default") -> None:
+                          group_name: str = "default",
+                          timeout: float = 300.0) -> None:
     """Join this process to a collective group (reference:
-    collective.py:150). Must be called by every rank, any order."""
+    collective.py:150). Must be called by every rank, any order.
+
+    Known limitation (round 1): if a rank crashes *between* posting its join
+    and the rest of the group joining, its stale join is still counted for
+    that generation; recovery is destroy_collective_group + full re-init by
+    all live ranks. `timeout` bounds the hang and surfaces the error.
+    """
     if backend not in ("shm", "xla"):
         raise ValueError(f"backend must be 'shm' or 'xla', got {backend!r}")
     if not 0 <= rank < world_size:
         raise ValueError(f"rank {rank} out of range for world {world_size}")
-    handle = _coordinator_actor(group_name, world_size, rank)
-    epoch = _ray().get(handle.join.remote(rank))  # barrier: all ranks joined
+    from .. import exceptions as exc
+    deadline = time.monotonic() + timeout
+    while True:
+        handle = _coordinator_actor(group_name, world_size, rank, timeout)
+        try:
+            # barrier: all ranks joined; bounded so a missing rank raises
+            epoch = _ray().get(handle.join.remote(rank), timeout=timeout)
+            break
+        except exc.ActorDiedError:
+            # destroy→re-init race: the name resolved to a dying (or, from a
+            # worker, a never-registered duplicate-named) coordinator. Retry
+            # until the old registration clears.
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.1)
     _groups[group_name] = _GroupState(group_name, handle, rank, world_size,
                                       epoch)
 
@@ -302,10 +326,20 @@ def reducescatter(tensor, group_name: str = "default",
     return _collective("reducescatter", t, group_name, op)
 
 
+def _check_rank(st: _GroupState, r: int, what: str):
+    if not 0 <= r < st.world:
+        raise ValueError(
+            f"{what} {r} out of range for world size {st.world}")
+
+
 def broadcast(tensor, src_rank: int = 0,
               group_name: str = "default"):
-    """Every rank gets src_rank's tensor (reference: collective.py:403)."""
-    return _collective("broadcast", tensor, group_name, src=src_rank)
+    """Every rank gets src_rank's tensor (reference: collective.py:403).
+    Only src_rank's payload is shipped; other ranks contribute None."""
+    st = _group(group_name)
+    _check_rank(st, src_rank, "src_rank")
+    payload = tensor if st.rank == src_rank else None
+    return _collective("broadcast", payload, group_name, src=src_rank)
 
 
 def barrier(group_name: str = "default") -> None:
@@ -317,6 +351,7 @@ def send(tensor, dst_rank: int, group_name: str = "default") -> None:
     """Point-to-point send (reference: collective.py:568)."""
     ray = _ray()
     st = _group(group_name)
+    _check_rank(st, dst_rank, "dst_rank")
     if dst_rank == st.rank:
         raise ValueError("cannot send to self")
     key = st.next_p2p_key(st.rank, dst_rank)
@@ -328,6 +363,7 @@ def recv(src_rank: int, group_name: str = "default"):
     collective.py:631 — reference writes into a passed tensor instead)."""
     ray = _ray()
     st = _group(group_name)
+    _check_rank(st, src_rank, "src_rank")
     if src_rank == st.rank:
         raise ValueError("cannot recv from self")
     key = st.next_p2p_key(src_rank, st.rank)

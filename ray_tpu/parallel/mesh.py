@@ -42,8 +42,10 @@ BATCH_AXES = ("dp", "fsdp")
 class MeshSpec:
     """Declarative mesh shape: axis name -> size; at most one -1 (inferred).
 
-    MeshSpec(dp=-1, tp=4) on 32 devices -> Mesh('pp':1 hidden, 'dp':8, 'tp':4)
-    (size-1 axes are dropped from the constructed mesh unless keep_unit_axes).
+    MeshSpec(dp=-1, tp=4) on 32 devices resolves dp=8. By default
+    (keep_unit_axes=True) ALL six axes appear in the mesh, size-1 ones
+    included — so sharding rules can target any axis unconditionally. With
+    keep_unit_axes=False only axes of size > 1 are kept.
     """
 
     pp: int = 1
@@ -103,7 +105,13 @@ def build_mesh(spec: MeshSpec | dict | None = None,
         try:
             dev_array = mesh_utils.create_device_mesh(
                 shape, devices=devices, allow_split_physical_axes=True)
-        except Exception:
+        except Exception as e:
+            import warnings
+            warnings.warn(
+                f"mesh_utils.create_device_mesh failed ({e!r}); falling back "
+                f"to naive device order — collective bandwidth may suffer "
+                f"because mesh axes no longer follow ICI topology",
+                RuntimeWarning, stacklevel=2)
             dev_array = np.asarray(devices).reshape(shape)
     else:
         dev_array = np.asarray(devices).reshape(shape)

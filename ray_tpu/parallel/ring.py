@@ -146,14 +146,22 @@ def _sharded(fn, mesh, q_specs):
                          check_vma=False)
 
 
+def _seq_spec(mesh, axis_name, batch_axes, head_axis) -> P:
+    if axis_name not in mesh.axis_names:
+        raise ValueError(
+            f"mesh {tuple(mesh.axis_names)} has no {axis_name!r} axis; "
+            f"build it with MeshSpec(sp=...) to use sequence parallelism")
+    return P(tuple(a for a in batch_axes if a in mesh.axis_names) or None,
+             axis_name,
+             head_axis if head_axis in mesh.axis_names else None)
+
+
 def ring_attention_sharded(q, k, v, mesh, axis_name: str = "sp",
                            causal: bool = False,
                            batch_axes=("dp", "fsdp"), head_axis="tp"):
     """Ring attention over globally-sharded [B, S, H, D] arrays: batch over
     dp/fsdp, sequence over sp, heads over tp."""
-    spec = P(tuple(a for a in batch_axes if a in mesh.axis_names) or None,
-             axis_name if axis_name in mesh.axis_names else None,
-             head_axis if head_axis in mesh.axis_names else None)
+    spec = _seq_spec(mesh, axis_name, batch_axes, head_axis)
     fn = functools.partial(ring_attention, axis_name=axis_name, causal=causal)
     return _sharded(fn, mesh, (spec, spec, spec))(q, k, v)
 
@@ -161,9 +169,7 @@ def ring_attention_sharded(q, k, v, mesh, axis_name: str = "sp",
 def ulysses_attention_sharded(q, k, v, mesh, axis_name: str = "sp",
                               causal: bool = False,
                               batch_axes=("dp", "fsdp"), head_axis="tp"):
-    spec = P(tuple(a for a in batch_axes if a in mesh.axis_names) or None,
-             axis_name if axis_name in mesh.axis_names else None,
-             head_axis if head_axis in mesh.axis_names else None)
+    spec = _seq_spec(mesh, axis_name, batch_axes, head_axis)
     fn = functools.partial(ulysses_attention, axis_name=axis_name,
                            causal=causal)
     return _sharded(fn, mesh, (spec, spec, spec))(q, k, v)

@@ -1,0 +1,42 @@
+"""ray_tpu.train — distributed training orchestration (Train v2 shape).
+
+Reference parity: python/ray/train/v2 (controller state machine
+controller.py:93, worker group worker_group.py:105, session/report
+train_fn_utils.py:13, checkpoints _checkpoint.py:56) re-designed TPU-first:
+the backend boots a JAX global mesh per gang instead of a torch NCCL process
+group (train/torch/config.py:115), and failure domains are slices, not
+single GPUs.
+
+User surface:
+    trainer = JaxTrainer(train_fn, scaling_config=ScalingConfig(num_workers=4),
+                         run_config=RunConfig(name="run1"))
+    result = trainer.fit()
+
+Inside train_fn:
+    from ray_tpu import train
+    ctx = train.get_context()          # rank / world size / mesh hints
+    train.report({"loss": ...}, checkpoint=ckpt)
+    ckpt = train.get_checkpoint()      # restored checkpoint on restart
+"""
+from .config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from .checkpoint import Checkpoint
+from .session import (
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    report,
+    TrainContext,
+)
+from .trainer import DataParallelTrainer, JaxTrainer, Result, TrainingFailedError
+
+__all__ = [
+    "Checkpoint", "CheckpointConfig", "FailureConfig", "RunConfig",
+    "ScalingConfig", "get_checkpoint", "get_context", "get_dataset_shard",
+    "report", "TrainContext", "DataParallelTrainer", "JaxTrainer", "Result",
+    "TrainingFailedError",
+]

@@ -1,0 +1,61 @@
+"""Training run configuration (reference parity: python/ray/air/config.py
+RunConfig/ScalingConfig/CheckpointConfig/FailureConfig — same fields where
+they make sense on TPU, plus slice-aware resources)."""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """Gang size and per-worker resources.
+
+    On TPU, `num_workers` is the number of *hosts* in the gang and
+    `tpus_per_worker` the chips each host contributes to the global mesh
+    (reference analog: ScalingConfig(num_workers, use_gpu,
+    resources_per_worker), air/config.py).
+    """
+    num_workers: int = 1
+    cpus_per_worker: float = 1.0
+    tpus_per_worker: float = 0.0
+    resources_per_worker: Optional[dict] = None
+    placement_strategy: str = "PACK"
+
+    def bundle(self) -> dict:
+        res = {"CPU": self.cpus_per_worker}
+        if self.tpus_per_worker:
+            res["TPU"] = self.tpus_per_worker
+        if self.resources_per_worker:
+            res.update(self.resources_per_worker)
+        return res
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """(reference: air/config.py FailureConfig) max_failures < 0 = retry
+    forever; 0 = fail fast."""
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    """(reference: air/config.py CheckpointConfig)"""
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = dataclasses.field(
+        default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = dataclasses.field(
+        default_factory=CheckpointConfig)
+
+    def resolved_storage_path(self) -> str:
+        return self.storage_path or os.path.join(
+            os.path.expanduser("~"), "ray_tpu_results")

@@ -1,0 +1,268 @@
+"""DataParallelTrainer / JaxTrainer: controller + worker-group state machine.
+
+Reference parity (SURVEY.md §3.4): train/v2/_internal/execution/controller/
+controller.py:93 (state machine: schedule workers → run → monitor →
+restart-on-failure), worker_group/worker_group.py:105 (placement-group gang,
+one actor per bundle :242,:364), failure_handling/failure_policy.py.
+
+TPU-first differences:
+* The backend hook configures a *JAX gang* — per-worker env for
+  jax.distributed (coordinator address, process ids) so all hosts of a slice
+  join one global mesh — instead of torch NCCL rendezvous
+  (reference: train/torch/config.py:115,153).
+* Failure granularity is the whole gang (an ICI slice dies as a unit): any
+  worker failure tears down and restarts the full group from the latest
+  checkpoint, per FailureConfig.
+"""
+from __future__ import annotations
+
+import os
+import time
+import uuid
+from typing import Any, Callable, Optional
+
+from .checkpoint import Checkpoint, CheckpointManager
+from .config import RunConfig, ScalingConfig
+from . import session as session_mod
+
+
+class TrainingFailedError(RuntimeError):
+    """Raised by fit() when training fails beyond FailureConfig limits
+    (reference: train/base_trainer.py TrainingFailedError)."""
+
+
+class Result:
+    """(reference: air/result.py) Final metrics + checkpoint handles."""
+
+    def __init__(self, metrics: dict, checkpoint: Optional[Checkpoint],
+                 best_checkpoint: Optional[Checkpoint], path: str,
+                 error: Optional[BaseException], metrics_history: list[dict]):
+        self.metrics = metrics
+        self.checkpoint = checkpoint
+        self.best_checkpoint = best_checkpoint
+        self.path = path
+        self.error = error
+        self.metrics_history = metrics_history
+
+    def __repr__(self):
+        return (f"Result(metrics={self.metrics}, "
+                f"checkpoint={self.checkpoint}, error={self.error!r})")
+
+
+class _ResultBus:
+    """Async rendezvous actor carrying report() traffic worker→controller
+    (reference analog: the report queue + sync actor of
+    train/v2/_internal/execution/checkpoint/sync_actor.py)."""
+
+    def __init__(self):
+        self._events: list[tuple] = []
+
+    async def push(self, rank: int, seq: int, metrics: dict,
+                   ckpt_path: Optional[str]):
+        self._events.append((rank, seq, metrics, ckpt_path))
+
+    async def drain(self) -> list[tuple]:
+        out, self._events = self._events, []
+        return out
+
+
+class _TrainWorker:
+    """One gang member; hosts the user's train_fn (reference:
+    worker_group/worker.py RayTrainWorker)."""
+
+    def __init__(self, run_name: str, rank: int, world_size: int,
+                 bus, env: dict):
+        self._ctx_args = (run_name, rank, world_size)
+        self._bus = bus
+        for k, v in env.items():
+            os.environ[k] = v
+
+    def run(self, fn_and_cfg: bytes, restore_path: Optional[str],
+            shards: Optional[dict]) -> str:
+        import cloudpickle
+        train_fn, train_cfg = cloudpickle.loads(fn_and_cfg)
+        run_name, rank, world = self._ctx_args
+        ctx = session_mod.TrainContext(
+            run_name=run_name, rank=rank, world_size=world,
+            restored_checkpoint=(Checkpoint(restore_path)
+                                 if restore_path else None),
+            dataset_shards=shards, _bus=self._bus)
+        session_mod._set_context(ctx)
+        try:
+            if train_cfg is _NO_CONFIG:
+                train_fn()
+            else:
+                train_fn(train_cfg)
+        finally:
+            session_mod._set_context(None)
+        return "done"
+
+
+_NO_CONFIG = object()
+
+
+class DataParallelTrainer:
+    """Gang-schedules `train_loop_per_worker` over a placement group and
+    supervises it (reference: v2/api/data_parallel_trainer.py:55, fit :103).
+    """
+
+    def __init__(self,
+                 train_loop_per_worker: Callable,
+                 *,
+                 train_loop_config: Any = _NO_CONFIG,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 datasets: Optional[dict] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None):
+        self.train_fn = train_loop_per_worker
+        self.train_cfg = train_loop_config
+        self.scaling = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.datasets = datasets or {}
+        self.resume_from = resume_from_checkpoint
+
+    # -- worker-group lifecycle -------------------------------------------
+
+    def _start_group(self, ray, run_name, bus, restore: Optional[Checkpoint]):
+        import cloudpickle
+        from ..util.placement_group import placement_group
+        n = self.scaling.num_workers
+        pg = placement_group([self.scaling.bundle() for _ in range(n)],
+                             strategy=self.scaling.placement_strategy)
+        if not pg.wait(120):
+            raise TrainingFailedError(
+                f"placement group for {n} workers never became ready "
+                f"(cluster too small for {self.scaling.bundle()} × {n}?)")
+        WorkerCls = ray.remote(_TrainWorker)
+        shards = self._split_datasets(n)
+        workers, run_refs = [], []
+        blob = cloudpickle.dumps((self.train_fn, self.train_cfg))
+        for rank in range(n):
+            env = self._worker_env(rank, n)
+            w = WorkerCls.options(
+                num_cpus=self.scaling.cpus_per_worker,
+                num_tpus=self.scaling.tpus_per_worker,
+                resources=self.scaling.resources_per_worker,
+                placement_group=pg,
+                placement_group_bundle_index=rank,
+            ).remote(run_name, rank, n, bus, env)
+            workers.append(w)
+        for rank, w in enumerate(workers):
+            run_refs.append(w.run.remote(
+                blob, restore.path if restore else None, shards[rank]))
+        return pg, workers, run_refs
+
+    def _worker_env(self, rank: int, world: int) -> dict:
+        """JAX gang env (the mesh-bootstrap analog of NCCL rendezvous env,
+        reference train/torch/config.py:153). Single-host: nothing needed;
+        multi-host slices get jax.distributed coordinates."""
+        return {
+            "RTPU_TRAIN_RANK": str(rank),
+            "RTPU_TRAIN_WORLD": str(world),
+        }
+
+    def _split_datasets(self, n: int) -> list[Optional[dict]]:
+        """Round-robin shard plain iterables; Dataset objects use
+        streaming_split (reference: dataset.py:1731) once data/ lands."""
+        shards: list[Optional[dict]] = [None] * n
+        if not self.datasets:
+            return shards
+        per_worker: list[dict] = [{} for _ in range(n)]
+        for name, ds in self.datasets.items():
+            if hasattr(ds, "streaming_split"):
+                for rank, piece in enumerate(ds.streaming_split(n)):
+                    per_worker[rank][name] = piece
+            else:
+                items = list(ds)
+                for rank in range(n):
+                    per_worker[rank][name] = items[rank::n]
+        return per_worker
+
+    # -- fit ---------------------------------------------------------------
+
+    def fit(self) -> Result:
+        import ray_tpu as ray
+        run_name = self.run_config.name or f"train_{uuid.uuid4().hex[:8]}"
+        storage = os.path.join(self.run_config.resolved_storage_path(),
+                               run_name)
+        ckpt_cfg = self.run_config.checkpoint_config
+        manager = CheckpointManager(
+            os.path.join(storage, "checkpoints"),
+            num_to_keep=ckpt_cfg.num_to_keep,
+            score_attribute=ckpt_cfg.checkpoint_score_attribute,
+            score_order=ckpt_cfg.checkpoint_score_order)
+
+        BusCls = ray.remote(_ResultBus)
+        bus = BusCls.options(max_concurrency=64).remote()
+
+        failures_left = self.run_config.failure_config.max_failures
+        restore = self.resume_from
+        metrics_history: list[dict] = []
+        last_metrics: dict = {}
+        # dedup multi-rank checkpoints per report step; generation
+        # disambiguates restarts (worker seq counters reset)
+        seen_ckpt_seqs: set[tuple] = set()
+        generation = 0
+        error: Optional[BaseException] = None
+
+        pg, workers, run_refs = self._start_group(ray, run_name, bus, restore)
+        try:
+            while True:
+                done, pending = ray.wait(run_refs, num_returns=len(run_refs),
+                                         timeout=0.25)
+                for rank, seq, metrics, ckpt_path in ray.get(
+                        bus.drain.remote()):
+                    key = (generation, seq)
+                    if ckpt_path and key not in seen_ckpt_seqs:
+                        seen_ckpt_seqs.add(key)
+                        manager.register(Checkpoint(ckpt_path), metrics)
+                    if rank == 0:
+                        metrics_history.append(metrics)
+                        last_metrics = metrics
+                try:
+                    ray.get(done)  # surfaces any worker failure immediately
+                except BaseException as e:  # noqa: BLE001
+                    if failures_left == 0:
+                        error = e
+                        break
+                    failures_left -= 1
+                    generation += 1
+                    restore = manager.latest or restore
+                    self._teardown(ray, workers, pg)
+                    pg, workers, run_refs = self._start_group(
+                        ray, run_name, bus, restore)
+                    continue
+                if not pending:
+                    break  # all workers finished cleanly
+        finally:
+            self._teardown(ray, workers, pg)
+            try:
+                ray.kill(bus)
+            except Exception:
+                pass
+
+        if error is not None:
+            raise TrainingFailedError(
+                f"training failed after exhausting "
+                f"{self.run_config.failure_config.max_failures} retries"
+            ) from error
+        return Result(last_metrics, manager.latest, manager.best, storage,
+                      None, metrics_history)
+
+    def _teardown(self, ray, workers, pg):
+        from ..util.placement_group import remove_placement_group
+        for w in workers:
+            try:
+                ray.kill(w)
+            except Exception:
+                pass
+        try:
+            remove_placement_group(pg)
+        except Exception:
+            pass
+
+
+class JaxTrainer(DataParallelTrainer):
+    """The flagship trainer (reference analog: TorchTrainer,
+    train/torch/torch_trainer.py — here the worker gang runs jax SPMD
+    programs over the gang's global mesh)."""

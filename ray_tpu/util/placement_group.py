@@ -34,6 +34,7 @@ class PlacementGroup:
     def __init__(self, pg_id: PlacementGroupID, bundle_specs: list[dict]):
         self._pg_id = pg_id
         self._bundle_specs = [dict(b) for b in bundle_specs]
+        self._ready_ref: Optional[ObjectRef] = None
 
     @property
     def id(self) -> PlacementGroupID:
@@ -51,9 +52,12 @@ class PlacementGroup:
         """ObjectRef that resolves (to the pg id hex) once all bundles are
         reserved (reference: PlacementGroup.ready, util/placement_group.py:70).
         """
+        if self._ready_ref is not None:  # one waiter thread per handle
+            return self._ready_ref
         rt = _runtime()
         oid = ObjectID.from_random()
         pg_id, pg_hex = self._pg_id, self._pg_id.hex()
+        rt.expect(oid)  # local mode pre-registers deferred oids; others no-op
 
         def _waiter():
             try:
@@ -70,7 +74,8 @@ class PlacementGroup:
                 except BaseException:
                     pass
         threading.Thread(target=_waiter, daemon=True).start()
-        return ObjectRef(oid)
+        self._ready_ref = ObjectRef(oid)
+        return self._ready_ref
 
     def wait(self, timeout_seconds: float = 30) -> bool:
         return _runtime().pg_wait(self._pg_id, timeout=timeout_seconds)

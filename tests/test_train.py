@@ -1,0 +1,111 @@
+"""JaxTrainer tests (reference parity: the Train v2 controller/worker-group
+behaviors of train/v2/tests — gang scheduling, report/checkpoint flow,
+failure restart from latest checkpoint)."""
+import os
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def ray(ray_start_regular):
+    return ray_start_regular
+
+
+def test_trainer_reports_and_checkpoints(ray, tmp_path):
+    from ray_tpu import train
+
+    def train_fn(config):
+        import jax
+        import jax.numpy as jnp
+        ctx = train.get_context()
+        assert ctx.get_world_size() == 2
+        w = jnp.zeros(())
+        for step in range(config["steps"]):
+            w = w + 1.0
+            ckpt = None
+            if ctx.get_world_rank() == 0:
+                ckpt = train.Checkpoint.from_state(
+                    {"w": jax.device_get(w), "step": step})
+            train.report({"step": step, "w": float(w)}, checkpoint=ckpt)
+
+    trainer = train.JaxTrainer(
+        train_fn,
+        train_loop_config={"steps": 3},
+        scaling_config=train.ScalingConfig(num_workers=2,
+                                           cpus_per_worker=1),
+        run_config=train.RunConfig(name="t1", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["step"] == 2
+    assert len(result.metrics_history) == 3
+    state = result.checkpoint.load_state()
+    assert state["step"] == 2
+    np.testing.assert_allclose(state["w"], 3.0)
+
+
+def test_trainer_failure_restart_resumes_from_checkpoint(ray, tmp_path):
+    from ray_tpu import train
+
+    crash_marker = str(tmp_path / "crashed_once")
+
+    def train_fn(config):
+        ctx = train.get_context()
+        start = 0
+        ckpt = train.get_checkpoint()
+        if ckpt is not None:
+            start = ckpt.load_state()["step"] + 1
+        for step in range(start, 4):
+            if step == 2 and not os.path.exists(crash_marker):
+                open(crash_marker, "w").close()
+                raise RuntimeError("boom")
+            c = train.Checkpoint.from_state({"step": step}) \
+                if ctx.get_world_rank() == 0 else None
+            train.report({"step": step, "resumed": start > 0}, checkpoint=c)
+
+    trainer = train.JaxTrainer(
+        train_fn,
+        train_loop_config={},
+        scaling_config=train.ScalingConfig(num_workers=1, cpus_per_worker=1),
+        run_config=train.RunConfig(
+            name="t2", storage_path=str(tmp_path),
+            failure_config=train.FailureConfig(max_failures=2)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["step"] == 3
+    assert result.metrics["resumed"] is True  # second run restored step>=0
+    assert result.checkpoint.load_state()["step"] == 3
+
+
+def test_trainer_fails_after_retries_exhausted(ray, tmp_path):
+    from ray_tpu import train
+
+    def train_fn(config):
+        raise ValueError("always broken")
+
+    trainer = train.JaxTrainer(
+        train_fn,
+        scaling_config=train.ScalingConfig(num_workers=1, cpus_per_worker=1),
+        run_config=train.RunConfig(name="t3", storage_path=str(tmp_path)),
+    )
+    with pytest.raises(train.TrainingFailedError):
+        trainer.fit()
+
+
+def test_trainer_dataset_shards(ray, tmp_path):
+    from ray_tpu import train
+
+    def train_fn(config=None):
+        shard = train.get_dataset_shard("train")
+        train.report({"n": len(list(shard))})
+
+    trainer = train.JaxTrainer(
+        train_fn,
+        scaling_config=train.ScalingConfig(num_workers=2, cpus_per_worker=1),
+        run_config=train.RunConfig(name="t4", storage_path=str(tmp_path)),
+        datasets={"train": list(range(10))},
+    )
+    result = trainer.fit()
+    assert result.metrics["n"] == 5
