@@ -1,0 +1,25 @@
+"""ray_tpu.llm — LLM serving and batch inference.
+
+Reference parity: python/ray/llm (serve.llm vllm_engine.py:180 VLLMEngine /
+llm_server.py:409, batch processor/base.py:104). The external vLLM engine is
+replaced by a JAX-native continuous-batching engine (engine.py): slot-based
+KV cache, jitted prefill/decode over the whole batch, in-jit sampling —
+attention/matmuls stay on the MXU, the Python loop only admits/retires
+requests.
+
+    from ray_tpu import llm
+    engine = llm.InferenceEngine(llm.EngineConfig(model=cfg), params)
+    out = engine.generate(["hello"], llm.SamplingParams(max_tokens=16))
+
+Serving: llm.serving.build_llm_deployment(...) -> a Serve app exposing an
+OpenAI-style completions API. Batch: llm.batch.build_llm_processor(...)
+maps a Dataset through tokenize -> generate -> detokenize stages
+(reference: data/llm.py:248).
+"""
+from .engine import EngineConfig, InferenceEngine, SamplingParams
+from .tokenizer import ByteTokenizer, get_tokenizer
+
+__all__ = ["EngineConfig", "InferenceEngine", "SamplingParams",
+           "ByteTokenizer", "get_tokenizer", "serving", "batch"]
+
+from . import serving, batch  # noqa: E402

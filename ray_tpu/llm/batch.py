@@ -1,0 +1,79 @@
+"""data.llm analog: batch inference processors over Datasets.
+
+Reference parity: python/ray/data/llm.py:248 build_llm_processor and
+llm/_internal/batch/processor/base.py:104 (Processor = chained stages:
+preprocess -> tokenize -> engine -> detokenize -> postprocess, each a Data
+transform). Here the engine stage is a map_batches over the JAX engine —
+one engine per task keeps it simple in round 1 (an actor-pool engine stage
+is the optimization path).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+from .engine import EngineConfig, InferenceEngine, SamplingParams
+
+_ENGINE_CACHE: dict[str, InferenceEngine] = {}
+
+
+def _get_engine(cfg: EngineConfig) -> InferenceEngine:
+    key = repr((cfg.model, cfg.max_batch_size, cfg.max_seq_len,
+                cfg.prefill_buckets))
+    if key not in _ENGINE_CACHE:
+        _ENGINE_CACHE[key] = InferenceEngine(cfg)
+    return _ENGINE_CACHE[key]
+
+
+@dataclasses.dataclass
+class ProcessorConfig:
+    engine: Optional[EngineConfig] = None
+    sampling: SamplingParams = dataclasses.field(
+        default_factory=SamplingParams)
+    prompt_column: str = "prompt"
+    output_column: str = "generated_text"
+    batch_size: int = 8
+
+
+class Processor:
+    """(reference: processor/base.py:104) `__call__(Dataset) -> Dataset`."""
+
+    def __init__(self, cfg: ProcessorConfig,
+                 preprocess: Optional[Callable] = None,
+                 postprocess: Optional[Callable] = None):
+        self.cfg = cfg
+        self.preprocess = preprocess
+        self.postprocess = postprocess
+
+    def __call__(self, ds):
+        cfg = self.cfg
+        if self.preprocess is not None:
+            ds = ds.map(self.preprocess)
+
+        def run_engine(batch: dict) -> dict:
+            from ..models import llama
+            engine_cfg = cfg.engine or EngineConfig(
+                model=llama.llama_tiny(),
+                max_batch_size=cfg.batch_size)
+            # engines cache per worker process: model init + XLA compiles
+            # are paid once, not once per block
+            engine = _get_engine(engine_cfg)
+            prompts = [str(p) for p in batch[cfg.prompt_column]]
+            outs = engine.generate(prompts, cfg.sampling)
+            result = dict(batch)
+            result[cfg.output_column] = [o["text"] for o in outs]
+            result["num_generated_tokens"] = [
+                len(o["token_ids"]) for o in outs]
+            return result
+
+        ds = ds.map_batches(run_engine)
+        if self.postprocess is not None:
+            ds = ds.map(self.postprocess)
+        return ds
+
+
+def build_llm_processor(config: ProcessorConfig,
+                        preprocess: Optional[Callable] = None,
+                        postprocess: Optional[Callable] = None) -> Processor:
+    """(reference: data/llm.py:248)"""
+    return Processor(config, preprocess, postprocess)

@@ -1,0 +1,257 @@
+"""JAX-native continuous-batching inference engine.
+
+The vLLM replacement (reference: llm/_internal/serve/deployments/llm/vllm/
+vllm_engine.py:180 — engine loop, scheduling, sampling; here re-designed for
+XLA): a fixed pool of batch *slots* backs a slot-indexed KV cache; prefill
+and decode are two jitted programs with static shapes (prompt lengths bucket
+to powers of two to bound recompiles); sampling (greedy/temperature/top-k)
+runs in-jit. The Python-side loop only admits requests into free slots and
+retires finished ones — all math stays compiled.
+
+Continuous batching: new requests join the running batch at any step; a
+finished slot frees immediately. Decode cost is one [B, 1] step per token
+over all active slots.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import llama
+from .tokenizer import get_tokenizer
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    """(reference: vLLM SamplingParams surface)"""
+    max_tokens: int = 64
+    temperature: float = 0.0          # 0 = greedy
+    top_k: int = 0                    # 0 = no top-k
+    stop_token_ids: tuple = ()
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    model: llama.LlamaConfig
+    max_batch_size: int = 8
+    max_seq_len: int = 1024
+    prefill_buckets: tuple = (32, 64, 128, 256, 512, 1024)
+    tokenizer: Any = None
+
+
+@dataclasses.dataclass
+class _Request:
+    rid: int
+    prompt_ids: list[int]
+    params: SamplingParams
+    out_ids: list[int] = dataclasses.field(default_factory=list)
+    slot: int = -1
+    done: bool = False
+    event: threading.Event = dataclasses.field(
+        default_factory=threading.Event)
+
+
+def sample_logits(logits: jax.Array, rng: jax.Array, temperature: float,
+                  top_k: int) -> jax.Array:
+    """In-jit sampling over [B, V] logits (greedy / temperature / top-k)."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    return jax.random.categorical(rng, logits, axis=-1)
+
+
+class InferenceEngine:
+    """Synchronous engine; the serving layer runs it on a background thread
+    and exposes an async API (reference: VLLMEngine's engine loop)."""
+
+    def __init__(self, cfg: EngineConfig, params: Optional[dict] = None,
+                 rng_seed: int = 0):
+        self.cfg = cfg
+        self.model_cfg = cfg.model
+        self.tokenizer = get_tokenizer(cfg.tokenizer)
+        if params is None:
+            params = llama.init(jax.random.PRNGKey(rng_seed), cfg.model)
+        self.params = params
+        self.cache = llama.init_slot_cache(cfg.model, cfg.max_batch_size,
+                                           cfg.max_seq_len)
+        self._free_slots = list(range(cfg.max_batch_size))
+        self._active: dict[int, _Request] = {}      # slot -> request
+        self._pending: list[_Request] = []
+        self._next_rid = 0
+        self._rng = jax.random.PRNGKey(rng_seed)
+        self._lock = threading.Lock()
+
+        mc = cfg.model
+        max_len = cfg.max_seq_len
+
+        @jax.jit
+        def _prefill(params, cache, tokens, slot, true_len):
+            """tokens [1, S] (right-padded to a bucket) -> writes K/V into
+            the slot's cache row, sets its length to true_len, and returns
+            the logits at the last REAL prompt position [V]. Pad positions'
+            K/V land beyond true_len and are never attended (decode masks
+            k_pos <= length) before being overwritten."""
+            logits, ks, vs = llama.apply_with_kv(params, tokens, mc)
+            cache_k = jax.lax.dynamic_update_slice(
+                cache["k"], ks[:, 0:1].astype(cache["k"].dtype),
+                (0, slot, 0, 0, 0))
+            cache_v = jax.lax.dynamic_update_slice(
+                cache["v"], vs[:, 0:1].astype(cache["v"].dtype),
+                (0, slot, 0, 0, 0))
+            lengths = cache["lengths"].at[slot].set(true_len)
+            last = jax.lax.dynamic_index_in_dim(logits[0], true_len - 1, 0,
+                                                keepdims=False)
+            return last, {"k": cache_k, "v": cache_v, "lengths": lengths}
+
+        @jax.jit
+        def _decode(params, cache, tokens, active):
+            """tokens [B] -> (logits [B, V], cache); inactive rows don't
+            advance their length."""
+            logits, new_cache = llama.decode_batched(
+                params, tokens[:, None], cache, mc)
+            lengths = jnp.where(active, new_cache["lengths"],
+                                cache["lengths"])
+            lengths = jnp.minimum(lengths, max_len - 1)
+            return logits, {"k": new_cache["k"], "v": new_cache["v"],
+                            "lengths": lengths}
+
+        self._prefill_fn = _prefill
+        self._decode_fn = _decode
+
+    # -- public API --------------------------------------------------------
+
+    def generate(self, prompts: list[str] | list[list[int]],
+                 params: SamplingParams | list[SamplingParams] = None,
+                 ) -> list[dict]:
+        """Blocking batch generation; returns [{text, token_ids,
+        prompt_tokens, finish_reason}] in prompt order."""
+        if params is None:
+            params = SamplingParams()
+        plist = params if isinstance(params, list) else \
+            [params] * len(prompts)
+        reqs = [self.submit(p, sp) for p, sp in zip(prompts, plist)]
+        self.run_until_done(reqs)
+        return [self._result(r) for r in reqs]
+
+    def submit(self, prompt, params: SamplingParams) -> _Request:
+        ids = (self.tokenizer.encode(prompt) if isinstance(prompt, str)
+               else list(prompt))
+        # keep the prompt (up to the cache capacity) and clamp max_tokens
+        # to the remaining room — never silently discard the prompt
+        ids = ids[: self.cfg.max_seq_len - 2]
+        if not ids:
+            raise ValueError("empty prompt")
+        capacity = self.cfg.max_seq_len - 1 - len(ids)
+        if params.max_tokens > capacity:
+            params = dataclasses.replace(params,
+                                         max_tokens=max(1, capacity))
+        with self._lock:
+            req = _Request(self._next_rid, ids, params)
+            self._next_rid += 1
+            self._pending.append(req)
+        return req
+
+    def run_until_done(self, reqs: list[_Request]):
+        while not all(r.done for r in reqs):
+            self.step()
+
+    def has_work(self) -> bool:
+        return bool(self._pending or self._active)
+
+    # -- engine loop -------------------------------------------------------
+
+    def step(self):
+        """One engine iteration: admit pending prompts (prefill), then one
+        batched decode step over all active slots."""
+        self._admit()
+        if not self._active:
+            return
+        bs = self.cfg.max_batch_size
+        tokens = np.zeros((bs,), np.int32)
+        active = np.zeros((bs,), bool)
+        for slot, req in self._active.items():
+            tokens[slot] = req.out_ids[-1]
+            active[slot] = True
+        self._rng, sub = jax.random.split(self._rng)
+        logits, self.cache = self._decode_fn(
+            self.params, self.cache, jnp.asarray(tokens),
+            jnp.asarray(active))
+        self._sample_and_retire(logits, sub)
+
+    def _admit(self):
+        with self._lock:
+            while self._pending and self._free_slots:
+                req = self._pending.pop(0)
+                slot = self._free_slots.pop(0)
+                req.slot = slot
+                self._active[slot] = req
+                self._do_prefill(req)
+
+    def _bucket(self, n: int) -> int:
+        for b in self.cfg.prefill_buckets:
+            if n <= b:
+                return min(b, self.cfg.max_seq_len)
+        return self.cfg.max_seq_len
+
+    def _do_prefill(self, req: _Request):
+        ids = req.prompt_ids
+        bucket = self._bucket(len(ids))
+        padded = ids + [0] * (bucket - len(ids))
+        last_logits, self.cache = self._prefill_fn(
+            self.params, self.cache, jnp.asarray([padded], jnp.int32),
+            req.slot, len(ids))
+        first = self._sample_one(last_logits[None, :], req.params)
+        req.out_ids.append(int(first[0]))
+
+    def _sample_one(self, logits, params: SamplingParams):
+        self._rng, sub = jax.random.split(self._rng)
+        return np.asarray(sample_logits(logits, sub, params.temperature,
+                                        params.top_k))
+
+    def _sample_and_retire(self, logits, rng):
+        by_temp: dict[tuple, list[int]] = {}
+        for slot, req in self._active.items():
+            by_temp.setdefault(
+                (req.params.temperature, req.params.top_k), []).append(slot)
+        next_tokens = {}
+        for (temp, top_k), slots in by_temp.items():
+            sampled = np.asarray(sample_logits(
+                logits[jnp.asarray(slots)], rng, temp, top_k))
+            for s, t in zip(slots, sampled):
+                next_tokens[s] = int(t)
+        eos = getattr(self.tokenizer, "eos_id",
+                      getattr(self.tokenizer, "eos_token_id", None))
+        for slot in list(self._active):
+            req = self._active[slot]
+            tok = next_tokens[slot]
+            req.out_ids.append(tok)
+            stop = (len(req.out_ids) >= req.params.max_tokens
+                    or tok == eos or tok in req.params.stop_token_ids
+                    or int(self.cache["lengths"][slot])
+                    >= self.cfg.max_seq_len - 1)
+            if stop:
+                req.done = True
+                req.event.set()
+                del self._active[slot]
+                self._free_slots.append(slot)
+
+    def _result(self, req: _Request) -> dict:
+        out = req.out_ids
+        eos = getattr(self.tokenizer, "eos_id", None)
+        trimmed = [t for t in out if t != eos]
+        return {
+            "text": self.tokenizer.decode(trimmed),
+            "token_ids": out,
+            "prompt_tokens": len(req.prompt_ids),
+            "finish_reason": ("stop" if eos is not None and eos in out
+                              else "length"),
+        }

@@ -1,0 +1,122 @@
+"""serve.llm analog: the engine behind a Serve deployment.
+
+Reference parity: llm/_internal/serve/deployments/llm/llm_server.py:409
+(LLMServer — async request intake feeding the engine loop) and :704
+(LLMDeployment — the Serve wrapper); router surface matches the OpenAI
+completions shape the reference's router exposes.
+
+TPU note (reference analog: LLMConfig -> PG bundles for TP×PP workers,
+configs/server_models.py:391-415): the engine's model runs under the current
+process's mesh; multi-chip TP serving shards the same jitted programs over a
+tp axis — replicas gang-schedule via the deployment's ray_actor_options
+TPU resources.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Optional
+
+from .engine import EngineConfig, InferenceEngine, SamplingParams
+
+
+@dataclasses.dataclass
+class LLMConfig:
+    """(reference: llm/_internal/serve/configs/server_models.py LLMConfig)"""
+    model_id: str = "llama-tiny"
+    engine: Optional[EngineConfig] = None
+    num_replicas: int = 1
+    max_ongoing_requests: int = 64
+    tpus_per_replica: float = 0.0
+
+
+class LLMServer:
+    """Deployment callable: background engine thread + request futures
+    (reference: llm_server.py:409)."""
+
+    def __init__(self, cfg: LLMConfig, params_ref=None):
+        from ..models import llama
+        engine_cfg = cfg.engine or EngineConfig(model=llama.llama_tiny())
+        params = None
+        if params_ref is not None:
+            import ray_tpu
+            params = ray_tpu.get(params_ref)
+        self.engine = InferenceEngine(engine_cfg, params)
+        self.model_id = cfg.model_id
+        self._wake = threading.Event()
+        self._stop = False
+        self._error: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        try:
+            while not self._stop:
+                if self.engine.has_work():
+                    self.engine.step()
+                else:
+                    self._wake.wait(timeout=0.05)
+                    self._wake.clear()
+        except BaseException as e:  # noqa: BLE001 — engine died: fail fast
+            self._error = e
+            # unblock every waiter; completions() re-raises the error, and
+            # check_health makes the controller replace this replica
+            for req in (list(self.engine._active.values())
+                        + list(self.engine._pending)):
+                req.event.set()
+
+    # -- OpenAI-ish surface ------------------------------------------------
+
+    def completions(self, request: dict) -> dict:
+        """{"prompt": str, "max_tokens": int, "temperature": float, ...}
+        -> completions response."""
+        prompt = request.get("prompt", "")
+        sp = SamplingParams(
+            max_tokens=int(request.get("max_tokens", 64)),
+            temperature=float(request.get("temperature", 0.0)),
+            top_k=int(request.get("top_k", 0)),
+        )
+        req = self.engine.submit(prompt, sp)
+        self._wake.set()
+        while not req.event.wait(timeout=1.0):
+            if self._error is not None:
+                raise RuntimeError("llm engine loop died") from self._error
+        if self._error is not None and not req.done:
+            raise RuntimeError("llm engine loop died") from self._error
+        out = self.engine._result(req)
+        return {
+            "object": "text_completion",
+            "model": self.model_id,
+            "choices": [{
+                "text": out["text"],
+                "finish_reason": out["finish_reason"],
+                "index": 0,
+            }],
+            "usage": {
+                "prompt_tokens": out["prompt_tokens"],
+                "completion_tokens": len(out["token_ids"]),
+            },
+        }
+
+    def __call__(self, request: dict) -> dict:
+        return self.completions(request or {})
+
+    def check_health(self):
+        if self._error is not None or not self._thread.is_alive():
+            raise RuntimeError("engine loop died") from self._error
+
+
+def build_llm_deployment(cfg: LLMConfig, params_ref=None):
+    """LLMConfig -> a Serve Application (reference:
+    build_openai_app / LLMDeployment, llm_server.py:704)."""
+    from .. import serve
+    dep = serve.deployment(
+        LLMServer,
+        name=f"llm:{cfg.model_id}",
+        num_replicas=cfg.num_replicas,
+        max_ongoing_requests=cfg.max_ongoing_requests,
+        ray_actor_options=(
+            {"num_tpus": cfg.tpus_per_replica}
+            if cfg.tpus_per_replica else {}),
+    )
+    return dep.bind(cfg, params_ref)

@@ -1,0 +1,36 @@
+"""Tokenizers for the LLM stack.
+
+Offline-friendly: the default ByteTokenizer needs no vocab download (the
+image has no egress); real deployments pass a HuggingFace tokenizer name or
+object (transformers is baked in) via get_tokenizer.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+
+class ByteTokenizer:
+    """UTF-8 bytes + BOS/EOS: ids 0..255 are bytes, 256=BOS, 257=EOS."""
+
+    vocab_size = 258
+    bos_id = 256
+    eos_id = 257
+
+    def encode(self, text: str, add_bos: bool = True) -> list[int]:
+        ids = list(text.encode("utf-8"))
+        return ([self.bos_id] if add_bos else []) + ids
+
+    def decode(self, ids) -> str:
+        data = bytes(i for i in ids if 0 <= int(i) < 256)
+        return data.decode("utf-8", errors="replace")
+
+
+def get_tokenizer(spec: Any = None):
+    """None -> ByteTokenizer; str -> transformers AutoTokenizer (requires a
+    local cache — no egress in CI); object -> used as-is."""
+    if spec is None:
+        return ByteTokenizer()
+    if isinstance(spec, str):
+        from transformers import AutoTokenizer
+        return AutoTokenizer.from_pretrained(spec)
+    return spec

@@ -179,12 +179,8 @@ def _attention(q, k, v, cfg: LlamaConfig, causal: bool, attn_impl):
     return mha_reference(q, k, v, causal=causal)
 
 
-def _layer(x, layer_params, cfg: LlamaConfig, cos, sin, attn_impl,
-           kv_cache=None, cache_idx=None):
-    """One transformer block. x [B, S, D]. Returns (x, new_kv) where new_kv
-    is None in training mode."""
-    p = layer_params
-    h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
+def _qkv(h, p, cfg: LlamaConfig, cos, sin):
+    """Projections + RoPE, shared by every forward mode. h [B, S, D]."""
     b, s, _ = h.shape
     q = (h @ p["wq"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
     k = (h @ p["wk"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
@@ -193,6 +189,25 @@ def _layer(x, layer_params, cfg: LlamaConfig, cos, sin, attn_impl,
     k = apply_rope(k, cos, sin)
     q = constrain(q, ("batch", "sequence", "heads", "head_dim"))
     k = constrain(k, ("batch", "sequence", "kv_heads", "head_dim"))
+    return q, k, v
+
+
+def _mlp_block(x, p, cfg: LlamaConfig):
+    """Post-attention SwiGLU MLP with residual, shared by every mode."""
+    h = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
+    gate = jax.nn.silu(h @ p["w_gate"])
+    x = x + (gate * (h @ p["w_up"])) @ p["w_down"]
+    return constrain(x, ("batch", "sequence", "embed"))
+
+
+def _layer(x, layer_params, cfg: LlamaConfig, cos, sin, attn_impl,
+           kv_cache=None, cache_idx=None):
+    """One transformer block. x [B, S, D]. Returns (x, new_kv) where new_kv
+    is None in training mode."""
+    p = layer_params
+    h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
+    b, s, _ = h.shape
+    q, k, v = _qkv(h, p, cfg, cos, sin)
 
     new_kv = None
     if kv_cache is not None:
@@ -221,13 +236,7 @@ def _layer(x, layer_params, cfg: LlamaConfig, cos, sin, attn_impl,
     attn = attn.reshape(b, s, cfg.n_heads * cfg.head_dim)
     x = x + attn @ p["wo"]
     x = constrain(x, ("batch", "sequence", "embed"))
-
-    h = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
-    gate = jax.nn.silu(h @ p["w_gate"])
-    up = h @ p["w_up"]
-    x = x + (gate * up) @ p["w_down"]
-    x = constrain(x, ("batch", "sequence", "embed"))
-    return x, new_kv
+    return _mlp_block(x, p, cfg), new_kv
 
 
 # ---------------------------------------------------------------------------
@@ -288,6 +297,86 @@ def apply_decode(params: dict, tokens: jax.Array, cache: dict,
                         preferred_element_type=jnp.float32)
     new_cache = {"k": new_k, "v": new_v,
                  "idx": cache["idx"] + tokens.shape[1]}
+    return logits, new_cache
+
+
+# ---------------------------------------------------------------------------
+# Continuous-batching cache (slot-based; used by the llm engine)
+# ---------------------------------------------------------------------------
+
+def init_slot_cache(cfg: LlamaConfig, max_batch: int, max_len: int) -> dict:
+    """Per-slot KV cache: each batch row is an independent request with its
+    own length (unlike init_kv_cache's single shared position)."""
+    shape = (cfg.n_layers, max_batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype),
+            "lengths": jnp.zeros((max_batch,), jnp.int32)}
+
+
+def apply_with_kv(params: dict, tokens: jax.Array, cfg: LlamaConfig):
+    """Prefill forward returning per-layer rope'd K/V for cache seeding:
+    tokens [B, S] -> (logits [B, S, V], k/v [L, B, S, KVH, D])."""
+    x = params["embed"][tokens].astype(cfg.dtype)
+    positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
+    cos, sin = rope_freqs(cfg, positions)
+
+    def body(x, layer_params):
+        p = layer_params
+        h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
+        b, s, _ = h.shape
+        q, k, v = _qkv(h, p, cfg, cos, sin)
+        attn = _attention(q, k, v, cfg, causal=True, attn_impl=None)
+        x = x + attn.reshape(b, s, -1) @ p["wo"]
+        x = constrain(x, ("batch", "sequence", "embed"))
+        return _mlp_block(x, p, cfg), (k, v)
+
+    x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"],
+                        preferred_element_type=jnp.float32)
+    return logits, ks, vs
+
+
+def decode_batched(params: dict, tokens: jax.Array, cache: dict,
+                   cfg: LlamaConfig) -> tuple[jax.Array, dict]:
+    """One decode step for a batch of independent slots.
+
+    tokens [B, 1] — next token per slot; cache rows advance at their own
+    `lengths`. Returns (logits [B, V], updated cache). Inactive slots should
+    carry any token; caller masks their outputs.
+    """
+    b = tokens.shape[0]
+    rows = jnp.arange(b)
+    x = params["embed"][tokens].astype(cfg.dtype)         # [B, 1, D]
+    positions = cache["lengths"][:, None]                 # [B, 1]
+    cos, sin = rope_freqs(cfg, positions)
+    k_pos = jnp.arange(cache["k"].shape[2])[None, :]      # [1, S]
+    mask = k_pos <= positions                             # [B, S]
+
+    def body(x, scanned):
+        p, (ck, cv) = scanned
+        h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
+        q, k, v = _qkv(h, p, cfg, cos, sin)
+        ck = ck.at[rows, cache["lengths"]].set(k[:, 0].astype(ck.dtype))
+        cv = cv.at[rows, cache["lengths"]].set(v[:, 0].astype(cv.dtype))
+        groups = cfg.n_heads // cfg.n_kv_heads
+        kr = jnp.repeat(ck, groups, axis=2)
+        vr = jnp.repeat(cv, groups, axis=2)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, kr,
+                            preferred_element_type=jnp.float32)
+        scores = scores * (cfg.head_dim ** -0.5)
+        scores = jnp.where(mask[:, None, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(vr.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vr)
+        x = x + attn.reshape(b, 1, -1) @ p["wo"]
+        return _mlp_block(x, p, cfg), (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["layers"], (cache["k"], cache["v"])))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"],
+                        preferred_element_type=jnp.float32)[:, 0]
+    new_cache = {"k": new_k, "v": new_v, "lengths": cache["lengths"] + 1}
     return logits, new_cache
 
 

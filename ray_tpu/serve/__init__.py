@@ -1,0 +1,36 @@
+"""ray_tpu.serve — model serving.
+
+Reference parity: python/ray/serve (controller _private/controller.py:88,
+deployment state machine deployment_state.py, pow-2 router
+request_router/pow_2_router.py:27, replicas replica.py:945, HTTP proxy
+proxy.py:709, autoscaling autoscaling_policy.py:12, public api serve/api.py).
+
+Shape here: a singleton ServeController actor reconciles declarative
+deployment specs into replica actors; DeploymentHandles route requests with
+power-of-two-choices over per-handle in-flight counts; an aiohttp proxy
+actor exposes HTTP; queue-based autoscaling adds/removes replicas between
+min/max. LLM serving (serve.llm analog) lives in ray_tpu.llm on top of this.
+
+    @serve.deployment(num_replicas=2)
+    class Model:
+        def __call__(self, x): ...
+
+    handle = serve.run(Model.bind(), name="app")
+    out = handle.remote(x).result()
+"""
+from .api import (
+    Application,
+    Deployment,
+    delete,
+    deployment,
+    get_app_handle,
+    run,
+    shutdown,
+    status,
+)
+from .handle import DeploymentHandle, DeploymentResponse
+
+__all__ = [
+    "Application", "Deployment", "deployment", "run", "shutdown", "delete",
+    "status", "get_app_handle", "DeploymentHandle", "DeploymentResponse",
+]

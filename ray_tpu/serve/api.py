@@ -1,0 +1,210 @@
+"""Public Serve API: @deployment, bind, run, status, shutdown.
+
+Reference parity: python/ray/serve/api.py (run :691, deployment decorator,
+Application/BuiltApplication model) and serve/deployment.py. Deployments are
+declarative specs; `.bind()` composes them into an application DAG whose
+non-ingress nodes are injected into their parents as DeploymentHandles
+(reference: model composition via handle passing).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+from .handle import DeploymentHandle
+
+CONTROLLER_NAME = "rtpu:serve:controller"
+
+
+@dataclasses.dataclass
+class AutoscalingConfig:
+    """(reference: serve/config.py AutoscalingConfig)"""
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 0.5
+    downscale_delay_s: float = 2.0
+
+
+@dataclasses.dataclass
+class DeploymentSpec:
+    name: str
+    func_or_class: Any
+    num_replicas: int = 1
+    max_ongoing_requests: int = 16
+    ray_actor_options: dict = dataclasses.field(default_factory=dict)
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    init_args: tuple = ()
+    init_kwargs: dict = dataclasses.field(default_factory=dict)
+
+
+class Application:
+    """A bound deployment DAG; `ingress` is the root (reference:
+    serve/_private/build_app.py BuiltApplication)."""
+
+    def __init__(self, ingress: "BoundDeployment"):
+        self.ingress = ingress
+
+    def specs(self) -> list[DeploymentSpec]:
+        out: dict[str, DeploymentSpec] = {}
+
+        def visit(node: BoundDeployment):
+            if node.spec.name in out:
+                return
+            out[node.spec.name] = node.spec
+            for dep in node.children():
+                visit(dep)
+        visit(self.ingress)
+        return list(out.values())
+
+
+class BoundDeployment:
+    def __init__(self, spec: DeploymentSpec, args: tuple, kwargs: dict):
+        self.spec = dataclasses.replace(spec, init_args=args,
+                                        init_kwargs=kwargs)
+
+    def children(self) -> list["BoundDeployment"]:
+        found = []
+        for a in list(self.spec.init_args) + list(
+                self.spec.init_kwargs.values()):
+            if isinstance(a, BoundDeployment):
+                found.append(a)
+        return found
+
+
+class Deployment:
+    """Declarative deployment template (reference: serve/deployment.py
+    Deployment). Call .bind(*init_args) to place it in an application."""
+
+    def __init__(self, spec: DeploymentSpec):
+        self._spec = spec
+
+    @property
+    def name(self) -> str:
+        return self._spec.name
+
+    def options(self, **kwargs) -> "Deployment":
+        allowed = {"name", "num_replicas", "max_ongoing_requests",
+                   "ray_actor_options", "autoscaling_config"}
+        bad = set(kwargs) - allowed
+        if bad:
+            raise ValueError(f"unknown deployment options {sorted(bad)}")
+        return Deployment(dataclasses.replace(self._spec, **kwargs))
+
+    def bind(self, *args, **kwargs) -> Application:
+        """Returns an Application rooted at this deployment. Bound child
+        applications passed as args become handles at runtime."""
+        args = tuple(a.ingress if isinstance(a, Application) else a
+                     for a in args)
+        kwargs = {k: (v.ingress if isinstance(v, Application) else v)
+                  for k, v in kwargs.items()}
+        return Application(BoundDeployment(self._spec, args, kwargs))
+
+
+def deployment(_func_or_class=None, *, name: Optional[str] = None,
+               num_replicas: int = 1, max_ongoing_requests: int = 16,
+               ray_actor_options: Optional[dict] = None,
+               autoscaling_config: Optional[dict | AutoscalingConfig] = None,
+               **_ignored) -> Any:
+    """@serve.deployment decorator (reference: serve/api.py:deployment)."""
+    if isinstance(autoscaling_config, dict):
+        autoscaling_config = AutoscalingConfig(**autoscaling_config)
+
+    def wrap(fc):
+        n = num_replicas
+        if n == "auto":
+            n = 1
+        return Deployment(DeploymentSpec(
+            name=name or getattr(fc, "__name__", "deployment"),
+            func_or_class=fc,
+            num_replicas=n,
+            max_ongoing_requests=max_ongoing_requests,
+            ray_actor_options=ray_actor_options or {},
+            autoscaling_config=autoscaling_config,
+        ))
+    if _func_or_class is not None:
+        return wrap(_func_or_class)
+    return wrap
+
+
+# ---------------------------------------------------------------------------
+# run / status / shutdown
+# ---------------------------------------------------------------------------
+
+def _ray():
+    import ray_tpu
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    return ray_tpu
+
+
+def _controller(create: bool = True):
+    ray = _ray()
+    from .controller import ServeController
+    try:
+        return ray.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        if not create:
+            raise
+    cls = ray.remote(ServeController)
+    return cls.options(name=CONTROLLER_NAME, max_concurrency=512).remote()
+
+
+def run(app: Application, *, name: str = "default",
+        route_prefix: Optional[str] = "/", blocking: bool = False,
+        http_port: Optional[int] = None) -> DeploymentHandle:
+    """Deploy an application; returns the ingress handle
+    (reference: serve/api.py:691)."""
+    import cloudpickle
+    ray = _ray()
+    ctrl = _controller()
+    specs_blob = cloudpickle.dumps(
+        (app.specs(), app.ingress.spec.name, route_prefix))
+    ray.get(ctrl.deploy_application.remote(name, specs_blob, http_port))
+    handle = DeploymentHandle(app.ingress.spec.name, name, ctrl)
+    if blocking:  # pragma: no cover - interactive use
+        import time
+        while True:
+            time.sleep(1)
+    return handle
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    ray = _ray()
+    ctrl = _controller(create=False)
+    ingress = ray.get(ctrl.get_ingress.remote(name))
+    return DeploymentHandle(ingress, name, ctrl)
+
+
+def status() -> dict:
+    ray = _ray()
+    try:
+        ctrl = _controller(create=False)
+    except ValueError:
+        return {"applications": {}}
+    return ray.get(ctrl.status.remote())
+
+
+def delete(name: str = "default") -> None:
+    ray = _ray()
+    try:
+        ctrl = _controller(create=False)
+    except ValueError:
+        return
+    ray.get(ctrl.delete_application.remote(name))
+
+
+def shutdown() -> None:
+    ray = _ray()
+    try:
+        ctrl = _controller(create=False)
+    except ValueError:
+        return
+    try:
+        ray.get(ctrl.shutdown.remote())
+    except Exception:
+        pass
+    try:
+        ray.kill(ctrl)
+    except Exception:
+        pass

@@ -1,0 +1,293 @@
+"""ServeController + ReplicaActor: the reconciling control loop.
+
+Reference parity: serve/_private/controller.py:88 (singleton controller,
+deploy_application :783), deployment_state.py (replica state machine),
+replica.py:945 (ReplicaActor), autoscaling_state.py + autoscaling_policy.py
+:12 (_calculate_desired_num_replicas over queue metrics).
+
+The controller is an async actor: `deploy_application` materializes replica
+actors for every deployment spec; a reconcile task keeps replica counts at
+target, replaces dead replicas, and autoscales queue-length-based between
+min/max replicas.
+"""
+from __future__ import annotations
+
+import asyncio
+import math
+import time
+from typing import Any, Optional
+
+from .api import AutoscalingConfig, DeploymentSpec
+
+
+class ReplicaActor:
+    """Hosts one replica of a deployment's callable (reference:
+    replica.py:945 — async execution with max_ongoing_requests enforced by
+    actor max_concurrency; here requests are counted for autoscaling
+    stats)."""
+
+    def __init__(self, spec_blob: bytes):
+        import cloudpickle
+        spec, handle_args, handle_kwargs = cloudpickle.loads(spec_blob)
+        fc = spec.func_or_class
+        self._ongoing = 0
+        self._total = 0
+        if isinstance(fc, type):
+            self._callable = fc(*handle_args, **handle_kwargs)
+        else:
+            if handle_args or handle_kwargs:
+                raise TypeError("function deployments take no init args")
+            self._callable = fc
+
+    async def handle_request(self, method: str, args: tuple, kwargs: dict):
+        self._ongoing += 1
+        self._total += 1
+        try:
+            # "__call__" covers both function deployments and class __call__
+            target = (self._callable if method == "__call__"
+                      else getattr(self._callable, method))
+            if asyncio.iscoroutinefunction(getattr(target, "__call__",
+                                                   target)) or \
+                    asyncio.iscoroutinefunction(target):
+                out = target(*args, **kwargs)
+            else:
+                # sync callables must not block the replica's event loop
+                # (reference: replica.py runs sync user code in a thread)
+                loop = asyncio.get_event_loop()
+                out = await loop.run_in_executor(
+                    None, lambda: target(*args, **kwargs))
+            if asyncio.iscoroutine(out):
+                out = await out
+            return out
+        finally:
+            self._ongoing -= 1
+
+    async def stats(self) -> dict:
+        return {"ongoing": self._ongoing, "total": self._total}
+
+    async def reconfigure(self, user_config: Any):
+        if hasattr(self._callable, "reconfigure"):
+            self._callable.reconfigure(user_config)
+
+    async def health_check(self) -> bool:
+        if hasattr(self._callable, "check_health"):
+            self._callable.check_health()
+        return True
+
+
+class _DeploymentState:
+    def __init__(self, spec: DeploymentSpec, app: str, version_counter):
+        self.spec = spec
+        self.app = app
+        self.replicas: list = []          # actor handles
+        self.target = spec.num_replicas
+        if spec.autoscaling_config:
+            self.target = spec.autoscaling_config.min_replicas
+        # versions are controller-global monotonic so a redeploy can never
+        # collide with a cached handle's last-seen version
+        self._vc = version_counter
+        self.version = next(version_counter)
+        self._last_scale_up = 0.0
+        self._last_scale_down = 0.0
+
+    def bump(self):
+        self.version = next(self._vc)
+
+
+class ServeController:
+    """Singleton control plane (reference: controller.py:88)."""
+
+    def __init__(self):
+        import itertools
+        self._apps: dict[str, dict[str, _DeploymentState]] = {}
+        self._ingress: dict[str, str] = {}
+        self._proxy = None
+        self._reconcile_task = None
+        self._shutdown = False
+        self._version_counter = itertools.count(1)
+        self._ticks = 0
+
+    # -- deploy ------------------------------------------------------------
+
+    async def deploy_application(self, app_name: str, specs_blob: bytes,
+                                 http_port: Optional[int] = None) -> None:
+        import cloudpickle
+        specs, ingress, route_prefix = cloudpickle.loads(specs_blob)
+        if app_name in self._apps:  # redeploy: tear down the old replicas
+            await self.delete_application(app_name)
+        states: dict[str, _DeploymentState] = {}
+        for spec in specs:
+            states[spec.name] = _DeploymentState(spec, app_name,
+                                                 self._version_counter)
+        self._apps[app_name] = states
+        self._ingress[app_name] = ingress
+        for st in states.values():
+            await self._scale_to_target(st)
+        if http_port is not None:
+            await self._ensure_proxy(http_port)
+        if self._reconcile_task is None:
+            self._reconcile_task = asyncio.get_event_loop().create_task(
+                self._reconcile_loop())
+
+    def _replica_blob(self, spec: DeploymentSpec) -> bytes:
+        import cloudpickle
+        from .api import BoundDeployment
+        from .handle import DeploymentHandle
+        # bound children become live handles (model composition)
+        def conv(a):
+            if isinstance(a, BoundDeployment):
+                import ray_tpu
+                ctrl = ray_tpu.get_actor("rtpu:serve:controller")
+                return DeploymentHandle(a.spec.name, spec_app(a), ctrl)
+            return a
+
+        def spec_app(bound):  # child deployments live in the same app
+            for app, states in self._apps.items():
+                if bound.spec.name in states:
+                    return app
+            return "default"
+
+        args = tuple(conv(a) for a in spec.init_args)
+        kwargs = {k: conv(v) for k, v in spec.init_kwargs.items()}
+        return cloudpickle.dumps((spec, args, kwargs))
+
+    async def _start_replica(self, st: _DeploymentState):
+        import ray_tpu
+        cls = ray_tpu.remote(ReplicaActor)
+        opts = dict(st.spec.ray_actor_options)
+        actor = cls.options(
+            num_cpus=opts.get("num_cpus", 0.1),
+            num_tpus=opts.get("num_tpus", 0),
+            resources=opts.get("resources"),
+            max_concurrency=max(st.spec.max_ongoing_requests, 1),
+        ).remote(self._replica_blob(st.spec))
+        st.replicas.append(actor)
+        st.bump()
+
+    async def _scale_to_target(self, st: _DeploymentState):
+        while len(st.replicas) < st.target:
+            await self._start_replica(st)
+        while len(st.replicas) > st.target:
+            import ray_tpu
+            victim = st.replicas.pop()
+            st.bump()
+            try:
+                ray_tpu.kill(victim)
+            except Exception:
+                pass
+
+    # -- routing state -----------------------------------------------------
+
+    async def get_replicas(self, app: str, deployment: str):
+        st = self._apps.get(app, {}).get(deployment)
+        if st is None:
+            raise ValueError(f"no deployment {deployment!r} in app {app!r}")
+        return st.version, list(st.replicas)
+
+    async def get_ingress(self, app: str) -> str:
+        if app not in self._ingress:
+            raise ValueError(f"no application {app!r}")
+        return self._ingress[app]
+
+    async def status(self) -> dict:
+        out: dict = {"applications": {}}
+        for app, states in self._apps.items():
+            out["applications"][app] = {
+                "ingress": self._ingress.get(app),
+                "deployments": {
+                    name: {"target_replicas": st.target,
+                           "running_replicas": len(st.replicas),
+                           "autoscaling": st.spec.autoscaling_config
+                           is not None}
+                    for name, st in states.items()},
+            }
+        return out
+
+    async def delete_application(self, app: str) -> None:
+        import ray_tpu
+        states = self._apps.pop(app, None)
+        self._ingress.pop(app, None)
+        if not states:
+            return
+        for st in states.values():
+            for r in st.replicas:
+                try:
+                    ray_tpu.kill(r)
+                except Exception:
+                    pass
+
+    async def shutdown(self) -> None:
+        self._shutdown = True
+        for app in list(self._apps):
+            await self.delete_application(app)
+        if self._proxy is not None:
+            import ray_tpu
+            try:
+                ray_tpu.kill(self._proxy)
+            except Exception:
+                pass
+
+    # -- reconcile + autoscaling ------------------------------------------
+
+    async def _reconcile_loop(self):
+        import ray_tpu
+        while not self._shutdown:
+            await asyncio.sleep(0.25)
+            self._ticks += 1
+            deep = self._ticks % 4 == 0  # user health_check every ~1s
+            for states in list(self._apps.values()):
+                for st in list(states.values()):
+                    alive = []
+                    ongoing = 0
+                    for r in st.replicas:
+                        try:
+                            s = await r.stats.remote()
+                            if deep:
+                                await r.health_check.remote()
+                            ongoing += s["ongoing"]
+                            alive.append(r)
+                        except Exception:
+                            # dead or failing health: drop from routing and
+                            # kill so _scale_to_target replaces it
+                            st.bump()
+                            try:
+                                ray_tpu.kill(r)
+                            except Exception:
+                                pass
+                    st.replicas = alive
+                    cfg = st.spec.autoscaling_config
+                    if cfg is not None:
+                        self._autoscale(st, cfg, ongoing)
+                    await self._scale_to_target(st)
+
+    def _autoscale(self, st: _DeploymentState, cfg: AutoscalingConfig,
+                   total_ongoing: int):
+        """(reference: autoscaling_policy.py:12
+        _calculate_desired_num_replicas)"""
+        now = time.monotonic()
+        desired = math.ceil(total_ongoing / max(cfg.target_ongoing_requests,
+                                                1e-9))
+        desired = max(cfg.min_replicas, min(cfg.max_replicas, desired))
+        if desired > st.target and \
+                now - self._last(st, "up") >= cfg.upscale_delay_s:
+            st.target = desired
+            st._last_scale_up = now
+        elif desired < st.target and \
+                now - self._last(st, "down") >= cfg.downscale_delay_s:
+            st.target = desired
+            st._last_scale_down = now
+
+    @staticmethod
+    def _last(st: _DeploymentState, which: str) -> float:
+        return st._last_scale_up if which == "up" else st._last_scale_down
+
+    # -- HTTP proxy --------------------------------------------------------
+
+    async def _ensure_proxy(self, port: int):
+        if self._proxy is not None:
+            return
+        import ray_tpu
+        from .proxy import ProxyActor
+        cls = ray_tpu.remote(ProxyActor)
+        self._proxy = cls.options(max_concurrency=512).remote(port)
+        await self._proxy.start.remote()

@@ -1,0 +1,151 @@
+"""DeploymentHandle: the client-side router.
+
+Reference parity: serve/handle.py:639 (DeploymentHandle.remote :715 ->
+DeploymentResponse), _private/router.py:365 (AsyncioRouter.assign_request
+:676) and request_router/pow_2_router.py:27 (power-of-two-choices).
+
+Routing here tracks in-flight counts per handle (each handle routes its own
+traffic) and picks the lighter of two random replicas; the replica set is
+cached and refreshed from the controller when its version changes or a
+replica dies mid-call (retried once on a fresh set).
+"""
+from __future__ import annotations
+
+import random
+import time
+from typing import Any, Optional
+
+
+class DeploymentResponse:
+    """Future for one request (reference: handle.py DeploymentResponse).
+    `.result()` blocks; `await` works inside async actors; passing a
+    response to another .remote() passes the underlying ObjectRef so the
+    payload never bounces through the caller.
+
+    `.result()` retries once on a fresh replica set when the chosen replica
+    died (scale-down or crash race against the handle's cached set)."""
+
+    def __init__(self, ref, on_done, retry=None):
+        self._ref = ref
+        self._done = False
+        self._on_done = on_done
+        self._retry = retry
+
+    def result(self, timeout_s: Optional[float] = None) -> Any:
+        import ray_tpu
+        from ..exceptions import ActorDiedError, WorkerCrashedError
+        try:
+            try:
+                return ray_tpu.get(self._ref, timeout=timeout_s)
+            except (ActorDiedError, WorkerCrashedError):
+                if self._retry is None:
+                    raise
+                self._ref = self._retry()
+                return ray_tpu.get(self._ref, timeout=timeout_s)
+        finally:
+            self._settle()
+
+    def _settle(self):
+        if not self._done:
+            self._done = True
+            self._on_done()
+
+    def _to_object_ref(self):
+        return self._ref
+
+    def __await__(self):
+        def gen():
+            try:
+                out = yield from self._ref.__await__()
+                return out
+            finally:
+                self._settle()
+        return gen()
+
+
+class DeploymentHandle:
+    def __init__(self, deployment: str, app: str, controller,
+                 method: str = "__call__"):
+        self.deployment_name = deployment
+        self.app_name = app
+        self._ctrl = controller
+        self._method = method
+        self._replicas: list = []
+        self._version = -1
+        self._inflight: dict[int, int] = {}
+        self._last_refresh = 0.0
+
+    # handles pickle into replicas/tasks; router state is rebuilt lazily
+    def __reduce__(self):
+        return (DeploymentHandle,
+                (self.deployment_name, self.app_name, self._ctrl,
+                 self._method))
+
+    def options(self, method_name: Optional[str] = None,
+                **_ignored) -> "DeploymentHandle":
+        return DeploymentHandle(self.deployment_name, self.app_name,
+                                self._ctrl, method_name or self._method)
+
+    def __getattr__(self, name: str) -> "DeploymentHandle":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return DeploymentHandle(self.deployment_name, self.app_name,
+                                self._ctrl, name)
+
+    # -- routing ----------------------------------------------------------
+
+    def _refresh(self, force: bool = False):
+        import ray_tpu
+        now = time.monotonic()
+        if not force and self._replicas and now - self._last_refresh < 2.0:
+            return
+        version, replicas = ray_tpu.get(self._ctrl.get_replicas.remote(
+            self.app_name, self.deployment_name))
+        if version != self._version:
+            self._version = version
+            self._replicas = replicas
+            self._inflight = {i: 0 for i in range(len(replicas))}
+        self._last_refresh = now
+
+    def _pick(self) -> int:
+        """Power-of-two-choices over local in-flight counts
+        (reference: pow_2_router.py:27)."""
+        n = len(self._replicas)
+        if n == 1:
+            return 0
+        i, j = random.sample(range(n), 2)
+        return i if self._inflight.get(i, 0) <= self._inflight.get(j, 0) \
+            else j
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        import ray_tpu
+        self._refresh()
+        deadline = time.monotonic() + 30.0
+        while not self._replicas:
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"no replicas for {self.deployment_name!r}")
+            time.sleep(0.05)
+            self._refresh(force=True)
+        args = tuple(a._to_object_ref() if isinstance(a, DeploymentResponse)
+                     else a for a in args)
+        kwargs = {k: (v._to_object_ref()
+                      if isinstance(v, DeploymentResponse) else v)
+                  for k, v in kwargs.items()}
+        idx = self._pick()
+        replica = self._replicas[idx]
+        self._inflight[idx] = self._inflight.get(idx, 0) + 1
+
+        def done(i=idx):
+            self._inflight[i] = max(0, self._inflight.get(i, 1) - 1)
+
+        def retry():
+            self._refresh(force=True)
+            if not self._replicas:
+                raise RuntimeError(
+                    f"no replicas for {self.deployment_name!r}")
+            r = self._replicas[self._pick()]
+            return r.handle_request.remote(self._method, args, kwargs)
+
+        ref = replica.handle_request.remote(self._method, args, kwargs)
+        return DeploymentResponse(ref, done, retry)

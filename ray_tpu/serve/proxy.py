@@ -1,0 +1,82 @@
+"""HTTP proxy actor (aiohttp).
+
+Reference parity: serve/_private/proxy.py:709 HTTPProxy / :1059 ProxyActor —
+uvicorn/Starlette there, aiohttp here (what the image ships). Routes
+`/<app_name>` (and `/` for the default app) to the app's ingress handle:
+JSON bodies become the callable's argument, JSON-able returns become the
+response body.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Optional
+
+
+class ProxyActor:
+    def __init__(self, port: int):
+        self._port = port
+        self._runner = None
+
+    async def start(self) -> int:
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._dispatch)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", self._port)
+        await site.start()
+        return self._port
+
+    async def _dispatch(self, request):
+        from aiohttp import web
+        import ray_tpu
+        from .handle import DeploymentHandle
+        from .api import CONTROLLER_NAME
+
+        path = request.match_info["tail"].strip("/")
+        app_name = path.split("/", 1)[0] if path else "default"
+        ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+        try:
+            ingress = ray_tpu.get(ctrl.get_ingress.remote(app_name))
+        except ValueError:
+            if app_name != "default":
+                try:
+                    ingress = ray_tpu.get(
+                        ctrl.get_ingress.remote("default"))
+                    app_name = "default"
+                except ValueError:
+                    return web.json_response(
+                        {"error": f"no app {app_name!r}"}, status=404)
+            else:
+                return web.json_response(
+                    {"error": "no default app"}, status=404)
+
+        payload: Optional[dict] = None
+        if request.can_read_body:
+            try:
+                payload = await request.json()
+            except Exception:
+                payload = {"body": (await request.read()).decode(
+                    errors="replace")}
+
+        def call():
+            # handle.remote() itself may block (replica-set refresh, cold
+            # start wait) — keep ALL of it off the proxy's event loop
+            handle = DeploymentHandle(ingress, app_name, ctrl)
+            resp = (handle.remote(payload) if payload is not None
+                    else handle.remote())
+            return resp.result(30.0)
+
+        loop = asyncio.get_event_loop()
+        out = await loop.run_in_executor(None, call)
+        try:
+            return web.json_response(out)
+        except TypeError:
+            return web.Response(text=json.dumps(str(out)),
+                                content_type="application/json")
+
+    async def stop(self):
+        if self._runner is not None:
+            await self._runner.cleanup()

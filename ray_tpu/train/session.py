@@ -27,6 +27,9 @@ class TrainContext:
     dataset_shards: Optional[dict] = None
     _bus: Any = None
     _seq: int = 0
+    # Tune trials report decision-synchronously: report() parks until the
+    # controller answers, and a STOP answer raises StopTrial
+    sync_decisions: bool = False
 
     def get_world_size(self) -> int:
         return self.world_size
@@ -57,6 +60,12 @@ def get_context() -> TrainContext:
     return ctx
 
 
+class StopTrial(BaseException):
+    """Scheduler-initiated graceful trial stop (reference analog: the
+    StopIteration path of tune function trainables). BaseException so user
+    `except Exception` blocks don't swallow it."""
+
+
 def report(metrics: dict, checkpoint: Optional[Checkpoint] = None) -> None:
     """Stream metrics (and optionally a checkpoint) to the controller
     (reference: train_fn_utils.py:13). Every rank should call report with
@@ -66,8 +75,14 @@ def report(metrics: dict, checkpoint: Optional[Checkpoint] = None) -> None:
     ctx = get_context()
     ctx._seq += 1
     ckpt_path = checkpoint.path if checkpoint is not None else None
-    ray_tpu.get(ctx._bus.push.remote(
-        ctx.rank, ctx._seq, dict(metrics), ckpt_path))
+    if ctx.sync_decisions:
+        decision = ray_tpu.get(ctx._bus.push_wait.remote(
+            ctx.rank, ctx._seq, dict(metrics), ckpt_path))
+        if decision == "STOP":
+            raise StopTrial()
+    else:
+        ray_tpu.get(ctx._bus.push.remote(
+            ctx.rank, ctx._seq, dict(metrics), ckpt_path))
 
 
 def get_checkpoint() -> Optional[Checkpoint]:

@@ -52,18 +52,48 @@ class Result:
 class _ResultBus:
     """Async rendezvous actor carrying report() traffic worker→controller
     (reference analog: the report queue + sync actor of
-    train/v2/_internal/execution/checkpoint/sync_actor.py)."""
+    train/v2/_internal/execution/checkpoint/sync_actor.py).
+
+    Two report modes: fire-and-forget `push` (Train workers) and
+    decision-synchronous `push_wait` (Tune trials — the reporter parks until
+    the controller answers CONTINUE/STOP, making scheduler decisions
+    deterministic regardless of trial speed)."""
 
     def __init__(self):
+        import asyncio
+        self._asyncio = asyncio
         self._events: list[tuple] = []
+        self._decisions: dict[tuple, str] = {}
+        self._waiters: dict[tuple, object] = {}
 
     async def push(self, rank: int, seq: int, metrics: dict,
                    ckpt_path: Optional[str]):
         self._events.append((rank, seq, metrics, ckpt_path))
 
+    async def push_wait(self, rank: int, seq: int, metrics: dict,
+                        ckpt_path: Optional[str]) -> str:
+        key = (rank, seq)
+        ev = self._asyncio.Event()
+        self._waiters[key] = ev
+        self._events.append((rank, seq, metrics, ckpt_path))
+        await ev.wait()
+        return self._decisions.pop(key, "CONTINUE")
+
+    async def decide(self, rank: int, seq: int, decision: str):
+        key = (rank, seq)
+        self._decisions[key] = decision
+        ev = self._waiters.pop(key, None)
+        if ev is not None:
+            ev.set()
+
     async def drain(self) -> list[tuple]:
         out, self._events = self._events, []
         return out
+
+    async def debug_state(self) -> dict:
+        return {"events": len(self._events),
+                "waiters": list(self._waiters),
+                "decisions": list(self._decisions)}
 
 
 class _TrainWorker:

@@ -1,0 +1,39 @@
+"""ray_tpu.tune — hyperparameter search.
+
+Reference parity: python/ray/tune (Tuner tuner.py:43, TuneController
+execution/tune_controller.py:68, schedulers/ — ASHA async_hyperband.py, PBT
+pbt.py, median stopping; search spaces tune/search/sample.py, grid/random
+search via BasicVariantGenerator). Trials are actors gang-scheduled by the
+core runtime; results stream over the same report bus the Train library
+uses (`tune.report` is `train.report`, matching the unified v2 API).
+"""
+from .search import (
+    choice,
+    grid_search,
+    loguniform,
+    qrandint,
+    quniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from .schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+)
+from .tuner import ResultGrid, TuneConfig, Tuner
+from ..train.session import get_context
+from ..train import Checkpoint
+
+# unified report API (reference: ray.tune.report == ray.train.report in v2)
+from ..train.session import report, get_checkpoint  # noqa: F401
+
+__all__ = [
+    "Tuner", "TuneConfig", "ResultGrid", "grid_search", "choice", "uniform",
+    "loguniform", "randint", "qrandint", "quniform", "sample_from",
+    "FIFOScheduler", "ASHAScheduler", "MedianStoppingRule",
+    "PopulationBasedTraining", "report", "get_checkpoint", "get_context",
+    "Checkpoint",
+]

@@ -1,0 +1,167 @@
+"""Trial schedulers: early stopping and population-based training.
+
+Reference parity: python/ray/tune/schedulers/ — FIFO (fifo.py), ASHA
+(async_hyperband.py AsyncHyperBandScheduler), median stopping
+(median_stopping_rule.py), PBT (pbt.py). The controller feeds every
+reported result to `on_result(trial, result)`; the scheduler answers
+CONTINUE / STOP, and PBT additionally mutates trial configs via
+`exploit_target(trial)`.
+"""
+from __future__ import annotations
+
+import random
+from collections import defaultdict
+from typing import Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    """Run every trial to completion (reference: fifo.py)."""
+
+    def setup(self, metric: str, mode: str):
+        self.metric, self.mode = metric, mode
+
+    def on_result(self, trial, result: dict) -> str:
+        return CONTINUE
+
+
+class ASHAScheduler(FIFOScheduler):
+    """Asynchronous successive halving (reference: async_hyperband.py).
+
+    Rungs at grace_period * reduction_factor^k; at each rung a trial stops
+    unless its metric is in the top 1/reduction_factor of results recorded
+    at that rung so far.
+    """
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: int = 4):
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.grace = grace_period
+        self.rf = reduction_factor
+        self.rungs: list[int] = []
+        t = grace_period
+        while t < max_t:
+            self.rungs.append(t)
+            t *= reduction_factor
+        self.rung_results: dict[int, list[float]] = defaultdict(list)
+        self._passed: dict[tuple, set] = defaultdict(set)
+
+    def on_result(self, trial, result: dict) -> str:
+        t = result.get(self.time_attr, 0)
+        val = result.get(self.metric)
+        if val is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        for rung in self.rungs:
+            if t >= rung and rung not in self._passed[(trial.trial_id,)]:
+                self._passed[(trial.trial_id,)].add(rung)
+                recorded = self.rung_results[rung]
+                recorded.append(val if self.mode == "max" else -val)
+                v = val if self.mode == "max" else -val
+                if len(recorded) >= self.rf:
+                    cutoff = sorted(recorded, reverse=True)[
+                        max(0, len(recorded) // self.rf - 1)]
+                    if v < cutoff:
+                        return STOP
+        return CONTINUE
+
+
+class MedianStoppingRule(FIFOScheduler):
+    """Stop a trial whose running-average metric falls below the median of
+    other trials' averages at the same step (reference:
+    median_stopping_rule.py)."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        self.time_attr = time_attr
+        self.grace = grace_period
+        self.min_samples = min_samples_required
+        self._sums: dict[str, float] = defaultdict(float)
+        self._counts: dict[str, int] = defaultdict(int)
+
+    def on_result(self, trial, result: dict) -> str:
+        t = result.get(self.time_attr, 0)
+        val = result.get(self.metric)
+        if val is None:
+            return CONTINUE
+        tid = trial.trial_id
+        self._sums[tid] += val if self.mode == "max" else -val
+        self._counts[tid] += 1
+        if t < self.grace or len(self._counts) < self.min_samples:
+            return CONTINUE
+        means = [self._sums[k] / self._counts[k]
+                 for k in self._counts if k != tid]
+        if not means:
+            return CONTINUE
+        my_mean = self._sums[tid] / self._counts[tid]
+        med = sorted(means)[len(means) // 2]
+        return STOP if my_mean < med else CONTINUE
+
+
+class PopulationBasedTraining(FIFOScheduler):
+    """PBT (reference: pbt.py): every perturbation_interval, bottom-quantile
+    trials clone a top-quantile trial's checkpoint + config, with
+    hyperparameters perturbed (×0.8 / ×1.2 or resampled)."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 perturbation_interval: int = 4,
+                 hyperparam_mutations: Optional[dict] = None,
+                 quantile_fraction: float = 0.25, seed: int = 0):
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.rng = random.Random(seed)
+        self._last: dict[str, dict] = {}       # trial_id -> last result
+        self._last_perturb: dict[str, int] = defaultdict(int)
+
+    def on_result(self, trial, result: dict) -> str:
+        self._last[trial.trial_id] = result
+        return CONTINUE
+
+    def should_perturb(self, trial, result: dict) -> bool:
+        t = result.get(self.time_attr, 0)
+        return t - self._last_perturb[trial.trial_id] >= self.interval
+
+    def exploit_target(self, trial, all_trials) -> Optional[object]:
+        """The trial to clone from, or None if `trial` is healthy."""
+        scored = []
+        for tr in all_trials:
+            res = self._last.get(tr.trial_id)
+            if res is None or self.metric not in res:
+                continue
+            v = res[self.metric]
+            scored.append((v if self.mode == "max" else -v, tr))
+        if len(scored) < 2:
+            return None
+        scored.sort(key=lambda x: x[0])
+        n_q = max(1, int(len(scored) * self.quantile))
+        bottom = [tr for _, tr in scored[:n_q]]
+        top = [tr for _, tr in scored[-n_q:]]
+        if any(tr.trial_id == trial.trial_id for tr in bottom):
+            self._last_perturb[trial.trial_id] = self._last.get(
+                trial.trial_id, {}).get(self.time_attr, 0)
+            return self.rng.choice(top)
+        self._last_perturb[trial.trial_id] = self._last.get(
+            trial.trial_id, {}).get(self.time_attr, 0)
+        return None
+
+    def perturb_config(self, config: dict) -> dict:
+        out = dict(config)
+        for key, spec in self.mutations.items():
+            if callable(spec):
+                out[key] = spec()
+            elif isinstance(spec, list):
+                out[key] = self.rng.choice(spec)
+            elif isinstance(spec, tuple) and len(spec) == 2:
+                lo, hi = spec
+                out[key] = self.rng.uniform(lo, hi)
+            else:
+                factor = self.rng.choice([0.8, 1.2])
+                out[key] = config[key] * factor
+        return out

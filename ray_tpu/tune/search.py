@@ -1,0 +1,137 @@
+"""Search spaces + variant generation.
+
+Reference parity: python/ray/tune/search/sample.py (Categorical/Float/
+Integer domains, grid_search) and search/basic_variant.py
+(BasicVariantGenerator — grid cross-product × num_samples random draws).
+"""
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Callable
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Categorical(Domain):
+    def __init__(self, categories):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class Uniform(Domain):
+    def __init__(self, low, high):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+class LogUniform(Domain):
+    def __init__(self, low, high):
+        import math
+        self.lo, self.hi = math.log(low), math.log(high)
+
+    def sample(self, rng):
+        import math
+        return math.exp(rng.uniform(self.lo, self.hi))
+
+
+class QUniform(Domain):
+    def __init__(self, low, high, q):
+        self.low, self.high, self.q = low, high, q
+
+    def sample(self, rng):
+        v = rng.uniform(self.low, self.high)
+        return round(v / self.q) * self.q
+
+
+class RandInt(Domain):
+    def __init__(self, low, high):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+class QRandInt(Domain):
+    def __init__(self, low, high, q):
+        self.low, self.high, self.q = low, high, q
+
+    def sample(self, rng):
+        return (rng.randrange(self.low, self.high) // self.q) * self.q
+
+
+class Function(Domain):
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def sample(self, rng):
+        return self.fn(None)
+
+
+class GridSearch:
+    def __init__(self, values):
+        self.values = list(values)
+
+
+def grid_search(values) -> GridSearch:
+    return GridSearch(values)
+
+
+def choice(categories) -> Categorical:
+    return Categorical(categories)
+
+
+def uniform(low, high) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low, high) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def quniform(low, high, q) -> QUniform:
+    return QUniform(low, high, q)
+
+
+def randint(low, high) -> RandInt:
+    return RandInt(low, high)
+
+
+def qrandint(low, high, q) -> QRandInt:
+    return QRandInt(low, high, q)
+
+
+def sample_from(fn: Callable) -> Function:
+    return Function(fn)
+
+
+def generate_variants(param_space: dict, num_samples: int,
+                      seed: int = 0) -> list[dict]:
+    """Grid axes cross-product × num_samples draws of stochastic domains
+    (reference: BasicVariantGenerator semantics)."""
+    rng = random.Random(seed)
+    grid_keys = [k for k, v in param_space.items()
+                 if isinstance(v, GridSearch)]
+    grid_values = [param_space[k].values for k in grid_keys]
+    variants = []
+    for _ in range(num_samples):
+        for combo in itertools.product(*grid_values) if grid_keys else [()]:
+            cfg = {}
+            for k, v in param_space.items():
+                if isinstance(v, GridSearch):
+                    cfg[k] = combo[grid_keys.index(k)]
+                elif isinstance(v, Domain):
+                    cfg[k] = v.sample(rng)
+                elif isinstance(v, dict):
+                    cfg[k] = generate_variants(v, 1, rng.randrange(1 << 30))[0]
+                else:
+                    cfg[k] = v
+            variants.append(cfg)
+    return variants

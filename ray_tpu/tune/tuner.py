@@ -1,0 +1,304 @@
+"""Tuner + trial controller.
+
+Reference parity: python/ray/tune/tuner.py:43 (Tuner.fit -> ResultGrid) and
+tune/execution/tune_controller.py:68 (the actor-based trial event loop:
+launch up to max_concurrent trials, stream results, apply scheduler
+decisions, early-stop/perturb, collect terminal states).
+"""
+from __future__ import annotations
+
+import os
+import uuid
+from typing import Any, Callable, Optional
+
+from ..train.checkpoint import Checkpoint
+from ..train.config import RunConfig
+from ..train.trainer import _ResultBus
+from ..train import session as session_mod
+from .schedulers import (
+    CONTINUE, STOP, FIFOScheduler, PopulationBasedTraining,
+)
+from .search import generate_variants
+
+
+class TuneConfig:
+    """(reference: tune/tune_config.py) metric/mode drive scheduler and
+    best-result selection; `stop` is an early-stop dict such as
+    {"training_iteration": 20} or {"loss": 0.1} (threshold reached =>
+    trial stops), matching RunConfig(stop=...) in the reference."""
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+                 num_samples: int = 1, scheduler=None,
+                 max_concurrent_trials: int = 2,
+                 stop: Optional[dict] = None, seed: int = 0):
+        if mode not in ("max", "min"):
+            raise ValueError("mode must be 'max' or 'min'")
+        self.metric = metric
+        self.mode = mode
+        self.num_samples = num_samples
+        self.scheduler = scheduler or FIFOScheduler()
+        self.max_concurrent_trials = max_concurrent_trials
+        self.stop = stop or {}
+        self.seed = seed
+
+
+class Trial:
+    PENDING, RUNNING, TERMINATED, STOPPED, ERROR = (
+        "PENDING", "RUNNING", "TERMINATED", "STOPPED", "ERROR")
+
+    def __init__(self, index: int, config: dict):
+        self.index = index
+        self.gen = 0  # bumped on every (re)launch; stale reports are dropped
+        self.trial_id = f"trial_{index:05d}_{uuid.uuid4().hex[:6]}"
+        self.config = dict(config)
+        self.status = Trial.PENDING
+        self.results: list[dict] = []
+        self.iteration = 0
+        self.last_checkpoint: Optional[Checkpoint] = None
+        self.error: Optional[BaseException] = None
+        self.restore_from: Optional[Checkpoint] = None
+        self.actor = None
+        self.run_ref = None
+
+    @property
+    def last_result(self) -> dict:
+        return self.results[-1] if self.results else {}
+
+
+class TrialResult:
+    """One row of the ResultGrid (reference: air/result.py Result)."""
+
+    def __init__(self, trial: Trial):
+        self.config = trial.config
+        self.metrics = trial.last_result
+        self.metrics_history = trial.results
+        self.checkpoint = trial.last_checkpoint
+        self.error = trial.error
+        self.trial_id = trial.trial_id
+        self.status = trial.status
+
+
+class ResultGrid:
+    def __init__(self, results: list[TrialResult], metric, mode):
+        self._results = results
+        self._metric, self._mode = metric, mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i) -> TrialResult:
+        return self._results[i]
+
+    @property
+    def errors(self) -> list[BaseException]:
+        return [r.error for r in self._results if r.error is not None]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> TrialResult:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        scored = [r for r in self._results if metric in r.metrics]
+        if not scored:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        key = lambda r: r.metrics[metric]  # noqa: E731
+        return max(scored, key=key) if mode == "max" else min(scored, key=key)
+
+    def get_dataframe(self):
+        import pandas as pd
+        rows = []
+        for r in self._results:
+            row = dict(r.metrics)
+            row.update({f"config/{k}": v for k, v in r.config.items()})
+            row["trial_id"] = r.trial_id
+            row["status"] = r.status
+            rows.append(row)
+        return pd.DataFrame(rows)
+
+
+class _TrialActor:
+    """Hosts one trial's function trainable (reference: the trainable actor
+    of tune_controller; session wiring mirrors the Train worker)."""
+
+    def __init__(self, trial_index: int, run_name: str, bus):
+        self._index = trial_index
+        self._run_name = run_name
+        self._bus = bus
+
+    def run(self, fn_blob: bytes, config: dict,
+            restore_path: Optional[str]) -> str:
+        import cloudpickle
+        fn = cloudpickle.loads(fn_blob)
+        ctx = session_mod.TrainContext(
+            run_name=self._run_name, rank=self._index, world_size=1,
+            restored_checkpoint=(Checkpoint(restore_path)
+                                 if restore_path else None),
+            _bus=self._bus, sync_decisions=True)
+        session_mod._set_context(ctx)
+        try:
+            fn(config)
+        except session_mod.StopTrial:
+            return "stopped"
+        finally:
+            session_mod._set_context(None)
+        return "done"
+
+
+class Tuner:
+    def __init__(self, trainable: Callable, *, param_space: dict,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 resources_per_trial: Optional[dict] = None):
+        self.trainable = trainable
+        self.param_space = param_space
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+        self.resources = resources_per_trial or {"CPU": 1}
+
+    # -- controller -------------------------------------------------------
+
+    def fit(self) -> ResultGrid:
+        import cloudpickle
+        import ray_tpu as ray
+
+        tc = self.tune_config
+        sched = tc.scheduler
+        sched.setup(tc.metric, tc.mode)
+        run_name = self.run_config.name or f"tune_{uuid.uuid4().hex[:8]}"
+        storage = os.path.join(self.run_config.resolved_storage_path(),
+                               run_name)
+        os.makedirs(storage, exist_ok=True)
+
+        variants = generate_variants(self.param_space, tc.num_samples,
+                                     tc.seed)
+        trials = [Trial(i, cfg) for i, cfg in enumerate(variants)]
+        by_index = {t.index: t for t in trials}
+        fn_blob = cloudpickle.dumps(self.trainable)
+
+        BusCls = ray.remote(_ResultBus)
+        bus = BusCls.options(max_concurrency=256).remote()
+        ActorCls = ray.remote(_TrialActor)
+
+        # reports are keyed rank = gen * _GEN + index so a restarted trial
+        # (PBT exploit) can't be corrupted by a killed actor's stale reports
+        _GEN = 1_000_000
+
+        def launch(trial: Trial):
+            trial.gen += 1
+            trial.actor = ActorCls.options(
+                num_cpus=self.resources.get("CPU", 1),
+                num_tpus=self.resources.get("TPU", 0),
+            ).remote(trial.gen * _GEN + trial.index, run_name, bus)
+            trial.run_ref = trial.actor.run.remote(
+                fn_blob, trial.config,
+                trial.restore_from.path if trial.restore_from else None)
+            trial.status = Trial.RUNNING
+
+        def stop_trial(trial: Trial, status: str,
+                       err: Optional[BaseException] = None):
+            trial.status = status
+            trial.error = err
+            if trial.actor is not None:
+                try:
+                    ray.kill(trial.actor)
+                except Exception:
+                    pass
+                trial.actor = None
+
+        def active():
+            return [t for t in trials if t.status == Trial.RUNNING]
+
+        def pending():
+            return [t for t in trials if t.status == Trial.PENDING]
+
+        try:
+            while pending() or active():
+                while pending() and len(active()) < tc.max_concurrent_trials:
+                    launch(pending()[0])
+
+                # reap finished/stopped/crashed trial actors
+                live = [t for t in trials if t.actor is not None
+                        and t.run_ref is not None]
+                refs = [t.run_ref for t in live]
+                done, _ = ray.wait(refs, num_returns=len(refs), timeout=0.2)
+                done_set = set(done)
+                for t in live:
+                    if t.run_ref not in done_set:
+                        continue
+                    err = None
+                    try:
+                        ray.get(t.run_ref)
+                    except BaseException as e:  # noqa: BLE001
+                        err = e
+                    if t.status == Trial.RUNNING:
+                        stop_trial(t, Trial.ERROR if err else
+                                   Trial.TERMINATED, err)
+                    else:  # scheduler already decided; just clear the actor
+                        stop_trial(t, t.status)
+
+                # stream reported results; every report is answered
+                # (reporters park in push_wait until the decision lands)
+                for rank, seq, metrics, ckpt_path in ray.get(
+                        bus.drain.remote()):
+                    t = by_index.get(rank % _GEN)
+                    if t is None or rank // _GEN != t.gen:
+                        # stale report from a killed generation: answer STOP
+                        # so a still-alive old actor exits, and drop it
+                        bus.decide.remote(rank, seq, STOP)
+                        continue
+                    t.iteration += 1
+                    metrics = dict(metrics)
+                    metrics.setdefault("training_iteration", t.iteration)
+                    t.results.append(metrics)
+                    if ckpt_path:
+                        t.last_checkpoint = Checkpoint(ckpt_path)
+                    decision = CONTINUE
+                    if self._should_stop(metrics):
+                        decision = STOP
+                        t.status = Trial.TERMINATED
+                    elif t.status == Trial.RUNNING:
+                        decision = sched.on_result(t, metrics)
+                        if decision == STOP:
+                            t.status = Trial.STOPPED
+                    bus.decide.remote(rank, seq, decision)
+                    if t.status == Trial.RUNNING and \
+                            isinstance(sched, PopulationBasedTraining) and \
+                            sched.should_perturb(t, metrics):
+                        self._pbt_step(sched, t, trials, stop_trial, launch)
+        finally:
+            for t in trials:
+                if t.actor is not None:
+                    stop_trial(t, t.status if t.status != Trial.RUNNING
+                               else Trial.STOPPED)
+            try:
+                ray.kill(bus)
+            except Exception:
+                pass
+
+        return ResultGrid([TrialResult(t) for t in trials],
+                          tc.metric, tc.mode)
+
+    def _should_stop(self, metrics: dict) -> bool:
+        for k, v in self.tune_config.stop.items():
+            if k not in metrics:
+                continue
+            if k == "training_iteration":
+                if metrics[k] >= v:
+                    return True
+            elif self.tune_config.mode == "max" and metrics[k] >= v:
+                return True
+            elif self.tune_config.mode == "min" and metrics[k] <= v:
+                return True
+        return False
+
+    def _pbt_step(self, sched, trial, trials, stop_trial, launch):
+        """Exploit+explore: clone a top trial's checkpoint with perturbed
+        hyperparams, restart this trial from it (reference: pbt.py
+        _exploit)."""
+        target = sched.exploit_target(trial, trials)
+        if target is None or target.last_checkpoint is None:
+            return
+        stop_trial(trial, Trial.PENDING)
+        trial.config = sched.perturb_config(target.config)
+        trial.restore_from = target.last_checkpoint
+        trial.error = None
+        launch(trial)
