@@ -5,15 +5,32 @@
 // eviction_policy.h). Unlike plasma's socket-server architecture (clients talk
 // to the store over a unix socket with fd-passing, plasma/client.h), this store
 // is a *single file-backed mmap region shared by all processes on the node*,
-// with a process-shared robust mutex + condvar in the header. Rationale: on a
+// with a process-shared robust mutex in the header. Rationale: on a
 // TPU host the heavy data plane (gradients/activations) lives inside XLA
 // programs on-device; the host object store serves control payloads, dataset
 // blocks and checkpoints, so a lock-based shm design is simpler and has lower
 // latency than a socket protocol (no round trip, no fd passing).
 //
+// Blocking get does NOT use a pthread condvar: process-shared condvars are
+// not robust — a client SIGKILLed inside pthread_cond_(timed)wait leaves its
+// group reference behind, and the next pthread_cond_broadcast blocks forever
+// in the group-switch quiesce (observed as a cluster-wide wedge with the
+// broadcaster holding the store mutex). Instead waiters block on a raw
+// futex over a seal-sequence counter: seal/delete bump the counter and
+// FUTEX_WAKE; the kernel keeps no per-waiter state, so a killed waiter
+// simply disappears.
+//
+// Crash robustness (workers are SIGKILLed by design — ray.kill parity):
+//   - robust mutex: owner death => EOWNERDEAD recovery on next lock
+//   - futex wait:   waiter death => nothing to clean up
+//   - pins:         per-pid pin slots; os_reclaim_pid(pid) drops pins and
+//                   aborts unsealed creates of a dead worker
+//   - free list:    walks are cycle-bounded so a torn list can never spin
+//                   forever while holding the mutex
+//
 // Features (parity targets):
 //   - create/seal/get/contains/delete/acquire/release  (plasma client.h ops)
-//   - blocking Get with timeout via pthread condvar     (plasma store.h:55 wait)
+//   - blocking Get with timeout via futex               (plasma store.h:55 wait)
 //   - LRU eviction of sealed, unreferenced objects      (eviction_policy.h)
 //   - first-fit free-list allocator with coalescing     (dlmalloc.cc stand-in)
 //   - robust-mutex crash recovery (owner dies holding lock)
@@ -22,14 +39,17 @@
 
 #include <atomic>
 #include <cerrno>
+#include <climits>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
 #include <ctime>
 #include <fcntl.h>
+#include <linux/futex.h>
 #include <pthread.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
+#include <sys/syscall.h>
 #include <unistd.h>
 
 namespace {
@@ -43,13 +63,26 @@ enum ObjState : int32_t {
   kSealed = 2,    // immutable, readable
 };
 
+// Per-pid pin bookkeeping so pins leaked by a SIGKILLed process can be
+// reclaimed (os_reclaim_pid). Pins from more than kPinSlots distinct pids
+// overflow into an aggregate count that cannot be reclaimed (rare; pins are
+// short-lived).
+constexpr int kPinSlots = 4;
+struct PinSlot {
+  int32_t pid;
+  int32_t count;
+};
+
 struct ObjEntry {
   uint8_t id[kIdSize];
   uint64_t offset;   // payload offset from region base
   uint64_t size;
   int32_t state;
-  int32_t refcnt;    // pins against eviction
+  int32_t refcnt;    // pins against eviction (incl. creator pin pre-seal)
   uint64_t lru_tick;
+  int32_t creator_pid;   // pid that os_create'd (abortable while kCreated)
+  int32_t overflow_pins;
+  PinSlot pins[kPinSlots];
 };
 
 // Free block header, stored inside the heap region itself.
@@ -66,7 +99,8 @@ struct Header {
   uint32_t max_entries;
   uint32_t pad0;
   pthread_mutex_t mutex;
-  pthread_cond_t cond;
+  uint32_t seal_seq;        // bumped on every seal/delete; futex wait target
+  uint32_t pad1;
   uint64_t lru_counter;
   uint64_t free_head;       // offset of first free block (0 = none)
   uint64_t bytes_in_use;
@@ -99,6 +133,47 @@ void lock(Handle* h) {
 
 void unlock(Handle* h) { pthread_mutex_unlock(&h->hdr->mutex); }
 
+// Raw futex wait/wake on the seal-sequence word (process-shared: no
+// FUTEX_PRIVATE flag). FUTEX_WAIT_BITSET takes an *absolute* CLOCK_MONOTONIC
+// deadline, matching the deadline os_get already computes.
+int futex_wait_abs(uint32_t* addr, uint32_t expected,
+                   const struct timespec* deadline) {
+  return (int)syscall(SYS_futex, addr, FUTEX_WAIT_BITSET, expected, deadline,
+                      nullptr, FUTEX_BITSET_MATCH_ANY);
+}
+
+void futex_wake_all(uint32_t* addr) {
+  syscall(SYS_futex, addr, FUTEX_WAKE, INT_MAX, nullptr, nullptr, 0);
+}
+
+void bump_seal_seq(Handle* h) {
+  __atomic_fetch_add(&h->hdr->seal_seq, 1, __ATOMIC_SEQ_CST);
+  futex_wake_all(&h->hdr->seal_seq);
+}
+
+// Per-pid pin bookkeeping. Caller holds the store mutex.
+void pin(ObjEntry* e, int32_t pid) {
+  e->refcnt++;
+  PinSlot* empty = nullptr;
+  for (int i = 0; i < kPinSlots; i++) {
+    if (e->pins[i].pid == pid) { e->pins[i].count++; return; }
+    if (!empty && e->pins[i].pid == 0) empty = &e->pins[i];
+  }
+  if (empty) { empty->pid = pid; empty->count = 1; return; }
+  e->overflow_pins++;
+}
+
+void unpin(ObjEntry* e, int32_t pid) {
+  if (e->refcnt > 0) e->refcnt--;
+  for (int i = 0; i < kPinSlots; i++) {
+    if (e->pins[i].pid == pid) {
+      if (--e->pins[i].count <= 0) { e->pins[i].pid = 0; e->pins[i].count = 0; }
+      return;
+    }
+  }
+  if (e->overflow_pins > 0) e->overflow_pins--;
+}
+
 ObjEntry* find(Handle* h, const uint8_t* id) {
   // Linear-probed open addressing over the entry table, hashed by id prefix.
   Header* hdr = h->hdr;
@@ -130,12 +205,22 @@ ObjEntry* find_slot(Handle* h, const uint8_t* id) {
 // too small to split off) so dealloc always returns the exact span —
 // otherwise absorbed tails would leak permanently. Returns the *payload*
 // offset (block + 8) or 0 on failure.
+// Upper bound on free-list length: every free block is bordered by
+// allocated spans, so a healthy list never exceeds max_entries + 1 nodes.
+// A torn list (process died mid-surgery under EOWNERDEAD) could contain a
+// cycle; bounding the walk turns "deadlock holding the mutex" into a
+// recoverable allocation failure.
+inline uint64_t walk_limit(Header* hdr) {
+  return (uint64_t)hdr->max_entries + 16;
+}
+
 uint64_t alloc(Handle* h, uint64_t size) {
   uint64_t want = align8(size) + 8;
   if (want < sizeof(FreeBlock)) want = sizeof(FreeBlock);
   Header* hdr = h->hdr;
   uint64_t prev = 0, cur = hdr->free_head;
-  while (cur) {
+  uint64_t steps = walk_limit(hdr);
+  while (cur && steps--) {
     FreeBlock* fb = reinterpret_cast<FreeBlock*>(h->base + cur);
     if (fb->size >= want) {
       uint64_t span = want;
@@ -170,12 +255,15 @@ void dealloc(Handle* h, uint64_t payload_off) {
   uint64_t off = payload_off - 8;
   uint64_t size = *reinterpret_cast<uint64_t*>(h->base + off);
   Header* hdr = h->hdr;
-  hdr->bytes_in_use -= size;
   uint64_t prev = 0, cur = hdr->free_head;
+  uint64_t steps = walk_limit(hdr);
   while (cur && cur < off) {
+    if (!steps--) return;  // torn/cyclic list: leak the block, don't spin
     prev = cur;
     cur = reinterpret_cast<FreeBlock*>(h->base + cur)->next;
   }
+  if (cur == off) return;  // double-free guard: already on the free list
+  hdr->bytes_in_use -= size;
   FreeBlock* nb = reinterpret_cast<FreeBlock*>(h->base + off);
   nb->size = size;
   nb->next = cur;
@@ -251,11 +339,7 @@ void* os_store_create(const char* path, uint64_t capacity, uint32_t max_entries)
   pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
   pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
   pthread_mutex_init(&hdr->mutex, &ma);
-  pthread_condattr_t ca;
-  pthread_condattr_init(&ca);
-  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
-  pthread_condattr_setclock(&ca, CLOCK_MONOTONIC);
-  pthread_cond_init(&hdr->cond, &ca);
+  hdr->seal_seq = 0;
 
   // one big free block spanning the heap
   FreeBlock* fb = reinterpret_cast<FreeBlock*>(base + hdr->heap_off);
@@ -308,6 +392,9 @@ uint64_t os_create(void* hv, const uint8_t* id, uint64_t size) {
   e->size = size;
   e->refcnt = 1;  // creator holds a pin until seal
   e->lru_tick = ++h->hdr->lru_counter;
+  e->creator_pid = (int32_t)getpid();
+  e->overflow_pins = 0;
+  memset(e->pins, 0, sizeof(e->pins));
   e->state = kCreated;
   h->hdr->num_objects++;
   unlock(h);
@@ -321,7 +408,8 @@ int os_seal(void* hv, const uint8_t* id) {
   if (!e || e->state != kCreated) { unlock(h); return -1; }
   e->state = kSealed;
   e->refcnt -= 1;  // drop creator pin
-  pthread_cond_broadcast(&h->hdr->cond);
+  e->creator_pid = 0;
+  bump_seal_seq(h);
   unlock(h);
   return 0;
 }
@@ -329,6 +417,8 @@ int os_seal(void* hv, const uint8_t* id) {
 // Blocking get: waits up to timeout_ms for the object to be sealed.
 // On success pins the object (caller must os_release) and fills offset/size.
 // Returns 0 ok, -1 timeout, -2 would-block (timeout_ms == 0 and not present).
+// Waiting is a raw futex on seal_seq — kill-safe (see file header), and the
+// mutex is NEVER held while blocked.
 int os_get(void* hv, const uint8_t* id, int64_t timeout_ms,
            uint64_t* offset, uint64_t* size) {
   Handle* h = reinterpret_cast<Handle*>(hv);
@@ -337,11 +427,12 @@ int os_get(void* hv, const uint8_t* id, int64_t timeout_ms,
   deadline.tv_sec += timeout_ms / 1000;
   deadline.tv_nsec += (timeout_ms % 1000) * 1000000L;
   if (deadline.tv_nsec >= 1000000000L) { deadline.tv_sec++; deadline.tv_nsec -= 1000000000L; }
+  int32_t me = (int32_t)getpid();
   lock(h);
   while (true) {
     ObjEntry* e = find(h, id);
     if (e && e->state == kSealed) {
-      e->refcnt++;
+      pin(e, me);
       e->lru_tick = ++h->hdr->lru_counter;
       *offset = e->offset;
       *size = e->size;
@@ -349,9 +440,12 @@ int os_get(void* hv, const uint8_t* id, int64_t timeout_ms,
       return 0;
     }
     if (timeout_ms == 0) { unlock(h); return -2; }
-    int rc = pthread_cond_timedwait(&h->hdr->cond, &h->hdr->mutex, &deadline);
-    if (rc == ETIMEDOUT) { unlock(h); return -1; }
-    if (rc == EOWNERDEAD) pthread_mutex_consistent(&h->hdr->mutex);
+    uint32_t seq = __atomic_load_n(&h->hdr->seal_seq, __ATOMIC_SEQ_CST);
+    unlock(h);
+    int rc = futex_wait_abs(&h->hdr->seal_seq, seq, &deadline);
+    if (rc != 0 && errno == ETIMEDOUT) return -1;
+    // 0 (woken), EAGAIN (seq already moved) or EINTR: re-check under lock.
+    lock(h);
   }
 }
 
@@ -368,8 +462,46 @@ void os_release(void* hv, const uint8_t* id) {
   Handle* h = reinterpret_cast<Handle*>(hv);
   lock(h);
   ObjEntry* e = find(h, id);
-  if (e && e->refcnt > 0) e->refcnt--;
+  if (e) unpin(e, (int32_t)getpid());
   unlock(h);
+}
+
+// Drop all store state owned by a dead process: its unsealed creates are
+// aborted and its leaked read pins removed, so objects become evictable
+// again. Called by the head when it reaps a worker (reference analog:
+// NodeManager worker-death cleanup, raylet/node_manager.h:124). Returns the
+// number of entries touched.
+int os_reclaim_pid(void* hv, int32_t pid) {
+  Handle* h = reinterpret_cast<Handle*>(hv);
+  int touched = 0;
+  lock(h);
+  Header* hdr = h->hdr;
+  for (uint32_t i = 0; i < hdr->max_entries; i++) {
+    ObjEntry* e = &h->entries[i];
+    if (e->state == kCreated && e->creator_pid == pid) {
+      dealloc(h, e->offset);
+      e->state = kFree;
+      hdr->num_objects--;
+      touched++;
+      continue;
+    }
+    if (e->state == kSealed) {
+      for (int s = 0; s < kPinSlots; s++) {
+        if (e->pins[s].pid == pid && e->pins[s].count > 0) {
+          e->refcnt -= e->pins[s].count;
+          if (e->refcnt < 0) e->refcnt = 0;
+          e->pins[s].pid = 0;
+          e->pins[s].count = 0;
+          touched++;
+        }
+      }
+    }
+  }
+  // a worker that died mid-create will never seal: wake blocked getters so
+  // their timeouts can fire against a now-consistent table
+  bump_seal_seq(h);
+  unlock(h);
+  return touched;
 }
 
 // Delete an object (abort an unsealed create or free a sealed object).
@@ -384,6 +516,9 @@ int os_delete(void* hv, const uint8_t* id) {
     dealloc(h, e->offset);
     e->state = kFree;
     h->hdr->num_objects--;
+    // keep the documented contract: every removal wakes waiters so a
+    // delete-then-recreate (error overwrite) never strands a blocked get
+    bump_seal_seq(h);
   } else {
     // readers still hold it: make it evictable as soon as they release
     e->lru_tick = 0;

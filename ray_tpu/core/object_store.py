@@ -65,6 +65,8 @@ def _load_lib() -> ctypes.CDLL:
     lib.os_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.os_delete.restype = ctypes.c_int
     lib.os_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.os_reclaim_pid.restype = ctypes.c_int
+    lib.os_reclaim_pid.argtypes = [ctypes.c_void_p, ctypes.c_int32]
     for fn in ("os_capacity", "os_bytes_in_use", "os_num_objects", "os_evictions"):
         getattr(lib, fn).restype = ctypes.c_uint64
         getattr(lib, fn).argtypes = [ctypes.c_void_p]
@@ -133,6 +135,11 @@ class SharedObjectStore:
 
     def delete(self, oid: ObjectID) -> None:
         self._lib.os_delete(self._handle(), oid.binary())
+
+    def reclaim_pid(self, pid: int) -> int:
+        """Abort unsealed creates and drop read pins leaked by a dead
+        process (call when a worker is reaped)."""
+        return self._lib.os_reclaim_pid(self._handle(), pid)
 
     # -- object-level API --------------------------------------------------
 

@@ -419,6 +419,12 @@ class Runtime:
             if w is None or w.state == "dead":
                 return
             w.state = "dead"
+            # reclaim store state the dead process can no longer release:
+            # unsealed creates (it died mid-put) and leaked read pins
+            try:
+                self.store.reclaim_pid(w.proc.pid)
+            except Exception:
+                pass
             node = self.nodes.get(w.node_id)
             if node:
                 node.workers.discard(wid)

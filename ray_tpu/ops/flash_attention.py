@@ -10,9 +10,10 @@ ours. Design:
   so scratch persists across the k sweep of one q block). Emits the
   log-sum-exp residual for the backward pass and for ring-attention
   composition (parallel.ring).
-* Backward: blockwise recompute in jnp (chunked `lax.scan`, O(S) memory) —
-  XLA fuses this well on TPU; a fully hand-scheduled Pallas backward is a
-  later optimization.
+* Backward: two Pallas kernels (a dq sweep and a dkv sweep) with f32 VMEM
+  accumulators, GQA gathered via BlockSpec index maps (no repeat). A jnp
+  blockwise-recompute fallback (chunked `lax.scan`, O(S) memory) covers
+  non-TPU backends.
 * CPU / debugging: `mha_reference` (the numerical oracle) is used when not
   on TPU; the Pallas path also runs under `interpret=True` in tests.
 
@@ -182,7 +183,205 @@ def _flash_fwd(q, k, v, causal: bool, scale: float,
 
 
 # ---------------------------------------------------------------------------
-# Memory-efficient backward (blockwise recompute, jnp)
+# Pallas backward kernels (dq sweep + dkv sweep)
+# ---------------------------------------------------------------------------
+#
+# Standard flash-attention backward split into two MXU-friendly passes:
+#   dq kernel : grid (B, H, nq, nk) — k-sweep innermost, dq accumulator in
+#               VMEM scratch carried across the k blocks of one q block.
+#   dkv kernel: grid (B, H, nk, nq) — q-sweep innermost, dk/dv accumulators
+#               carried across the q blocks of one k block.
+# GQA: k/v blocks are gathered per q-head via the BlockSpec index map
+# (hi // groups) — no materialized repeat. dk/dv come out per q-head
+# [B, Sk, H, D] and are group-summed to [B, Sk, KVH, D] by XLA (cheap,
+# fused elementwise reduction).
+# delta = rowsum(dO · O) is precomputed outside (bandwidth-bound, fuses).
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc_ref,
+                   *, causal: bool, scale: float, block_q: int, block_k: int,
+                   num_k_blocks: int):
+    from jax.experimental import pallas as pl
+
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
+
+    def _compute():
+        q = q_ref[:, :]                                        # [BQ, D]
+        k = k_ref[:, :]                                        # [BK, D]
+        v = v_ref[:, :]                                        # [BK, D]
+        do = do_ref[:, :]                                      # [BQ, D]
+        lse = lse_ref[:, :]                                    # [BQ, 1]
+        delta = delta_ref[:, :]                                # [BQ, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale        # [BQ, BK]
+        if causal:
+            q_pos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                                   # [BQ, BK]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)                # [BQ, BK]
+        ds = p * (dp - delta) * scale
+        dq_acc_ref[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                # [BQ, D]
+
+    if causal:
+        @pl.when((iq + 1) * block_q - 1 >= ik * block_k)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(ik == num_k_blocks - 1)
+    def _finalize():
+        dq_ref[:, :] = dq_acc_ref[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc_ref, dv_acc_ref,
+                    *, causal: bool, scale: float, block_q: int, block_k: int,
+                    num_q_blocks: int):
+    from jax.experimental import pallas as pl
+
+    ik = pl.program_id(2)
+    iq = pl.program_id(3)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
+
+    def _compute():
+        q = q_ref[:, :]                                        # [BQ, D]
+        k = k_ref[:, :]                                        # [BK, D]
+        v = v_ref[:, :]                                        # [BK, D]
+        do = do_ref[:, :]                                      # [BQ, D]
+        lse = lse_ref[:, :]                                    # [BQ, 1]
+        delta = delta_ref[:, :]                                # [BQ, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale        # [BQ, BK]
+        if causal:
+            q_pos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                                   # [BQ, BK]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)                # [BQ, BK]
+        ds = (p * (dp - delta) * scale).astype(q.dtype)        # [BQ, BK]
+        # dk += ds^T @ q ; dv += p^T @ dO   (contract over the q dim)
+        dk_acc_ref[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                # [BK, D]
+        dv_acc_ref[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                # [BK, D]
+
+    if causal:
+        @pl.when((iq + 1) * block_q - 1 >= ik * block_k)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(iq == num_q_blocks - 1)
+    def _finalize():
+        dk_ref[:, :] = dk_acc_ref[:].astype(dk_ref.dtype)
+        dv_ref[:, :] = dv_acc_ref[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, g, *, causal: bool, scale: float,
+               block_q: int, block_k: int, interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, sq, h, d = q.shape
+    _, sk, kvh, _ = k.shape
+    groups = h // kvh
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError(
+            f"seq lengths ({sq},{sk}) must divide blocks ({block_q},{block_k})")
+    nq, nk = sq // block_q, sk // block_k
+
+    qt = jnp.swapaxes(q, 1, 2)                                 # [B,H,Sq,D]
+    kt = jnp.swapaxes(k, 1, 2)                                 # [B,KVH,Sk,D]
+    vt = jnp.swapaxes(v, 1, 2)
+    gt = jnp.swapaxes(g, 1, 2)                                 # [B,H,Sq,D]
+    delta = jnp.sum(gt.astype(jnp.float32)
+                    * jnp.swapaxes(out, 1, 2).astype(jnp.float32),
+                    axis=-1, keepdims=True)                    # [B,H,Sq,1]
+    lse4 = lse[..., None]                                      # [B,H,Sq,1]
+
+    q_spec = pl.BlockSpec((None, None, block_q, d),
+                          lambda bi, hi, iq, ik: (bi, hi, iq, 0))
+    kv_spec = pl.BlockSpec((None, None, block_k, d),
+                           lambda bi, hi, iq, ik: (bi, hi // groups, ik, 0))
+    row_spec = pl.BlockSpec((None, None, block_q, 1),
+                            lambda bi, hi, iq, ik: (bi, hi, iq, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, causal=causal, scale=scale,
+                          block_q=block_q, block_k=block_k, num_k_blocks=nk),
+        grid=(b, h, nq, nk),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=[q_spec],
+        out_shape=[jax.ShapeDtypeStruct(qt.shape, q.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, gt, lse4, delta)[0]
+
+    # dkv sweep: q innermost. Note the index maps take (bi, hi, ik, iq).
+    q_spec2 = pl.BlockSpec((None, None, block_q, d),
+                           lambda bi, hi, ik, iq: (bi, hi, iq, 0))
+    kv_spec2 = pl.BlockSpec((None, None, block_k, d),
+                            lambda bi, hi, ik, iq: (bi, hi // groups, ik, 0))
+    row_spec2 = pl.BlockSpec((None, None, block_q, 1),
+                             lambda bi, hi, ik, iq: (bi, hi, iq, 0))
+    dkv_out_spec = pl.BlockSpec((None, None, block_k, d),
+                                lambda bi, hi, ik, iq: (bi, hi, ik, 0))
+
+    dk_h, dv_h = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale,
+                          block_q=block_q, block_k=block_k, num_q_blocks=nq),
+        grid=(b, h, nk, nq),
+        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2],
+        out_specs=[dkv_out_spec, dkv_out_spec],
+        out_shape=[jax.ShapeDtypeStruct((b, h, sk, d), k.dtype),
+                   jax.ShapeDtypeStruct((b, h, sk, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, gt, lse4, delta)
+
+    dq = jnp.swapaxes(dq, 1, 2)                                # [B,Sq,H,D]
+    dk_h = jnp.swapaxes(dk_h, 1, 2)                            # [B,Sk,H,D]
+    dv_h = jnp.swapaxes(dv_h, 1, 2)
+    if groups > 1:
+        dk = dk_h.reshape(b, sk, kvh, groups, d).sum(axis=3)
+        dv = dv_h.reshape(b, sk, kvh, groups, d).sum(axis=3)
+    else:
+        dk, dv = dk_h, dv_h
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Memory-efficient backward (blockwise recompute, jnp — CPU fallback)
 # ---------------------------------------------------------------------------
 
 def _bwd_blockwise(res, g, *, causal, scale, block_k):
@@ -286,8 +485,13 @@ def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret):
 
 
 def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
     if scale is None:
-        scale = res[0].shape[-1] ** -0.5
+        scale = q.shape[-1] ** -0.5
+    if interpret or _on_tpu():
+        return _flash_bwd(q, k, v, out, lse, g, causal=causal, scale=scale,
+                          block_q=block_q, block_k=block_k,
+                          interpret=interpret)
     return _bwd_blockwise(res, g, causal=causal, scale=scale, block_k=block_k)
 
 

@@ -1,0 +1,16 @@
+"""ray_tpu.rl — RL training library (RLlib equivalent, JAX-native).
+
+Reference parity: rllib/ (algorithms/algorithm.py:207, env/
+single_agent_env_runner.py:68, core/learner/learner.py:108,
+core/rl_module/rl_module.py:258). PPO is the first algorithm (north-star
+config 3: PPO EnvRunner actors + jitted JAX learner over the mesh).
+"""
+from .algorithm import PPO, AlgorithmConfig
+from .env_runner import EnvRunner, make_gym_env
+from .learner import PPOConfig, PPOLearner, compute_gae
+from .module import MLPConfig
+
+__all__ = [
+    "PPO", "AlgorithmConfig", "EnvRunner", "make_gym_env",
+    "PPOConfig", "PPOLearner", "compute_gae", "MLPConfig",
+]

@@ -1,0 +1,148 @@
+"""EnvRunner: sampling actor over a gymnasium vector env.
+
+Reference parity: rllib/env/single_agent_env_runner.py:68 (sample :149 —
+vectorized gym envs stepped with the module's exploration forward) and
+env_runner_group.py:71 (the actor group fanning sample() out). TPU-first
+split: env runners are cheap CPU actors; the policy forward inside them is
+a jitted JAX function on host CPU, while the learner's copy of the same
+module trains on the accelerator mesh. Weights flow runner-ward through the
+object store once per iteration (the reference broadcasts torch state dicts
+the same way).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from . import module as module_lib
+
+
+class EnvRunner:
+    """Collects fixed-length rollout fragments from a vector env.
+
+    Returned sample batch layout (numpy, time-major):
+      obs      [T, E, obs_dim]   observations BEFORE each step
+      actions  [T, E]
+      logp     [T, E]            behaviour log-probs (for the PPO ratio)
+      values   [T, E]            value estimates at obs
+      rewards  [T, E]
+      dones    [T, E]            episode terminated/truncated after step t
+      last_obs [E, obs_dim]      for bootstrap value
+    """
+
+    def __init__(self, env_fn: Callable, num_envs: int, rollout_len: int,
+                 seed: int = 0):
+        import gymnasium as gym
+
+        # SAME_STEP autoreset: the env resets within the step() that ends an
+        # episode, so every recorded transition is real. gymnasium 1.x's
+        # NEXT_STEP default would make the post-done step a phantom
+        # transition (action ignored, reward 0) that biases GAE.
+        self._venv = gym.vector.SyncVectorEnv(
+            [_make_env(env_fn) for _ in range(num_envs)],
+            autoreset_mode=gym.vector.AutoresetMode.SAME_STEP)
+        self._num_envs = num_envs
+        self._rollout_len = rollout_len
+        self._obs, _ = self._venv.reset(seed=seed)
+        self._rng = np.random.default_rng(seed + 1)
+        self._sample_fn = None
+        # per-env running episode returns for metrics
+        self._ep_return = np.zeros(num_envs, np.float64)
+        self._ep_len = np.zeros(num_envs, np.int64)
+        self._completed: list[tuple[float, int]] = []
+
+    def _policy(self):
+        if self._sample_fn is None:
+            import jax
+            self._sample_fn = jax.jit(module_lib.sample_action)
+            self._value_fn = jax.jit(
+                lambda p, o: module_lib.logits_and_value(p, o)[1])
+        return self._sample_fn
+
+    def sample(self, params) -> dict:
+        """One rollout fragment with the given module params."""
+        import jax
+
+        T, E = self._rollout_len, self._num_envs
+        policy = self._policy()
+        obs_buf = np.empty((T, E) + self._obs.shape[1:], np.float32)
+        act_buf = np.empty((T, E), np.int64)
+        logp_buf = np.empty((T, E), np.float32)
+        val_buf = np.empty((T, E), np.float32)
+        rew_buf = np.empty((T, E), np.float32)
+        done_buf = np.empty((T, E), np.bool_)
+
+        key = jax.random.PRNGKey(int(self._rng.integers(2**31)))
+        for t in range(T):
+            key, sub = jax.random.split(key)
+            action, logp, value = policy(params, self._obs.astype(np.float32),
+                                         sub)
+            action = np.asarray(action)
+            obs_buf[t] = self._obs
+            act_buf[t] = action
+            logp_buf[t] = np.asarray(logp)
+            val_buf[t] = np.asarray(value)
+            nxt, rew, term, trunc, _ = self._venv.step(action)
+            done = np.logical_or(term, trunc)
+            rew_buf[t] = rew
+            done_buf[t] = done
+            self._ep_return += rew
+            self._ep_len += 1
+            for i in np.nonzero(done)[0]:
+                self._completed.append(
+                    (float(self._ep_return[i]), int(self._ep_len[i])))
+                self._ep_return[i] = 0.0
+                self._ep_len[i] = 0
+            self._obs = nxt
+
+        episodes, self._completed = self._completed, []
+        last_value = np.asarray(
+            self._value_fn(params, self._obs.astype(np.float32)))
+        return {
+            "obs": obs_buf, "actions": act_buf, "logp": logp_buf,
+            "values": val_buf, "rewards": rew_buf, "dones": done_buf,
+            "last_obs": self._obs.astype(np.float32),
+            "last_value": last_value,
+            "episode_returns": [r for r, _ in episodes],
+            "episode_lens": [n for _, n in episodes],
+        }
+
+    def evaluate(self, params, num_episodes: int = 5) -> dict:
+        """Greedy-policy evaluation episodes (fresh env, no training state)."""
+        import gymnasium as gym
+        import jax
+
+        det = jax.jit(module_lib.deterministic_action)
+        env = self._venv.envs[0]
+        returns = []
+        for ep in range(num_episodes):
+            obs, _ = env.reset(seed=10_000 + ep)
+            total, done = 0.0, False
+            while not done:
+                a = int(np.asarray(det(params, obs.astype(np.float32))))
+                obs, rew, term, trunc, _ = env.step(a)
+                total += float(rew)
+                done = bool(term or trunc)
+        # note: env state is shared with sampling; reset on exit
+            returns.append(total)
+        self._obs, _ = self._venv.reset()
+        return {"episode_returns": returns,
+                "mean_return": float(np.mean(returns))}
+
+
+def _make_env(env_fn):
+    return lambda: env_fn()
+
+
+def make_gym_env(env_id: str, **kwargs) -> Callable:
+    """Picklable env constructor for gymnasium registry ids."""
+    import functools
+
+    return functools.partial(_gym_make, env_id, kwargs)
+
+
+def _gym_make(env_id, kwargs):
+    import gymnasium as gym
+
+    return gym.make(env_id, **kwargs)

@@ -1,0 +1,80 @@
+"""RLModule: the policy/value network container, pure-pytree JAX.
+
+Reference parity: rllib/core/rl_module/rl_module.py:258 (RLModule holds the
+networks and exposes forward_exploration / forward_train). TPU-first design:
+params are a plain pytree and every forward is a pure function, so the same
+module runs jitted on a learner mesh and on CPU inside env-runner actors
+with no framework glue (the reference needs torch DDP wrapping instead).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPConfig:
+    obs_dim: int
+    num_actions: int
+    hidden: Sequence[int] = (64, 64)
+    # separate value trunk (rllib's vf_share_layers=False default for PPO)
+    shared_trunk: bool = False
+
+
+def _dense_init(key, in_dim, out_dim, scale):
+    w_key, _ = jax.random.split(key)
+    std = scale / math.sqrt(in_dim)
+    return {
+        "w": jax.random.normal(w_key, (in_dim, out_dim), jnp.float32) * std,
+        "b": jnp.zeros((out_dim,), jnp.float32),
+    }
+
+
+def _mlp_init(key, dims, out_dim, out_scale):
+    keys = jax.random.split(key, len(dims))
+    layers = []
+    for i in range(len(dims) - 1):
+        layers.append(_dense_init(keys[i], dims[i], dims[i + 1], 1.0))
+    head = _dense_init(keys[-1], dims[-1], out_dim, out_scale)
+    return {"layers": layers, "head": head}
+
+
+def _mlp_apply(p, x):
+    for layer in p["layers"]:
+        x = jnp.tanh(x @ layer["w"] + layer["b"])
+    return x @ p["head"]["w"] + p["head"]["b"]
+
+
+def init(rng: jax.Array, cfg: MLPConfig) -> dict:
+    k_pi, k_v = jax.random.split(rng)
+    dims = (cfg.obs_dim, *cfg.hidden)
+    return {
+        # small-scale policy head init stabilizes early PPO updates
+        "pi": _mlp_init(k_pi, dims, cfg.num_actions, 0.01),
+        "vf": _mlp_init(k_v, dims, 1, 1.0),
+    }
+
+
+def logits_and_value(params: dict, obs: jax.Array):
+    """obs [..., obs_dim] -> (logits [..., A], value [...])."""
+    logits = _mlp_apply(params["pi"], obs)
+    value = _mlp_apply(params["vf"], obs)[..., 0]
+    return logits, value
+
+
+def sample_action(params: dict, obs: jax.Array, rng: jax.Array):
+    """Exploration forward: (action, logp, value), all [...]."""
+    logits, value = logits_and_value(params, obs)
+    action = jax.random.categorical(rng, logits, axis=-1)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    logp_a = jnp.take_along_axis(logp, action[..., None], axis=-1)[..., 0]
+    return action, logp_a, value
+
+
+def deterministic_action(params: dict, obs: jax.Array):
+    logits, _ = logits_and_value(params, obs)
+    return jnp.argmax(logits, axis=-1)

@@ -55,3 +55,8 @@ def shutdown_only():
     yield ray
     if ray.is_initialized():
         ray.shutdown()
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running convergence/regression tests")

@@ -95,3 +95,102 @@ def test_zero_length_and_odd_sizes(store):
         oid = ObjectID.from_random()
         store.put(oid, b"x" * n)
         assert store.get(oid) == b"x" * n
+
+
+# -- crash robustness (SIGKILLed clients must never wedge the store) --------
+#
+# Round-1/2 deadlock post-mortem: a client SIGKILLed inside a process-shared
+# pthread_cond_timedwait left its condvar group reference behind, and the
+# next broadcast (os_seal, holding the store mutex) blocked forever in the
+# group-switch quiesce. The store now waits on a raw futex (kernel keeps no
+# per-waiter state), so a killed waiter is invisible. These tests pin that.
+
+def _child_block_in_get(path, oid_bin, ready):
+    from ray_tpu.core.object_store import SharedObjectStore
+    from ray_tpu.core.ids import ObjectID
+    s = SharedObjectStore(path, create=False)
+    ready.set()
+    s.get(ObjectID(oid_bin), timeout_ms=60_000)  # blocks in futex wait
+
+
+def _child_pin_forever(path, oid_bin, ready):
+    import time
+    from ray_tpu.core.object_store import SharedObjectStore
+    from ray_tpu.core.ids import ObjectID
+    s = SharedObjectStore(path, create=False)
+    assert s.get_raw(ObjectID(oid_bin), timeout_ms=1000) is not None
+    ready.set()
+    time.sleep(60)  # die holding the pin (parent SIGKILLs us)
+
+
+def _child_create_unsealed(path, oid_bin, ready):
+    import time
+    from ray_tpu.core.object_store import SharedObjectStore
+    from ray_tpu.core.ids import ObjectID
+    s = SharedObjectStore(path, create=False)
+    s.create_raw(ObjectID(oid_bin), 1024)
+    ready.set()
+    time.sleep(60)  # die before sealing
+
+
+def test_sigkilled_waiter_does_not_wedge_seal(store):
+    import multiprocessing as mp
+    ctx = mp.get_context("spawn")
+    oid = ObjectID.from_random()
+    ready = ctx.Event()
+    p = ctx.Process(target=_child_block_in_get,
+                    args=(store.path, oid.binary(), ready))
+    p.start()
+    assert ready.wait(30)
+    import time
+    time.sleep(0.3)  # let the child reach the futex wait
+    p.kill()
+    p.join()
+    # seal must complete promptly and wake nobody-left-behind
+    t0 = time.monotonic()
+    store.put(oid, 42)
+    assert time.monotonic() - t0 < 5
+    assert store.get(oid) == 42
+    # and later seals stay healthy too
+    oid2 = ObjectID.from_random()
+    store.put(oid2, 43)
+    assert store.get(oid2) == 43
+
+
+def test_reclaim_pid_frees_dead_readers_pin(store):
+    import multiprocessing as mp
+    import time
+    ctx = mp.get_context("spawn")
+    oid = ObjectID.from_random()
+    store.put(oid, np.zeros(1024, dtype=np.uint8))
+    ready = ctx.Event()
+    p = ctx.Process(target=_child_pin_forever,
+                    args=(store.path, oid.binary(), ready))
+    p.start()
+    assert ready.wait(30)
+    p.kill()
+    p.join()
+    assert store.reclaim_pid(p.pid) >= 1
+    # pin is gone: delete now frees immediately and the slot is reusable
+    store.delete(oid)
+    assert not store.contains(oid)
+    time.sleep(0)
+
+
+def test_reclaim_pid_aborts_unsealed_create(store):
+    import multiprocessing as mp
+    ctx = mp.get_context("spawn")
+    oid = ObjectID.from_random()
+    ready = ctx.Event()
+    p = ctx.Process(target=_child_create_unsealed,
+                    args=(store.path, oid.binary(), ready))
+    p.start()
+    assert ready.wait(30)
+    p.kill()
+    p.join()
+    before = store.num_objects()
+    assert store.reclaim_pid(p.pid) >= 1
+    assert store.num_objects() == before - 1
+    # the id is free again
+    store.put(oid, b"fresh")
+    assert store.get(oid) == b"fresh"
