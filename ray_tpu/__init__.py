@@ -19,6 +19,7 @@ from .core.api import (
     get,
     get_actor,
     get_runtime_context,
+    head_address,
     init,
     is_initialized,
     kill,
@@ -35,7 +36,7 @@ from .core.actor import ActorHandle
 __all__ = [
     "__version__", "exceptions", "init", "shutdown", "is_initialized",
     "remote", "get", "put", "wait", "kill", "cancel", "get_actor",
-    "get_runtime_context", "nodes", "cluster_resources",
+    "get_runtime_context", "head_address", "nodes", "cluster_resources",
     "available_resources", "timeline", "ObjectRef", "ActorHandle", "util",
 ]
 

@@ -164,6 +164,16 @@ def timeline(filename: Optional[str] = None):
     return events
 
 
+def head_address() -> dict:
+    """Connection info for joining this cluster from another host:
+    `python -m ray_tpu.core.node_agent --head <address> --authkey <authkey>`
+    (reference analog: the bootstrap address `ray start --address=` dials)."""
+    rt = _runtime()
+    if not isinstance(rt, Runtime):
+        raise RuntimeError("head_address() only works on the head runtime")
+    return {"address": rt.head_address, "authkey": rt._authkey.hex()}
+
+
 class RuntimeContext:
     """Reference: python/ray/runtime_context.py."""
 

@@ -1,0 +1,145 @@
+"""Node agent: joins a host to a running cluster over TCP.
+
+Reference parity: the per-node raylet daemon (reference:
+src/ray/raylet/main.cc:139 + node_manager.h:124) reduced to its worker-pool
+role — it registers the host's resources with the head, forks/kills worker
+processes on request, and reports their exits. Scheduling stays centralized
+in the head (unlike the reference's per-node scheduler) because on a TPU pod
+the unit of placement is the slice, not the node (SURVEY.md §7 inversion).
+
+Current scope: the agent's workers attach the head's shared-memory object
+store, so the agent must run on a host that can see it (same machine or a
+shared /dev/shm). The cross-host data plane (object push/pull over DCN,
+reference object_manager.h:119) is the next layer on top of this control
+plane.
+
+Usage:
+    python -m ray_tpu.core.node_agent --head HOST:PORT --authkey HEX \
+        --num-cpus 4 [--name NAME] [--resources '{"TPU": 4}']
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import traceback
+from multiprocessing.connection import Client
+
+
+class NodeAgent:
+    def __init__(self, head: str, authkey: bytes, resources: dict,
+                 name: str = ""):
+        host, port = head.rsplit(":", 1)
+        self.conn = Client((host, int(port)), authkey=authkey)
+        self.head_host = host
+        self.send_lock = threading.Lock()
+        self.conn.send({"t": "register_node", "resources": resources,
+                        "name": name or f"agent-{os.uname().nodename}"})
+        reply = self.conn.recv()
+        if reply.get("t") != "registered":
+            raise RuntimeError(f"head rejected registration: {reply}")
+        self.node_id = reply["node_id"]
+        self.store_path = reply["store_path"]
+        # the head never echoes the authkey; we authenticated with our copy
+        self.authkey = authkey.hex()
+        self.tcp_port = reply["tcp_port"]
+        if not os.path.exists(self.store_path):
+            raise RuntimeError(
+                f"object store {self.store_path} is not visible from this "
+                f"host; the DCN object transfer service is required for "
+                f"fully remote nodes")
+        self.procs: dict[str, subprocess.Popen] = {}
+
+    def send(self, msg):
+        with self.send_lock:
+            self.conn.send(msg)
+
+    def _spawn(self, wid: str, node_id: str, tpu: bool):
+        from .runtime import build_worker_env
+
+        env = build_worker_env(
+            store_path=self.store_path,
+            head_addr=f"{self.head_host}:{self.tcp_port}",
+            head_family="AF_INET", authkey_hex=self.authkey,
+            wid=wid, node_id_hex=node_id, tpu=tpu)
+        log_dir = os.environ.get("RTPU_AGENT_LOG_DIR", "/tmp/ray_tpu_agent")
+        os.makedirs(log_dir, exist_ok=True)
+        log = open(os.path.join(log_dir, f"worker-{wid}.log"), "wb")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.worker"],
+            env=env, stdout=log, stderr=subprocess.STDOUT,
+            start_new_session=True)
+        self.procs[wid] = proc
+        self.send({"t": "worker_spawned", "wid": wid, "pid": proc.pid})
+        threading.Thread(target=self._watch, args=(wid, proc),
+                         daemon=True).start()
+
+    def _watch(self, wid: str, proc: subprocess.Popen):
+        rc = proc.wait()
+        self.procs.pop(wid, None)
+        try:
+            self.send({"t": "worker_exit", "wid": wid, "rc": rc})
+        except Exception:
+            pass
+
+    def run(self):
+        try:
+            while True:
+                msg = self.conn.recv()
+                t = msg.get("t")
+                if t == "spawn_worker":
+                    try:
+                        self._spawn(msg["wid"], msg["node_id"],
+                                    msg.get("tpu", False))
+                    except Exception:
+                        traceback.print_exc()
+                        self.send({"t": "worker_exit", "wid": msg["wid"],
+                                   "rc": -1})
+                elif t == "kill_worker":
+                    p = self.procs.get(msg["wid"])
+                    if p is not None:
+                        try:
+                            p.kill()
+                        except Exception:
+                            pass
+                elif t == "shutdown":
+                    break
+        except (EOFError, OSError):
+            pass  # head went away
+        finally:
+            for p in list(self.procs.values()):
+                try:
+                    p.kill()
+                except Exception:
+                    pass
+            deadline = time.monotonic() + 2.0
+            for p in list(self.procs.values()):
+                try:
+                    p.wait(timeout=max(0.01, deadline - time.monotonic()))
+                except Exception:
+                    pass
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--head", required=True, help="head TCP address host:port")
+    ap.add_argument("--authkey", default=None,
+                    help="cluster authkey hex (or env RTPU_AUTHKEY)")
+    ap.add_argument("--num-cpus", type=float, default=1.0)
+    ap.add_argument("--resources", default="{}",
+                    help='extra resources JSON, e.g. \'{"TPU": 4}\'')
+    ap.add_argument("--name", default="")
+    args = ap.parse_args(argv)
+    authkey = bytes.fromhex(args.authkey or os.environ["RTPU_AUTHKEY"])
+    resources = {"CPU": args.num_cpus, **json.loads(args.resources)}
+    agent = NodeAgent(args.head, authkey, resources, args.name)
+    print(f"node_agent: joined as node {agent.node_id}", flush=True)
+    agent.run()
+
+
+if __name__ == "__main__":
+    main()

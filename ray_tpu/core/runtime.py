@@ -70,6 +70,9 @@ class NodeInfo:
         self.name = name
         self.alive = True
         self.workers: set[str] = set()
+        # set for agent-backed nodes (a node_agent process joined over TCP);
+        # worker spawn/kill on this node routes through the agent
+        self.agent: Optional["_AgentHandle"] = None
         # allow one worker per CPU plus headroom for zero-cpu tasks
         self.max_workers = int(resources.get("CPU", 1)) + 4
 
@@ -106,6 +109,93 @@ class WorkerInfo:
             return True
         except (OSError, ValueError, BrokenPipeError):
             return False
+
+
+def host_ip() -> str:
+    """Best-effort externally-dialable IP of this host (connected-UDP-socket
+    trick; gethostbyname(hostname) commonly resolves to loopback)."""
+    import socket
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("10.255.255.255", 1))  # no packets sent
+            return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+
+
+def build_worker_env(*, store_path: str, head_addr: str, head_family: str,
+                     authkey_hex: str, wid: str, node_id_hex: str,
+                     tpu: bool) -> dict:
+    """Environment for a `python -m ray_tpu.core.worker` process — the ONE
+    definition shared by the head's local pool and node agents, so worker
+    behavior cannot drift by host."""
+    env = dict(os.environ)
+    paths = [p for p in sys.path if p] + [env.get("PYTHONPATH", "")]
+    if not tpu:
+        # shadow the image's sitecustomize (imports jax+TPU plugin, ~2s)
+        # for workers that will never touch the accelerator; pin them to
+        # the cpu platform
+        boot = os.path.join(os.path.dirname(__file__), "_worker_boot")
+        paths.insert(0, boot)
+        env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(paths)
+    env["RTPU_STORE_PATH"] = store_path
+    env["RTPU_HEAD_ADDR"] = head_addr
+    if head_family != "AF_UNIX":
+        env["RTPU_HEAD_FAMILY"] = head_family
+    env["RTPU_AUTHKEY"] = authkey_hex
+    env["RTPU_WORKER_ID"] = wid
+    env["RTPU_NODE_ID"] = node_id_hex
+    return env
+
+
+class _AgentHandle:
+    """Head-side handle on a node_agent control connection (the raylet-client
+    analog, reference: raylet_client/raylet_client.h — here the head asks the
+    agent to fork/kill workers instead of leasing from a local pool)."""
+
+    def __init__(self, conn: Connection):
+        self.conn = conn
+        self.send_lock = threading.Lock()
+
+    def send(self, msg) -> bool:
+        try:
+            with self.send_lock:
+                self.conn.send(msg)
+            return True
+        except (OSError, ValueError, BrokenPipeError):
+            return False
+
+
+class _RemoteProc:
+    """Process handle for a worker living under a node agent: mirrors the
+    subprocess.Popen surface the runtime uses (pid/kill/terminate/wait/poll),
+    delegating kills to the agent and completing on agent exit reports."""
+
+    def __init__(self, agent: _AgentHandle, wid: str):
+        self._agent = agent
+        self._wid = wid
+        self.pid: int | None = None
+        self.returncode: int | None = None
+        self._exited = threading.Event()
+
+    def kill(self):
+        self._agent.send({"t": "kill_worker", "wid": self._wid})
+
+    terminate = kill
+
+    def wait(self, timeout: float | None = None):
+        if not self._exited.wait(timeout):
+            raise subprocess.TimeoutExpired(f"agent-worker {self._wid}",
+                                            timeout)
+        return self.returncode
+
+    def poll(self):
+        return self.returncode
+
+    def mark_exited(self, rc: int | None):
+        self.returncode = rc if rc is not None else -1
+        self._exited.set()
 
 
 class DirEntry:
@@ -154,7 +244,8 @@ class Runtime:
     def __init__(self, resources: dict[str, float],
                  object_store_memory: int = 2 << 30,
                  session_dir: str | None = None,
-                 head_labels: dict[str, str] | None = None):
+                 head_labels: dict[str, str] | None = None,
+                 enable_remote_nodes: bool = False):
         self.job_id = JobID.from_random()
         sid = self.job_id.hex()[:8]
         self.session_dir = session_dir or f"/tmp/ray_tpu/session_{sid}"
@@ -188,14 +279,29 @@ class Runtime:
                                   head_labels, name="head")
         self.nodes[self.head_node.node_id] = self.head_node
 
-        # control-plane listener
+        # control-plane listeners: AF_UNIX for local workers, TCP for node
+        # agents / remote workers (reference analog: the gRPC services every
+        # raylet/worker dials, rpc/grpc_server.h:88 — one authkeyed
+        # connection-oriented channel here)
         addr = os.path.join(self.session_dir, "head.sock")
         self._authkey = os.urandom(16)
         self.listener = Listener(addr, "AF_UNIX", authkey=self._authkey)
         self.listener_addr = addr
+        # loopback unless the user opts into remote nodes: the channel is
+        # authkey-HMAC-gated but carries pickles, so it must not face the
+        # network by default
+        self._tcp_host = "0.0.0.0" if enable_remote_nodes else "127.0.0.1"
+        self.tcp_listener = Listener((self._tcp_host, 0), "AF_INET",
+                                     authkey=self._authkey)
+        self.tcp_port = self.tcp_listener.address[1]
         self._accept_thread = threading.Thread(
-            target=self._accept_loop, daemon=True, name="rtpu-accept")
+            target=self._accept_loop, args=(self.listener,),
+            daemon=True, name="rtpu-accept")
         self._accept_thread.start()
+        self._tcp_accept_thread = threading.Thread(
+            target=self._accept_loop, args=(self.tcp_listener,),
+            daemon=True, name="rtpu-accept-tcp")
+        self._tcp_accept_thread.start()
 
         # prestart the worker pool so first tasks don't pay process cold-start
         # (reference: worker_pool.h:283 PrestartWorkers / idle pool)
@@ -208,19 +314,32 @@ class Runtime:
     # connection plumbing
     # ------------------------------------------------------------------ #
 
-    def _accept_loop(self):
+    def _accept_loop(self, listener):
         while not self._shutdown:
             try:
-                conn = self.listener.accept()
+                conn = listener.accept()
             except (OSError, EOFError):
                 return
             threading.Thread(target=self._recv_loop, args=(conn,),
                              daemon=True, name="rtpu-recv").start()
 
+    @property
+    def head_address(self) -> str:
+        """TCP address a node agent dials
+        (`ray_tpu.core.node_agent --head <this>`). With the default
+        loopback bind this is only dialable from this host; pass
+        init(enable_remote_nodes=True) for other hosts."""
+        if self._tcp_host != "0.0.0.0":
+            return f"{self._tcp_host}:{self.tcp_port}"
+        return f"{host_ip()}:{self.tcp_port}"
+
     def _recv_loop(self, conn: Connection):
         wid = None
         try:
             msg = conn.recv()
+            if msg.get("t") == "register_node":
+                self._agent_loop(conn, msg)
+                return
             if msg.get("t") != "register":
                 conn.close()
                 return
@@ -319,6 +438,61 @@ class Runtime:
                     self._abandoned_rpcs.discard(oid)
                 self.store.delete(oid)
 
+    def _agent_loop(self, conn: Connection, msg: dict):
+        """Serve one node agent for its lifetime (reference analog: the
+        node-membership half of GcsNodeManager, gcs_node_manager.h:49 —
+        register on connect, dead on disconnect)."""
+        agent = _AgentHandle(conn)
+        node = NodeInfo(NodeID.from_random(), msg["resources"],
+                        msg.get("labels"), name=msg.get("name", "agent"))
+        node.agent = agent
+        # reply BEFORE the node becomes schedulable: otherwise a pending
+        # task could push a spawn_worker ahead of this reply and the agent's
+        # registration recv would read the wrong message. The agent already
+        # holds the authkey (it authenticated with it) — never echo it.
+        agent.send({"t": "registered", "node_id": node.node_id.hex(),
+                    "store_path": self.store_path,
+                    "tcp_port": self.tcp_port})
+        with self.lock:
+            self.nodes[node.node_id] = node
+            self._schedule_locked()
+        try:
+            while True:
+                m = conn.recv()
+                t = m.get("t")
+                if t == "worker_spawned":
+                    with self.lock:
+                        w = self.workers.get(m["wid"])
+                        if w is not None and isinstance(w.proc, _RemoteProc):
+                            w.proc.pid = m["pid"]
+                elif t == "worker_exit":
+                    w = self.workers.get(m["wid"])
+                    if w is not None and isinstance(w.proc, _RemoteProc):
+                        w.proc.mark_exited(m.get("rc"))
+                    self._on_worker_death(m["wid"])
+                elif t == "deregister":
+                    break
+        except (EOFError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except Exception:
+                pass
+            # complete every orphaned remote proc first so remove_node's
+            # per-worker proc.wait() returns immediately instead of timing
+            # out sequentially
+            with self.lock:
+                wids = list(node.workers)
+            for wid in wids:
+                w = self.workers.get(wid)
+                if w is not None and isinstance(w.proc, _RemoteProc):
+                    w.proc.mark_exited(-1)
+            try:
+                self.remove_node(node.node_id)
+            except Exception:
+                pass
+
     # Worker→head request/reply: the reply value is written into the shared
     # store at a worker-chosen oid (reference analog: the CoreWorkerService /
     # GCS RPCs workers issue for name resolution and cluster state,
@@ -374,22 +548,23 @@ class Runtime:
     def _spawn_worker_locked(self, node: NodeInfo, tpu: bool = False) -> WorkerInfo:
         self._worker_seq += 1
         wid = f"w{self._worker_seq:05d}"
-        env = dict(os.environ)
-        paths = [p for p in sys.path if p] + [env.get("PYTHONPATH", "")]
-        if not tpu:
-            # shadow the image's sitecustomize (imports jax+TPU plugin, ~2s)
-            # for workers that will never touch the accelerator
-            boot = os.path.join(os.path.dirname(__file__), "_worker_boot")
-            paths.insert(0, boot)
-        env["PYTHONPATH"] = os.pathsep.join(paths)
-        env["RTPU_STORE_PATH"] = self.store_path
-        env["RTPU_HEAD_ADDR"] = self.listener_addr
-        env["RTPU_AUTHKEY"] = self._authkey.hex()
-        env["RTPU_WORKER_ID"] = wid
-        env["RTPU_NODE_ID"] = node.node_id.hex()
-        if not tpu:
-            # only TPU-designated workers may grab the accelerator runtime
-            env["JAX_PLATFORMS"] = "cpu"
+        if node.agent is not None:
+            # agent-backed node: the agent forks the worker on its host and
+            # reports pid/exit back over its control connection
+            w = WorkerInfo(wid, node.node_id,
+                           _RemoteProc(node.agent, wid), tpu)
+            w.pending_spec = None
+            w.pending_actor = None
+            self.workers[wid] = w
+            node.workers.add(wid)
+            node.agent.send({
+                "t": "spawn_worker", "wid": wid, "tpu": tpu,
+                "node_id": node.node_id.hex()})
+            return w
+        env = build_worker_env(
+            store_path=self.store_path, head_addr=self.listener_addr,
+            head_family="AF_UNIX", authkey_hex=self._authkey.hex(),
+            wid=wid, node_id_hex=node.node_id.hex(), tpu=tpu)
         log = open(os.path.join(self.session_dir, f"worker-{wid}.log"), "wb")
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.core.worker"],
@@ -1264,6 +1439,9 @@ class Runtime:
             workers = list(self.workers.values())
         for w in workers:
             w.send({"t": "exit"})
+        for node in list(self.nodes.values()):
+            if node.agent is not None:
+                node.agent.send({"t": "shutdown"})
         deadline = time.monotonic() + 1.0
         for w in workers:
             if w.proc is None:
@@ -1275,10 +1453,11 @@ class Runtime:
                     w.proc.kill()
                 except Exception:
                     pass
-        try:
-            self.listener.close()
-        except Exception:
-            pass
+        for lst in (self.listener, self.tcp_listener):
+            try:
+                lst.close()
+            except Exception:
+                pass
         # sever control-plane connections so recv threads exit before the
         # store mapping goes away (they may touch the store while handling
         # late messages)

@@ -231,7 +231,11 @@ class WorkerLoop:
         authkey = bytes.fromhex(os.environ["RTPU_AUTHKEY"])
         self.wid = os.environ["RTPU_WORKER_ID"]
         self.store = SharedObjectStore(store_path)
-        self.conn = Client(addr, "AF_UNIX", authkey=authkey)
+        if os.environ.get("RTPU_HEAD_FAMILY") == "AF_INET":
+            host, port = addr.rsplit(":", 1)
+            self.conn = Client((host, int(port)), authkey=authkey)
+        else:
+            self.conn = Client(addr, "AF_UNIX", authkey=authkey)
         self.rt = WorkerRuntime(self.store, self.conn, self.wid)
         rt_mod.set_runtime(self.rt)
         self.actor_instance = None

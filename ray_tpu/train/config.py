@@ -22,6 +22,15 @@ class ScalingConfig:
     tpus_per_worker: float = 0.0
     resources_per_worker: Optional[dict] = None
     placement_strategy: str = "PACK"
+    # Multi-host gang: when True the trainer allocates a coordinator port and
+    # every worker calls jax.distributed.initialize before the train fn, so
+    # all workers' local chips form ONE global mesh (jax.devices() = global).
+    # The mesh-bootstrap analog of the reference's NCCL rendezvous
+    # (train/torch/config.py:115,153).
+    jax_distributed: bool = False
+    # Virtual local device count per worker for CPU gangs (tests; maps to
+    # --xla_force_host_platform_device_count). None = leave as-is.
+    local_device_count: Optional[int] = None
 
     def bundle(self) -> dict:
         res = {"CPU": self.cpus_per_worker}

@@ -65,6 +65,16 @@ class _ResultBus:
         self._events: list[tuple] = []
         self._decisions: dict[tuple, str] = {}
         self._waiters: dict[tuple, object] = {}
+        self._kv: dict[str, object] = {}
+
+    # tiny KV rendezvous (GCS-KV analog, reference gcs_kv_manager.h): rank 0
+    # publishes the jax.distributed coordinator address under the group's
+    # generation key; peers poll until it lands
+    async def set_kv(self, key: str, value):
+        self._kv[key] = value
+
+    async def get_kv(self, key: str):
+        return self._kv.get(key)
 
     async def push(self, rank: int, seq: int, metrics: dict,
                    ckpt_path: Optional[str]):
@@ -106,12 +116,25 @@ class _TrainWorker:
         self._bus = bus
         for k, v in env.items():
             os.environ[k] = v
+        # CPU gangs: the virtual local-device flag must land before this
+        # process first initializes a jax backend (flags are read once);
+        # replace any inherited instance (e.g. the test harness's 8)
+        n_local = env.get("RTPU_LOCAL_DEVICE_COUNT")
+        if n_local and os.environ.get("JAX_PLATFORMS") == "cpu":
+            import re
+            flags = os.environ.get("XLA_FLAGS", "")
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", "", flags)
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{n_local}").strip()
 
     def run(self, fn_and_cfg: bytes, restore_path: Optional[str],
             shards: Optional[dict]) -> str:
         import cloudpickle
         train_fn, train_cfg = cloudpickle.loads(fn_and_cfg)
         run_name, rank, world = self._ctx_args
+        dist = self._init_jax_distributed(rank, world)
         ctx = session_mod.TrainContext(
             run_name=run_name, rank=rank, world_size=world,
             restored_checkpoint=(Checkpoint(restore_path)
@@ -119,16 +142,70 @@ class _TrainWorker:
             dataset_shards=shards, _bus=self._bus)
         session_mod._set_context(ctx)
         try:
-            if train_cfg is _NO_CONFIG:
+            if isinstance(train_cfg, str) and train_cfg == _NO_CONFIG:
                 train_fn()
             else:
                 train_fn(train_cfg)
         finally:
             session_mod._set_context(None)
+            if dist:
+                import jax
+                try:
+                    jax.distributed.shutdown()
+                except Exception:
+                    pass
         return "done"
 
+    def _init_jax_distributed(self, rank: int, world: int) -> bool:
+        """Form the global mesh: every gang worker joins one jax.distributed
+        world, so jax.devices() spans all workers' local chips (the
+        mesh-bootstrap analog of NCCL rendezvous, reference
+        train/torch/config.py:115,153; on TPU pods this is what makes one
+        SPMD program per slice possible, SURVEY.md §7).
 
-_NO_CONFIG = object()
+        Rank 0 picks the coordinator endpoint ON ITS OWN HOST (it may be a
+        different machine than the driver) and publishes it through the
+        result bus; peers poll the bus for it. The generation key isolates
+        restarted gangs from a dead predecessor's address."""
+        if os.environ.get("RTPU_JAX_DIST") != "1" or world <= 1:
+            return False
+        import time as _time
+
+        import ray_tpu as ray
+
+        key = f"coord:{os.environ.get('RTPU_TRAIN_GEN', '0')}"
+        if rank == 0:
+            from ..core.runtime import host_ip
+            coord = f"{host_ip()}:{_free_port()}"
+            ray.get(self._bus.set_kv.remote(key, coord))
+        else:
+            deadline = _time.monotonic() + 60
+            while True:
+                coord = ray.get(self._bus.get_kv.remote(key))
+                if coord:
+                    break
+                if _time.monotonic() > deadline:
+                    raise TrainingFailedError(
+                        "rank 0 never published the jax.distributed "
+                        "coordinator address")
+                _time.sleep(0.05)
+        import jax
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=world, process_id=rank)
+        return True
+
+
+# String sentinel: must survive a cloudpickle round-trip to the worker
+# (an `object()` sentinel would lose identity and break the `is` check).
+_NO_CONFIG = "__rtpu_no_config__"
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
 
 
 class DataParallelTrainer:
@@ -150,6 +227,7 @@ class DataParallelTrainer:
         self.run_config = run_config or RunConfig()
         self.datasets = datasets or {}
         self.resume_from = resume_from_checkpoint
+        self._start_count = 0
 
     # -- worker-group lifecycle -------------------------------------------
 
@@ -167,6 +245,7 @@ class DataParallelTrainer:
         shards = self._split_datasets(n)
         workers, run_refs = [], []
         blob = cloudpickle.dumps((self.train_fn, self.train_cfg))
+        self._start_count += 1
         for rank in range(n):
             env = self._worker_env(rank, n)
             w = WorkerCls.options(
@@ -184,12 +263,20 @@ class DataParallelTrainer:
 
     def _worker_env(self, rank: int, world: int) -> dict:
         """JAX gang env (the mesh-bootstrap analog of NCCL rendezvous env,
-        reference train/torch/config.py:153). Single-host: nothing needed;
-        multi-host slices get jax.distributed coordinates."""
-        return {
+        reference train/torch/config.py:153). With
+        ScalingConfig(jax_distributed=True) the gang forms one
+        jax.distributed world: rank 0's host carries the coordinator."""
+        env = {
             "RTPU_TRAIN_RANK": str(rank),
             "RTPU_TRAIN_WORLD": str(world),
         }
+        if self.scaling.jax_distributed and world > 1:
+            env["RTPU_JAX_DIST"] = "1"
+            env["RTPU_TRAIN_GEN"] = str(self._start_count)
+        if self.scaling.local_device_count:
+            env["RTPU_LOCAL_DEVICE_COUNT"] = str(
+                self.scaling.local_device_count)
+        return env
 
     def _split_datasets(self, n: int) -> list[Optional[dict]]:
         """Round-robin shard plain iterables; Dataset objects use
