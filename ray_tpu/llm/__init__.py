@@ -2,10 +2,12 @@
 
 Reference parity: python/ray/llm (serve.llm vllm_engine.py:180 VLLMEngine /
 llm_server.py:409, batch processor/base.py:104). The external vLLM engine is
-replaced by a JAX-native continuous-batching engine (engine.py): slot-based
-KV cache, jitted prefill/decode over the whole batch, in-jit sampling —
-attention/matmuls stay on the MXU, the Python loop only admits/retires
-requests.
+replaced by JAX-native continuous-batching engines: paged_engine.py is the
+production path (paged KV cache with block tables, Pallas paged decode
+attention, chunked prefill so admission never stalls decode); engine.py is
+the simpler dense-slot variant. Jitted prefill/decode over the whole batch,
+in-jit sampling — attention/matmuls stay on the MXU, the Python loop only
+admits/retires requests and allocates pages.
 
     from ray_tpu import llm
     engine = llm.InferenceEngine(llm.EngineConfig(model=cfg), params)
@@ -17,9 +19,11 @@ maps a Dataset through tokenize -> generate -> detokenize stages
 (reference: data/llm.py:248).
 """
 from .engine import EngineConfig, InferenceEngine, SamplingParams
+from .paged_engine import PagedEngineConfig, PagedInferenceEngine
 from .tokenizer import ByteTokenizer, get_tokenizer
 
 __all__ = ["EngineConfig", "InferenceEngine", "SamplingParams",
+           "PagedEngineConfig", "PagedInferenceEngine",
            "ByteTokenizer", "get_tokenizer", "serving", "batch"]
 
 from . import serving, batch  # noqa: E402

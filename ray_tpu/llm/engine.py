@@ -47,12 +47,17 @@ class EngineConfig:
 
 @dataclasses.dataclass
 class _Request:
+    """One in-flight generation (shared by both engines)."""
     rid: int
     prompt_ids: list[int]
     params: SamplingParams
     out_ids: list[int] = dataclasses.field(default_factory=list)
     slot: int = -1
+    pages: list[int] = dataclasses.field(default_factory=list)
+    prefill_pos: int = 0          # prompt tokens already prefilled (paged)
     done: bool = False
+    submit_t: float = 0.0
+    first_token_t: float = 0.0    # TTFT = first_token_t - submit_t
     event: threading.Event = dataclasses.field(
         default_factory=threading.Event)
 
@@ -69,7 +74,90 @@ def sample_logits(logits: jax.Array, rng: jax.Array, temperature: float,
     return jax.random.categorical(rng, logits, axis=-1)
 
 
-class InferenceEngine:
+class _EngineBase:
+    """Request intake, sampling dispatch and result shaping shared by the
+    dense-slot and paged engines (the engine-loop surface of the reference's
+    VLLMEngine). Subclasses provide step()/has_work() and the two compiled
+    programs; they must maintain self.cfg (with .max_seq_len), self._lock,
+    self._pending, self._active, self._rng, self.tokenizer."""
+
+    def generate(self, prompts, params=None) -> list[dict]:
+        """Blocking batch generation; returns [{text, token_ids,
+        prompt_tokens, ttft_s, finish_reason}] in prompt order."""
+        if params is None:
+            params = SamplingParams()
+        plist = params if isinstance(params, list) else \
+            [params] * len(prompts)
+        reqs = [self.submit(p, sp) for p, sp in zip(prompts, plist)]
+        while not all(r.done for r in reqs):
+            self.step()
+        return [self._result(r) for r in reqs]
+
+    def submit(self, prompt, params: SamplingParams) -> _Request:
+        import time
+        ids = (self.tokenizer.encode(prompt) if isinstance(prompt, str)
+               else list(prompt))
+        # keep the prompt (up to the cache capacity) and clamp max_tokens
+        # to the remaining room — never silently discard the prompt
+        ids = ids[: self.cfg.max_seq_len - 2]
+        if not ids:
+            raise ValueError("empty prompt")
+        capacity = self.cfg.max_seq_len - 1 - len(ids)
+        if params.max_tokens > capacity:
+            params = dataclasses.replace(params,
+                                         max_tokens=max(1, capacity))
+        with self._lock:
+            req = _Request(self._next_rid, ids, params)
+            req.submit_t = time.perf_counter()
+            self._next_rid += 1
+            self._pending.append(req)
+        return req
+
+    def has_work(self) -> bool:
+        return bool(self._pending or self._active)
+
+    def run_until_done(self, reqs: list[_Request]):
+        while not all(r.done for r in reqs):
+            self.step()
+
+    def _sample_one(self, logits, params: SamplingParams):
+        self._rng, sub = jax.random.split(self._rng)
+        return np.asarray(sample_logits(logits, sub, params.temperature,
+                                        params.top_k))
+
+    def _sample_next_tokens(self, logits, rng) -> dict[int, int]:
+        """Per-slot next token, batching slots that share sampling params."""
+        by_temp: dict[tuple, list[int]] = {}
+        for slot, req in self._active.items():
+            by_temp.setdefault(
+                (req.params.temperature, req.params.top_k), []).append(slot)
+        next_tokens: dict[int, int] = {}
+        for (temp, top_k), slots in by_temp.items():
+            sampled = np.asarray(sample_logits(
+                logits[jnp.asarray(slots)], rng, temp, top_k))
+            for s, t in zip(slots, sampled):
+                next_tokens[s] = int(t)
+        return next_tokens
+
+    def _eos_id(self):
+        return getattr(self.tokenizer, "eos_id",
+                       getattr(self.tokenizer, "eos_token_id", None))
+
+    def _result(self, req: _Request) -> dict:
+        eos = getattr(self.tokenizer, "eos_id", None)
+        trimmed = [t for t in req.out_ids if t != eos]
+        return {
+            "text": self.tokenizer.decode(trimmed),
+            "token_ids": req.out_ids,
+            "prompt_tokens": len(req.prompt_ids),
+            "ttft_s": (req.first_token_t - req.submit_t
+                       if req.first_token_t else None),
+            "finish_reason": ("stop" if eos is not None and eos in req.out_ids
+                              else "length"),
+        }
+
+
+class InferenceEngine(_EngineBase):
     """Synchronous engine; the serving layer runs it on a background thread
     and exposes an async API (reference: VLLMEngine's engine loop)."""
 
@@ -127,46 +215,6 @@ class InferenceEngine:
         self._prefill_fn = _prefill
         self._decode_fn = _decode
 
-    # -- public API --------------------------------------------------------
-
-    def generate(self, prompts: list[str] | list[list[int]],
-                 params: SamplingParams | list[SamplingParams] = None,
-                 ) -> list[dict]:
-        """Blocking batch generation; returns [{text, token_ids,
-        prompt_tokens, finish_reason}] in prompt order."""
-        if params is None:
-            params = SamplingParams()
-        plist = params if isinstance(params, list) else \
-            [params] * len(prompts)
-        reqs = [self.submit(p, sp) for p, sp in zip(prompts, plist)]
-        self.run_until_done(reqs)
-        return [self._result(r) for r in reqs]
-
-    def submit(self, prompt, params: SamplingParams) -> _Request:
-        ids = (self.tokenizer.encode(prompt) if isinstance(prompt, str)
-               else list(prompt))
-        # keep the prompt (up to the cache capacity) and clamp max_tokens
-        # to the remaining room — never silently discard the prompt
-        ids = ids[: self.cfg.max_seq_len - 2]
-        if not ids:
-            raise ValueError("empty prompt")
-        capacity = self.cfg.max_seq_len - 1 - len(ids)
-        if params.max_tokens > capacity:
-            params = dataclasses.replace(params,
-                                         max_tokens=max(1, capacity))
-        with self._lock:
-            req = _Request(self._next_rid, ids, params)
-            self._next_rid += 1
-            self._pending.append(req)
-        return req
-
-    def run_until_done(self, reqs: list[_Request]):
-        while not all(r.done for r in reqs):
-            self.step()
-
-    def has_work(self) -> bool:
-        return bool(self._pending or self._active)
-
     # -- engine loop -------------------------------------------------------
 
     def step(self):
@@ -203,6 +251,7 @@ class InferenceEngine:
         return self.cfg.max_seq_len
 
     def _do_prefill(self, req: _Request):
+        import time
         ids = req.prompt_ids
         bucket = self._bucket(len(ids))
         padded = ids + [0] * (bucket - len(ids))
@@ -211,25 +260,11 @@ class InferenceEngine:
             req.slot, len(ids))
         first = self._sample_one(last_logits[None, :], req.params)
         req.out_ids.append(int(first[0]))
-
-    def _sample_one(self, logits, params: SamplingParams):
-        self._rng, sub = jax.random.split(self._rng)
-        return np.asarray(sample_logits(logits, sub, params.temperature,
-                                        params.top_k))
+        req.first_token_t = time.perf_counter()
 
     def _sample_and_retire(self, logits, rng):
-        by_temp: dict[tuple, list[int]] = {}
-        for slot, req in self._active.items():
-            by_temp.setdefault(
-                (req.params.temperature, req.params.top_k), []).append(slot)
-        next_tokens = {}
-        for (temp, top_k), slots in by_temp.items():
-            sampled = np.asarray(sample_logits(
-                logits[jnp.asarray(slots)], rng, temp, top_k))
-            for s, t in zip(slots, sampled):
-                next_tokens[s] = int(t)
-        eos = getattr(self.tokenizer, "eos_id",
-                      getattr(self.tokenizer, "eos_token_id", None))
+        next_tokens = self._sample_next_tokens(logits, rng)
+        eos = self._eos_id()
         for slot in list(self._active):
             req = self._active[slot]
             tok = next_tokens[slot]
@@ -243,15 +278,3 @@ class InferenceEngine:
                 req.event.set()
                 del self._active[slot]
                 self._free_slots.append(slot)
-
-    def _result(self, req: _Request) -> dict:
-        out = req.out_ids
-        eos = getattr(self.tokenizer, "eos_id", None)
-        trimmed = [t for t in out if t != eos]
-        return {
-            "text": self.tokenizer.decode(trimmed),
-            "token_ids": out,
-            "prompt_tokens": len(req.prompt_ids),
-            "finish_reason": ("stop" if eos is not None and eos in out
-                              else "length"),
-        }

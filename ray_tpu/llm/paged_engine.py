@@ -1,0 +1,241 @@
+"""Paged-KV continuous-batching engine (the production serving path).
+
+vLLM-analog re-designed for XLA (reference role:
+llm/_internal/serve/deployments/llm/vllm/vllm_engine.py:180): the KV cache
+is a pool of fixed-size pages shared by all sequences; each request owns a
+block table of page ids, so cache capacity is bounded by TOKENS IN FLIGHT,
+not max_batch x max_seq_len, and decode attention (Pallas,
+ops/paged_attention.py) reads only the pages a sequence actually uses.
+
+Two jitted programs with static shapes:
+  - chunked prefill: one page-aligned chunk of one prompt per engine step
+    (bounded work — a long prompt can no longer stall every decode slot;
+    vLLM's chunked-prefill role);
+  - batched decode: one token for every decode-ready slot.
+
+The Python loop does admission, page allocation, sampling dispatch and
+retirement; all math stays compiled. Cache buffers are donated through both
+programs so XLA updates pages in place.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import llama
+from .engine import SamplingParams, _EngineBase, _Request  # noqa: F401 — SamplingParams re-exported
+from .tokenizer import get_tokenizer
+
+
+@dataclasses.dataclass
+class PagedEngineConfig:
+    model: llama.LlamaConfig
+    max_batch_size: int = 8
+    page_size: int = 16
+    num_pages: int = 512
+    max_pages_per_seq: int = 64
+    # prefill chunk (page multiple); one chunk of one prompt per step
+    chunk_size: int = 128
+    tokenizer: Any = None
+
+    def __post_init__(self):
+        if self.chunk_size % self.page_size:
+            raise ValueError("chunk_size must be a multiple of page_size")
+
+    @property
+    def max_seq_len(self) -> int:
+        return self.max_pages_per_seq * self.page_size
+
+
+class PagedInferenceEngine(_EngineBase):
+    """Synchronous paged engine; serving runs it on a background thread."""
+
+    def __init__(self, cfg: PagedEngineConfig, params: Optional[dict] = None,
+                 rng_seed: int = 0, interpret: bool = False):
+        self.cfg = cfg
+        mc = cfg.model
+        self.tokenizer = get_tokenizer(cfg.tokenizer)
+        if params is None:
+            params = llama.init(jax.random.PRNGKey(rng_seed), mc)
+        self.params = params
+        self.caches = llama.init_paged_cache(mc, cfg.num_pages,
+                                             cfg.page_size)
+        # page 0 is the write sink for slots that are idle during a decode
+        # step (their dummy token writes land there, never attended); it is
+        # never allocated to a sequence
+        self._free_pages = list(range(1, cfg.num_pages))
+        self._free_slots = list(range(cfg.max_batch_size))
+        self._block_tables = np.zeros(
+            (cfg.max_batch_size, cfg.max_pages_per_seq), np.int32)
+        self._lengths = np.zeros((cfg.max_batch_size,), np.int32)
+        self._active: dict[int, _Request] = {}
+        self._prefilling: list[_Request] = []   # admitted, prompt not done
+        self._pending: list[_Request] = []
+        self._next_rid = 0
+        self._rng = jax.random.PRNGKey(rng_seed)
+        self._lock = threading.Lock()
+
+        page = cfg.page_size
+
+        # cache pytrees are donated so XLA updates pages in place
+        self._decode_fn = jax.jit(
+            lambda p, c, t, bt, ln: llama.decode_paged(
+                p, t[:, None], c, bt, ln, mc, page_size=page,
+                interpret=interpret),
+            donate_argnums=(1,))
+        self._prefill_fn = jax.jit(
+            lambda p, c, chunk, bt, sp, tl: llama.prefill_paged_chunk(
+                p, chunk[None, :], c, bt, sp, mc, page_size=page,
+                true_chunk_len=tl),
+            donate_argnums=(1,))
+
+    # -- public API --------------------------------------------------------
+
+    def has_work(self) -> bool:
+        return bool(self._pending or self._prefilling or self._active)
+
+    # -- page allocation ---------------------------------------------------
+
+    def _pages_needed(self, tokens: int) -> int:
+        return (tokens + self.cfg.page_size - 1) // self.cfg.page_size
+
+    def _ensure_pages(self, req: _Request, upto_tokens: int) -> bool:
+        """Grow req's page list to cover upto_tokens; False if pool dry."""
+        need = self._pages_needed(upto_tokens) - len(req.pages)
+        if need <= 0:
+            return True
+        if len(self._free_pages) < need:
+            return False
+        for _ in range(need):
+            req.pages.append(self._free_pages.pop())
+        bt = self._block_tables[req.slot]
+        bt[:len(req.pages)] = req.pages
+        return True
+
+    def _release(self, req: _Request):
+        self._free_pages.extend(req.pages)
+        req.pages = []
+        if req.slot >= 0:
+            # zero the row so nothing stale survives into the next tenant
+            # (writes through leftover entries would hit recycled pages)
+            self._block_tables[req.slot, :] = 0
+            self._free_slots.append(req.slot)
+            self._lengths[req.slot] = 0
+            req.slot = -1
+
+    # -- engine loop -------------------------------------------------------
+
+    def step(self):
+        """One iteration: admit, one prefill chunk (bounded), one decode."""
+        self._admit()
+        self._prefill_step()
+        self._decode_step()
+
+    def _admit(self):
+        with self._lock:
+            while self._pending and self._free_slots:
+                # admission control: hold requests until the pool can cover
+                # the whole prompt (avoids deadlocking a half-prefilled seq)
+                req = self._pending[0]
+                if (self._pages_needed(len(req.prompt_ids) + 1)
+                        > len(self._free_pages)):
+                    break
+                self._pending.pop(0)
+                req.slot = self._free_slots.pop(0)
+                self._ensure_pages(req, len(req.prompt_ids) + 1)
+                self._prefilling.append(req)
+
+    def _prefill_step(self):
+        import time
+        if not self._prefilling:
+            return
+        req = self._prefilling[0]
+        c = self.cfg.chunk_size
+        start = req.prefill_pos
+        chunk_ids = req.prompt_ids[start:start + c]
+        true_in_chunk = len(chunk_ids)
+        chunk = np.zeros((c,), np.int32)
+        chunk[:true_in_chunk] = chunk_ids
+        logits, self.caches = self._prefill_fn(
+            self.params, self.caches, jnp.asarray(chunk),
+            jnp.asarray(self._block_tables[req.slot]),
+            np.int32(start), np.int32(true_in_chunk))
+        req.prefill_pos += true_in_chunk
+        if req.prefill_pos >= len(req.prompt_ids):
+            # prompt done: sample the first generated token
+            last = jax.lax.dynamic_index_in_dim(
+                logits, true_in_chunk - 1, 0, keepdims=False)
+            tok = int(self._sample_one(last[None, :], req.params)[0])
+            req.out_ids.append(tok)
+            req.first_token_t = time.perf_counter()
+            self._lengths[req.slot] = len(req.prompt_ids)
+            self._prefilling.pop(0)
+            self._active[req.slot] = req
+            self._maybe_finish(req, tok)
+        # NOTE: pad positions of the final chunk were written into the
+        # sequence's own pages beyond its true length; decode masks
+        # positions >= length so they are never attended.
+
+    def _decode_step(self):
+        if not self._active:
+            return
+        bs = self.cfg.max_batch_size
+        tokens = np.zeros((bs,), np.int32)
+        lengths = np.zeros((bs,), np.int32)
+        # slots not decoding this step get a zeroed block-table row: their
+        # dummy write goes to sink page 0 instead of a live (possibly
+        # reused) page
+        bt = np.zeros_like(self._block_tables)
+        for slot, req in self._active.items():
+            tokens[slot] = req.out_ids[-1]
+            lengths[slot] = self._lengths[slot]
+            bt[slot] = self._block_tables[slot]
+        self._rng, sub = jax.random.split(self._rng)
+        logits, self.caches = self._decode_fn(
+            self.params, self.caches, jnp.asarray(tokens),
+            jnp.asarray(bt), jnp.asarray(lengths))
+        for slot in list(self._active):
+            self._lengths[slot] += 1
+        self._sample_and_retire(logits, sub)
+
+    def _sample_and_retire(self, logits, rng):
+        next_tokens = self._sample_next_tokens(logits, rng)
+        for slot in list(self._active):
+            req = self._active[slot]
+            tok = next_tokens[slot]
+            req.out_ids.append(tok)
+            self._maybe_finish(req, tok)
+
+    def _maybe_finish(self, req: _Request, tok: int):
+        eos = self._eos_id()
+        total = len(req.prompt_ids) + len(req.out_ids)
+        stop = (len(req.out_ids) >= req.params.max_tokens
+                or tok == eos or tok in req.params.stop_token_ids
+                or total >= self.cfg.max_seq_len - 1)
+        if not stop:
+            # growing by one token may need one more page
+            if not self._ensure_pages(req, total + 1):
+                stop = True  # pool exhausted: finish early rather than wedge
+        if stop:
+            req.done = True
+            req.event.set()
+            self._active.pop(req.slot, None)
+            if req in self._prefilling:
+                self._prefilling.remove(req)
+            self._release(req)
+
+    # -- stats -------------------------------------------------------------
+
+    def pool_stats(self) -> dict:
+        return {
+            "free_pages": len(self._free_pages),
+            "total_pages": self.cfg.num_pages,
+            "active": len(self._active),
+            "prefilling": len(self._prefilling),
+            "pending": len(self._pending),
+        }

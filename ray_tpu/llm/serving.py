@@ -18,13 +18,18 @@ import threading
 from typing import Any, Optional
 
 from .engine import EngineConfig, InferenceEngine, SamplingParams
+from .paged_engine import PagedEngineConfig, PagedInferenceEngine
 
 
 @dataclasses.dataclass
 class LLMConfig:
-    """(reference: llm/_internal/serve/configs/server_models.py LLMConfig)"""
+    """(reference: llm/_internal/serve/configs/server_models.py LLMConfig)
+
+    `engine` may be an EngineConfig (dense slot cache) or a
+    PagedEngineConfig (paged-KV continuous batching — the production path);
+    the default is paged."""
     model_id: str = "llama-tiny"
-    engine: Optional[EngineConfig] = None
+    engine: Optional[EngineConfig | PagedEngineConfig] = None
     num_replicas: int = 1
     max_ongoing_requests: int = 64
     tpus_per_replica: float = 0.0
@@ -36,12 +41,16 @@ class LLMServer:
 
     def __init__(self, cfg: LLMConfig, params_ref=None):
         from ..models import llama
-        engine_cfg = cfg.engine or EngineConfig(model=llama.llama_tiny())
+        engine_cfg = cfg.engine or PagedEngineConfig(
+            model=llama.llama_tiny())
         params = None
         if params_ref is not None:
             import ray_tpu
             params = ray_tpu.get(params_ref)
-        self.engine = InferenceEngine(engine_cfg, params)
+        if isinstance(engine_cfg, PagedEngineConfig):
+            self.engine = PagedInferenceEngine(engine_cfg, params)
+        else:
+            self.engine = InferenceEngine(engine_cfg, params)
         self.model_id = cfg.model_id
         self._wake = threading.Event()
         self._stop = False
@@ -62,7 +71,8 @@ class LLMServer:
             # unblock every waiter; completions() re-raises the error, and
             # check_health makes the controller replace this replica
             for req in (list(self.engine._active.values())
-                        + list(self.engine._pending)):
+                        + list(self.engine._pending)
+                        + list(getattr(self.engine, "_prefilling", []))):
                 req.event.set()
 
     # -- OpenAI-ish surface ------------------------------------------------

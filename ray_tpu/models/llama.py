@@ -18,13 +18,14 @@ Decode-time KV caching lives here too (used by the serving engine).
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
 
-from ..ops.flash_attention import flash_attention, mha_reference
+from ..ops.flash_attention import _on_tpu, flash_attention, mha_reference
 from ..parallel.sharding import constrain
 
 
@@ -378,6 +379,174 @@ def decode_batched(params: dict, tokens: jax.Array, cache: dict,
                         preferred_element_type=jnp.float32)[:, 0]
     new_cache = {"k": new_k, "v": new_v, "lengths": cache["lengths"] + 1}
     return logits, new_cache
+
+
+# ---------------------------------------------------------------------------
+# Paged KV cache (block tables; used by the paged serving engine)
+# ---------------------------------------------------------------------------
+
+def init_paged_cache(cfg: LlamaConfig, num_pages: int,
+                     page_size: int) -> list[dict]:
+    """Per-layer page pools: [{'k','v': [P, page, KVH, D]}] * n_layers.
+
+    Kept as SEPARATE per-layer arrays (not a stacked [L, ...] tensor): the
+    decode step is unrolled over layers so each Pallas paged-attention call
+    consumes its layer's pool directly — a scan-sliced stacked tensor would
+    materialize a full-layer copy per step.
+
+    Convention: physical page 0 is a write SINK — allocators must never
+    hand it to a sequence. decode_paged (idle rows) and prefill_paged_chunk
+    (pad pages) dump never-attended writes there.
+    """
+    shape = (num_pages, page_size, cfg.n_kv_heads, cfg.head_dim)
+    return [{"k": jnp.zeros(shape, cfg.dtype),
+             "v": jnp.zeros(shape, cfg.dtype)}
+            for _ in range(cfg.n_layers)]
+
+
+def _layer_params(params: dict, layer: int) -> dict:
+    return jax.tree.map(lambda a: a[layer], params["layers"])
+
+
+def decode_paged(params: dict, tokens: jax.Array, caches: list[dict],
+                 block_tables: jax.Array, lengths: jax.Array,
+                 cfg: LlamaConfig, *, page_size: int,
+                 interpret: bool = False):
+    """One decode step over paged caches.
+
+    tokens [B, 1]; block_tables [B, max_pages]; lengths [B] = tokens already
+    WRITTEN (current token goes at position `lengths`). Returns
+    (logits [B, V], updated caches). Inactive rows: pass length 0 and mask
+    the output — their token writes land in page block_tables[b, 0] slot 0
+    and are overwritten on real use.
+    """
+    from ..ops.paged_attention import (
+        paged_decode_attention, paged_decode_reference,
+    )
+
+    b = tokens.shape[0]
+    rows = jnp.arange(b)
+    x = params["embed"][tokens].astype(cfg.dtype)          # [B, 1, D]
+    cos, sin = rope_freqs(cfg, lengths[:, None])
+    page_ids = block_tables[rows, lengths // page_size]    # [B]
+    offsets = lengths % page_size                          # [B]
+
+    new_caches = []
+    for layer in range(cfg.n_layers):
+        p = _layer_params(params, layer)
+        cache = caches[layer]
+        h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
+        q, k, v = _qkv(h, p, cfg, cos, sin)                # q [B,1,H,D]
+        k_pages = cache["k"].at[page_ids, offsets].set(
+            k[:, 0].astype(cache["k"].dtype))
+        v_pages = cache["v"].at[page_ids, offsets].set(
+            v[:, 0].astype(cache["v"].dtype))
+        attend = paged_decode_reference if not (
+            interpret or _on_tpu()) else functools.partial(
+                paged_decode_attention, interpret=interpret)
+        attn = attend(q[:, 0], k_pages, v_pages, block_tables,
+                      lengths + 1)                         # [B, H, D]
+        x = x + attn.reshape(b, 1, -1) @ p["wo"]
+        x = _mlp_block(x, p, cfg)
+        new_caches.append({"k": k_pages, "v": v_pages})
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"],
+                        preferred_element_type=jnp.float32)[:, 0]
+    return logits, new_caches
+
+
+def prefill_paged_chunk(params: dict, chunk: jax.Array, caches: list[dict],
+                        block_table_row: jax.Array, start_pos: jax.Array,
+                        cfg: LlamaConfig, *, page_size: int,
+                        true_chunk_len: jax.Array | None = None):
+    """Prefill ONE page-aligned chunk of one sequence.
+
+    chunk [1, C] (C a multiple of page_size, right-padded with zeros);
+    block_table_row [max_pages]; start_pos = tokens already prefilled
+    (page-aligned); true_chunk_len = real tokens in this chunk (defaults to
+    C). Attends over the already-written paged prefix plus causally within
+    the chunk, writes the chunk's K/V into its pages, and returns
+    (logits [C, V], updated caches) — caller picks the logit at the
+    prompt's true last position.
+
+    Pages past the chunk's real tokens (pad pages of the final chunk, or
+    logical pages beyond the block table) are written to page 0 — the
+    reserved sink page no sequence owns — so a short final chunk can never
+    clobber pages the allocator has handed to another sequence.
+
+    Chunked prefill exists so admission never stalls decode: the engine
+    interleaves one bounded chunk per step (vLLM's chunked-prefill role).
+    """
+    c = chunk.shape[1]
+    n_chunk_pages = c // page_size
+    max_pages = block_table_row.shape[0]
+    prefix_len = max_pages * page_size                    # static gather size
+    positions = start_pos + jnp.arange(c)[None, :]        # [1, C]
+    cos, sin = rope_freqs(cfg, positions)
+    groups = cfg.n_heads // cfg.n_kv_heads
+    scale = cfg.head_dim ** -0.5
+    if true_chunk_len is None:
+        true_chunk_len = jnp.int32(c)
+    # gather (not dynamic_slice: it clamps at the row end and would silently
+    # shift the write window); invalid logical pages route to sink page 0
+    logical = start_pos // page_size + jnp.arange(n_chunk_pages)
+    valid_pages = (true_chunk_len + page_size - 1) // page_size
+    valid = (jnp.arange(n_chunk_pages) < valid_pages) & (logical < max_pages)
+    chunk_page_ids = jnp.where(
+        valid, block_table_row[jnp.clip(logical, 0, max_pages - 1)], 0)
+
+    x = params["embed"][chunk].astype(cfg.dtype)          # [1, C, D]
+    new_caches = []
+    for layer in range(cfg.n_layers):
+        p = _layer_params(params, layer)
+        cache = caches[layer]
+        h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
+        q, k, v = _qkv(h, p, cfg, cos, sin)               # [1,C,H/KVH,D]
+
+        # gathered prefix (static size; masked beyond start_pos)
+        pk = cache["k"][block_table_row].reshape(
+            1, prefix_len, cfg.n_kv_heads, cfg.head_dim)
+        pv = cache["v"][block_table_row].reshape(
+            1, prefix_len, cfg.n_kv_heads, cfg.head_dim)
+        kk = jnp.concatenate([pk, k.astype(pk.dtype)], axis=1)
+        vv = jnp.concatenate([pv, v.astype(pv.dtype)], axis=1)
+        if groups > 1:
+            kk = jnp.repeat(kk, groups, axis=2)
+            vv = jnp.repeat(vv, groups, axis=2)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                       kk.astype(jnp.float32)) * scale
+        k_pos = jnp.concatenate(
+            [jnp.arange(prefix_len),
+             start_pos + jnp.arange(c)])                  # [K]
+        prefix_valid = jnp.concatenate(
+            [jnp.arange(prefix_len) < start_pos,
+             jnp.ones((c,), bool)])
+        mask = (k_pos[None, :] <= positions[0][:, None]) & \
+            prefix_valid[None, :]                         # [C, K]
+        s = jnp.where(mask[None, None], s, -1e30)
+        w = jax.nn.softmax(s, axis=-1)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", w,
+                          vv.astype(jnp.float32)).astype(cfg.dtype)
+        x = x + attn.reshape(1, c, -1) @ p["wo"]
+        x = _mlp_block(x, p, cfg)
+
+        # write the chunk's K/V into its (page-aligned) pages
+        k_w = k[0].reshape(n_chunk_pages, page_size,
+                           cfg.n_kv_heads, cfg.head_dim)
+        v_w = v[0].reshape(n_chunk_pages, page_size,
+                           cfg.n_kv_heads, cfg.head_dim)
+        new_caches.append({
+            "k": cache["k"].at[chunk_page_ids].set(
+                k_w.astype(cache["k"].dtype)),
+            "v": cache["v"].at[chunk_page_ids].set(
+                v_w.astype(cache["v"].dtype)),
+        })
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"],
+                        preferred_element_type=jnp.float32)[0]
+    return logits, new_caches
 
 
 def cross_entropy_loss(logits: jax.Array, targets: jax.Array,

@@ -454,11 +454,19 @@ def flash_attention(q, k, v, causal: bool = False,
     return out
 
 
+_ON_TPU: Optional[bool] = None
+
+
 def _on_tpu() -> bool:
-    try:
-        return jax.devices()[0].platform == "tpu"
-    except Exception:
-        return False
+    """Cached platform probe shared by every kernel-vs-reference dispatch
+    (flash fwd/bwd, paged decode)."""
+    global _ON_TPU
+    if _ON_TPU is None:
+        try:
+            _ON_TPU = jax.devices()[0].platform == "tpu"
+        except Exception:
+            return False  # don't cache a failed probe
+    return _ON_TPU
 
 
 def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):

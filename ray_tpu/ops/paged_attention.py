@@ -1,0 +1,160 @@
+"""Paged decode attention for TPU (Pallas): block-table KV cache.
+
+The serving engine's KV cache is a pool of fixed-size pages
+(`k_pages/v_pages [num_pages, page_size, KVH, D]`); each sequence owns a
+list of page ids (`block_table [B, max_pages]`, lengths `[B]`). One decode
+step attends each query row over exactly the pages its sequence owns —
+HBM traffic scales with the sequence's true length, not the pool capacity.
+
+Kernel shape (the ragged-paged-attention idea from PAPERS.md, original
+implementation): grid (batch, max_pages) with the block table scalar-
+prefetched so the K/V page BlockSpec index maps select each sequence's
+physical page; a streaming-softmax accumulator in VMEM scratch carries
+across the page sweep; pages at or beyond the sequence's page count are
+skipped (`pl.when`), and the tail page is masked by position.
+
+Reference role (not design): vLLM's paged attention under
+llm/_internal/serve/deployments/llm/vllm/vllm_engine.py:180 — the
+reference orchestrates it, the kernel itself is ours.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _paged_decode_kernel(bt_ref, len_ref,                 # scalar prefetch
+                         q_ref, k_ref, v_ref,             # blocks
+                         o_ref,                           # output
+                         acc_ref, m_ref, l_ref,           # VMEM scratch
+                         *, scale: float, page_size: int, num_kv_heads: int,
+                         groups: int, max_pages: int):
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    length = len_ref[b]
+    n_pages = (length + page_size - 1) // page_size
+
+    @pl.when(p < n_pages)
+    def _compute():
+        q = q_ref[:, :]                                   # [H, D]
+        k_pos = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)                 # [1, page]
+        valid = k_pos < length
+        # per-kv-head static loop: each query group attends its kv head
+        rows = []
+        for h in range(num_kv_heads):
+            q_sub = q[h * groups:(h + 1) * groups, :]     # [G, D]
+            k_sub = k_ref[:, h, :]                        # [page, D]
+            s = jax.lax.dot_general(
+                q_sub, k_sub, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale   # [G, page]
+            rows.append(s)
+        s = jnp.concatenate(rows, axis=0)                 # [H, page]
+        s = jnp.where(valid, s, NEG_INF)
+        m_prev, l_prev = m_ref[:], l_ref[:]
+        m_cur = jnp.max(s, axis=-1)[:, None]
+        m_new = jnp.maximum(m_prev, m_cur)
+        pexp = jnp.exp(s - m_new)
+        pexp = jnp.where(valid, pexp, 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_prev * alpha + jnp.sum(pexp, axis=-1)[:, None]
+        m_ref[:] = m_new
+        pvs = []
+        for h in range(num_kv_heads):
+            p_sub = pexp[h * groups:(h + 1) * groups, :]  # [G, page]
+            v_sub = v_ref[:, h, :]                        # [page, D]
+            pvs.append(jax.lax.dot_general(
+                p_sub.astype(v_sub.dtype), v_sub, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))      # [G, D]
+        pv = jnp.concatenate(pvs, axis=0)                 # [H, D]
+        acc_ref[:] = acc_ref[:] * alpha + pv
+
+    @pl.when(p == max_pages - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:], 1e-30)                  # noqa: E741
+        o_ref[:, :] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q, k_pages, v_pages, block_table, lengths,
+                           *, scale: float | None = None,
+                           interpret: bool = False):
+    """q [B, H, D]; k_pages/v_pages [P, page, KVH, D];
+    block_table [B, max_pages] int32 (physical page per logical page);
+    lengths [B] int32 (tokens already in cache INCLUDING current step's —
+    i.e. attend over positions < length). Returns [B, H, D]."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, d = q.shape
+    _, page_size, kvh, _ = k_pages.shape
+    groups = h // kvh
+    max_pages = block_table.shape[1]
+    if scale is None:
+        scale = d ** -0.5
+
+    kernel = functools.partial(
+        _paged_decode_kernel, scale=scale, page_size=page_size,
+        num_kv_heads=kvh, groups=groups, max_pages=max_pages)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, max_pages),
+        in_specs=[
+            pl.BlockSpec((None, h, d), lambda bi, p, bt, ln: (bi, 0, 0)),
+            # the physical page for (sequence bi, logical page p) comes from
+            # the scalar-prefetched block table
+            pl.BlockSpec((None, page_size, kvh, d),
+                         lambda bi, p, bt, ln: (bt[bi, p], 0, 0, 0)),
+            pl.BlockSpec((None, page_size, kvh, d),
+                         lambda bi, p, bt, ln: (bt[bi, p], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, h, d), lambda bi, p, bt, ln: (bi, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, d), jnp.float32),
+            pltpu.VMEM((h, 1), jnp.float32),
+            pltpu.VMEM((h, 1), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        interpret=interpret,
+    )(block_table, lengths, q, k_pages, v_pages)
+
+
+def paged_decode_reference(q, k_pages, v_pages, block_table, lengths,
+                           scale: float | None = None):
+    """Numerical oracle (jnp gather). Same contract as the kernel."""
+    b, h, d = q.shape
+    p_total, page_size, kvh, _ = k_pages.shape
+    groups = h // kvh
+    max_pages = block_table.shape[1]
+    if scale is None:
+        scale = d ** -0.5
+    # gather each sequence's pages -> [B, max_pages*page, KVH, D]
+    k = k_pages[block_table].reshape(b, max_pages * page_size, kvh, d)
+    v = v_pages[block_table].reshape(b, max_pages * page_size, kvh, d)
+    if groups > 1:
+        k = jnp.repeat(k, groups, axis=2)
+        v = jnp.repeat(v, groups, axis=2)
+    s = jnp.einsum("bhd,bkhd->bhk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    pos = jnp.arange(max_pages * page_size)[None, :]
+    s = jnp.where(pos[:, None] < lengths[:, None, None], s, NEG_INF)
+    w = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhk,bkhd->bhd", w,
+                      v.astype(jnp.float32)).astype(q.dtype)
