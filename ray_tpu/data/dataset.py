@@ -179,6 +179,15 @@ class Dataset:
             self._cached = Executor(self._ctx).execute(self._plan)
         return self._cached
 
+    def _stream_pairs(self):
+        """(block_ref, meta) pairs for consumption: the cached list when
+        materialized, otherwise the streaming executor's bounded-window
+        generator (read/map/consume overlap; at most
+        ctx.max_tasks_in_flight blocks in flight)."""
+        if self._cached is not None:
+            return self._cached
+        return Executor(self._ctx).execute_streaming(self._plan)
+
     def materialize(self) -> "Dataset":
         pairs = self._execute()
         out = Dataset(InputData(pairs), self._ctx)
@@ -203,7 +212,7 @@ class Dataset:
 
     def take(self, n: int = 20) -> list[dict]:
         out: list[dict] = []
-        for blk in iter_blocks(self._execute()):
+        for blk in iter_blocks(self._stream_pairs()):
             for row in B.to_rows(blk):
                 out.append(row)
                 if len(out) >= n:
@@ -221,26 +230,34 @@ class Dataset:
     # -- iteration --------------------------------------------------------
 
     def iter_rows(self) -> Iterator[dict]:
-        for blk in iter_blocks(self._execute()):
+        for blk in iter_blocks(self._stream_pairs()):
             yield from B.to_rows(blk)
 
     def iter_batches(self, *, batch_size: Optional[int] = 256,
                      batch_format: str = "numpy",
                      drop_last: bool = False) -> Iterator:
-        return DataIterator(self._execute()).iter_batches(
+        return DataIterator(self._stream_pairs()).iter_batches(
             batch_size=batch_size, batch_format=batch_format,
             drop_last=drop_last)
 
     def iter_jax_batches(self, *, batch_size: int = 256,
                          drop_last: bool = True, sharding=None) -> Iterator:
-        return DataIterator(self._execute()).iter_jax_batches(
+        return DataIterator(self._stream_pairs()).iter_jax_batches(
             batch_size=batch_size, drop_last=drop_last, sharding=sharding)
 
     def streaming_split(self, n: int) -> list["DataIterator"]:
-        """n iterators over disjoint block subsets, one per Train worker
-        (reference: dataset.py:1731)."""
-        pairs = self._execute()
-        return [DataIterator(pairs[i::n]) for i in range(n)]
+        """n iterators sharing ONE streaming execution, one per Train
+        worker (reference: dataset.py:1731 + the output-splitter operator).
+        A coordinator actor owns the bounded-window execution; each shard
+        claims the next finished block through it (work-stealing split), so
+        shards are picklable to workers and no one waits for the whole
+        dataset to materialize."""
+        if self._cached is not None:
+            return [DataIterator(self._cached[i::n]) for i in range(n)]
+        import ray_tpu as ray
+        Coord = ray.remote(_SplitCoordinator)
+        coord = Coord.remote(self._plan, self._ctx, n)
+        return [DataIterator(_ActorFeed(coord)) for _ in range(n)]
 
     def split(self, n: int) -> list["Dataset"]:
         pairs = self._execute()
@@ -276,15 +293,81 @@ class Dataset:
         return f"Dataset(plan={self._plan!r})"
 
 
-class DataIterator:
-    """Streams batches from a block list (reference:
-    data/iterator.py DataIterator; iter_torch_batches -> iter_jax_batches)."""
+class _SplitCoordinator:
+    """Actor owning one streaming execution for streaming_split consumers
+    (reference analog: the output-splitter coordination of
+    _internal/execution/operators/output_splitter.py). Single-threaded
+    actor => next() calls serialize; consumers fetch block payloads from
+    the store in parallel afterwards.
 
-    def __init__(self, pairs: list[tuple[Any, BlockMeta]]):
+    Self-terminates after every consumer has seen exhaustion, so repeated
+    streaming_split calls don't accumulate idle actors."""
+
+    def __init__(self, plan, ctx, n_consumers: int):
+        self._it = Executor(ctx).execute_streaming(plan)
+        self._nones_left = n_consumers
+
+    def next(self):
+        try:
+            return next(self._it)
+        except StopIteration:
+            self._nones_left -= 1
+            if self._nones_left <= 0:
+                import os
+                import threading
+                # reply first, then exit (daemon timer outlives this call)
+                threading.Timer(0.5, lambda: os._exit(0)).start()
+            return None
+
+
+class _ActorFeed:
+    """Picklable pair-iterable backed by a _SplitCoordinator handle.
+
+    Claimed pairs are CACHED so a shard is re-iterable (multi-epoch train
+    loops replay the same blocks); only the first pass pulls from the
+    coordinator."""
+
+    def __init__(self, coord):
+        self._coord = coord
+        self._cache: list = []
+        self._complete = False
+
+    def __iter__(self):
+        yield from self._cache
+        if self._complete:
+            return
+        import ray_tpu
+        while True:
+            pair = ray_tpu.get(self._coord.next.remote())
+            if pair is None:
+                self._complete = True
+                return
+            self._cache.append(pair)
+            yield pair
+
+
+class DataIterator:
+    """Streams batches from block pairs — a materialized list or a live
+    streaming-executor generator (reference: data/iterator.py DataIterator;
+    iter_torch_batches -> iter_jax_batches)."""
+
+    def __init__(self, pairs):
         self._pairs = pairs
 
+    def _as_list(self) -> list[tuple[Any, BlockMeta]]:
+        if isinstance(self._pairs, _ActorFeed) and not self._pairs._complete:
+            # draining the shared coordinator here would claim every
+            # remaining block for THIS shard and starve its siblings
+            raise TypeError(
+                "count() on an unconsumed streaming_split shard would "
+                "steal the other shards' blocks; iterate it (or "
+                "materialize() the dataset) first")
+        if not isinstance(self._pairs, list):
+            self._pairs = list(self._pairs)
+        return self._pairs
+
     def count(self) -> int:
-        return sum(m.rows for _, m in self._pairs)
+        return sum(m.rows for _, m in self._as_list())
 
     def iter_blocks(self) -> Iterator[B.Block]:
         return iter_blocks(self._pairs)

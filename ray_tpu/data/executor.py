@@ -167,6 +167,9 @@ def _slice_task(block, start, end):
 # Executor
 # ---------------------------------------------------------------------------
 
+_DEFAULT = object()
+
+
 def _ray():
     import ray_tpu
     if not ray_tpu.is_initialized():
@@ -175,41 +178,98 @@ def _ray():
 
 
 class Executor:
-    """Executes a logical plan bottom-up, fusing BlockOp chains."""
+    """Executes a logical plan bottom-up, fusing BlockOp chains.
+
+    Two modes:
+      * `execute` — materialize every output pair (used by count/schema/
+        materialize and inside exchanges, which are all-to-all barriers);
+      * `execute_streaming` — a generator with BOUNDED in-flight tasks
+        (ctx.max_tasks_in_flight): tasks are submitted as the consumer
+        pulls, completed blocks yield as they finish, so read/map/consume
+        overlap and at most `window` blocks wait in the object store
+        (reference: _internal/execution/streaming_executor.py:52 +
+        the memory-aware admission of streaming_executor_state.py:646).
+    """
 
     def __init__(self, ctx: Optional[DataContext] = None):
         self.ctx = ctx or DataContext.get_current()
+        # high-water mark of concurrently in-flight tasks (observable by
+        # tests and stats)
+        self.max_in_flight_seen = 0
 
-    def execute(self, op: LogicalOp) -> list[tuple[Any, BlockMeta]]:
-        """Returns [(block_ref, meta)] — metas are concrete."""
-        ray = _ray()
-        fused: list[Callable] = []
-        node = op
-        # peel fusable block ops off the top of the chain
+    def _peel(self, op: LogicalOp):
+        """Split a plan top into (fused block fns, source node)."""
         chain: list[BlockOp] = []
+        node = op
         while isinstance(node, BlockOp):
             chain.append(node)
             node = node.inputs[0]
-        fused = [c.fn for c in reversed(chain)]
+        return [c.fn for c in reversed(chain)], node
 
-        remote_fused = ray.remote(_run_fused).options(num_returns=2)
+    def execute(self, op: LogicalOp) -> list[tuple[Any, BlockMeta]]:
+        """Returns [(block_ref, meta)] — metas are concrete. Barrier mode:
+        everything is submitted at once (the results are materialized into
+        a list anyway, so the streaming window would only serialize it)."""
+        return list(self.execute_streaming(op, window=None))
+
+    def execute_streaming(self, op: LogicalOp, window: int | object = _DEFAULT):
+        """Yield (block_ref, meta) in PLAN ORDER as tasks finish, with at
+        most `window` (default ctx.max_tasks_in_flight; None = unbounded)
+        tasks in flight. Plan-order delivery keeps order-sensitive
+        consumers (zip alignment, limit/take, seeded shuffles) exact while
+        still overlapping read/map/consume."""
+        ray = _ray()
+        fused, node = self._peel(op)
         if isinstance(node, Read):
             remote_read = ray.remote(_run_read).options(num_returns=2)
-            out = [remote_read.remote(rt, fused) for rt in node.read_tasks]
-            return self._resolve(out)
+            thunks = (
+                (lambda rt=rt: remote_read.remote(rt, fused))
+                for rt in node.read_tasks)
+            yield from self._stream(thunks, window)
+            return
         if isinstance(node, InputData):
             base = node.refs_and_meta
-            if not fused:
-                return list(base)
-            out = [remote_fused.remote(fused, ref) for ref, _ in base]
-            return self._resolve(out)
-        if isinstance(node, Exchange):
-            base = self._execute_exchange(node)
-            if not fused:
-                return base
-            out = [remote_fused.remote(fused, ref) for ref, _ in base]
-            return self._resolve(out)
-        raise TypeError(f"cannot execute {node!r}")
+        elif isinstance(node, Exchange):
+            base = self._execute_exchange(node)   # all-to-all barrier
+        else:
+            raise TypeError(f"cannot execute {node!r}")
+        if not fused:
+            yield from base
+            return
+        remote_fused = ray.remote(_run_fused).options(num_returns=2)
+        thunks = (
+            (lambda ref=ref: remote_fused.remote(fused, ref))
+            for ref, _ in base)
+        yield from self._stream(thunks, window)
+
+    def _stream(self, thunks, window=_DEFAULT):
+        """Bounded-window submission loop (the scheduling loop of the
+        reference's StreamingExecutor, _scheduling_loop_step)."""
+        from collections import deque
+
+        ray = _ray()
+        if window is _DEFAULT:
+            window = max(1, self.ctx.max_tasks_in_flight)
+        pending: deque = deque()         # (block_ref, meta_ref), plan order
+        it = iter(thunks)
+        exhausted = False
+        while True:
+            while not exhausted and (window is None
+                                     or len(pending) < window):
+                try:
+                    thunk = next(it)
+                except StopIteration:
+                    exhausted = True
+                    break
+                pending.append(thunk())
+            self.max_in_flight_seen = max(self.max_in_flight_seen,
+                                          len(pending))
+            if not pending:
+                return
+            # head-of-line: deliver strictly in plan order (later tasks
+            # keep running in the window meanwhile)
+            block_ref, meta_ref = pending.popleft()
+            yield block_ref, ray.get(meta_ref)
 
     def _resolve(self, pairs) -> list[tuple[Any, BlockMeta]]:
         ray = _ray()

@@ -160,3 +160,103 @@ class TestIO:
         p.write_text("hello\nworld\n")
         ds = data.read_text(str(p))
         assert [r["text"] for r in ds.take_all()] == ["hello", "world"]
+
+
+class TestStreamingExecutor:
+    """VERDICT item 6: bounded in-flight tasks, blocks streamed to
+    consumers as produced (reference: streaming_executor.py:52,
+    select_operator_to_run backpressure)."""
+
+    def test_bounded_in_flight_over_100_blocks(self, data, tmp_path):
+        from ray_tpu.data.context import DataContext
+        from ray_tpu.data.dataset import Executor
+
+        ctx = DataContext(max_tasks_in_flight=4)
+        ds = data.range(1000, override_num_blocks=100).map_batches(
+            lambda b: {"id": b["id"] * 2})
+        ex = Executor(ctx)
+        seen_rows = 0
+        for ref, meta in ex.execute_streaming(ds._plan):
+            seen_rows += meta.rows
+            assert ex.max_in_flight_seen <= 4
+        assert seen_rows == 1000
+        assert ex.max_in_flight_seen == 4  # it did run ahead of the consumer
+
+    def test_streaming_is_lazy_not_materialized(self, data, tmp_path):
+        """Consuming ONE block must not have executed the whole plan:
+        read tasks touch marker files; after the first pull at most
+        window + 1 may have run."""
+        import os
+        from ray_tpu.data.context import DataContext
+        from ray_tpu.data.dataset import Executor
+
+        marker_dir = str(tmp_path / "markers")
+        os.makedirs(marker_dir, exist_ok=True)
+
+        def make_read(i):
+            def read():
+                import numpy as np
+                import pyarrow as pa
+                open(os.path.join(marker_dir, f"r{i:03d}"), "w").close()
+                return pa.table({"id": np.arange(5) + i * 5})
+            return read
+
+        from ray_tpu.data.executor import Read
+        from ray_tpu.data.dataset import Dataset
+        ds = Dataset(Read([make_read(i) for i in range(40)]),
+                     DataContext(max_tasks_in_flight=3))
+        gen = Executor(ds._ctx).execute_streaming(ds._plan)
+        next(gen)
+        executed = len(os.listdir(marker_dir))
+        assert executed <= 1 + 3, f"{executed} tasks ran for one consumed block"
+        # drain: everything eventually runs exactly once
+        rest = list(gen)
+        assert len(rest) == 39
+        assert len(os.listdir(marker_dir)) == 40
+
+    def test_streaming_split_shards_are_picklable_to_actors(self, data):
+        import ray_tpu as ray
+
+        shards = data.range(60).streaming_split(2)
+
+        @ray.remote
+        class Consumer:
+            def consume(self, it):
+                return sorted(r["id"] for r in it.iter_rows())
+
+        consumers = [Consumer.remote() for _ in range(2)]
+        got = ray.get([c.consume.remote(s)
+                       for c, s in zip(consumers, shards)], timeout=120)
+        # work-stealing split: totals are exact, the per-shard cut is not
+        # deterministic (a cold consumer may claim fewer blocks)
+        assert sorted(got[0] + got[1]) == list(range(60))
+
+    def test_streaming_preserves_plan_order(self, data):
+        """Blocks must arrive in plan order even when completion order
+        differs (zip alignment, limit, seeded shuffles depend on it)."""
+        import time
+
+        def slow_first(batch):
+            # the FIRST block (ids 0..9) sleeps so later blocks finish first
+            if int(batch["id"][0]) == 0:
+                time.sleep(1.0)
+            return batch
+
+        ds = data.range(50, override_num_blocks=5).map_batches(slow_first)
+        ids = [int(b["id"][0]) for b in ds.iter_batches(batch_size=10)]
+        assert ids == [0, 10, 20, 30, 40]
+
+    def test_streaming_split_shards_reiterable_for_epochs(self, data):
+        shards = data.range(40).streaming_split(2)
+        epoch1 = [sorted(r["id"] for r in s.iter_rows()) for s in shards]
+        epoch2 = [sorted(r["id"] for r in s.iter_rows()) for s in shards]
+        assert epoch1 == epoch2           # same blocks replayed per shard
+        assert sorted(epoch1[0] + epoch1[1]) == list(range(40))
+
+    def test_streaming_split_count_guard(self, data):
+        shards = data.range(40).streaming_split(2)
+        with pytest.raises(TypeError):
+            shards[0].count()
+        # after full iteration, count works from the cache
+        n0 = sum(1 for _ in shards[0].iter_rows())
+        assert shards[0].count() == n0
