@@ -1,0 +1,101 @@
+"""Core runtime microbenchmarks (reference harness parity:
+python/ray/_private/ray_perf.py:95 via release/microbenchmark).
+
+Prints one JSON line per metric plus a combined gate line. Baselines are
+the reference's checked-in 2.47.0 numbers (BASELINE.md): single-client
+tasks 961/s, 1:1 actor calls sync 1960/s, async 8220/s, gets 10841/s,
+put 19.56 GiB/s.
+"""
+import json
+import time
+
+import numpy as np
+
+
+def timed(n, fn):
+    t0 = time.perf_counter()
+    fn()
+    return n / (time.perf_counter() - t0)
+
+
+def main():
+    import ray_tpu as ray
+
+    ray.init(num_cpus=4, object_store_memory=1 << 30)
+
+    @ray.remote
+    def nop():
+        return None
+
+    @ray.remote
+    class Actor:
+        def nop(self):
+            return None
+
+    results = {}
+
+    # warmup: worker pool spin-up + code ship
+    ray.get([nop.remote() for _ in range(20)], timeout=120)
+
+    # single client tasks sync
+    def tasks_sync():
+        for _ in range(500):
+            ray.get(nop.remote(), timeout=60)
+    results["single_client_tasks_sync"] = (timed(500, tasks_sync), 961)
+
+    # single client tasks async (batch submit, one drain)
+    def tasks_async():
+        ray.get([nop.remote() for _ in range(2000)], timeout=120)
+    results["single_client_tasks_async"] = (timed(2000, tasks_async), 6787)
+
+    a = Actor.remote()
+    ray.get(a.nop.remote(), timeout=60)
+
+    def actor_sync():
+        for _ in range(500):
+            ray.get(a.nop.remote(), timeout=60)
+    results["1_1_actor_calls_sync"] = (timed(500, actor_sync), 1960)
+
+    def actor_async():
+        ray.get([a.nop.remote() for _ in range(2000)], timeout=120)
+    results["1_1_actor_calls_async"] = (timed(2000, actor_async), 8220)
+
+    # single client get (small object, repeated)
+    ref = ray.put(b"x" * 1024)
+
+    def gets():
+        for _ in range(3000):
+            ray.get(ref, timeout=60)
+    results["single_client_get_calls"] = (timed(3000, gets), 10841)
+
+    # put throughput (512 MiB total in 128 MiB chunks; put currently pins
+    # objects for the driver's lifetime, so stay under the store capacity)
+    chunk = np.zeros(128 * 1024 * 1024, dtype=np.uint8)
+
+    def puts():
+        for _ in range(4):
+            ray.put(chunk)
+    gibs = timed(4, puts) * 128 / 1024
+    results["single_client_put_gigabytes"] = (gibs, 19.56)
+
+    ray.shutdown()
+
+    worst = 1e9
+    for name, (value, base) in results.items():
+        ratio = value / base
+        worst = min(worst, ratio)
+        print(json.dumps({
+            "metric": name, "value": round(float(value), 2),
+            "unit": "GiB/s" if "gigabytes" in name else "ops/s",
+            "vs_baseline": round(ratio, 3),
+        }))
+    print(json.dumps({
+        "metric": "core_microbench_worst_ratio",
+        "value": round(worst, 3),
+        "unit": "min(ours/reference) across metrics",
+        "vs_baseline": round(worst, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

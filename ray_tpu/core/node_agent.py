@@ -44,6 +44,7 @@ class NodeAgent:
             raise RuntimeError(f"head rejected registration: {reply}")
         self.node_id = reply["node_id"]
         self.store_path = reply["store_path"]
+        self.spill_dir = reply.get("spill_dir", "")
         # the head never echoes the authkey; we authenticated with our copy
         self.authkey = authkey.hex()
         self.tcp_port = reply["tcp_port"]
@@ -65,7 +66,8 @@ class NodeAgent:
             store_path=self.store_path,
             head_addr=f"{self.head_host}:{self.tcp_port}",
             head_family="AF_INET", authkey_hex=self.authkey,
-            wid=wid, node_id_hex=node_id, tpu=tpu)
+            wid=wid, node_id_hex=node_id, tpu=tpu,
+            spill_dir=self.spill_dir)
         log_dir = os.environ.get("RTPU_AGENT_LOG_DIR", "/tmp/ray_tpu_agent")
         os.makedirs(log_dir, exist_ok=True)
         log = open(os.path.join(log_dir, f"worker-{wid}.log"), "wb")

@@ -73,6 +73,45 @@ def _load_lib() -> ctypes.CDLL:
     return lib
 
 
+class SpillStore:
+    """Disk spill area for objects the shm store can't hold (reference:
+    raylet/local_object_manager.h:42 SpillObjects :112 +
+    _private/external_storage.py FileSystemStorage). One file per object,
+    written atomically (tmp + rename) so readers never see partials."""
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, oid: ObjectID) -> str:
+        return os.path.join(self.dir, oid.hex() + ".bin")
+
+    def spill(self, oid: ObjectID, value: Any,
+              is_exception: bool = False) -> int:
+        blob = cloudpickle.dumps((bool(is_exception), value), protocol=5)
+        tmp = self._path(oid) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, self._path(oid))
+        return len(blob)
+
+    def contains(self, oid: ObjectID) -> bool:
+        return os.path.exists(self._path(oid))
+
+    def load(self, oid: ObjectID) -> Any:
+        with open(self._path(oid), "rb") as f:
+            is_exception, value = pickle.loads(f.read())
+        if is_exception:
+            raise value
+        return value
+
+    def delete(self, oid: ObjectID) -> None:
+        try:
+            os.unlink(self._path(oid))
+        except OSError:
+            pass
+
+
 class SharedObjectStore:
     """One per process; created by the head (driver), attached by workers."""
 

@@ -40,12 +40,12 @@ import cloudpickle
 from .. import exceptions as exc
 from .ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID
 from .object_store import GetTimeoutError as StoreTimeout
-from .object_store import SharedObjectStore
+from .object_store import ObjectStoreFullError, SharedObjectStore, SpillStore
 from .ref import ObjectRef
 from .task_spec import ActorSpec, TaskSpec
 
 # directory states
-PENDING, READY, FAILED = 0, 1, 2
+PENDING, READY, FAILED, SPILLED = 0, 1, 2, 3
 
 _runtime: Optional["Runtime"] = None
 _runtime_lock = threading.Lock()
@@ -125,7 +125,7 @@ def host_ip() -> str:
 
 def build_worker_env(*, store_path: str, head_addr: str, head_family: str,
                      authkey_hex: str, wid: str, node_id_hex: str,
-                     tpu: bool) -> dict:
+                     tpu: bool, spill_dir: str = "") -> dict:
     """Environment for a `python -m ray_tpu.core.worker` process — the ONE
     definition shared by the head's local pool and node agents, so worker
     behavior cannot drift by host."""
@@ -140,6 +140,8 @@ def build_worker_env(*, store_path: str, head_addr: str, head_family: str,
         env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = os.pathsep.join(paths)
     env["RTPU_STORE_PATH"] = store_path
+    if spill_dir:
+        env["RTPU_SPILL_DIR"] = spill_dir
     env["RTPU_HEAD_ADDR"] = head_addr
     if head_family != "AF_UNIX":
         env["RTPU_HEAD_FAMILY"] = head_family
@@ -253,11 +255,24 @@ class Runtime:
         self.store_path = f"/dev/shm/ray_tpu_{sid}"
         self.store = SharedObjectStore(
             self.store_path, capacity=object_store_memory, create=True)
+        self.spill = SpillStore(os.path.join(self.session_dir, "spill"))
 
         self.lock = threading.RLock()
         self.cv = threading.Condition(self.lock)
 
         self.directory: dict[ObjectID, DirEntry] = {}
+        # distributed refcounting (reference_count.h:73 analog):
+        # which processes hold >=1 live ObjectRef, serialized-copy pins
+        # (may go negative when a receiver's add outruns the sender's pin —
+        # per-connection FIFO makes that transient), driver-local counts,
+        # and driver-side store pins from ray.put
+        self.interest: dict[ObjectID, set[str]] = {}
+        self.xfer_pins: dict[ObjectID, int] = {}
+        self._local_refs: dict[ObjectID, int] = {}
+        self._pinned: set[ObjectID] = set()
+        # containment edges: outer stored object -> refs pickled inside it
+        # (the outer holds interest in its inners until the outer is freed)
+        self.contained: dict[ObjectID, list[ObjectID]] = {}
         self.func_registry: dict[str, bytes] = {}
         self.nodes: dict[NodeID, NodeInfo] = {}
         self.workers: dict[str, WorkerInfo] = {}
@@ -273,6 +288,12 @@ class Runtime:
         self._shutdown = False
         self._worker_seq = 0
         self._spread_rr = 0
+        import concurrent.futures
+        # worker->head rpc handlers (blocking calls like pg_wait run here)
+        # 32 threads: pg_wait parks here for up to its full timeout, and a
+        # gang of waiters must not starve cheap rpcs behind it
+        self._rpc_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=32, thread_name_prefix="rtpu-rpc")
 
         # head node
         self.head_node = NodeInfo(NodeID.from_random(), resources,
@@ -392,6 +413,30 @@ class Runtime:
         elif t == "put":
             with self.lock:
                 self.directory[msg["oid"]] = DirEntry(READY)
+        elif t == "put_spilled":
+            with self.lock:
+                oid = ObjectID(msg["oid"])
+                e = self.directory.get(oid)
+                if e is None:
+                    self.directory[oid] = DirEntry(SPILLED)
+                else:
+                    e.state = SPILLED  # keep lineage for later recovery
+        elif t == "contained":
+            with self.lock:
+                self._register_contained_locked(
+                    ObjectID(msg["oid"]),
+                    [ObjectID(b) for b in msg["inner"]])
+        elif t == "ref_add":
+            with self.lock:
+                self._ref_add_locked(ObjectID(msg["oid"]), wid,
+                                     msg.get("transfer", False))
+        elif t == "ref_drop":
+            with self.lock:
+                self._ref_drop_locked(ObjectID(msg["oid"]), wid)
+        elif t == "ref_xfer":
+            with self.lock:
+                oid = ObjectID(msg["oid"])
+                self.xfer_pins[oid] = self.xfer_pins.get(oid, 0) + 1
         elif t == "create_actor":
             with self.lock:
                 self._create_actor_locked(msg["spec"])
@@ -422,9 +467,9 @@ class Runtime:
                         force=msg.get("force", False))
         elif t == "rpc":
             # Handled off-thread: rpcs like pg_wait block, and this recv loop
-            # must keep draining the worker's other messages.
-            threading.Thread(target=self._handle_worker_rpc, args=(msg,),
-                             daemon=True).start()
+            # must keep draining the worker's other messages. A shared pool
+            # replaces the former thread-per-rpc spawn (hot-path cost).
+            self._rpc_pool.submit(self._handle_worker_rpc, msg)
         elif t == "rpc_abandon":
             # Worker timed out waiting for a reply. Mark abandoned FIRST,
             # then reclaim if already written — this order closes the race
@@ -452,6 +497,7 @@ class Runtime:
         # holds the authkey (it authenticated with it) — never echo it.
         agent.send({"t": "registered", "node_id": node.node_id.hex(),
                     "store_path": self.store_path,
+                    "spill_dir": self.spill.dir,
                     "tcp_port": self.tcp_port})
         with self.lock:
             self.nodes[node.node_id] = node
@@ -564,7 +610,8 @@ class Runtime:
         env = build_worker_env(
             store_path=self.store_path, head_addr=self.listener_addr,
             head_family="AF_UNIX", authkey_hex=self._authkey.hex(),
-            wid=wid, node_id_hex=node.node_id.hex(), tpu=tpu)
+            wid=wid, node_id_hex=node.node_id.hex(), tpu=tpu,
+            spill_dir=self.spill.dir)
         log = open(os.path.join(self.session_dir, f"worker-{wid}.log"), "wb")
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.core.worker"],
@@ -600,6 +647,9 @@ class Runtime:
                 self.store.reclaim_pid(w.proc.pid)
             except Exception:
                 pass
+            # and its refcount interest (it will never send ref_drop)
+            for oid in [o for o, s in self.interest.items() if wid in s]:
+                self._ref_drop_locked(oid, wid)
             node = self.nodes.get(w.node_id)
             if node:
                 node.workers.discard(wid)
@@ -653,10 +703,17 @@ class Runtime:
     # ------------------------------------------------------------------ #
 
     def put(self, value: Any, pin: bool = True) -> ObjectRef:
-        ref = self.put_at(ObjectID.from_random(), value)
+        oid = ObjectID.from_random()
+        ref = self.put_at(oid, value)
         if pin:
-            # keep a refcount so LRU eviction never drops a live ray.put()
-            self.store.get_raw(ref.id(), timeout_ms=0)
+            with self.lock:
+                e = self.directory.get(oid)
+                if e is not None and e.state == READY and \
+                        oid not in self._pinned:
+                    # store-level pin so LRU eviction never drops a live
+                    # ray.put (released when the refcount frees the object)
+                    if self.store.get_raw(oid, timeout_ms=0) is not None:
+                        self._pinned.add(oid)
         return ref
 
     def expect(self, oid: ObjectID) -> None:
@@ -665,11 +722,99 @@ class Runtime:
 
     def put_at(self, oid: ObjectID, value: Any,
                is_exception: bool = False) -> ObjectRef:
-        """Write `value` under a pre-allocated id (deferred-resolution refs)."""
-        self.store.put(oid, value, is_exception=is_exception)
+        """Write `value` under a pre-allocated id (deferred-resolution refs).
+        Objects the shm store can't hold spill to disk. Refs pickled inside
+        `value` become containment edges so they outlive one transfer."""
+        from .ref import capture_serialized_refs
+        with capture_serialized_refs() as inner_ids:
+            try:
+                self.store.put(oid, value, is_exception=is_exception)
+                state = READY
+            except ObjectStoreFullError:
+                self.spill.spill(oid, value, is_exception=is_exception)
+                state = SPILLED
         with self.lock:
-            self.directory[oid] = DirEntry(READY)
+            self.directory[oid] = DirEntry(state)
+            if inner_ids:
+                self._register_contained_locked(oid, inner_ids)
         return ObjectRef(oid)
+
+    def _register_contained_locked(self, outer: ObjectID,
+                                   inner_ids: list[ObjectID]):
+        holder = f"obj:{outer.hex()}"
+        self.contained.setdefault(outer, []).extend(inner_ids)
+        for inner in inner_ids:
+            self.interest.setdefault(inner, set()).add(holder)
+
+    # -- refcounting (reference: reference_count.h:73) ---------------------
+
+    def ref_created(self, oid: ObjectID, from_transfer: bool):
+        with self.lock:
+            c = self._local_refs.get(oid, 0)
+            self._local_refs[oid] = c + 1
+            if c == 0 or from_transfer:
+                self._ref_add_locked(oid, "driver", from_transfer)
+
+    def ref_deleted(self, oid: ObjectID):
+        with self.lock:
+            c = self._local_refs.get(oid, 0) - 1
+            if c <= 0:
+                self._local_refs.pop(oid, None)
+                self._ref_drop_locked(oid, "driver")
+            else:
+                self._local_refs[oid] = c
+
+    def ref_serialized(self, oid: ObjectID):
+        with self.lock:
+            self.xfer_pins[oid] = self.xfer_pins.get(oid, 0) + 1
+
+    def _ref_add_locked(self, oid: ObjectID, holder: str,
+                        from_transfer: bool):
+        self.interest.setdefault(oid, set()).add(holder)
+        if from_transfer:
+            # clamp at 0: deserializations of refs embedded in STORED
+            # objects carry no pin (containment edges protect those), and
+            # a pin must never be cancelled by an unrelated deserialize
+            n = self.xfer_pins.get(oid, 0) - 1
+            if n <= 0:
+                self.xfer_pins.pop(oid, None)
+            else:
+                self.xfer_pins[oid] = n
+
+    def _ref_drop_locked(self, oid: ObjectID, holder: str):
+        s = self.interest.get(oid)
+        if s is not None:
+            s.discard(holder)
+            if not s:
+                self.interest.pop(oid, None)
+        self._maybe_free_locked(oid)
+
+    def _maybe_free_locked(self, oid: ObjectID):
+        """Free payload + metadata once the object is unreachable: no
+        process holds a ref, no serialized copy is in flight, and no task
+        is about to produce it."""
+        if oid in self.interest or self.xfer_pins.get(oid, 0) > 0:
+            return
+        e = self.directory.get(oid)
+        if e is None or e.state == PENDING:
+            return
+        self.directory.pop(oid, None)
+        if oid in self._pinned:
+            self._pinned.discard(oid)
+            try:
+                self.store.release(oid)
+            except Exception:
+                pass
+        try:
+            self.store.delete(oid)
+        except Exception:
+            pass
+        self.spill.delete(oid)
+        self.xfer_pins.pop(oid, None)
+        # the freed outer no longer keeps its inners alive
+        holder = f"obj:{oid.hex()}"
+        for inner in self.contained.pop(oid, []):
+            self._ref_drop_locked(inner, holder)
 
     def _store_error(self, oid: ObjectID, err: BaseException):
         try:
@@ -679,8 +824,15 @@ class Runtime:
             pass
 
     def _ensure_available_locked(self, oid: ObjectID):
-        """If `oid` was evicted, resubmit its producing task (lineage)."""
+        """If `oid` was evicted, restore it from spill or resubmit its
+        producing task (lineage)."""
         e = self.directory.get(oid)
+        if e is not None and e.state == SPILLED:
+            # spilled objects are served from disk: the head reads the file
+            # directly and workers fall back to the shared spill directory
+            # (restoring into the store here would do multi-GB IO under the
+            # runtime lock and lose spill-awareness on later eviction)
+            return
         if e is None or e.state != READY or self.store.contains(oid):
             return
         if e.lineage is None:
@@ -709,12 +861,22 @@ class Runtime:
 
     def submit_task(self, spec: TaskSpec) -> list[ObjectRef]:
         with self.lock:
+            # interest BEFORE the task can run: a fast task finishing
+            # between submit and ref construction must not see an
+            # unreferenced result and free it
+            refs = [ObjectRef(o) for o in spec.return_ids]
             self._submit_locked(spec)
-        return [ObjectRef(o) for o in spec.return_ids]
+        return refs
 
     def _submit_locked(self, spec: TaskSpec):
         for oid in spec.return_ids:
             self.directory[oid] = DirEntry(PENDING, lineage=spec)
+        # the task holds interest in its args until it terminally completes
+        # (covers re-deserialization on retries; the submitter may drop its
+        # refs right after submit)
+        holder = f"task:{spec.task_id.hex()}"
+        for d in spec.dep_oids:
+            self.interest.setdefault(d, set()).add(holder)
         if spec.is_actor_task:
             self._route_actor_task_locked(spec)
         else:
@@ -778,6 +940,10 @@ class Runtime:
             e = self.directory.get(d)
             if e is not None and e.state == FAILED:
                 return "failed"
+            if e is not None and e.state == SPILLED:
+                # satisfiable from disk: workers fall back to the shared
+                # spill directory when the store misses
+                continue
             if not self.store.contains(d):
                 if e is not None and e.state == READY:
                     self._ensure_available_locked(d)  # evicted → reconstruct
@@ -896,7 +1062,14 @@ class Runtime:
             if e is not None:
                 e.state = FAILED
                 e.error_brief = repr(err)
+            self._maybe_free_locked(oid)
+        self._drop_task_dep_interest_locked(spec)
         self.cv.notify_all()
+
+    def _drop_task_dep_interest_locked(self, spec):
+        holder = f"task:{spec.task_id.hex()}"
+        for d in spec.dep_oids:
+            self._ref_drop_locked(d, holder)
 
     def _on_task_done(self, wid: str, msg: dict):
         with self.lock:
@@ -927,8 +1100,13 @@ class Runtime:
                 if msg["ok"]:
                     for oid in spec.return_ids:
                         e = self.directory.get(oid)
-                        if e is not None:
+                        if e is not None and e.state == PENDING:
+                            # (a SPILLED return must stay SPILLED)
                             e.state = READY
+                        # a consumer may have dropped its ref while we were
+                        # still PENDING; re-check now that we're final
+                        self._maybe_free_locked(oid)
+                    self._drop_task_dep_interest_locked(spec)
                 elif msg.get("retryable"):
                     self._handle_failed_task_locked(
                         spec, exc.RayError(msg.get("err", "")), retryable=True)
@@ -938,6 +1116,7 @@ class Runtime:
                         if e is not None:
                             e.state = FAILED
                             e.error_brief = msg.get("err")
+                        self._maybe_free_locked(oid)
             self._schedule_locked()
             self.cv.notify_all()
 
@@ -1037,10 +1216,14 @@ class Runtime:
 
     def submit_actor_task_spec(self, spec: TaskSpec) -> list[ObjectRef]:
         with self.lock:
+            refs = [ObjectRef(o) for o in spec.return_ids]  # interest first
             for oid in spec.return_ids:
                 self.directory[oid] = DirEntry(PENDING, lineage=None)
+            holder = f"task:{spec.task_id.hex()}"
+            for d in spec.dep_oids:
+                self.interest.setdefault(d, set()).add(holder)
             self._route_actor_task_locked(spec)
-        return [ObjectRef(o) for o in spec.return_ids]
+        return refs
 
     def _route_actor_task_locked(self, spec: TaskSpec):
         a = self.actors.get(spec.actor_id)
@@ -1322,6 +1505,15 @@ class Runtime:
                 value = self.store.get(oid, timeout_ms=slice_ms)
             except StoreTimeout:
                 with self.lock:
+                    e = self.directory.get(oid)
+                    spilled = e is not None and e.state == SPILLED
+                if spilled:
+                    # objects bigger than the store never leave disk
+                    try:
+                        return self.spill.load(oid)
+                    except exc.RayTaskError as e:
+                        raise e.as_instanceof_cause() from None
+                with self.lock:
                     self._ensure_available_locked(oid)
                     self._schedule_locked()
                 continue
@@ -1442,6 +1634,11 @@ class Runtime:
         for node in list(self.nodes.values()):
             if node.agent is not None:
                 node.agent.send({"t": "shutdown"})
+        # wake pg_wait blockers so rpc-pool threads exit promptly, then
+        # release the pool without joining in-flight handlers
+        for pg in self.pgs.values():
+            pg.ready_event.set()
+        self._rpc_pool.shutdown(wait=False, cancel_futures=True)
         deadline = time.monotonic() + 1.0
         for w in workers:
             if w.proc is None:
@@ -1479,6 +1676,16 @@ class LocalModeRuntime:
     debugging user code with pdb; actors are plain objects, objects live in a
     dict.
     """
+
+    # refcounting is a no-op in local mode (objects live in a plain dict)
+    def ref_created(self, oid, from_transfer):
+        pass
+
+    def ref_deleted(self, oid):
+        pass
+
+    def ref_serialized(self, oid):
+        pass
 
     def __init__(self):
         self.objects: dict[ObjectID, Any] = {}

@@ -35,7 +35,8 @@ import cloudpickle
 from .. import exceptions as exc
 from .ids import ObjectID
 from .object_store import GetTimeoutError as StoreTimeout
-from .object_store import SharedObjectStore
+from .object_store import ObjectStoreFullError as StoreFull
+from .object_store import SharedObjectStore, SpillStore
 from .ref import ObjectRef
 from .task_spec import ActorSpec, TaskSpec
 from . import runtime as rt_mod
@@ -45,14 +46,62 @@ class WorkerRuntime:
     """Worker-side implementation of the runtime interface used by the public
     API (`ray_tpu.get/put/wait/...` called *inside* a task or actor)."""
 
-    def __init__(self, store: SharedObjectStore, conn, wid: str):
+    def __init__(self, store: SharedObjectStore, conn, wid: str,
+                 spill=None):
         self.store = store
+        self.spill = spill
         self.conn = conn
         self.wid = wid
         self.send_lock = threading.Lock()
         self.func_registry: dict[str, object] = {}
         self._sent_fids: set[str] = set()
         self.current_task_name = ""
+        # process-local ObjectRef counts; 0<->1 transitions notify the head
+        # (reference_count.h:73 borrower protocol, simplified)
+        self._ref_counts: dict = {}
+        self._ref_lock = threading.Lock()
+        # __del__ may fire from a GC pass triggered INSIDE send() or
+        # ref_created() on the same thread; doing IPC or taking these locks
+        # there would self-deadlock. Drops only enqueue (SimpleQueue.put is
+        # reentrant-safe); a dedicated thread drains and notifies.
+        import queue
+        self._drop_q: "queue.SimpleQueue" = queue.SimpleQueue()
+        threading.Thread(target=self._drop_loop, daemon=True,
+                         name="ref-drops").start()
+
+    # -- refcounting -------------------------------------------------------
+
+    def ref_created(self, oid, from_transfer: bool):
+        with self._ref_lock:
+            c = self._ref_counts.get(oid, 0)
+            self._ref_counts[oid] = c + 1
+            notify = (c == 0) or from_transfer
+        if notify:
+            self.send({"t": "ref_add", "oid": oid.binary(),
+                       "transfer": from_transfer})
+
+    def ref_deleted(self, oid):
+        self._drop_q.put(oid)
+
+    def _drop_loop(self):
+        while True:
+            oid = self._drop_q.get()
+            try:
+                with self._ref_lock:
+                    c = self._ref_counts.get(oid, 0) - 1
+                    if c <= 0:
+                        self._ref_counts.pop(oid, None)
+                        drop = True
+                    else:
+                        self._ref_counts[oid] = c
+                        drop = False
+                if drop:
+                    self.send({"t": "ref_drop", "oid": oid.binary()})
+            except Exception:
+                return  # connection gone: worker is exiting
+
+    def ref_serialized(self, oid):
+        self.send({"t": "ref_xfer", "oid": oid.binary()})
 
     # -- messaging ---------------------------------------------------------
 
@@ -78,9 +127,30 @@ class WorkerRuntime:
         """No-op (see Runtime.expect)."""
 
     def put_at(self, oid: ObjectID, value, is_exception: bool = False):
-        self.store.put(oid, value, is_exception=is_exception)
-        self.send({"t": "put", "oid": oid})
+        self.store_or_spill(oid, value, is_exception, notify_put=True)
         return ObjectRef(oid)
+
+    def store_or_spill(self, oid: ObjectID, value, is_exception: bool,
+                       notify_put: bool):
+        """Store a value, spilling to disk when the shm store is full; refs
+        pickled inside become containment edges on the head."""
+        from .ref import capture_serialized_refs
+        with capture_serialized_refs() as inner_ids:
+            try:
+                self.store.put(oid, value, is_exception=is_exception)
+                spilled = False
+            except StoreFull:
+                if self.spill is None:
+                    raise
+                self.spill.spill(oid, value, is_exception=is_exception)
+                spilled = True
+        if inner_ids:
+            self.send({"t": "contained", "oid": oid.binary(),
+                       "inner": [i.binary() for i in inner_ids]})
+        if spilled:
+            self.send({"t": "put_spilled", "oid": oid.binary()})
+        elif notify_put:
+            self.send({"t": "put", "oid": oid})
 
     def get(self, refs, timeout: float | None = None):
         single = isinstance(refs, ObjectRef)
@@ -118,6 +188,13 @@ class WorkerRuntime:
             try:
                 return self.store.get(oid, timeout_ms=slice_ms)
             except StoreTimeout:
+                if self.spill is not None and self.spill.contains(oid):
+                    try:
+                        return self.spill.load(oid)
+                    except OSError:
+                        pass  # freed between contains and load; keep waiting
+                    except exc.RayTaskError as e:
+                        raise e.as_instanceof_cause() from None
                 if first:
                     on_wait()
                     self.send({"t": "ensure", "oids": [oid.binary()]})
@@ -151,16 +228,20 @@ class WorkerRuntime:
 
     def submit_task(self, spec: TaskSpec):
         spec.owner = self.wid
+        # refs first: their ref_add precedes the submit on this conn, so the
+        # head registers interest before the task can complete
+        refs = [ObjectRef(o) for o in spec.return_ids]
         self.send({"t": "submit", "spec": spec})
-        return [ObjectRef(o) for o in spec.return_ids]
+        return refs
 
     def create_actor(self, spec: ActorSpec):
         self.send({"t": "create_actor", "spec": spec})
 
     def submit_actor_task_spec(self, spec: TaskSpec):
         spec.owner = self.wid
+        refs = [ObjectRef(o) for o in spec.return_ids]  # interest first
         self.send({"t": "actor_call", "spec": spec})
-        return [ObjectRef(o) for o in spec.return_ids]
+        return refs
 
     def kill_actor(self, actor_id, no_restart=True):
         self.send({"t": "kill_actor", "actor_id": actor_id.binary(),
@@ -231,12 +312,14 @@ class WorkerLoop:
         authkey = bytes.fromhex(os.environ["RTPU_AUTHKEY"])
         self.wid = os.environ["RTPU_WORKER_ID"]
         self.store = SharedObjectStore(store_path)
+        spill_dir = os.environ.get("RTPU_SPILL_DIR")
+        spill = SpillStore(spill_dir) if spill_dir else None
         if os.environ.get("RTPU_HEAD_FAMILY") == "AF_INET":
             host, port = addr.rsplit(":", 1)
             self.conn = Client((host, int(port)), authkey=authkey)
         else:
             self.conn = Client(addr, "AF_UNIX", authkey=authkey)
-        self.rt = WorkerRuntime(self.store, self.conn, self.wid)
+        self.rt = WorkerRuntime(self.store, self.conn, self.wid, spill)
         rt_mod.set_runtime(self.rt)
         self.actor_instance = None
         self.actor_spec: ActorSpec | None = None
@@ -260,6 +343,10 @@ class WorkerLoop:
 
     # -- execution ---------------------------------------------------------
 
+    def _store_value(self, oid, value, is_exception=False):
+        """Store a task output, spilling to disk when the store is full."""
+        self.rt.store_or_spill(oid, value, is_exception, notify_put=False)
+
     def _store_returns(self, spec: TaskSpec, result):
         n = len(spec.return_ids)
         if n == 0:
@@ -274,7 +361,7 @@ class WorkerLoop:
                     f"{len(vals)} values")
         for oid, v in zip(spec.return_ids, vals):
             try:
-                self.store.put(oid, v)
+                self._store_value(oid, v)
             except FileExistsError:
                 pass  # retry re-executed an already-stored return
 
@@ -299,7 +386,7 @@ class WorkerLoop:
                 for oid in spec.return_ids:
                     try:
                         self.store.delete(oid)
-                        self.store.put(oid, werr, is_exception=True)
+                        self._store_value(oid, werr, is_exception=True)
                     except Exception:
                         pass
         finally:
