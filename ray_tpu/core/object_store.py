@@ -73,11 +73,59 @@ def _load_lib() -> ctypes.CDLL:
     return lib
 
 
+class _FramedValue:
+    """One serialization of a value in the store's wire framing, writable
+    to either a shm buffer or a spill file (serialize once, place anywhere).
+    """
+
+    def __init__(self, value: Any, is_exception: bool):
+        buffers: list[pickle.PickleBuffer] = []
+        self.payload = cloudpickle.dumps(value, protocol=5,
+                                         buffer_callback=buffers.append)
+        self.raws = [b.raw() for b in buffers]
+        self.flags = _FLAG_EXCEPTION if is_exception else _FLAG_NORMAL
+        self.total = (_HEADER.size + len(self.payload)
+                      + sum(8 + len(r) for r in self.raws))
+
+    def write_into(self, buf) -> None:
+        _HEADER.pack_into(buf, 0, self.flags, len(self.raws),
+                          len(self.payload))
+        pos = _HEADER.size
+        buf[pos:pos + len(self.payload)] = self.payload
+        pos += len(self.payload)
+        for r in self.raws:
+            struct.pack_into("<Q", buf, pos, len(r))
+            pos += 8
+            buf[pos:pos + len(r)] = r
+            pos += len(r)
+
+
+def _parse_frame(view) -> Any:
+    """Inverse of _FramedValue over a buffer; raises stored exceptions."""
+    from .ref import loading_stored_refs
+    flags, n_bufs, plen = _HEADER.unpack_from(view, 0)
+    pos = _HEADER.size
+    payload = bytes(view[pos:pos + plen])
+    pos += plen
+    bufs = []
+    for _ in range(n_bufs):
+        (blen,) = struct.unpack_from("<Q", view, pos)
+        pos += 8
+        bufs.append(bytes(view[pos:pos + blen]))
+        pos += blen
+    with loading_stored_refs():
+        value = pickle.loads(payload, buffers=bufs)
+    if flags == _FLAG_EXCEPTION:
+        raise value
+    return value
+
+
 class SpillStore:
     """Disk spill area for objects the shm store can't hold (reference:
     raylet/local_object_manager.h:42 SpillObjects :112 +
-    _private/external_storage.py FileSystemStorage). One file per object,
-    written atomically (tmp + rename) so readers never see partials."""
+    _private/external_storage.py FileSystemStorage). One file per object in
+    the store's wire framing, written atomically (tmp + rename) so readers
+    never see partials."""
 
     def __init__(self, directory: str):
         self.dir = directory
@@ -88,22 +136,23 @@ class SpillStore:
 
     def spill(self, oid: ObjectID, value: Any,
               is_exception: bool = False) -> int:
-        blob = cloudpickle.dumps((bool(is_exception), value), protocol=5)
+        return self.spill_frame(oid, _FramedValue(value, is_exception))
+
+    def spill_frame(self, oid: ObjectID, frame: "_FramedValue") -> int:
+        buf = bytearray(frame.total)
+        frame.write_into(buf)
         tmp = self._path(oid) + ".tmp"
         with open(tmp, "wb") as f:
-            f.write(blob)
+            f.write(buf)
         os.replace(tmp, self._path(oid))
-        return len(blob)
+        return frame.total
 
     def contains(self, oid: ObjectID) -> bool:
         return os.path.exists(self._path(oid))
 
     def load(self, oid: ObjectID) -> Any:
         with open(self._path(oid), "rb") as f:
-            is_exception, value = pickle.loads(f.read())
-        if is_exception:
-            raise value
-        return value
+            return _parse_frame(f.read())
 
     def delete(self, oid: ObjectID) -> None:
         try:
@@ -184,24 +233,30 @@ class SharedObjectStore:
 
     def put(self, oid: ObjectID, value: Any, is_exception: bool = False) -> int:
         """Serialize `value` into the store under `oid`. Returns payload size."""
-        buffers: list[pickle.PickleBuffer] = []
-        payload = cloudpickle.dumps(value, protocol=5, buffer_callback=buffers.append)
-        raws = [b.raw() for b in buffers]
-        total = _HEADER.size + len(payload) + sum(8 + len(r) for r in raws)
-        buf = self.create_raw(oid, total)
-        flags = _FLAG_EXCEPTION if is_exception else _FLAG_NORMAL
-        _HEADER.pack_into(buf, 0, flags, len(raws), len(payload))
-        pos = _HEADER.size
-        buf[pos:pos + len(payload)] = payload
-        pos += len(payload)
-        for r in raws:
-            struct.pack_into("<Q", buf, pos, len(r))
-            pos += 8
-            buf[pos:pos + len(r)] = r
-            pos += len(r)
+        frame = _FramedValue(value, is_exception)
+        buf = self.create_raw(oid, frame.total)
+        frame.write_into(buf)
         del buf
         self.seal(oid)
-        return total
+        return frame.total
+
+    def put_or_spill(self, oid: ObjectID, value: Any, is_exception: bool,
+                     spill: Optional["SpillStore"]) -> bool:
+        """Store `value`, spilling the SAME serialized frame to disk when
+        the store is full (one serialization either way). Returns True if
+        spilled. Raises ObjectStoreFullError when full and spill is None."""
+        frame = _FramedValue(value, is_exception)
+        try:
+            buf = self.create_raw(oid, frame.total)
+        except ObjectStoreFullError:
+            if spill is None:
+                raise
+            spill.spill_frame(oid, frame)
+            return True
+        frame.write_into(buf)
+        del buf
+        self.seal(oid)
+        return False
 
     def get(self, oid: ObjectID, timeout_ms: int = -1) -> Any:
         """Deserialize the object. Raises GetTimeoutError on timeout and
@@ -210,24 +265,10 @@ class SharedObjectStore:
         if view is None:
             raise GetTimeoutError(f"timed out waiting for {oid}")
         try:
-            flags, n_bufs, plen = _HEADER.unpack_from(view, 0)
-            pos = _HEADER.size
-            payload = bytes(view[pos:pos + plen])
-            pos += plen
-            bufs = []
-            for _ in range(n_bufs):
-                (blen,) = struct.unpack_from("<Q", view, pos)
-                pos += 8
-                # copy out: the view is only pinned while we hold the refcount
-                bufs.append(bytes(view[pos:pos + blen]))
-                pos += blen
-            value = pickle.loads(payload, buffers=bufs)
+            return _parse_frame(view)
         finally:
             del view
             self.release(oid)
-        if flags == _FLAG_EXCEPTION:
-            raise value
-        return value
 
     # -- stats -------------------------------------------------------------
 

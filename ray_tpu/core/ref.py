@@ -54,6 +54,23 @@ def _capture_target():
     return stack[-1] if stack else None
 
 
+# Deserializing FROM the store (or a spill file): inner refs there are
+# containment-protected, so they must register plain interest WITHOUT
+# consuming a transfer pin — a stored copy's deserialize must never steal
+# the pin of an unrelated in-flight message transfer.
+class loading_stored_refs:
+    def __enter__(self):
+        _capture.loading = getattr(_capture, "loading", 0) + 1
+
+    def __exit__(self, *exc_info):
+        _capture.loading -= 1
+        return False
+
+
+def _loading_stored() -> bool:
+    return getattr(_capture, "loading", 0) > 0
+
+
 def _get_runtime():
     from . import runtime as rt
     r = rt.get_runtime_if_exists()
@@ -150,4 +167,4 @@ class ObjectRef:
 
 
 def _deserialize_ref(binary: bytes) -> ObjectRef:
-    return ObjectRef(ObjectID(binary), _transfer=True)
+    return ObjectRef(ObjectID(binary), _transfer=not _loading_stored())

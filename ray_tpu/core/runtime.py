@@ -294,6 +294,10 @@ class Runtime:
         # gang of waiters must not starve cheap rpcs behind it
         self._rpc_pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=32, thread_name_prefix="rtpu-rpc")
+        import queue
+        self._drop_q: "queue.SimpleQueue" = queue.SimpleQueue()
+        threading.Thread(target=self._drop_loop, daemon=True,
+                         name="rtpu-ref-drops").start()
 
         # head node
         self.head_node = NodeInfo(NodeID.from_random(), resources,
@@ -727,12 +731,9 @@ class Runtime:
         `value` become containment edges so they outlive one transfer."""
         from .ref import capture_serialized_refs
         with capture_serialized_refs() as inner_ids:
-            try:
-                self.store.put(oid, value, is_exception=is_exception)
-                state = READY
-            except ObjectStoreFullError:
-                self.spill.spill(oid, value, is_exception=is_exception)
-                state = SPILLED
+            spilled = self.store.put_or_spill(oid, value, is_exception,
+                                              self.spill)
+        state = SPILLED if spilled else READY
         with self.lock:
             self.directory[oid] = DirEntry(state)
             if inner_ids:
@@ -756,13 +757,26 @@ class Runtime:
                 self._ref_add_locked(oid, "driver", from_transfer)
 
     def ref_deleted(self, oid: ObjectID):
-        with self.lock:
-            c = self._local_refs.get(oid, 0) - 1
-            if c <= 0:
-                self._local_refs.pop(oid, None)
-                self._ref_drop_locked(oid, "driver")
-            else:
-                self._local_refs[oid] = c
+        # __del__ context: must not mutate interest/directory synchronously
+        # (a GC pass can fire inside code iterating those dicts on this
+        # very thread); enqueue and let the drop thread do the bookkeeping
+        self._drop_q.put(oid)
+
+    def _drop_loop(self):
+        while True:
+            oid = self._drop_q.get()
+            if oid is None:
+                return
+            try:
+                with self.lock:
+                    c = self._local_refs.get(oid, 0) - 1
+                    if c <= 0:
+                        self._local_refs.pop(oid, None)
+                        self._ref_drop_locked(oid, "driver")
+                    else:
+                        self._local_refs[oid] = c
+            except Exception:
+                traceback.print_exc()
 
     def ref_serialized(self, oid: ObjectID):
         with self.lock:
@@ -1117,6 +1131,7 @@ class Runtime:
                             e.state = FAILED
                             e.error_brief = msg.get("err")
                         self._maybe_free_locked(oid)
+                    self._drop_task_dep_interest_locked(spec)
             self._schedule_locked()
             self.cv.notify_all()
 
@@ -1538,8 +1553,10 @@ class Runtime:
                 else:
                     with self.lock:
                         e = self.directory.get(r.id())
-                        if e is not None and e.state == FAILED:
-                            ready.append(r)  # errors count as ready
+                        if e is not None and e.state in (FAILED, SPILLED):
+                            # errors count as ready; spilled objects are
+                            # readable from disk
+                            ready.append(r)
                             continue
                         if iters % 40 == 0:
                             # evicted-but-READY objects need lineage re-exec,

@@ -72,13 +72,17 @@ class WorkerRuntime:
     # -- refcounting -------------------------------------------------------
 
     def ref_created(self, oid, from_transfer: bool):
+        # the count transition and its notification must be ATOMIC per oid:
+        # a drop-loop 1->0 send racing a fresh 0->1 add could otherwise
+        # reach the head in the wrong order and strip live interest.
+        # Holding _ref_lock across send() is safe: __del__ never takes
+        # these locks (it only enqueues).
         with self._ref_lock:
             c = self._ref_counts.get(oid, 0)
             self._ref_counts[oid] = c + 1
-            notify = (c == 0) or from_transfer
-        if notify:
-            self.send({"t": "ref_add", "oid": oid.binary(),
-                       "transfer": from_transfer})
+            if c == 0 or from_transfer:
+                self.send({"t": "ref_add", "oid": oid.binary(),
+                           "transfer": from_transfer})
 
     def ref_deleted(self, oid):
         self._drop_q.put(oid)
@@ -91,12 +95,9 @@ class WorkerRuntime:
                     c = self._ref_counts.get(oid, 0) - 1
                     if c <= 0:
                         self._ref_counts.pop(oid, None)
-                        drop = True
+                        self.send({"t": "ref_drop", "oid": oid.binary()})
                     else:
                         self._ref_counts[oid] = c
-                        drop = False
-                if drop:
-                    self.send({"t": "ref_drop", "oid": oid.binary()})
             except Exception:
                 return  # connection gone: worker is exiting
 
@@ -132,18 +133,13 @@ class WorkerRuntime:
 
     def store_or_spill(self, oid: ObjectID, value, is_exception: bool,
                        notify_put: bool):
-        """Store a value, spilling to disk when the shm store is full; refs
-        pickled inside become containment edges on the head."""
+        """Store a value, spilling the same serialized frame to disk when
+        the shm store is full; refs pickled inside become containment edges
+        on the head."""
         from .ref import capture_serialized_refs
         with capture_serialized_refs() as inner_ids:
-            try:
-                self.store.put(oid, value, is_exception=is_exception)
-                spilled = False
-            except StoreFull:
-                if self.spill is None:
-                    raise
-                self.spill.spill(oid, value, is_exception=is_exception)
-                spilled = True
+            spilled = self.store.put_or_spill(oid, value, is_exception,
+                                              self.spill)
         if inner_ids:
             self.send({"t": "contained", "oid": oid.binary(),
                        "inner": [i.binary() for i in inner_ids]})
@@ -211,7 +207,9 @@ class WorkerRuntime:
         while True:
             still = []
             for r in pending:
-                (ready if self.store.contains(r.id()) else still).append(r)
+                present = self.store.contains(r.id()) or (
+                    self.spill is not None and self.spill.contains(r.id()))
+                (ready if present else still).append(r)
             pending = still
             if len(ready) >= num_returns or not pending:
                 break

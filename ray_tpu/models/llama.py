@@ -382,6 +382,39 @@ def decode_batched(params: dict, tokens: jax.Array, cache: dict,
 
 
 # ---------------------------------------------------------------------------
+# Pipeline-parallel forward (GPipe over the pp mesh axis)
+# ---------------------------------------------------------------------------
+
+def apply_pipelined(params: dict, tokens: jax.Array, cfg: LlamaConfig,
+                    mesh, num_microbatches: int,
+                    attn_impl=None) -> jax.Array:
+    """Training forward with transformer blocks pipelined over the mesh's
+    `pp` axis (parallel.pipeline GPipe schedule). Embedding and lm_head are
+    pp-replicated and stay outside the pipeline; cfg.n_layers must divide
+    the pp size. Matches `apply` numerically."""
+    from ..parallel.pipeline import pipeline_apply, split_stages
+
+    n_stages = mesh.shape.get("pp", 1)
+    x = params["embed"][tokens].astype(cfg.dtype)
+    positions = jnp.arange(tokens.shape[1])[None, :]
+    cos, sin = rope_freqs(cfg, positions)  # [1, S, hd/2]: broadcasts over mb
+
+    def stage_fn(stage_layers, h):
+        def body(h, layer_params):
+            y, _ = _layer(h, layer_params, cfg, cos, sin, attn_impl)
+            return y, None
+        h, _ = jax.lax.scan(body, h, stage_layers)
+        return h
+
+    stages = split_stages(params["layers"], n_stages)
+    x = pipeline_apply(stage_fn, stages, x, mesh, num_microbatches,
+                       remat=cfg.remat)
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return jnp.einsum("bsd,dv->bsv", x, params["lm_head"],
+                      preferred_element_type=jnp.float32)
+
+
+# ---------------------------------------------------------------------------
 # Paged KV cache (block tables; used by the paged serving engine)
 # ---------------------------------------------------------------------------
 

@@ -16,6 +16,8 @@ Modules:
                 object store, plus in-program XLA collective helpers
   ring        — sequence/context parallelism: ring attention and Ulysses
                 all-to-all re-sharding (absent from the reference, SURVEY §5.7)
+  pipeline    — GPipe schedule over the pp axis inside one SPMD program
+                (the compiled-graph/aDAG pipeline analog, SURVEY §2.4 PP)
 """
 import importlib
 
@@ -29,8 +31,10 @@ _EXPORTS = {
     "shard_pytree": "sharding", "constrain": "sharding",
     "ring_attention": "ring", "ulysses_attention": "ring",
     "ring_attention_sharded": "ring", "ulysses_attention_sharded": "ring",
+    "pipeline_apply": "pipeline", "split_stages": "pipeline",
+    "stage_sharding": "pipeline",
 }
-_MODULES = ("mesh", "sharding", "collective", "ring")
+_MODULES = ("mesh", "sharding", "collective", "ring", "pipeline")
 
 __all__ = list(_EXPORTS) + list(_MODULES)
 

@@ -1,0 +1,112 @@
+"""Pipeline parallelism over the `pp` mesh axis (GPipe schedule, SPMD).
+
+Reference role: the reference has NO pipeline schedule of its own — PP runs
+inside vLLM over Ray workers coordinated by compiled graphs
+(dag/compiled_dag_node.py:808; SURVEY.md §2.4). On TPU the idiomatic
+construction is the inverse: the schedule lives INSIDE one compiled SPMD
+program. Each pp shard holds one stage's parameters; every schedule tick,
+all stages run the same stage function on their current microbatch and
+activations hop to the next stage with `lax.ppermute`. Autodiff flows
+through the whole schedule (ppermute transposes to the reverse rotation),
+so the backward pipeline needs no extra code — this is the
+compiled-graph-channels analog with XLA owning the transfers (PAPERS.md
+JaxPP-style, original implementation).
+
+Schedule: GPipe — M microbatches through S stages in M + S - 1 ticks;
+activation-memory trade is handled by jax.checkpoint over the stage fn.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x: jax.Array,
+                   mesh: Mesh, num_microbatches: int,
+                   remat: bool = True, x_spec: P = P()) -> jax.Array:
+    """Run `x` through a chain of pp-sharded stages.
+
+    stage_fn(params_one_stage, h) -> h : one stage's computation (e.g. a
+        `lax.scan` over its transformer layers).
+    stage_params : pytree whose leaves have leading dim S (=mesh pp size),
+        sharded P("pp") — leaf i is stage i's parameters.
+    x [B, ...] : input activations, replicated over pp (embedding and head
+        stay outside the pipeline: they're pp-replicated). `x_spec` shards
+        the activation dims over OTHER mesh axes (e.g. P("dp") to compose
+        pp with data parallelism — each (pp, dp) shard pipelines its local
+        batch slice).
+    Returns y [B, ...] — the last stage's output, replicated over pp,
+    sharded per x_spec elsewhere.
+
+    The per-shard batch must divide into num_microbatches equal
+    microbatches.
+    """
+    from jax import shard_map  # current API (check_vma, not check_rep)
+
+    S = mesh.shape.get("pp", 1)
+    if S == 1:
+        return stage_fn(jax.tree.map(lambda a: a[0], stage_params), x)
+    M = num_microbatches
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    def inner(params, xs):
+        # params: this shard's stage, leading dim 1 — squeeze it
+        sp = jax.tree.map(lambda a: a[0], params)
+        idx = jax.lax.axis_index("pp")
+        b = xs.shape[0]
+        mb = b // M
+        xs = xs.reshape(M, mb, *xs.shape[1:])
+        state = jnp.zeros_like(xs[0])
+        outputs = jnp.zeros_like(xs)
+        fwd = [(i, (i + 1) % S) for i in range(S)]
+        for t in range(M + S - 1):
+            # stage 0 injects microbatch t; others consume the carried state
+            inject = xs[t] if t < M else jnp.zeros_like(xs[0])
+            h = jnp.where(idx == 0, inject, state)
+            h = fn(sp, h)
+            # the last stage's tick t output is microbatch t-(S-1)
+            if t >= S - 1:
+                outputs = outputs.at[t - (S - 1)].set(
+                    jnp.where(idx == S - 1, h, outputs[t - (S - 1)]))
+            state = jax.lax.ppermute(h, "pp", fwd)
+        # replicate the last stage's outputs to every pp shard
+        outputs = jnp.where(idx == S - 1, outputs, 0.0)
+        outputs = jax.lax.psum(outputs, "pp")
+        return outputs.reshape(b, *outputs.shape[2:])
+
+    per_shard = x.shape[0]
+    for ax in (x_spec[0] if len(x_spec) else None,) :
+        if ax is not None:
+            names = (ax,) if isinstance(ax, str) else tuple(ax)
+            for n in names:
+                per_shard //= mesh.shape.get(n, 1)
+    if per_shard % M:
+        raise ValueError(
+            f"per-shard batch {per_shard} must divide microbatches {M}")
+
+    return shard_map(
+        inner, mesh=mesh,
+        in_specs=(P("pp"), x_spec),
+        out_specs=x_spec,
+        check_vma=False,
+    )(stage_params, x)
+
+
+def split_stages(stacked_layer_params, n_stages: int):
+    """[L, ...] layer-stacked params -> [S, L/S, ...] stage-major params
+    (shard dim 0 over pp)."""
+    def reshape(a):
+        L = a.shape[0]
+        if L % n_stages:
+            raise ValueError(f"{L} layers not divisible by {n_stages} stages")
+        return a.reshape(n_stages, L // n_stages, *a.shape[1:])
+
+    return jax.tree.map(reshape, stacked_layer_params)
+
+
+def stage_sharding(mesh: Mesh):
+    """NamedSharding placing stage-major params on the pp axis."""
+    return NamedSharding(mesh, P("pp"))

@@ -1,0 +1,111 @@
+"""Pipeline parallelism (GPipe over the pp mesh axis).
+
+Reference parity: SURVEY.md §2.4 PP row — the reference orchestrates
+external engines' pipelines via compiled graphs (dag/compiled_dag_node.py:
+808); here the schedule is a native SPMD program. Done criterion (VERDICT
+item 8): 2-stage CPU-mesh training matches single-stage loss/grads."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import llama
+from ray_tpu.parallel import MeshSpec, build_mesh
+from ray_tpu.parallel.pipeline import pipeline_apply, split_stages
+
+
+def _cfg(**kw):
+    return llama.llama_tiny(vocab_size=128, n_layers=4, dim=32, mlp_dim=64,
+                            n_heads=4, n_kv_heads=2, max_seq_len=32, **kw)
+
+
+def test_pipeline_apply_matches_sequential():
+    mesh = build_mesh(MeshSpec(pp=2), devices=jax.devices()[:2])
+    rng = np.random.RandomState(0)
+    S, L, D = 2, 4, 16
+    params = {"w": jnp.asarray(rng.randn(L, D, D) * 0.1, jnp.float32)}
+
+    def stage_fn(sp, h):
+        def body(h, w):
+            return jnp.tanh(h @ w), None
+        h, _ = jax.lax.scan(body, h, sp["w"])
+        return h
+
+    x = jnp.asarray(rng.randn(8, D), jnp.float32)
+    stages = split_stages(params, S)
+    got = pipeline_apply(stage_fn, stages, x, mesh, num_microbatches=4)
+
+    h = x
+    for i in range(L):
+        h = jnp.tanh(h @ params["w"][i])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(h),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_llama_pipelined_matches_apply():
+    mesh = build_mesh(MeshSpec(pp=2), devices=jax.devices()[:2])
+    cfg = _cfg()
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (4, 16)),
+        jnp.int32)
+    want = llama.apply(params, tokens, cfg)
+    got = llama.apply_pipelined(params, tokens, cfg, mesh,
+                                num_microbatches=2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_pipelined_training_step_matches_gradients():
+    """VERDICT done criterion: pp=2 training matches single-stage loss AND
+    parameter gradients within tolerance."""
+    mesh = build_mesh(MeshSpec(pp=2), devices=jax.devices()[:2])
+    cfg = _cfg()
+    params = llama.init(jax.random.PRNGKey(1), cfg)
+    tokens = jnp.asarray(
+        np.random.RandomState(1).randint(0, cfg.vocab_size, (4, 17)),
+        jnp.int32)
+
+    def loss_plain(p):
+        logits = llama.apply(p, tokens[:, :-1], cfg)
+        return llama.cross_entropy_loss(logits, tokens[:, 1:])
+
+    def loss_pp(p):
+        logits = llama.apply_pipelined(p, tokens[:, :-1], cfg, mesh,
+                                       num_microbatches=2)
+        return llama.cross_entropy_loss(logits, tokens[:, 1:])
+
+    l0, g0 = jax.value_and_grad(loss_plain)(params)
+    l1, g1 = jax.jit(jax.value_and_grad(loss_pp))(params)
+    assert abs(float(l0) - float(l1)) < 1e-4
+    flat0 = jax.tree.leaves(g0)
+    flat1 = jax.tree.leaves(g1)
+    for a, b in zip(flat0, flat1):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=5e-3, atol=5e-4)
+
+
+def test_pipeline_composes_with_dp():
+    """pp x dp mesh: batch sharded over dp, stages over pp."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = build_mesh(MeshSpec(pp=2, dp=2), devices=jax.devices()[:4])
+    rng = np.random.RandomState(2)
+    L, D = 4, 16
+    params = {"w": jnp.asarray(rng.randn(L, D, D) * 0.1, jnp.float32)}
+
+    def stage_fn(sp, h):
+        def body(h, w):
+            return jnp.tanh(h @ w), None
+        h, _ = jax.lax.scan(body, h, sp["w"])
+        return h
+
+    x = jnp.asarray(rng.randn(8, D), jnp.float32)
+    x_sharded = jax.device_put(x, NamedSharding(mesh, P("dp")))
+    got = pipeline_apply(stage_fn, split_stages(params, 2), x_sharded, mesh,
+                         num_microbatches=2, x_spec=P("dp"))
+    h = x
+    for i in range(L):
+        h = jnp.tanh(h @ params["w"][i])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(h),
+                               rtol=1e-5, atol=1e-5)
