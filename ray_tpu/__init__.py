@@ -38,6 +38,8 @@ __all__ = [
     "remote", "get", "put", "wait", "kill", "cancel", "get_actor",
     "get_runtime_context", "head_address", "nodes", "cluster_resources",
     "available_resources", "timeline", "ObjectRef", "ActorHandle", "util",
+    "state",
 ]
 
 from . import util  # noqa: E402  (needs the names above)
+from . import state  # noqa: E402  (state API + Prometheus metrics)

@@ -31,7 +31,7 @@ import sys
 import threading
 import time
 import traceback
-from collections import deque
+from collections import OrderedDict, deque
 from multiprocessing.connection import Connection, Listener
 from typing import Any, Optional
 
@@ -282,9 +282,15 @@ class Runtime:
         self.pending: deque[TaskSpec] = deque()
         self._abandoned_rpcs: set[ObjectID] = set()
         # timeline events, bounded so a long-lived driver doesn't grow
-        # without limit (lineage-entry pruning is round-2 work: needs
-        # distributed ObjectRef refcounting before DirEntries can be freed)
+        # without limit
         self.events: deque[dict] = deque(maxlen=20000)
+        # per-task state records for the state API (reference analog: the
+        # GCS task-event store, gcs_task_manager.h:94); bounded FIFO
+        self.task_records: "OrderedDict" = OrderedDict()
+        self.task_records_max = 10000
+        self.counters = {"tasks_submitted": 0, "tasks_finished": 0,
+                         "tasks_failed": 0, "tasks_retried": 0,
+                         "actors_created": 0}
         self._shutdown = False
         self._worker_seq = 0
         self._spread_rr = 0
@@ -882,7 +888,25 @@ class Runtime:
             self._submit_locked(spec)
         return refs
 
+    def _record_task_locked(self, spec, state: str, **extra):
+        rec = self.task_records.get(spec.task_id)
+        if rec is None:
+            if state != "PENDING":
+                # record was FIFO-evicted: don't resurrect it with a bogus
+                # submitted_at — honest absence beats wrong timestamps
+                return
+            rec = {"task_id": spec.task_id.hex(), "name": spec.name,
+                   "state": state, "is_actor_task": spec.is_actor_task,
+                   "submitted_at": time.time()}
+            self.task_records[spec.task_id] = rec
+            while len(self.task_records) > self.task_records_max:
+                self.task_records.popitem(last=False)
+        rec["state"] = state
+        rec.update(extra)
+
     def _submit_locked(self, spec: TaskSpec):
+        self.counters["tasks_submitted"] += 1
+        self._record_task_locked(spec, "PENDING")
         for oid in spec.return_ids:
             self.directory[oid] = DirEntry(PENDING, lineage=spec)
         # the task holds interest in its args until it terminally completes
@@ -1036,6 +1060,9 @@ class Runtime:
             return
         w.state = "busy"
         self._ship_function_locked(w, spec.func_id)
+        self._record_task_locked(spec, "RUNNING", worker=w.wid,
+                                 node=w.node_id.hex(),
+                                 started_at=time.time())
         self.events.append({"name": spec.name, "cat": "task", "ph": "B",
                             "pid": w.wid, "ts": time.time() * 1e6,
                             "tid": spec.task_id.hex()[:8]})
@@ -1065,11 +1092,16 @@ class Runtime:
                                    retryable: bool = True):
         if retryable and spec.retries_left > 0:
             spec.retries_left -= 1
+            self.counters["tasks_retried"] += 1
+            self._record_task_locked(spec, "RETRYING", error=repr(err))
             if spec.is_actor_task:
                 self._route_actor_task_locked(spec)
             else:
                 self.pending.append(spec)
             return
+        self.counters["tasks_failed"] += 1
+        self._record_task_locked(spec, "FAILED", finished_at=time.time(),
+                                 error=repr(err))
         for oid in spec.return_ids:
             self._store_error(oid, err)
             e = self.directory.get(oid)
@@ -1112,6 +1144,10 @@ class Runtime:
                                 "tid": task_id.hex()[:8]})
             if spec is not None and spec.task_id == task_id:
                 if msg["ok"]:
+                    self.counters["tasks_finished"] += 1
+                    self._record_task_locked(spec, "FINISHED",
+                                             finished_at=time.time(),
+                                             duration_s=msg.get("dur"))
                     for oid in spec.return_ids:
                         e = self.directory.get(oid)
                         if e is not None and e.state == PENDING:
@@ -1125,6 +1161,10 @@ class Runtime:
                     self._handle_failed_task_locked(
                         spec, exc.RayError(msg.get("err", "")), retryable=True)
                 else:
+                    self.counters["tasks_failed"] += 1
+                    self._record_task_locked(spec, "FAILED",
+                                             finished_at=time.time(),
+                                             error=msg.get("err"))
                     for oid in spec.return_ids:
                         e = self.directory.get(oid)
                         if e is not None:
@@ -1148,6 +1188,7 @@ class Runtime:
         if spec.named:
             if spec.named in self.named_actors:
                 raise ValueError(f"actor name {spec.named!r} already taken")
+        self.counters["actors_created"] += 1
         a = ActorInfo(spec)
         if spec.named:
             self.named_actors[spec.named] = spec.actor_id
@@ -1232,6 +1273,8 @@ class Runtime:
     def submit_actor_task_spec(self, spec: TaskSpec) -> list[ObjectRef]:
         with self.lock:
             refs = [ObjectRef(o) for o in spec.return_ids]  # interest first
+            self.counters["tasks_submitted"] += 1
+            self._record_task_locked(spec, "PENDING")
             for oid in spec.return_ids:
                 self.directory[oid] = DirEntry(PENDING, lineage=None)
             holder = f"task:{spec.task_id.hex()}"
@@ -1258,6 +1301,9 @@ class Runtime:
             return
         self._ship_function_locked(w, spec.func_id)
         a.running[spec.task_id] = spec
+        self._record_task_locked(spec, "RUNNING", worker=w.wid,
+                                 node=w.node_id.hex(),
+                                 started_at=time.time())
         if not w.send({"t": "actor_task", "spec": spec}):
             self._on_worker_death(w.wid)
 

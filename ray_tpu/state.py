@@ -1,0 +1,223 @@
+"""ray_tpu.state — cluster state API + Prometheus metrics.
+
+Reference parity: python/ray/util/state/api.py (`ray list tasks|actors|
+objects|nodes|workers`, `ray summary`) backed by the GCS task-event store
+(src/ray/gcs/gcs_task_manager.h:94), and the per-node Prometheus pipeline
+(_private/metrics_agent.py + stats/metric_defs.cc). Here the head runtime
+IS the control plane, so the state API reads its tables directly (driver)
+or over the worker->head rpc channel, and one HTTP endpoint exposes the
+native counters in Prometheus text format.
+
+    import ray_tpu
+    from ray_tpu import state
+    state.list_tasks()                  # [{'task_id', 'name', 'state', ...}]
+    state.list_actors()
+    state.list_objects()
+    state.list_nodes()
+    state.list_workers()
+    state.summary()
+    port = state.start_metrics_server()  # GET /metrics
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from .core import runtime as rt_mod
+
+
+def _head():
+    rt = rt_mod.get_runtime_if_exists()
+    if rt is None:
+        raise RuntimeError("ray_tpu.init() has not been called")
+    if not isinstance(rt, rt_mod.Runtime):
+        raise RuntimeError(
+            "the state API reads head tables; call it from the driver")
+    return rt
+
+
+_STATE_NAMES = {0: "PENDING", 1: "READY", 2: "FAILED", 3: "SPILLED"}
+
+
+def list_tasks(limit: int = 1000, filters: Optional[dict] = None) -> list[dict]:
+    """Most-recent-first task records (reference: `ray list tasks`)."""
+    rt = _head()
+    with rt.lock:
+        recs = [dict(r) for r in reversed(rt.task_records.values())]
+    if filters:
+        recs = [r for r in recs
+                if all(r.get(k) == v for k, v in filters.items())]
+    return recs[:limit]
+
+
+def list_actors(limit: int = 1000) -> list[dict]:
+    rt = _head()
+    with rt.lock:
+        out = []
+        for aid, a in rt.actors.items():
+            out.append({
+                "actor_id": aid.hex(), "class_name": a.spec.name,
+                "state": a.state.upper(), "name": a.spec.named or "",
+                "worker": a.wid or "", "restarts_left": a.restarts_left,
+                "pending_calls": len(a.queue), "running_calls": len(a.running),
+                "death_cause": a.death_cause,
+            })
+    return out[:limit]
+
+
+def list_objects(limit: int = 1000) -> list[dict]:
+    rt = _head()
+    with rt.lock:
+        out = []
+        for oid, e in rt.directory.items():
+            out.append({
+                "object_id": oid.hex(),
+                "state": _STATE_NAMES.get(e.state, str(e.state)),
+                "in_store": rt.store.contains(oid),
+                "has_lineage": e.lineage is not None,
+                "holders": sorted(rt.interest.get(oid, ())),
+            })
+            if len(out) >= limit:
+                break
+    return out
+
+
+def list_nodes() -> list[dict]:
+    return _head().node_table()
+
+
+def list_workers() -> list[dict]:
+    rt = _head()
+    with rt.lock:
+        return [{
+            "worker_id": w.wid, "state": w.state,
+            "node": w.node_id.hex(),
+            "pid": getattr(w.proc, "pid", None),
+            "tpu": w.tpu,
+            "current_task": (w.current.name if w.current else ""),
+            "actor_id": w.actor_id.hex() if w.actor_id else "",
+        } for w in rt.workers.values()]
+
+
+def summary() -> dict:
+    """Cluster summary (reference: `ray summary tasks` + cluster status)."""
+    rt = _head()
+    with rt.lock:
+        by_state: dict[str, int] = {}
+        for r in rt.task_records.values():
+            by_state[r["state"]] = by_state.get(r["state"], 0) + 1
+        return {
+            "tasks": dict(rt.counters),
+            "tasks_by_state": by_state,
+            "actors": len(rt.actors),
+            "workers": {s: sum(1 for w in rt.workers.values()
+                               if w.state == s)
+                        for s in ("idle", "busy", "actor", "starting",
+                                  "dead")},
+            "nodes_alive": sum(1 for n in rt.nodes.values() if n.alive),
+            "pending_tasks": len(rt.pending),
+            "objects_tracked": len(rt.directory),
+            "object_store": {
+                "capacity": rt.store.capacity(),
+                "bytes_in_use": rt.store.bytes_in_use(),
+                "num_objects": rt.store.num_objects(),
+                "evictions": rt.store.evictions(),
+            },
+        }
+
+
+# ---------------------------------------------------------------------------
+# Prometheus endpoint (reference: _private/metrics_agent.py exposition)
+# ---------------------------------------------------------------------------
+
+def _prometheus_text() -> str:
+    s = summary()
+    lines = []
+
+    def gauge(name, value, help_txt):
+        lines.append(f"# HELP {name} {help_txt}")
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {value}")
+
+    def counter(name, value, help_txt):
+        lines.append(f"# HELP {name} {help_txt}")
+        lines.append(f"# TYPE {name} counter")
+        lines.append(f"{name} {value}")
+
+    for k, v in s["tasks"].items():
+        counter(f"ray_tpu_{k}_total", v, f"cumulative {k.replace('_', ' ')}")
+    gauge("ray_tpu_pending_tasks", s["pending_tasks"],
+          "tasks queued for scheduling")
+    gauge("ray_tpu_actors", s["actors"], "actors registered")
+    gauge("ray_tpu_nodes_alive", s["nodes_alive"], "alive nodes")
+    gauge("ray_tpu_objects_tracked", s["objects_tracked"],
+          "directory entries")
+    lines.append("# HELP ray_tpu_workers worker processes by state")
+    lines.append("# TYPE ray_tpu_workers gauge")
+    for st, n in s["workers"].items():
+        lines.append(
+            f'ray_tpu_workers{{state="{st}"}} {n}')
+    st = s["object_store"]
+    gauge("ray_tpu_object_store_capacity_bytes", st["capacity"],
+          "shm store capacity")
+    gauge("ray_tpu_object_store_used_bytes", st["bytes_in_use"],
+          "shm store bytes in use")
+    gauge("ray_tpu_object_store_objects", st["num_objects"],
+          "objects resident in the shm store")
+    counter("ray_tpu_object_store_evictions_total", st["evictions"],
+            "LRU evictions")
+    return "\n".join(lines) + "\n"
+
+
+_server = None
+
+
+def start_metrics_server(port: int = 0) -> int:
+    """Serve GET /metrics in Prometheus text format; returns the bound
+    port. Idempotent per process."""
+    global _server
+    if _server is not None:
+        return _server.server_address[1]
+    _head()  # fail fast if not on the driver
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path.rstrip("/") not in ("", "/metrics"):
+                self.send_response(404)
+                self.end_headers()
+                return
+            try:
+                body = _prometheus_text().encode()
+            except Exception as e:  # noqa: BLE001
+                self.send_response(500)
+                self.end_headers()
+                self.wfile.write(str(e).encode())
+                return
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    _server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    threading.Thread(target=_server.serve_forever, daemon=True,
+                     name="rtpu-metrics").start()
+    return _server.server_address[1]
+
+
+def stop_metrics_server() -> None:
+    global _server
+    if _server is not None:
+        _server.shutdown()
+        _server = None
+
+
+def timeline() -> list[dict]:
+    """Chrome-trace events (reference: ray.timeline)."""
+    return _head().timeline()
