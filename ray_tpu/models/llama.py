@@ -46,6 +46,13 @@ class LlamaConfig:
     use_flash: bool = True            # Pallas flash attention (vs reference)
     attn_block_q: int = 512
     attn_block_k: int = 512
+    # mixture-of-experts (0 = dense MLP). Experts shard over the ep mesh
+    # axis ("expert" logical axis); dispatch/combine einsums induce the
+    # all-to-all when tokens are dp/sp-sharded (SURVEY §2.4 EP row).
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity: float = 2.0         # slots per expert = cap*k*T/E
+    moe_aux_weight: float = 0.01      # load-balance loss weight
 
     @property
     def head_dim(self) -> int:
@@ -59,9 +66,14 @@ class LlamaConfig:
 
     def num_params(self) -> int:
         d, v = self.dim, self.vocab_size
+        if self.moe_experts:
+            mlp = (3 * d * self.mlp_dim * self.moe_experts
+                   + d * self.moe_experts)                           # + router
+        else:
+            mlp = 3 * d * self.mlp_dim
         per_layer = (
             d * d + 2 * d * self.n_kv_heads * self.head_dim + d * d  # qkvo
-            + 3 * d * self.mlp_dim                                   # swiglu
+            + mlp                                                    # (swi)glu
             + 2 * d)                                                 # norms
         return v * d + self.n_layers * per_layer + d + d * v
 
@@ -105,7 +117,24 @@ def init(rng: jax.Array, cfg: LlamaConfig) -> dict:
         return (jax.random.normal(key, shape, jnp.float32) * std).astype(
             cfg.dtype)
 
-    ks = jax.random.split(k_layers, 7)
+    ks = jax.random.split(k_layers, 8)
+    if cfg.moe_experts:
+        E = cfg.moe_experts
+        mlp = {
+            "mlp_norm": norm_init(L, d),
+            "w_router": (jax.random.normal(
+                ks[7], (L, d, E), jnp.float32) / math.sqrt(d)),
+            "w_gate": dense_init(ks[4], (L, E, d, cfg.mlp_dim), d),
+            "w_up": dense_init(ks[5], (L, E, d, cfg.mlp_dim), d),
+            "w_down": dense_init(ks[6], (L, E, cfg.mlp_dim, d), cfg.mlp_dim),
+        }
+    else:
+        mlp = {
+            "mlp_norm": norm_init(L, d),
+            "w_gate": dense_init(ks[4], (L, d, cfg.mlp_dim), d),
+            "w_up": dense_init(ks[5], (L, d, cfg.mlp_dim), d),
+            "w_down": dense_init(ks[6], (L, cfg.mlp_dim, d), cfg.mlp_dim),
+        }
     return {
         "embed": dense_init(k_emb, (cfg.vocab_size, d), d),
         "layers": {
@@ -114,10 +143,7 @@ def init(rng: jax.Array, cfg: LlamaConfig) -> dict:
             "wk": dense_init(ks[1], (L, d, kvd), d),
             "wv": dense_init(ks[2], (L, d, kvd), d),
             "wo": dense_init(ks[3], (L, cfg.n_heads * hd, d), cfg.dim),
-            "mlp_norm": norm_init(L, d),
-            "w_gate": dense_init(ks[4], (L, d, cfg.mlp_dim), d),
-            "w_up": dense_init(ks[5], (L, d, cfg.mlp_dim), d),
-            "w_down": dense_init(ks[6], (L, cfg.mlp_dim, d), cfg.mlp_dim),
+            **mlp,
         },
         "final_norm": norm_init(d),
         "lm_head": dense_init(k_out, (d, cfg.vocab_size), d),
@@ -127,6 +153,21 @@ def init(rng: jax.Array, cfg: LlamaConfig) -> dict:
 def logical_axes(cfg: LlamaConfig) -> dict:
     """Logical sharding axes per param (leading None = scanned layer dim).
     Resolved against the mesh by parallel.sharding.logical_sharding."""
+    if cfg.moe_experts:
+        mlp = {
+            "mlp_norm": (None, "norm"),
+            "w_router": (None, "embed", None),
+            "w_gate": (None, "expert", "embed", "mlp"),
+            "w_up": (None, "expert", "embed", "mlp"),
+            "w_down": (None, "expert", "mlp", "embed"),
+        }
+    else:
+        mlp = {
+            "mlp_norm": (None, "norm"),
+            "w_gate": (None, "embed", "mlp"),
+            "w_up": (None, "embed", "mlp"),
+            "w_down": (None, "mlp", "embed"),
+        }
     return {
         "embed": ("vocab", "embed"),
         "layers": {
@@ -135,10 +176,7 @@ def logical_axes(cfg: LlamaConfig) -> dict:
             "wk": (None, "embed", "heads"),
             "wv": (None, "embed", "heads"),
             "wo": (None, "heads", "embed"),
-            "mlp_norm": (None, "norm"),
-            "w_gate": (None, "embed", "mlp"),
-            "w_up": (None, "embed", "mlp"),
-            "w_down": (None, "mlp", "embed"),
+            **mlp,
         },
         "final_norm": ("norm",),
         "lm_head": ("embed", "vocab"),
@@ -194,11 +232,60 @@ def _qkv(h, p, cfg: LlamaConfig, cos, sin):
 
 
 def _mlp_block(x, p, cfg: LlamaConfig):
-    """Post-attention SwiGLU MLP with residual, shared by every mode."""
+    """Post-attention MLP with residual: dense SwiGLU, or top-k MoE when
+    cfg.moe_experts > 0 (returns aux=0.0 / load-balance loss)."""
     h = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
+    if cfg.moe_experts:
+        y, aux = _moe_ffn(h, p, cfg)
+        x = x + y
+        return constrain(x, ("batch", "sequence", "embed")), aux
     gate = jax.nn.silu(h @ p["w_gate"])
     x = x + (gate * (h @ p["w_up"])) @ p["w_down"]
-    return constrain(x, ("batch", "sequence", "embed"))
+    return constrain(x, ("batch", "sequence", "embed")), jnp.float32(0.0)
+
+
+def _moe_ffn(h, p, cfg: LlamaConfig):
+    """Top-k expert SwiGLU over capacity-bounded slots (GShard-style dense
+    dispatch/combine einsums — static shapes, MXU-friendly; with experts
+    sharded over ep and tokens over dp, XLA lowers the dispatch einsum to
+    the expert all-to-all). h [B, S, D] -> (out [B, S, D], aux_loss)."""
+    b, s, d = h.shape
+    E, k = cfg.moe_experts, cfg.moe_top_k
+    T = b * s
+    C = max(1, int(cfg.moe_capacity * k * T / E))
+    ht = h.reshape(T, d)
+
+    logits = ht.astype(jnp.float32) @ p["w_router"]            # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_k, idx_k = jax.lax.top_k(probs, k)                    # [T, k]
+    gate_k = gate_k / jnp.maximum(
+        gate_k.sum(axis=-1, keepdims=True), 1e-9)
+
+    # Switch-style load-balance aux: E * sum(frac_routed * mean_prob)
+    me = probs.mean(axis=0)                                    # [E]
+    ce = jax.nn.one_hot(idx_k[:, 0], E).mean(axis=0)           # [E]
+    aux = E * jnp.sum(me * ce)
+
+    combine = jnp.zeros((T, E, C), jnp.float32)
+    prev_counts = jnp.zeros((E,), jnp.int32)
+    for j in range(k):                                         # k is tiny
+        oh = jax.nn.one_hot(idx_k[:, j], E, dtype=jnp.int32)   # [T, E]
+        pos = jnp.cumsum(oh, axis=0) - 1 + prev_counts         # [T, E]
+        prev_counts = prev_counts + oh.sum(axis=0)
+        in_cap = (pos < C) & (oh > 0)                          # [T, E]
+        slot = jax.nn.one_hot(jnp.clip(pos, 0, C - 1), C)      # [T, E, C]
+        combine = combine + (gate_k[:, j][:, None, None]
+                             * in_cap[..., None] * slot)
+    dispatch = (combine > 0).astype(h.dtype)                   # [T, E, C]
+
+    xe = jnp.einsum("tec,td->ecd", dispatch, ht)               # [E, C, D]
+    xe = constrain(xe, ("expert", None, "embed"))
+    ge = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xe, p["w_gate"]))
+    ue = jnp.einsum("ecd,edf->ecf", xe, p["w_up"])
+    ye = jnp.einsum("ecf,efd->ecd", ge * ue, p["w_down"])
+    ye = constrain(ye, ("expert", None, "embed"))
+    out = jnp.einsum("tec,ecd->td", combine.astype(ye.dtype), ye)
+    return out.reshape(b, s, d), aux
 
 
 def _layer(x, layer_params, cfg: LlamaConfig, cos, sin, attn_impl,
@@ -237,7 +324,8 @@ def _layer(x, layer_params, cfg: LlamaConfig, cos, sin, attn_impl,
     attn = attn.reshape(b, s, cfg.n_heads * cfg.head_dim)
     x = x + attn @ p["wo"]
     x = constrain(x, ("batch", "sequence", "embed"))
-    return _mlp_block(x, p, cfg), new_kv
+    x, aux = _mlp_block(x, p, cfg)
+    return x, aux, new_kv
 
 
 # ---------------------------------------------------------------------------
@@ -249,24 +337,34 @@ def apply(params: dict, tokens: jax.Array, cfg: LlamaConfig,
     """Training/prefill forward: tokens [B, S] int32 -> logits [B, S, V] f32.
 
     `attn_impl(q, k, v)` overrides attention (the trainer passes a
-    ring-attention closure when an "sp" axis is active).
+    ring-attention closure when an "sp" axis is active). MoE configs:
+    use apply_with_aux to also get the load-balance loss.
     """
+    return apply_with_aux(params, tokens, cfg, attn_impl)[0]
+
+
+def apply_with_aux(params: dict, tokens: jax.Array, cfg: LlamaConfig,
+                   attn_impl=None):
+    """(logits, aux) — aux is the mean per-layer MoE load-balance loss
+    (0.0 for dense configs); add cfg.moe_aux_weight * aux to the loss."""
     x = params["embed"][tokens].astype(cfg.dtype)
     x = constrain(x, ("batch", "sequence", "embed"))
     positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
     cos, sin = rope_freqs(cfg, positions)
 
-    def body(x, layer_params):
-        y, _ = _layer(x, layer_params, cfg, cos, sin, attn_impl)
-        return y, None
+    def body(carry, layer_params):
+        x, aux = carry
+        y, a, _ = _layer(x, layer_params, cfg, cos, sin, attn_impl)
+        return (y, aux + a), None
 
     if cfg.remat:
         body = jax.checkpoint(body)
-    x, _ = jax.lax.scan(body, x, params["layers"])
+    (x, aux), _ = jax.lax.scan(body, (x, jnp.float32(0.0)),
+                               params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"],
                         preferred_element_type=jnp.float32)
-    return logits
+    return logits, aux / cfg.n_layers
 
 
 def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int) -> dict:
@@ -287,8 +385,8 @@ def apply_decode(params: dict, tokens: jax.Array, cache: dict,
 
     def body(x, scanned):
         layer_params, kv = scanned
-        y, new_kv = _layer(x, layer_params, cfg, cos, sin, None,
-                           kv_cache=kv, cache_idx=cache["idx"])
+        y, _, new_kv = _layer(x, layer_params, cfg, cos, sin, None,
+                              kv_cache=kv, cache_idx=cache["idx"])
         return y, new_kv
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -329,7 +427,8 @@ def apply_with_kv(params: dict, tokens: jax.Array, cfg: LlamaConfig):
         attn = _attention(q, k, v, cfg, causal=True, attn_impl=None)
         x = x + attn.reshape(b, s, -1) @ p["wo"]
         x = constrain(x, ("batch", "sequence", "embed"))
-        return _mlp_block(x, p, cfg), (k, v)
+        x, _ = _mlp_block(x, p, cfg)
+        return x, (k, v)
 
     x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
@@ -370,7 +469,8 @@ def decode_batched(params: dict, tokens: jax.Array, cache: dict,
         probs = jax.nn.softmax(scores, axis=-1).astype(vr.dtype)
         attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vr)
         x = x + attn.reshape(b, 1, -1) @ p["wo"]
-        return _mlp_block(x, p, cfg), (ck, cv)
+        x, _ = _mlp_block(x, p, cfg)
+        return x, (ck, cv)
 
     x, (new_k, new_v) = jax.lax.scan(
         body, x, (params["layers"], (cache["k"], cache["v"])))
@@ -401,7 +501,7 @@ def apply_pipelined(params: dict, tokens: jax.Array, cfg: LlamaConfig,
 
     def stage_fn(stage_layers, h):
         def body(h, layer_params):
-            y, _ = _layer(h, layer_params, cfg, cos, sin, attn_impl)
+            y, _, _ = _layer(h, layer_params, cfg, cos, sin, attn_impl)
             return y, None
         h, _ = jax.lax.scan(body, h, stage_layers)
         return h
@@ -480,7 +580,7 @@ def decode_paged(params: dict, tokens: jax.Array, caches: list[dict],
         attn = attend(q[:, 0], k_pages, v_pages, block_tables,
                       lengths + 1)                         # [B, H, D]
         x = x + attn.reshape(b, 1, -1) @ p["wo"]
-        x = _mlp_block(x, p, cfg)
+        x, _ = _mlp_block(x, p, cfg)
         new_caches.append({"k": k_pages, "v": v_pages})
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
@@ -562,7 +662,7 @@ def prefill_paged_chunk(params: dict, chunk: jax.Array, caches: list[dict],
         attn = jnp.einsum("bhqk,bkhd->bqhd", w,
                           vv.astype(jnp.float32)).astype(cfg.dtype)
         x = x + attn.reshape(1, c, -1) @ p["wo"]
-        x = _mlp_block(x, p, cfg)
+        x, _ = _mlp_block(x, p, cfg)
 
         # write the chunk's K/V into its (page-aligned) pages
         k_w = k[0].reshape(n_chunk_pages, page_size,
