@@ -1,0 +1,6 @@
+"""`python -m ray_tpu` — the cluster/job CLI (scripts/scripts.py analog)."""
+import sys
+
+from .cli import main
+
+sys.exit(main())

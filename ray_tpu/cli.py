@@ -1,0 +1,359 @@
+"""Cluster + job CLI: ``python -m ray_tpu <command>``.
+
+Reference parity: the `ray` CLI (python/ray/scripts/scripts.py — start/stop/
+status) and the job CLI (dashboard/modules/job/cli.py — submit/list/status/
+logs/stop).  The head here is one daemon process owning the whole control
+plane (SURVEY.md §7 inversion: no per-node raylet zoo to supervise), so
+`start --head` forks exactly one process and `start --address` runs a node
+agent in the foreground.
+
+Commands:
+    start --head [--num-cpus N] [--num-tpus N] [--name NAME] [--block]
+    start --address CLUSTER_FILE [--num-cpus N] ...   (join as a node agent)
+    stop [--name NAME]
+    status [--address ...]
+    job submit [--working-dir DIR] [--env K=V ...] [--follow] -- CMD...
+    job list | job status ID | job logs ID [--follow] | job stop ID
+    state tasks|actors|nodes|objects|jobs  (state API, ray list analog)
+    timeline --out FILE
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def _client(address):
+    import ray_tpu
+    info = ray_tpu.init(address=address or "auto")
+    from .core import runtime as rt_mod
+    return ray_tpu, rt_mod.get_runtime_if_exists(), info
+
+
+# --------------------------------------------------------------------- #
+# start / stop / status
+# --------------------------------------------------------------------- #
+
+def _cluster_pointer(name: str) -> str:
+    return f"/tmp/ray_tpu/named_{name}.json"
+
+
+def cmd_start(args) -> int:
+    if args.head:
+        if args.block:
+            return _run_head(args)
+        # fork a detached head daemon, wait for its cluster file
+        cmd = [sys.executable, "-m", "ray_tpu.cli", "start", "--head",
+               "--block", "--name", args.name]
+        if args.num_cpus is not None:
+            cmd += ["--num-cpus", str(args.num_cpus)]
+        if args.num_tpus is not None:
+            cmd += ["--num-tpus", str(args.num_tpus)]
+        if args.enable_remote_nodes:
+            cmd += ["--enable-remote-nodes"]
+        pointer = _cluster_pointer(args.name)
+        if os.path.exists(pointer):
+            with open(pointer) as f:
+                old = json.load(f)
+            if _alive(old.get("head_pid", -1)):
+                print(f"cluster {args.name!r} already running "
+                      f"(pid {old['head_pid']}); `stop` it first",
+                      file=sys.stderr)
+                return 1
+            os.unlink(pointer)
+        proc = subprocess.Popen(cmd, start_new_session=True,
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if os.path.exists(pointer):
+                with open(pointer) as f:
+                    info = json.load(f)
+                print(f"head started (pid {proc.pid})")
+                print(f"cluster file: {info['cluster_file']}")
+                print("connect with: ray_tpu.init(address='auto')")
+                return 0
+            if proc.poll() is not None:
+                print("head failed to start", file=sys.stderr)
+                return 1
+            time.sleep(0.1)
+        print("timed out waiting for head", file=sys.stderr)
+        return 1
+    if args.address:
+        # join as a node agent (foreground; daemonize with nohup/systemd)
+        with open(args.address) as f:
+            cf = json.load(f)
+        from .core.node_agent import main as agent_main
+        host = cf["tcp_host"]
+        if host == "0.0.0.0":
+            host = "127.0.0.1"
+        agent_args = ["--head", f"{host}:{cf['tcp_port']}",
+                      "--authkey", cf["authkey"],
+                      "--num-cpus", str(args.num_cpus or os.cpu_count())]
+        if args.num_tpus:
+            agent_args += ["--resources", json.dumps({"TPU": args.num_tpus})]
+        return agent_main(agent_args) or 0
+    print("start needs --head or --address", file=sys.stderr)
+    return 2
+
+
+def _run_head(args) -> int:
+    import ray_tpu
+    from .core import runtime as rt_mod
+    ray_tpu.init(num_cpus=args.num_cpus, num_tpus=args.num_tpus,
+                 **({"enable_remote_nodes": True}
+                    if args.enable_remote_nodes else {}))
+    rt = rt_mod.get_runtime_if_exists()
+    pointer = _cluster_pointer(args.name)
+    os.makedirs(os.path.dirname(pointer), exist_ok=True)
+    with open(pointer, "w") as f:
+        json.dump({"cluster_file": rt.cluster_file,
+                   "head_pid": os.getpid(), "name": args.name}, f)
+    stop = {"flag": False}
+
+    def _term(signum, frame):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    try:
+        while not stop["flag"]:
+            time.sleep(0.2)
+    finally:
+        try:
+            os.unlink(pointer)
+        except OSError:
+            pass
+        ray_tpu.shutdown()
+    return 0
+
+
+def _alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except (OSError, TypeError):
+        return False
+
+
+def cmd_stop(args) -> int:
+    pointer = _cluster_pointer(args.name)
+    if not os.path.exists(pointer):
+        print(f"no cluster {args.name!r}", file=sys.stderr)
+        return 1
+    with open(pointer) as f:
+        info = json.load(f)
+    pid = info["head_pid"]
+    if not _alive(pid):
+        os.unlink(pointer)
+        print("head already gone; cleaned up pointer")
+        return 0
+    os.kill(pid, signal.SIGTERM)
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if not _alive(pid):
+            print("stopped")
+            return 0
+        time.sleep(0.1)
+    os.kill(pid, signal.SIGKILL)
+    print("killed (did not stop in 15s)")
+    return 0
+
+
+def cmd_status(args) -> int:
+    ray, rt, info = _client(args.address)
+    res = rt.cluster_resources()
+    avail = rt.available_resources()
+    print(f"cluster: {info['address']}")
+    for node in rt.node_table():
+        state = "ALIVE" if node["Alive"] else "DEAD"
+        print(f"  node {node['NodeName']:<12} {state:<6} "
+              f"{node['Resources']}")
+    print(f"resources: {res}")
+    print(f"available: {avail}")
+    jobs = _job_rpc(rt, "job_list")
+    if jobs:
+        print("jobs:")
+        for j in jobs:
+            print(f"  {j['job_id']:<10} {j['status']:<10} {j['entrypoint']}")
+    ray.shutdown()
+    return 0
+
+
+# --------------------------------------------------------------------- #
+# jobs
+# --------------------------------------------------------------------- #
+
+def _job_rpc(rt, method, *rpc_args):
+    if hasattr(rt, "_rpc"):
+        return rt._rpc(method, *rpc_args)
+    return getattr(rt, method)(*rpc_args)
+
+
+def cmd_job(args) -> int:
+    ray, rt, _ = _client(args.address)
+    try:
+        if args.job_cmd == "submit":
+            wd = None
+            if args.working_dir:
+                from .core.job_manager import pack_working_dir
+                wd = pack_working_dir(args.working_dir)
+            env = {}
+            for kv in args.env or []:
+                k, _, v = kv.partition("=")
+                env[k] = v
+            import shlex
+            entrypoint = shlex.join(args.entrypoint)
+            job_id = _job_rpc(rt, "job_submit", entrypoint, env, wd,
+                              {"submitted_via": "cli"}, args.job_id)
+            print(f"submitted {job_id}")
+            if args.follow:
+                return _follow(rt, job_id)
+            return 0
+        if args.job_cmd == "list":
+            for j in _job_rpc(rt, "job_list"):
+                print(f"{j['job_id']:<10} {j['status']:<10} "
+                      f"{j['entrypoint']}")
+            return 0
+        if args.job_cmd == "status":
+            print(json.dumps(_job_rpc(rt, "job_status", args.id), indent=2))
+            return 0
+        if args.job_cmd == "logs":
+            if args.follow:
+                return _follow(rt, args.id)
+            sys.stdout.write(_job_rpc(rt, "job_logs", args.id))
+            return 0
+        if args.job_cmd == "stop":
+            stopped = _job_rpc(rt, "job_stop", args.id)
+            print("stopped" if stopped else "already finished")
+            return 0
+        print(f"unknown job command {args.job_cmd!r}", file=sys.stderr)
+        return 2
+    finally:
+        ray.shutdown()
+
+
+def _follow(rt, job_id: str) -> int:
+    offset = 0  # byte cursor into the driver log (not capped by the
+    while True:  # default tail window, so >1MB logs keep streaming)
+        chunk = _job_rpc(rt, "job_logs", job_id, 1 << 20, offset)
+        if chunk:
+            sys.stdout.write(chunk)
+            sys.stdout.flush()
+            offset += len(chunk.encode(errors="replace"))
+        st = _job_rpc(rt, "job_status", job_id)
+        if st["status"] not in ("PENDING", "RUNNING"):
+            chunk = _job_rpc(rt, "job_logs", job_id, 1 << 20, offset)
+            if chunk:
+                sys.stdout.write(chunk)
+            print(f"\n--- job {job_id} {st['status']} ---")
+            return 0 if st["status"] == "SUCCEEDED" else 1
+        time.sleep(0.5)
+
+
+# --------------------------------------------------------------------- #
+# state / timeline
+# --------------------------------------------------------------------- #
+
+def cmd_state(args) -> int:
+    ray, rt, _ = _client(args.address)
+    try:
+        if args.kind == "jobs":
+            rows = _job_rpc(rt, "job_list")
+        else:
+            from . import state as state_api
+            rows = getattr(state_api, f"list_{args.kind}")()
+        print(json.dumps(rows, indent=2, default=str))
+        return 0
+    finally:
+        ray.shutdown()
+
+
+def cmd_timeline(args) -> int:
+    ray, rt, _ = _client(args.address)
+    try:
+        events = rt.timeline()
+        with open(args.out, "w") as f:
+            json.dump(events, f)
+        print(f"wrote {len(events)} events to {args.out} "
+              f"(open in chrome://tracing or Perfetto)")
+        return 0
+    finally:
+        ray.shutdown()
+
+
+# --------------------------------------------------------------------- #
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="ray_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("start", help="start a head or join a cluster")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address", help="cluster file to join as a node agent")
+    sp.add_argument("--num-cpus", type=float, default=None)
+    sp.add_argument("--num-tpus", type=float, default=None)
+    sp.add_argument("--name", default="default")
+    sp.add_argument("--block", action="store_true",
+                    help="run the head in the foreground")
+    sp.add_argument("--enable-remote-nodes", action="store_true")
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("stop", help="stop a named head")
+    sp.add_argument("--name", default="default")
+    sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser("status", help="cluster resources + jobs")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("job")
+    jsub = sp.add_subparsers(dest="job_cmd", required=True)
+    js = jsub.add_parser("submit")
+    js.add_argument("--address", default=None)
+    js.add_argument("--working-dir", default=None)
+    js.add_argument("--env", action="append")
+    js.add_argument("--job-id", default=None)
+    js.add_argument("--follow", action="store_true")
+    js.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    for name in ("list",):
+        j = jsub.add_parser(name)
+        j.add_argument("--address", default=None)
+    for name in ("status", "stop"):
+        j = jsub.add_parser(name)
+        j.add_argument("id")
+        j.add_argument("--address", default=None)
+    j = jsub.add_parser("logs")
+    j.add_argument("id")
+    j.add_argument("--follow", action="store_true")
+    j.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_job)
+
+    sp = sub.add_parser("state", help="list cluster state")
+    sp.add_argument("kind", choices=["tasks", "actors", "nodes", "objects",
+                                     "jobs"])
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_state)
+
+    sp = sub.add_parser("timeline", help="dump chrome trace")
+    sp.add_argument("--out", default="timeline.json")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_timeline)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    # strip a leading "--" from REMAINDER entrypoints
+    if getattr(args, "entrypoint", None) and args.entrypoint[0] == "--":
+        args.entrypoint = args.entrypoint[1:]
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,7 +18,8 @@ from . import runtime as rt_mod
 from .runtime import LocalModeRuntime, Runtime
 
 
-def init(num_cpus: Optional[float] = None,
+def init(address: Optional[str] = None,
+         num_cpus: Optional[float] = None,
          num_tpus: Optional[float] = None,
          resources: Optional[dict[str, float]] = None,
          object_store_memory: Optional[int] = None,
@@ -28,18 +29,31 @@ def init(num_cpus: Optional[float] = None,
          log_to_driver: bool = True,
          namespace: Optional[str] = None,
          **_compat) -> dict:
-    """Start the head runtime in this process.
+    """Start the head runtime in this process, or — with ``address`` — attach
+    to a running cluster as a driver client.
 
     Reference: ray.init (python/ray/_private/worker.py:1336). TPU-specific:
     `num_tpus` declares how many TPU chips this host exposes as schedulable
     "TPU" resources; auto-detected from the JAX runtime when None and
     detection is cheap (env var, never imports jax here).
+
+    ``address``: "auto" resolves the newest local cluster (or
+    ``$RTPU_ADDRESS``, which job drivers inherit); otherwise a path to a
+    session's ``cluster.json``. None starts a new in-process head —
+    unless ``RTPU_ADDRESS`` is set (so a submitted job's plain
+    ``init()`` joins its cluster), matching the reference's env-driven
+    auto-connect.
     """
     if rt_mod.get_runtime_if_exists() is not None:
         if ignore_reinit_error:
             return {"already_initialized": True}
         raise RuntimeError("ray_tpu.init() called twice "
                            "(pass ignore_reinit_error=True to allow)")
+    if address is None and os.environ.get("RTPU_ADDRESS") and not local_mode:
+        address = "auto"
+    if address is not None and address != "local":
+        from .client import connect
+        return connect(address)
     if local_mode:
         rt = LocalModeRuntime()
         rt_mod.set_runtime(rt)
@@ -52,7 +66,7 @@ def init(num_cpus: Optional[float] = None,
     if num_tpus:
         res["TPU"] = float(num_tpus)
     rt = Runtime(res,
-                 object_store_memory=object_store_memory or (2 << 30),
+                 object_store_memory=object_store_memory or None,
                  head_labels=labels)
     rt_mod.set_runtime(rt)
     return {"node_id": rt.head_node.node_id.hex(),

@@ -1,0 +1,162 @@
+"""Typed runtime config flags, overridable via ``RTPU_*`` env vars.
+
+Reference parity: the ``RAY_CONFIG`` flag system
+(src/ray/common/ray_config.h:60, ray_config_def.h — 226 entries).  The
+reference generates a C++ class whose every field reads a ``RAY_<name>`` env
+var at process start; here a flag is a typed descriptor on a singleton, read
+once at first access and cacheable, with ``RTPU_<NAME>`` (upper-cased) as
+the override channel.  Workers inherit the head's environment, so flags set
+before ``init()`` propagate to the whole local cluster.
+
+Usage::
+
+    from ray_tpu.core.config import cfg
+    cap = cfg.object_store_memory
+    cfg.override(worker_prestart=0)      # tests / programmatic override
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+class Flag:
+    """One typed config entry (a ``RAY_CONFIG(type, name, default)`` row)."""
+
+    __slots__ = ("name", "default", "type", "doc", "env")
+
+    def __init__(self, name: str, default: Any, doc: str = ""):
+        self.name = name
+        self.default = default
+        self.type = type(default)
+        self.doc = doc
+        self.env = f"RTPU_{name.upper()}"
+
+    def parse(self, raw: str) -> Any:
+        if self.type is bool:
+            return _parse_bool(raw)
+        if self.type is int:
+            return int(raw, 0)  # accepts 0x..., underscores not needed
+        return self.type(raw)
+
+
+class Config:
+    """Singleton flag table. Attribute access returns the effective value:
+    programmatic override > ``RTPU_*`` env var > default."""
+
+    def __init__(self, flags: list[Flag]):
+        self._flags = {f.name: f for f in flags}
+        self._overrides: dict[str, Any] = {}
+        self._cache: dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def __getattr__(self, name: str) -> Any:
+        # __getattr__ only fires for names not found normally, so _flags
+        # etc. resolve through __init__'s instance dict without recursion.
+        flags = object.__getattribute__(self, "_flags")
+        if name not in flags:
+            raise AttributeError(f"unknown config flag {name!r}")
+        with self._lock:
+            if name in self._overrides:
+                return self._overrides[name]
+            if name in self._cache:
+                return self._cache[name]
+            f = flags[name]
+            raw = os.environ.get(f.env)
+            val = f.default if raw is None else f.parse(raw)
+            self._cache[name] = val
+            return val
+
+    def override(self, **kv: Any) -> None:
+        """Programmatically pin flags (tests, embedders). Type-checked."""
+        with self._lock:
+            for name, val in kv.items():
+                f = self._flags.get(name)
+                if f is None:
+                    raise AttributeError(f"unknown config flag {name!r}")
+                if not isinstance(val, f.type) and not (
+                        f.type is float and isinstance(val, int)):
+                    raise TypeError(
+                        f"{name} expects {f.type.__name__}, got "
+                        f"{type(val).__name__}")
+                self._overrides[name] = f.type(val)
+
+    def reset(self, *names: str) -> None:
+        """Drop overrides/cache (all flags when called with no names)."""
+        with self._lock:
+            if not names:
+                self._overrides.clear()
+                self._cache.clear()
+            for n in names:
+                self._overrides.pop(n, None)
+                self._cache.pop(n, None)
+
+    def dump(self) -> dict[str, Any]:
+        """Effective value of every flag (for the state API / debugging)."""
+        return {n: getattr(self, n) for n in self._flags}
+
+    def describe(self) -> list[dict[str, Any]]:
+        out = []
+        for n, f in self._flags.items():
+            out.append({"name": n, "env": f.env, "type": f.type.__name__,
+                        "default": f.default, "value": getattr(self, n),
+                        "doc": f.doc})
+        return out
+
+
+_FLAGS = [
+    # ---- object store / memory -------------------------------------- #
+    Flag("object_store_memory", 2 << 30,
+         "shm object store capacity in bytes"),
+    Flag("object_spilling_threshold", 0.8,
+         "store fill fraction above which sealed objects spill to disk"),
+    Flag("min_spilling_size", 1 << 20,
+         "don't spill objects smaller than this (bytes)"),
+    Flag("memory_monitor_refresh_ms", 250,
+         "memory-monitor poll interval; 0 disables the monitor"),
+    Flag("memory_usage_threshold", 0.95,
+         "host memory fraction above which the OOM killer engages"),
+    # ---- scheduler / worker pool ------------------------------------ #
+    Flag("worker_prestart", 4,
+         "max workers prestarted at init so first tasks skip cold-start"),
+    Flag("worker_idle_timeout_s", 60.0,
+         "idle workers beyond the prestart pool are reaped after this"),
+    Flag("scheduler_spread_threshold", 0.5,
+         "node utilization below which the hybrid policy packs"),
+    Flag("task_retry_delay_ms", 0,
+         "delay before re-submitting a retriable failed task"),
+    Flag("actor_restart_delay_ms", 0,
+         "delay before restarting a restartable dead actor"),
+    Flag("pg_retry_timeout_s", 120.0,
+         "how long placement groups keep retrying reservation"),
+    # ---- control plane ---------------------------------------------- #
+    Flag("rpc_pool_workers", 32,
+         "threads serving worker->head RPCs (pg_wait parks here)"),
+    Flag("task_records_max", 10000,
+         "bounded task-state records kept for the state API"),
+    Flag("timeline_events_max", 20000,
+         "bounded chrome-trace timeline events kept in memory"),
+    Flag("health_check_period_ms", 1000,
+         "node-agent heartbeat period"),
+    Flag("health_check_timeout_s", 10.0,
+         "node declared dead after this long without a heartbeat"),
+    Flag("gcs_snapshot_period_s", 5.0,
+         "head-table persistence snapshot period; 0 disables"),
+    # ---- serve ------------------------------------------------------- #
+    Flag("serve_replica_poll_s", 2.0,
+         "handle replica-set refresh TTL (long-poll fallback)"),
+    Flag("serve_autoscale_period_s", 1.0,
+         "controller reconcile/autoscale loop period"),
+    # ---- observability ----------------------------------------------- #
+    Flag("metrics_export_port", 0,
+         "Prometheus /metrics port (0 = ephemeral)"),
+    Flag("event_export_enabled", False,
+         "write task/actor events to session_dir/events.jsonl"),
+]
+
+cfg = Config(_FLAGS)

@@ -244,8 +244,20 @@ class SharedObjectStore:
                      spill: Optional["SpillStore"]) -> bool:
         """Store `value`, spilling the SAME serialized frame to disk when
         the store is full (one serialization either way). Returns True if
-        spilled. Raises ObjectStoreFullError when full and spill is None."""
+        spilled. Raises ObjectStoreFullError when full and spill is None.
+
+        Proactive spilling (local_object_manager.h:112 analog): once the
+        store passes ``cfg.object_spilling_threshold`` fill, frames at least
+        ``cfg.min_spilling_size`` go straight to disk instead of forcing
+        LRU eviction of hot shm objects."""
+        from .config import cfg
         frame = _FramedValue(value, is_exception)
+        if (spill is not None
+                and frame.total >= cfg.min_spilling_size
+                and self.bytes_in_use()
+                    > cfg.object_spilling_threshold * self.capacity()):
+            spill.spill_frame(oid, frame)
+            return True
         try:
             buf = self.create_raw(oid, frame.total)
         except ObjectStoreFullError:

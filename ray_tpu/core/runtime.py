@@ -25,6 +25,7 @@ the least-utilized feasible node; SPREAD strategy round-robins.
 """
 from __future__ import annotations
 
+import json
 import os
 import subprocess
 import sys
@@ -200,6 +201,23 @@ class _RemoteProc:
         self._exited.set()
 
 
+class _ExternalProc:
+    """Proc shim for driver clients: the head supervises but never owns the
+    process — kill/wait are no-ops beyond state tracking."""
+
+    def __init__(self, pid: int):
+        self.pid = pid
+
+    def kill(self):
+        pass
+
+    def wait(self, timeout: float | None = None):
+        return 0
+
+    def poll(self):
+        return None
+
+
 class DirEntry:
     __slots__ = ("state", "lineage", "error_brief")
 
@@ -244,10 +262,13 @@ class Runtime:
     """The head runtime. Exactly one per driver process."""
 
     def __init__(self, resources: dict[str, float],
-                 object_store_memory: int = 2 << 30,
+                 object_store_memory: int | None = None,
                  session_dir: str | None = None,
                  head_labels: dict[str, str] | None = None,
                  enable_remote_nodes: bool = False):
+        from .config import cfg
+        if object_store_memory is None:
+            object_store_memory = cfg.object_store_memory
         self.job_id = JobID.from_random()
         sid = self.job_id.hex()[:8]
         self.session_dir = session_dir or f"/tmp/ray_tpu/session_{sid}"
@@ -283,11 +304,11 @@ class Runtime:
         self._abandoned_rpcs: set[ObjectID] = set()
         # timeline events, bounded so a long-lived driver doesn't grow
         # without limit
-        self.events: deque[dict] = deque(maxlen=20000)
+        self.events: deque[dict] = deque(maxlen=cfg.timeline_events_max)
         # per-task state records for the state API (reference analog: the
         # GCS task-event store, gcs_task_manager.h:94); bounded FIFO
         self.task_records: "OrderedDict" = OrderedDict()
-        self.task_records_max = 10000
+        self.task_records_max = cfg.task_records_max
         self.counters = {"tasks_submitted": 0, "tasks_finished": 0,
                          "tasks_failed": 0, "tasks_retried": 0,
                          "actors_created": 0}
@@ -299,7 +320,7 @@ class Runtime:
         # 32 threads: pg_wait parks here for up to its full timeout, and a
         # gang of waiters must not starve cheap rpcs behind it
         self._rpc_pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=32, thread_name_prefix="rtpu-rpc")
+            max_workers=cfg.rpc_pool_workers, thread_name_prefix="rtpu-rpc")
         import queue
         self._drop_q: "queue.SimpleQueue" = queue.SimpleQueue()
         threading.Thread(target=self._drop_loop, daemon=True,
@@ -334,10 +355,28 @@ class Runtime:
             daemon=True, name="rtpu-accept-tcp")
         self._tcp_accept_thread.start()
 
+        # cluster file: everything a driver client / node agent / job needs
+        # to dial this cluster (reference analog: the GCS address + redis
+        # password a reference driver resolves from --address; here a
+        # 0600 json since the authkey is a credential)
+        from .job_manager import JobManager
+        self.cluster_file = os.path.join(self.session_dir, "cluster.json")
+        cf = {"unix_addr": addr, "tcp_host": self._tcp_host,
+              "tcp_port": self.tcp_port, "authkey": self._authkey.hex(),
+              "store_path": self.store_path, "spill_dir": self.spill.dir,
+              "session_dir": self.session_dir, "pid": os.getpid()}
+        fd = os.open(self.cluster_file,
+                     os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
+            json.dump(cf, f)
+        self.jobs = JobManager(self.session_dir, self.cluster_file)
+        self._driver_seq = 0
+
         # prestart the worker pool so first tasks don't pay process cold-start
         # (reference: worker_pool.h:283 PrestartWorkers / idle pool)
         with self.lock:
-            n_prestart = min(int(resources.get("CPU", 1)), 4)
+            n_prestart = min(int(resources.get("CPU", 1)),
+                             cfg.worker_prestart)
             for _ in range(n_prestart):
                 self._spawn_worker_locked(self.head_node)
 
@@ -371,6 +410,32 @@ class Runtime:
             if msg.get("t") == "register_node":
                 self._agent_loop(conn, msg)
                 return
+            if msg.get("t") == "register_driver":
+                # a driver client (reference analog: ray.init(address=...)
+                # attaching a driver core worker to a running cluster /
+                # the util/client proxy role). It speaks the full worker
+                # protocol but never executes tasks: it lives outside every
+                # node's worker pool so the scheduler cannot pick it.
+                with self.lock:
+                    self._driver_seq += 1
+                    wid = f"driver-{self._driver_seq:04d}"
+                    w = WorkerInfo(wid, self.head_node.node_id,
+                                   _ExternalProc(int(msg.get("pid", 0))),
+                                   tpu=False)
+                    w.state = "driver"
+                    w.conn = conn
+                    self.workers[wid] = w
+                with w.send_lock:
+                    conn.send({"t": "registered_driver", "wid": wid,
+                               "store_path": self.store_path,
+                               "spill_dir": self.spill.dir,
+                               "job_id": self.job_id.hex()})
+                while True:
+                    m = conn.recv()
+                    try:
+                        self._handle_msg(wid, m)
+                    except Exception:
+                        traceback.print_exc()
             if msg.get("t") != "register":
                 conn.close()
                 return
@@ -555,7 +620,29 @@ class Runtime:
     # gcs_client/accessor.h — here the shm store doubles as the reply channel).
     _RPC_METHODS = ("get_actor_by_name", "cluster_resources",
                     "available_resources", "node_table", "pg_wait",
-                    "create_placement_group_rpc", "remove_placement_group_rpc")
+                    "create_placement_group_rpc", "remove_placement_group_rpc",
+                    "timeline", "state_list", "state_summary",
+                    "job_submit", "job_list", "job_status", "job_logs",
+                    "job_stop")
+
+    def state_list(self, kind, limit=1000, filters=None):
+        """State-API rows for workers/driver clients (util/state/api.py)."""
+        from .. import state as state_api
+        fn = getattr(state_api, f"list_{kind}", None)
+        if fn is None:
+            raise ValueError(f"unknown state kind {kind!r}")
+        import inspect
+        params = inspect.signature(fn).parameters
+        kwargs = {}
+        if "limit" in params:
+            kwargs["limit"] = limit
+        if "filters" in params and filters:
+            kwargs["filters"] = filters
+        return fn(**kwargs)
+
+    def state_summary(self):
+        from .. import state as state_api
+        return state_api.summary()
 
     def _handle_worker_rpc(self, msg: dict):
         oid = ObjectID(msg["reply_oid"])
@@ -579,6 +666,24 @@ class Runtime:
             self._abandoned_rpcs.discard(oid)
         if abandoned:
             self.store.delete(oid)
+
+    # job-table RPCs (gcs_job_manager.h:52 / job_manager.py:60 analog)
+    def job_submit(self, entrypoint, env=None, working_dir_zip=None,
+                   metadata=None, job_id=None):
+        return self.jobs.submit(entrypoint, env, working_dir_zip,
+                                metadata, job_id)
+
+    def job_list(self):
+        return self.jobs.list()
+
+    def job_status(self, job_id):
+        return self.jobs.status(job_id)
+
+    def job_logs(self, job_id, tail_bytes=1 << 20, offset=None):
+        return self.jobs.logs(job_id, tail_bytes, offset)
+
+    def job_stop(self, job_id):
+        return self.jobs.stop(job_id)
 
     def create_placement_group_rpc(self, bundles, strategy, name=""):
         pg = self.create_placement_group(bundles, strategy, name)
@@ -1692,6 +1797,7 @@ class Runtime:
                 return
             self._shutdown = True
             workers = list(self.workers.values())
+        self.jobs.shutdown()
         for w in workers:
             w.send({"t": "exit"})
         for node in list(self.nodes.values()):
@@ -1728,6 +1834,10 @@ class Runtime:
             except Exception:
                 pass
         self.store.close(unlink=True)
+        try:
+            os.unlink(self.cluster_file)  # address='auto' must not find us
+        except OSError:
+            pass
         if _runtime is self:
             _runtime = None
 

@@ -117,13 +117,16 @@ def init(rng: jax.Array, cfg: LlamaConfig) -> dict:
         return (jax.random.normal(key, shape, jnp.float32) * std).astype(
             cfg.dtype)
 
-    ks = jax.random.split(k_layers, 8)
+    # 7 keys as in the dense-only original; the router key is derived via
+    # fold_in so dense init for a given seed is unchanged by the MoE branch
+    ks = jax.random.split(k_layers, 7)
     if cfg.moe_experts:
         E = cfg.moe_experts
         mlp = {
             "mlp_norm": norm_init(L, d),
             "w_router": (jax.random.normal(
-                ks[7], (L, d, E), jnp.float32) / math.sqrt(d)),
+                jax.random.fold_in(k_layers, 7), (L, d, E),
+                jnp.float32) / math.sqrt(d)),
             "w_gate": dense_init(ks[4], (L, E, d, cfg.mlp_dim), d),
             "w_up": dense_init(ks[5], (L, E, d, cfg.mlp_dim), d),
             "w_down": dense_init(ks[6], (L, E, cfg.mlp_dim, d), cfg.mlp_dim),
@@ -493,6 +496,14 @@ def apply_pipelined(params: dict, tokens: jax.Array, cfg: LlamaConfig,
     pp-replicated and stay outside the pipeline; cfg.n_layers must divide
     the pp size. Matches `apply` numerically."""
     from ..parallel.pipeline import pipeline_apply, split_stages
+
+    if cfg.moe_experts:
+        # the GPipe stage fn drops each layer's load-balance aux term; MoE
+        # training must not lose it silently — use apply_with_aux (dense pp
+        # for MoE needs an aux-accumulating pipeline, not yet built)
+        raise NotImplementedError(
+            "apply_pipelined does not propagate the MoE aux loss; "
+            "train MoE configs with apply_with_aux (ep/dp sharding)")
 
     n_stages = mesh.shape.get("pp", 1)
     x = params["embed"][tokens].astype(cfg.dtype)

@@ -15,6 +15,8 @@ import random
 import time
 from typing import Any, Optional
 
+from ..core.config import cfg as _cfg
+
 
 class DeploymentResponse:
     """Future for one request (reference: handle.py DeploymentResponse).
@@ -97,7 +99,8 @@ class DeploymentHandle:
     def _refresh(self, force: bool = False):
         import ray_tpu
         now = time.monotonic()
-        if not force and self._replicas and now - self._last_refresh < 2.0:
+        if not force and self._replicas and (
+                now - self._last_refresh < _cfg.serve_replica_poll_s):
             return
         version, replicas = ray_tpu.get(self._ctrl.get_replicas.remote(
             self.app_name, self.deployment_name))

@@ -37,11 +37,28 @@ def _head():
     return rt
 
 
+def _remote():
+    """The worker/driver-client runtime if this process is not the head
+    (state calls then go through the `state_list` head RPC). Local-mode
+    falls through to _head() for its clear error."""
+    rt = rt_mod.get_runtime_if_exists()
+    if rt is None:
+        raise RuntimeError("ray_tpu.init() has not been called")
+    if isinstance(rt, (rt_mod.Runtime, rt_mod.LocalModeRuntime)):
+        return None
+    return rt
+
+
 _STATE_NAMES = {0: "PENDING", 1: "READY", 2: "FAILED", 3: "SPILLED"}
 
 
 def list_tasks(limit: int = 1000, filters: Optional[dict] = None) -> list[dict]:
     """Most-recent-first task records (reference: `ray list tasks`)."""
+    remote = _remote()
+    if remote is not None:
+        # filters apply server-side, BEFORE the limit truncation, so
+        # remote and head-local calls return identical rows
+        return remote._rpc("state_list", "tasks", limit, filters)
     rt = _head()
     with rt.lock:
         recs = [dict(r) for r in reversed(rt.task_records.values())]
@@ -52,6 +69,9 @@ def list_tasks(limit: int = 1000, filters: Optional[dict] = None) -> list[dict]:
 
 
 def list_actors(limit: int = 1000) -> list[dict]:
+    remote = _remote()
+    if remote is not None:
+        return remote._rpc("state_list", "actors", limit)
     rt = _head()
     with rt.lock:
         out = []
@@ -67,6 +87,9 @@ def list_actors(limit: int = 1000) -> list[dict]:
 
 
 def list_objects(limit: int = 1000) -> list[dict]:
+    remote = _remote()
+    if remote is not None:
+        return remote._rpc("state_list", "objects", limit)
     rt = _head()
     with rt.lock:
         out = []
@@ -84,10 +107,16 @@ def list_objects(limit: int = 1000) -> list[dict]:
 
 
 def list_nodes() -> list[dict]:
+    remote = _remote()
+    if remote is not None:
+        return remote.node_table()
     return _head().node_table()
 
 
 def list_workers() -> list[dict]:
+    remote = _remote()
+    if remote is not None:
+        return remote._rpc("state_list", "workers", 10000)
     rt = _head()
     with rt.lock:
         return [{
@@ -100,8 +129,19 @@ def list_workers() -> list[dict]:
         } for w in rt.workers.values()]
 
 
+def list_jobs() -> list[dict]:
+    """Job table (reference: `ray job list` / GcsJobManager)."""
+    remote = _remote()
+    if remote is not None:
+        return remote._rpc("job_list")
+    return _head().jobs.list()
+
+
 def summary() -> dict:
     """Cluster summary (reference: `ray summary tasks` + cluster status)."""
+    remote = _remote()
+    if remote is not None:
+        return remote._rpc("state_summary")
     rt = _head()
     with rt.lock:
         by_state: dict[str, int] = {}

@@ -42,7 +42,6 @@ def test_moe_ffn_matches_dense_expert_eval():
         sel = np.argsort(-probs[t])[:cfg.moe_top_k]
         gates = probs[t][sel] / probs[t][sel].sum()
         for g, e in zip(gates, sel):
-            ge = np.tanh(0)  # silence lint
             a = ht[t] @ np.asarray(p["w_gate"][e])
             silu = a / (1 + np.exp(-a))
             b = ht[t] @ np.asarray(p["w_up"][e])
@@ -92,14 +91,16 @@ def test_moe_model_trains_and_aux_flows():
         updates, opt_state = opt.update(grads, opt_state)
         return optax.apply_updates(params, updates), opt_state, ce, aux
 
+    router0 = np.asarray(params["layers"]["w_router"]).copy()
     ces = []
     for _ in range(10):
         params, opt_state, ce, aux = step(params, opt_state)
         ces.append(float(ce))
         assert np.isfinite(float(aux)) and float(aux) > 0
     assert ces[-1] < ces[0] * 0.9, ces
-    # router weights actually receive gradient
-    assert float(jnp.abs(params["layers"]["w_router"]).sum()) > 0
+    # router weights actually receive gradient: they moved from init
+    router_delta = np.abs(np.asarray(params["layers"]["w_router"]) - router0)
+    assert router_delta.max() > 1e-6, "router never updated"
 
 
 def test_moe_sharded_over_ep_matches_unsharded():
