@@ -15,7 +15,8 @@ import cloudpickle
 
 from .ids import ActorID, ObjectID, TaskID
 from .ref import ObjectRef
-from .remote_function import prepare_args, resolve_strategy
+from .remote_function import (prepare_args, prepare_runtime_env,
+                              resolve_strategy)
 from .task_spec import ActorSpec, TaskSpec, validate_resources
 
 _DEFAULT_ACTOR_OPTS = dict(
@@ -23,6 +24,7 @@ _DEFAULT_ACTOR_OPTS = dict(
     max_restarts=0, max_task_retries=0, max_concurrency=1,
     lifetime=None, scheduling_strategy="DEFAULT", placement_group=None,
     placement_group_bundle_index=-1, _node_id=None, _node_soft=False,
+    runtime_env=None,
 )
 
 
@@ -80,6 +82,7 @@ class ActorClass:
             node_affinity_soft=strat["node_affinity_soft"],
             named=o["name"],
             ready_oid=ready_oid,
+            runtime_env=prepare_runtime_env(rt, o["runtime_env"]),
         )
         rt.create_actor(spec)
         methods = sorted(

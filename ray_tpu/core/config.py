@@ -148,8 +148,9 @@ _FLAGS = [
     Flag("gcs_snapshot_period_s", 5.0,
          "head-table persistence snapshot period; 0 disables"),
     # ---- serve ------------------------------------------------------- #
-    Flag("serve_replica_poll_s", 2.0,
-         "handle replica-set refresh TTL (long-poll fallback)"),
+    Flag("serve_replica_poll_s", 10.0,
+         "handle replica-set TTL refresh — fallback only; the long-poll "
+         "listener pushes changes promptly"),
     Flag("serve_autoscale_period_s", 1.0,
          "controller reconcile/autoscale loop period"),
     # ---- observability ----------------------------------------------- #

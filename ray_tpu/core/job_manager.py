@@ -14,13 +14,11 @@ exports, exactly like a reference job driver dialing its cluster's GCS.
 """
 from __future__ import annotations
 
-import io
 import os
 import shlex
 import subprocess
 import threading
 import time
-import zipfile
 
 # terminal states (reference: JobStatus in job/common.py)
 PENDING = "PENDING"
@@ -61,6 +59,9 @@ class JobManager:
         self.jobs: dict[str, JobInfo] = {}
         self._procs: dict[str, subprocess.Popen] = {}
         self._seq = 0
+        # status-change hook (the head wires this to its pubsub "jobs"
+        # channel); called outside self.lock
+        self.on_status = lambda job_id, status: None
 
     def submit(self, entrypoint: str, env: dict | None = None,
                working_dir_zip: bytes | None = None,
@@ -83,8 +84,9 @@ class JobManager:
             os.makedirs(job_dir, exist_ok=True)
             cwd = os.getcwd()
             if working_dir_zip is not None:
+                # _safe_extract creates the dir (atomically; it no-ops on
+                # an existing one, so don't pre-create it)
                 cwd = os.path.join(job_dir, "working_dir")
-                os.makedirs(cwd, exist_ok=True)
                 _safe_extract(working_dir_zip, cwd)
         except (OSError, ValueError) as e:
             with self.lock:
@@ -138,6 +140,7 @@ class JobManager:
             except (ProcessLookupError, PermissionError):
                 pass
             return job_id
+        self.on_status(job_id, RUNNING)
         threading.Thread(target=self._watch, args=(job_id, proc),
                          daemon=True, name=f"rtpu-job-{job_id}").start()
         return job_id
@@ -155,6 +158,8 @@ class JobManager:
             else:
                 info.status = FAILED
                 info.message = f"driver exited with code {rc}"
+            status = info.status
+        self.on_status(job_id, status)
 
     def stop(self, job_id: str) -> bool:
         with self.lock:
@@ -167,6 +172,7 @@ class JobManager:
             info.status = STOPPED
             info.message = "stopped by user"
             info.end_time = time.time()
+        self.on_status(job_id, STOPPED)
         try:
             # the whole session group: the driver may have forked
             os.killpg(os.getpgid(proc.pid), 15)
@@ -218,25 +224,14 @@ class JobManager:
 
 def pack_working_dir(path: str) -> bytes:
     """Zip a directory for submission (reference: working_dir upload to the
-    GCS KV store, _private/runtime_env/working_dir.py)."""
-    buf = io.BytesIO()
-    path = os.path.abspath(path)
-    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
-        for root, dirs, files in os.walk(path):
-            dirs[:] = [d for d in dirs
-                       if d not in ("__pycache__", ".git", ".venv")]
-            for fn in files:
-                full = os.path.join(root, fn)
-                z.write(full, os.path.relpath(full, path))
-    return buf.getvalue()
+    GCS KV store, _private/runtime_env/working_dir.py). One packer serves
+    jobs and runtime envs — see runtime_env._zip_path."""
+    from .runtime_env import _zip_path
+    return _zip_path(path)
 
 
 def _safe_extract(zip_bytes: bytes, dest: str) -> None:
-    """Extract, refusing entries that escape dest (zip-slip)."""
-    dest = os.path.abspath(dest)
-    with zipfile.ZipFile(io.BytesIO(zip_bytes)) as z:
-        for name in z.namelist():
-            target = os.path.abspath(os.path.join(dest, name))
-            if not target.startswith(dest + os.sep) and target != dest:
-                raise ValueError(f"zip entry escapes working_dir: {name!r}")
-        z.extractall(dest)
+    """Extract with zip-slip protection (shared impl:
+    runtime_env._extract)."""
+    from .runtime_env import _extract
+    _extract(zip_bytes, dest)

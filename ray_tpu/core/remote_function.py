@@ -25,7 +25,18 @@ _DEFAULT_TASK_OPTS = dict(
     max_retries=3, retry_exceptions=False, name=None,
     scheduling_strategy="DEFAULT", placement_group=None,
     placement_group_bundle_index=-1, _node_id=None, _node_soft=False,
+    runtime_env=None,
 )
+
+
+def prepare_runtime_env(rt, renv: dict | None) -> dict | None:
+    """Validate + pack a runtime_env option into its wire form, registering
+    blobs with the head (zips content-cached, registration idempotent)."""
+    if not renv:
+        return None
+    from . import runtime_env as renv_mod
+    prepared = renv_mod.prepare(renv, rt.register_renv)
+    return prepared or None
 
 
 def _runtime():
@@ -122,6 +133,7 @@ class RemoteFunction:
             resources=res,
             retries_left=max(0, o["max_retries"]),
             retry_exceptions=bool(o["retry_exceptions"]),
+            runtime_env=prepare_runtime_env(rt, o["runtime_env"]),
             **strat,
         )
         refs = rt.submit_task(spec)

@@ -95,6 +95,9 @@ class WorkerInfo:
         self.state = "starting"          # starting|idle|busy|actor|dead
         self.current: Optional[TaskSpec] = None
         self.funcs: set[str] = set()
+        # runtime-env dedication: a worker that applied env E only runs
+        # env-E work (reference worker_pool.h matching semantics)
+        self.env_hash: Optional[str] = None
         self.actor_id: Optional[ActorID] = None
         self.holding: dict[str, float] = {}   # node resources acquired
         self.holding_bundle: tuple | None = None  # (pg_id, idx, res)
@@ -295,6 +298,9 @@ class Runtime:
         # (the outer holds interest in its inners until the outer is freed)
         self.contained: dict[ObjectID, list[ObjectID]] = {}
         self.func_registry: dict[str, bytes] = {}
+        # runtime-env blobs (working_dir / py_modules zips), hash-addressed
+        # (reference analog: the GCS KV store runtime-env uploads)
+        self.renv_registry: dict[str, bytes] = {}
         self.nodes: dict[NodeID, NodeInfo] = {}
         self.workers: dict[str, WorkerInfo] = {}
         self.actors: dict[ActorID, ActorInfo] = {}
@@ -369,7 +375,11 @@ class Runtime:
                      os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
         with os.fdopen(fd, "w") as f:
             json.dump(cf, f)
+        from .pubsub import Publisher
+        self.pubsub = Publisher()
         self.jobs = JobManager(self.session_dir, self.cluster_file)
+        self.jobs.on_status = lambda job_id, status: self.pubsub.publish(
+            "jobs", {"job_id": job_id, "status": status})
         self._driver_seq = 0
 
         # prestart the worker pool so first tasks don't pay process cold-start
@@ -485,6 +495,9 @@ class Runtime:
         elif t == "func_def":
             with self.lock:
                 self.func_registry.setdefault(msg["fid"], msg["blob"])
+        elif t == "renv_def":
+            with self.lock:
+                self.renv_registry.setdefault(msg["hash"], msg["blob"])
         elif t == "put":
             with self.lock:
                 self.directory[msg["oid"]] = DirEntry(READY)
@@ -577,6 +590,8 @@ class Runtime:
         with self.lock:
             self.nodes[node.node_id] = node
             self._schedule_locked()
+        self.pubsub.publish("nodes", {"node_id": node.node_id.hex(),
+                                      "event": "added", "name": node.name})
         try:
             while True:
                 m = conn.recv()
@@ -622,6 +637,7 @@ class Runtime:
                     "available_resources", "node_table", "pg_wait",
                     "create_placement_group_rpc", "remove_placement_group_rpc",
                     "timeline", "state_list", "state_summary",
+                    "pubsub_poll",
                     "job_submit", "job_list", "job_status", "job_logs",
                     "job_stop")
 
@@ -643,6 +659,10 @@ class Runtime:
     def state_summary(self):
         from .. import state as state_api
         return state_api.summary()
+
+    def pubsub_poll(self, channel, cursor=0, timeout_s=20.0):
+        # runs on the rpc pool (long-poll parks a pool thread, like pg_wait)
+        return self.pubsub.poll(channel, cursor, timeout_s)
 
     def _handle_worker_rpc(self, msg: dict):
         oid = ObjectID(msg["reply_oid"])
@@ -980,6 +1000,10 @@ class Runtime:
     # hybrid_scheduling_policy.h:50, local_task_manager.h:60)
     # ------------------------------------------------------------------ #
 
+    def register_renv(self, h: str, blob: bytes):
+        with self.lock:
+            self.renv_registry.setdefault(h, blob)
+
     def register_function(self, fid: str, blob: bytes):
         with self.lock:
             self.func_registry.setdefault(fid, blob)
@@ -1119,14 +1143,30 @@ class Runtime:
         self.pending = still_pending
 
     def _acquire_worker_locked(self, node: NodeInfo, spec) -> Optional[WorkerInfo]:
+        from .runtime_env import env_hash as _env_hash
+        want_env = _env_hash(getattr(spec, "runtime_env", None))
         for wid in node.workers:
             w = self.workers[wid]
             if w.state == "idle" and w.conn is not None and w.tpu == (
-                    spec.resources.get("TPU", 0) > 0):
+                    spec.resources.get("TPU", 0) > 0) and \
+                    w.env_hash == want_env:
                 self._mark_busy(w, node, spec)
                 return w
         live = sum(1 for wid in node.workers
                    if self.workers[wid].state != "dead")
+        if live >= node.max_workers:
+            # pool full of idle workers dedicated to OTHER runtime envs?
+            # reap one so this env can make progress (reference: the worker
+            # pool kills idle dedicated workers under starvation)
+            victim = next(
+                (self.workers[wid] for wid in node.workers
+                 if self.workers[wid].state == "idle"
+                 and self.workers[wid].env_hash != want_env), None)
+            if victim is None:
+                return None
+            victim.send({"t": "exit"})
+            self._on_worker_death_locked_prep(victim)
+            live -= 1
         if live < node.max_workers:
             w = self._spawn_worker_locked(
                 node, tpu=spec.resources.get("TPU", 0) > 0)
@@ -1134,6 +1174,16 @@ class Runtime:
             self._mark_busy(w, node, spec, dispatch_later=True)
             return w
         return None
+
+    def _on_worker_death_locked_prep(self, w: WorkerInfo):
+        """Mark an intentionally-reaped worker dead under the lock (the
+        recv-loop EOF will find state=='dead' and no-op)."""
+        w.state = "dead"
+        for oid in [o for o, s in self.interest.items() if w.wid in s]:
+            self._ref_drop_locked(oid, w.wid)
+        node = self.nodes.get(w.node_id)
+        if node:
+            node.workers.discard(w.wid)
 
     def _mark_busy(self, w: WorkerInfo, node: NodeInfo, spec,
                    dispatch_later: bool = False):
@@ -1164,6 +1214,8 @@ class Runtime:
             w.pending_spec = spec
             return
         w.state = "busy"
+        if spec.runtime_env and w.env_hash is None:
+            self._ship_renv_locked(w, spec.runtime_env)
         self._ship_function_locked(w, spec.func_id)
         self._record_task_locked(spec, "RUNNING", worker=w.wid,
                                  node=w.node_id.hex(),
@@ -1173,6 +1225,24 @@ class Runtime:
                             "tid": spec.task_id.hex()[:8]})
         if not w.send({"t": "task", "spec": spec}):
             self._on_worker_death(w.wid)
+
+    def _ship_renv_locked(self, w: WorkerInfo, renv_spec: dict):
+        """Dedicate `w` to this runtime env: ship the env spec + its blobs
+        once; the worker applies them process-wide before the task runs
+        (messages are ordered on the connection)."""
+        hashes = list(renv_spec.get("py_modules", []))
+        if renv_spec.get("working_dir"):
+            hashes.append(renv_spec["working_dir"])
+        blobs = {h: self.renv_registry[h] for h in hashes
+                 if h in self.renv_registry}
+        missing = [h for h in hashes if h not in blobs]
+        if missing:
+            # blob lost (e.g. head restarted): fail loudly at dispatch
+            w.send({"t": "renv", "spec": renv_spec, "blobs": blobs,
+                    "missing": missing})
+        else:
+            w.send({"t": "renv", "spec": renv_spec, "blobs": blobs})
+        w.env_hash = renv_spec["hash"]
 
     def _ship_function_locked(self, w: WorkerInfo, fid: str):
         if fid and fid not in w.funcs:
@@ -1350,6 +1420,8 @@ class Runtime:
     def _dispatch_actor_locked(self, w: WorkerInfo, a: ActorInfo):
         if a.state == "dead":
             return
+        if a.spec.runtime_env and w.env_hash is None:
+            self._ship_renv_locked(w, a.spec.runtime_env)
         cls_blob = self.func_registry.get(a.spec.class_id)
         w.send({"t": "func", "fid": a.spec.class_id, "blob": cls_blob})
         w.funcs.add(a.spec.class_id)
@@ -1363,6 +1435,9 @@ class Runtime:
                 return
             if msg["ok"]:
                 a.state = "alive"
+                self.pubsub.publish("actors", {
+                    "actor_id": a.spec.actor_id.hex(), "state": "alive",
+                    "name": a.spec.name})
                 if a.spec.ready_oid is not None:
                     e = self.directory.get(a.spec.ready_oid)
                     if e is not None:
@@ -1435,6 +1510,9 @@ class Runtime:
                 a.restarts_left -= 1
             a.state = "restarting"
             a.wid = None
+            self.pubsub.publish("actors", {
+                "actor_id": a.spec.actor_id.hex(), "state": "restarting",
+                "name": a.spec.name})
             self._schedule_actor_locked(a)
         else:
             self._fail_actor_locked(a, exc.ActorDiedError(
@@ -1444,6 +1522,9 @@ class Runtime:
                            creation_failed: bool = False):
         a.state = "dead"
         a.death_cause = str(err)
+        self.pubsub.publish("actors", {
+            "actor_id": a.spec.actor_id.hex(), "state": "dead",
+            "name": a.spec.name, "cause": a.death_cause})
         if a.spec.named and self.named_actors.get(a.spec.named) == a.spec.actor_id:
             del self.named_actors[a.spec.named]
         if a.spec.ready_oid is not None:
@@ -1611,6 +1692,8 @@ class Runtime:
         with self.lock:
             self.nodes[node.node_id] = node
             self._schedule_locked()
+        self.pubsub.publish("nodes", {"node_id": node.node_id.hex(),
+                                      "event": "added", "name": node.name})
         return node.node_id
 
     def remove_node(self, node_id: NodeID):
@@ -1636,6 +1719,8 @@ class Runtime:
                     pg.ready_event.clear()
                     threading.Thread(target=self._retry_pg, args=(pg,),
                                      daemon=True).start()
+        self.pubsub.publish("nodes", {"node_id": node_id.hex(),
+                                      "event": "removed", "name": node.name})
         for wid in wids:
             w = self.workers.get(wid)
             if w is not None:
@@ -1869,6 +1954,9 @@ class LocalModeRuntime:
 
     def register_function(self, fid, blob):
         self.func_registry.setdefault(fid, cloudpickle.loads(blob))
+
+    def register_renv(self, h, blob):
+        pass  # local mode runs in-process; runtime envs are validated only
 
     def put(self, value, pin=True):
         oid = ObjectID.from_random()

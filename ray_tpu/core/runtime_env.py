@@ -1,0 +1,189 @@
+"""Per-task/actor runtime environments.
+
+Reference parity: _private/runtime_env/ — the plugin framework
+(plugin.py), working_dir/py_modules packaging (working_dir.py: zip to the
+GCS KV store, extracted per node by the runtime-env agent,
+agent/runtime_env_agent.py:164), and the dedicated-worker matching of
+raylet's worker pool (worker_pool.h: workers are keyed by runtime-env
+hash and never shared across envs).
+
+TPU-first reductions, by design:
+  - blobs travel over the control plane into a head-side registry (the
+    function-registry mechanism) and are shipped to a worker once, at its
+    first task with that env — same role as the reference's KV-store
+    upload + per-node agent download, without a separate agent daemon;
+  - workers are *dedicated*: a worker that applied env E only ever runs
+    tasks with env E (matching the reference's pool semantics), so
+    env_vars / cwd / sys.path can be applied process-wide;
+  - ``pip`` / ``conda`` / ``container`` are rejected up front: this image
+    has no package network and one interpreter (environment constraint) —
+    a clear error beats a silent no-op.
+
+Supported keys: ``env_vars`` (dict str→str), ``working_dir`` (local dir
+path, zipped at submission), ``py_modules`` (list of local dirs/files put
+on sys.path), ``config`` (ignored passthrough for API compat).
+"""
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import sys
+import zipfile
+
+_UNSUPPORTED = ("pip", "conda", "uv", "container", "image_uri",
+                "java_jars", "nsight")
+_SUPPORTED = ("env_vars", "working_dir", "py_modules", "config")
+
+# driver-side cache: fingerprint of (relpath, mtime_ns, size) per file ->
+# (content_hash, zip_bytes). Keying on content metadata (not just the
+# path) means editing a file and resubmitting ships the NEW code — the
+# fingerprint walk is cheap, the zip isn't.
+_pack_cache: dict[str, tuple[str, bytes]] = {}
+
+
+def _fingerprint(path: str) -> str:
+    entries = []
+    if os.path.isfile(path):
+        st = os.stat(path)
+        entries.append((os.path.basename(path), st.st_mtime_ns, st.st_size))
+    else:
+        for root, dirs, files in os.walk(path):
+            dirs[:] = sorted(d for d in dirs if d not in
+                             ("__pycache__", ".git", ".venv"))
+            for fn in sorted(files):
+                full = os.path.join(root, fn)
+                try:
+                    st = os.stat(full)
+                except OSError:
+                    continue
+                entries.append((os.path.relpath(full, path),
+                                st.st_mtime_ns, st.st_size))
+    return hashlib.sha256(repr((path, entries)).encode()).hexdigest()
+
+
+def validate(renv: dict) -> None:
+    for k in renv:
+        if k in _UNSUPPORTED:
+            raise ValueError(
+                f"runtime_env[{k!r}] is not supported on this runtime: the "
+                f"TPU image is hermetic (no package network); bake deps "
+                f"into the image or vendor them via py_modules")
+        if k not in _SUPPORTED:
+            raise ValueError(f"unknown runtime_env key {k!r}; supported: "
+                             f"{_SUPPORTED}")
+    ev = renv.get("env_vars", {})
+    if not all(isinstance(k, str) and isinstance(v, str)
+               for k, v in ev.items()):
+        raise TypeError("runtime_env['env_vars'] must be dict[str, str]")
+
+
+def _zip_path(path: str) -> bytes:
+    """Deterministic zip of a dir or single file (stable hash for caching)."""
+    buf = io.BytesIO()
+    path = os.path.abspath(path)
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        if os.path.isfile(path):
+            zi = zipfile.ZipInfo(os.path.basename(path))
+            with open(path, "rb") as f:
+                z.writestr(zi, f.read())
+        else:
+            entries = []
+            for root, dirs, files in os.walk(path):
+                dirs[:] = sorted(d for d in dirs if d not in
+                                 ("__pycache__", ".git", ".venv"))
+                for fn in sorted(files):
+                    full = os.path.join(root, fn)
+                    entries.append((os.path.relpath(full, path), full))
+            for rel, full in sorted(entries):
+                zi = zipfile.ZipInfo(rel)  # fixed date -> deterministic
+                with open(full, "rb") as f:
+                    z.writestr(zi, f.read())
+    return buf.getvalue()
+
+
+def _pack(path: str) -> tuple[str, bytes]:
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"runtime_env path {path!r} does not exist")
+    path = os.path.abspath(path)
+    key = _fingerprint(path)
+    cached = _pack_cache.get(key)
+    if cached is None:
+        blob = _zip_path(path)
+        h = hashlib.sha256(blob).hexdigest()[:16]
+        if len(_pack_cache) > 64:  # bound memory across many env versions
+            _pack_cache.clear()
+        cached = _pack_cache[key] = (h, blob)
+    return cached
+
+
+def prepare(renv: dict, register_blob) -> dict:
+    """Driver-side: validate, zip local paths, register blobs with the head
+    via ``register_blob(hash, bytes)``. Returns the wire-form env spec
+    (hashes instead of paths) with a deterministic overall ``hash``."""
+    validate(renv)
+    spec: dict = {}
+    if renv.get("env_vars"):
+        spec["env_vars"] = dict(renv["env_vars"])
+    if renv.get("working_dir"):
+        h, blob = _pack(renv["working_dir"])
+        register_blob(h, blob)
+        spec["working_dir"] = h
+    if renv.get("py_modules"):
+        hashes = []
+        for p in renv["py_modules"]:
+            h, blob = _pack(p)
+            register_blob(h, blob)
+            hashes.append(h)
+        spec["py_modules"] = hashes
+    if not spec:
+        return {}
+    import json
+    # sort_keys canonicalizes nested dicts too — env_vars insertion order
+    # must not fork dedicated-worker pools
+    digest = hashlib.sha256(
+        json.dumps(spec, sort_keys=True).encode()).hexdigest()
+    spec["hash"] = digest[:16]
+    return spec
+
+
+def env_hash(spec: dict | None) -> str | None:
+    return spec.get("hash") if spec else None
+
+
+def apply_in_worker(spec: dict, blobs: dict[str, bytes],
+                    base_dir: str) -> None:
+    """Worker-side: materialize the env in THIS process (the worker is
+    dedicated to it). env_vars -> os.environ; working_dir -> extract,
+    chdir, sys.path[0]; py_modules -> extract, sys.path."""
+    for k, v in spec.get("env_vars", {}).items():
+        os.environ[k] = v
+    for h in spec.get("py_modules", []):
+        d = _extract(blobs[h], os.path.join(base_dir, h))
+        if d not in sys.path:
+            sys.path.insert(0, d)
+    wd = spec.get("working_dir")
+    if wd is not None:
+        d = _extract(blobs[wd], os.path.join(base_dir, wd))
+        os.chdir(d)
+        if d not in sys.path:
+            sys.path.insert(0, d)
+
+
+def _extract(blob: bytes, dest: str) -> str:
+    dest = os.path.abspath(dest)
+    if not os.path.isdir(dest):
+        tmp = dest + ".tmp"
+        os.makedirs(tmp, exist_ok=True)
+        with zipfile.ZipFile(io.BytesIO(blob)) as z:
+            for name in z.namelist():  # zip-slip guard
+                target = os.path.abspath(os.path.join(tmp, name))
+                if not target.startswith(tmp + os.sep) and target != tmp:
+                    raise ValueError(f"zip entry escapes dest: {name!r}")
+            z.extractall(tmp)
+        try:
+            os.rename(tmp, dest)  # atomic: concurrent workers race safely
+        except OSError:
+            if not os.path.isdir(dest):
+                raise
+    return dest

@@ -36,6 +36,8 @@ class TaskSpec:
     node_affinity_soft: bool = False
     scheduling_strategy: str = "DEFAULT"    # DEFAULT | SPREAD
     owner: str = "driver"              # "driver" or worker-id hex
+    # prepared runtime env (hashes, not blobs — core/runtime_env.py)
+    runtime_env: Optional[dict] = None
 
     @property
     def is_actor_task(self) -> bool:
@@ -60,6 +62,7 @@ class ActorSpec:
     named: Optional[str] = None        # ray.get_actor() name
     # creation-readiness object: resolves when the actor __init__ finished
     ready_oid: Optional[ObjectID] = None
+    runtime_env: Optional[dict] = None
 
 
 def validate_resources(res: dict[str, float]) -> dict[str, float]:

@@ -55,6 +55,7 @@ class WorkerRuntime:
         self.send_lock = threading.Lock()
         self.func_registry: dict[str, object] = {}
         self._sent_fids: set[str] = set()
+        self._sent_renvs: set[str] = set()
         self.current_task_name = ""
         # process-local ObjectRef counts; 0<->1 transitions notify the head
         # (reference_count.h:73 borrower protocol, simplified)
@@ -114,6 +115,11 @@ class WorkerRuntime:
         if fid not in self._sent_fids:
             self.send({"t": "func_def", "fid": fid, "blob": blob})
             self._sent_fids.add(fid)
+
+    def register_renv(self, h: str, blob: bytes):
+        if h not in self._sent_renvs:
+            self.send({"t": "renv_def", "hash": h, "blob": blob})
+            self._sent_renvs.add(h)
 
     def register_function(self, fid: str, blob: bytes):
         self.func_registry.setdefault(fid, cloudpickle.loads(blob))
@@ -328,6 +334,7 @@ class WorkerLoop:
         self._exec_tid: int | None = None
         self._current_task_id = None
         self._cancel_lock = threading.Lock()
+        self._renv_error: BaseException | None = None
 
     # -- arg resolution ----------------------------------------------------
 
@@ -368,6 +375,8 @@ class WorkerLoop:
         self.rt.current_task_name = spec.name
         t0 = time.time()
         try:
+            if self._renv_error is not None:
+                raise self._renv_error
             fn = self.rt.func_registry[spec.func_id]
             args, kwargs = self._resolve_args(spec.args_blob)
             result = fn(*args, **kwargs)
@@ -396,6 +405,8 @@ class WorkerLoop:
 
     def _run_actor_create(self, spec: ActorSpec):
         try:
+            if self._renv_error is not None:
+                raise self._renv_error
             cls = self.rt.func_registry[spec.class_id]
             args, kwargs = self._resolve_args(spec.args_blob)
             self.actor_instance = cls(*args, **kwargs)
@@ -459,6 +470,19 @@ class WorkerLoop:
         self._exec_tid = threading.get_ident()
         fn(*a)
 
+    def _apply_renv(self, msg: dict):
+        from . import runtime_env as renv_mod
+        if msg.get("missing"):
+            # blobs lost head-side; poison this worker's tasks clearly
+            self._renv_error = RuntimeError(
+                f"runtime_env blobs missing on head: {msg['missing']}")
+            return
+        try:
+            renv_mod.apply_in_worker(msg["spec"], msg["blobs"],
+                                     base_dir="/tmp/ray_tpu/renvs")
+        except Exception as e:  # noqa: BLE001 — surface via task errors
+            self._renv_error = e
+
     def run(self):
         self.conn.send({"t": "register", "wid": self.wid, "pid": os.getpid()})
         while True:
@@ -471,6 +495,12 @@ class WorkerLoop:
                 self.rt.func_registry[msg["fid"]] = cloudpickle.loads(
                     msg["blob"])
                 self.rt._sent_fids.add(msg["fid"])
+            elif t == "renv":
+                # dedicate this worker to the runtime env BEFORE the task
+                # that needs it arrives (messages are ordered); application
+                # runs on the exec thread so it cannot race a running task
+                self.executor.submit(self._exec_wrapper, self._apply_renv,
+                                     msg)
             elif t == "task":
                 self.executor.submit(self._exec_wrapper, self._run_task,
                                      msg["spec"])

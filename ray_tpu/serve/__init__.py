@@ -28,9 +28,15 @@ from .api import (
     shutdown,
     status,
 )
-from .handle import DeploymentHandle, DeploymentResponse
+from .batching import batch
+from .context import get_multiplexed_model_id, get_request_context
+from .handle import (DeploymentHandle, DeploymentResponse,
+                     DeploymentResponseGenerator)
+from .multiplex import multiplexed
 
 __all__ = [
     "Application", "Deployment", "deployment", "run", "shutdown", "delete",
     "status", "get_app_handle", "DeploymentHandle", "DeploymentResponse",
+    "DeploymentResponseGenerator", "batch", "multiplexed",
+    "get_multiplexed_model_id", "get_request_context",
 ]

@@ -32,6 +32,9 @@ class ReplicaActor:
         fc = spec.func_or_class
         self._ongoing = 0
         self._total = 0
+        self._streams: dict[int, Any] = {}
+        self._pending: dict[int, Any] = {}  # parked __anext__ futures
+        self._stream_seq = 0
         if isinstance(fc, type):
             self._callable = fc(*handle_args, **handle_kwargs)
         else:
@@ -39,9 +42,10 @@ class ReplicaActor:
                 raise TypeError("function deployments take no init args")
             self._callable = fc
 
-    async def handle_request(self, method: str, args: tuple, kwargs: dict):
-        self._ongoing += 1
-        self._total += 1
+    async def _invoke(self, method: str, args: tuple, kwargs: dict,
+                      context: Optional[dict]):
+        from .context import reset_request_context, set_request_context
+        token = set_request_context(**(context or {}))
         try:
             # "__call__" covers both function deployments and class __call__
             target = (self._callable if method == "__call__"
@@ -52,15 +56,116 @@ class ReplicaActor:
                 out = target(*args, **kwargs)
             else:
                 # sync callables must not block the replica's event loop
-                # (reference: replica.py runs sync user code in a thread)
+                # (reference: replica.py runs sync user code in a thread);
+                # the contextvar copies into the executor thread via
+                # a captured Context
+                import contextvars
+                ctx = contextvars.copy_context()
                 loop = asyncio.get_event_loop()
                 out = await loop.run_in_executor(
-                    None, lambda: target(*args, **kwargs))
+                    None, lambda: ctx.run(target, *args, **kwargs))
             if asyncio.iscoroutine(out):
                 out = await out
             return out
         finally:
+            reset_request_context(token)
+
+    async def handle_request(self, method: str, args: tuple, kwargs: dict,
+                             context: Optional[dict] = None):
+        self._ongoing += 1
+        self._total += 1
+        try:
+            return await self._invoke(method, args, kwargs, context)
+        finally:
             self._ongoing -= 1
+
+    # -- streaming responses (reference: replica.py handles generator
+    # results via ray streaming generators; here the replica retains the
+    # generator and the caller drains it in batched stream_next calls) ----
+
+    async def handle_request_streaming(self, method: str, args: tuple,
+                                       kwargs: dict,
+                                       context: Optional[dict] = None) -> int:
+        self._ongoing += 1
+        self._total += 1
+        try:
+            out = await self._invoke(method, args, kwargs, context)
+            if not hasattr(out, "__anext__") and \
+                    not hasattr(out, "__next__"):
+                raise TypeError(
+                    f"streaming call to {method!r} returned "
+                    f"{type(out).__name__}, not a generator")
+        except BaseException:
+            self._ongoing -= 1
+            raise
+        self._stream_seq += 1
+        sid = self._stream_seq
+        self._streams[sid] = out
+        return sid
+
+    async def stream_next(self, sid: int, max_items: int = 8):
+        """(items, done): blocks for the FIRST item only, then takes up to
+        max_items - 1 more that are already available — a slow generator
+        streams item-by-item (low latency), a fast one ships batches (the
+        round-trip amortization). The possibly-unfinished __anext__ is
+        parked in _pending for the next call, never cancelled (cancelling
+        mid-__anext__ would corrupt the generator)."""
+        gen = self._streams.get(sid)
+        if gen is None:
+            return [], True
+        items: list = []
+        done = False
+        try:
+            if hasattr(gen, "__anext__"):
+                pending = self._pending.pop(sid, None)
+                while len(items) < max_items:
+                    if pending is None:
+                        pending = asyncio.ensure_future(gen.__anext__())
+                    try:
+                        if items:
+                            # only take immediately-ready items past the 1st
+                            item = await asyncio.wait_for(
+                                asyncio.shield(pending), 0)
+                        else:
+                            item = await pending
+                    except asyncio.TimeoutError:
+                        self._pending[sid] = pending
+                        return items, False
+                    except StopAsyncIteration:
+                        done = True
+                        break
+                    pending = None
+                    items.append(item)
+                if pending is not None:
+                    self._pending[sid] = pending
+            else:
+                # sync generator: one item per call — next() can block
+                # arbitrarily in a pinned executor thread, so favor
+                # latency; sync deployments wanting throughput should
+                # yield pre-batched chunks
+                loop = asyncio.get_event_loop()
+                def pull():
+                    try:
+                        return [next(gen)], False
+                    except StopIteration:
+                        return [], True
+                items, done = await loop.run_in_executor(None, pull)
+        except BaseException:
+            self._drop_stream(sid)
+            raise
+        if done:
+            self._drop_stream(sid)
+        return items, done
+
+    def _drop_stream(self, sid: int):
+        if self._streams.pop(sid, None) is not None:
+            self._ongoing -= 1
+        pending = self._pending.pop(sid, None)
+        if pending is not None:
+            pending.cancel()
+
+    async def stream_cancel(self, sid: int):
+        self._drop_stream(sid)
 
     async def stats(self) -> dict:
         return {"ongoing": self._ongoing, "total": self._total}
@@ -89,9 +194,14 @@ class _DeploymentState:
         self.version = next(version_counter)
         self._last_scale_up = 0.0
         self._last_scale_down = 0.0
+        # long-poll wakeup (reference: _private/long_poll.py:222 — waiters
+        # park on the event; bump() swaps in a fresh one)
+        self.changed = asyncio.Event()
 
     def bump(self):
         self.version = next(self._vc)
+        old, self.changed = self.changed, asyncio.Event()
+        old.set()
 
 
 class ServeController:
@@ -183,6 +293,38 @@ class ServeController:
         if st is None:
             raise ValueError(f"no deployment {deployment!r} in app {app!r}")
         return st.version, list(st.replicas)
+
+    async def listen_for_change(self, app: str, deployment: str,
+                                known_version: int,
+                                timeout_s: float = 30.0):
+        """Long-poll: return (version, replicas) as soon as the replica set
+        differs from the caller's known_version, else after timeout_s with
+        the unchanged state (reference: LongPollHost.listen_for_change,
+        _private/long_poll.py:222). Many handles parking here cost only an
+        asyncio waiter each — no controller work per poll tick."""
+        st = self._apps.get(app, {}).get(deployment)
+        if st is None:
+            raise ValueError(f"no deployment {deployment!r} in app {app!r}")
+        if st.version == known_version:
+            try:
+                await asyncio.wait_for(st.changed.wait(), timeout_s)
+            except asyncio.TimeoutError:
+                pass
+            # re-resolve: a redeploy may have replaced the state object
+            st = self._apps.get(app, {}).get(deployment)
+            if st is None:
+                raise ValueError(
+                    f"deployment {deployment!r} was deleted from {app!r}")
+        return st.version, list(st.replicas)
+
+    async def set_target(self, app: str, deployment: str, n: int) -> None:
+        """Manually retarget a deployment's replica count (ops escape
+        hatch; autoscaling keeps adjusting around it when configured)."""
+        st = self._apps.get(app, {}).get(deployment)
+        if st is None:
+            raise ValueError(f"no deployment {deployment!r} in app {app!r}")
+        st.target = max(0, int(n))
+        await self._scale_to_target(st)
 
     async def get_ingress(self, app: str) -> str:
         if app not in self._ingress:

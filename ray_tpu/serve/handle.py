@@ -65,36 +65,151 @@ class DeploymentResponse:
         return gen()
 
 
+class DeploymentResponseGenerator:
+    """Iterator over a streaming deployment response (reference:
+    handle.py DeploymentResponseGenerator). Pulls batched chunks from the
+    replica-retained generator via stream_next."""
+
+    def __init__(self, replica, sid: int, on_done):
+        self._replica = replica
+        self._sid = sid
+        self._on_done = on_done
+        self._buf: list = []
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        import ray_tpu
+        while not self._buf:
+            if self._done:
+                raise StopIteration
+            items, done = ray_tpu.get(
+                self._replica.stream_next.remote(self._sid))
+            self._buf.extend(items)
+            if done:
+                self._done = True
+                if self._on_done:
+                    self._on_done()
+                    self._on_done = None
+        return self._buf.pop(0)
+
+    def cancel(self):
+        import ray_tpu
+        if not self._done:
+            self._done = True
+            try:
+                ray_tpu.get(self._replica.stream_cancel.remote(self._sid))
+            except Exception:
+                pass
+            if self._on_done:
+                self._on_done()
+                self._on_done = None
+
+
+def _listen_loop_weak(handle_ref):
+    """Body of a handle's long-poll listener thread. Takes a weakref so an
+    abandoned handle (and this thread) can die; between polls only ids are
+    kept live."""
+    import ray_tpu
+    failures = 0
+    while True:
+        h = handle_ref()
+        if h is None:
+            return
+        ctrl, app, dep, known = (h._ctrl, h.app_name, h.deployment_name,
+                                 h._version)
+        del h  # don't pin the handle across the (long) poll
+        try:
+            version, replicas = ray_tpu.get(
+                ctrl.listen_for_change.remote(app, dep, known),
+                timeout=45.0)
+            failures = 0
+        except Exception:
+            # controller busy/restarting or deployment deleted; back off
+            # and give up after repeated failures (the TTL path in
+            # _refresh still keeps the handle usable)
+            failures += 1
+            h = handle_ref()
+            if failures >= 5 or h is None:
+                if h is not None:
+                    h._listener_started = False
+                return
+            del h
+            time.sleep(min(2.0 ** failures, 10.0))
+            continue
+        h = handle_ref()
+        if h is None:
+            return
+        if version != h._version:
+            # atomic installs: readers snapshot these attributes
+            h._inflight = {i: 0 for i in range(len(replicas))}
+            h._replicas = replicas
+            h._version = version
+        h._last_refresh = time.monotonic()
+        del h
+
+
 class DeploymentHandle:
     def __init__(self, deployment: str, app: str, controller,
-                 method: str = "__call__"):
+                 method: str = "__call__", stream: bool = False,
+                 multiplexed_model_id: str = ""):
         self.deployment_name = deployment
         self.app_name = app
         self._ctrl = controller
         self._method = method
+        self._stream = stream
+        self._model_id = multiplexed_model_id
         self._replicas: list = []
         self._version = -1
         self._inflight: dict[int, int] = {}
         self._last_refresh = 0.0
+        self._listener_started = False
 
     # handles pickle into replicas/tasks; router state is rebuilt lazily
     def __reduce__(self):
         return (DeploymentHandle,
                 (self.deployment_name, self.app_name, self._ctrl,
-                 self._method))
+                 self._method, self._stream, self._model_id))
 
     def options(self, method_name: Optional[str] = None,
+                stream: Optional[bool] = None,
+                multiplexed_model_id: Optional[str] = None,
                 **_ignored) -> "DeploymentHandle":
-        return DeploymentHandle(self.deployment_name, self.app_name,
-                                self._ctrl, method_name or self._method)
+        return DeploymentHandle(
+            self.deployment_name, self.app_name, self._ctrl,
+            method_name or self._method,
+            self._stream if stream is None else stream,
+            self._model_id if multiplexed_model_id is None
+            else multiplexed_model_id)
 
     def __getattr__(self, name: str) -> "DeploymentHandle":
         if name.startswith("_"):
             raise AttributeError(name)
         return DeploymentHandle(self.deployment_name, self.app_name,
-                                self._ctrl, name)
+                                self._ctrl, name, self._stream,
+                                self._model_id)
 
     # -- routing ----------------------------------------------------------
+
+    def _ensure_listener(self):
+        """Long-poll push of replica-set changes (reference:
+        _private/long_poll.py LongPollClient): one daemon thread parks in
+        the controller's listen_for_change, so scale-ups/downs reach this
+        handle promptly instead of on the next TTL poll, and steady-state
+        traffic costs the controller one parked waiter, not one
+        get_replicas per poll interval. The thread holds only a WEAKREF to
+        this handle and exits when the handle is collected — short-lived
+        handles (e.g. per-request ones) must not each pin a thread."""
+        if self._listener_started:
+            return
+        self._listener_started = True
+        import threading
+        import weakref
+        threading.Thread(target=_listen_loop_weak,
+                         args=(weakref.ref(self),), daemon=True,
+                         name=f"serve-lp-{self.deployment_name}").start()
 
     def _refresh(self, force: bool = False):
         import ray_tpu
@@ -110,12 +225,24 @@ class DeploymentHandle:
             self._inflight = {i: 0 for i in range(len(replicas))}
         self._last_refresh = now
 
-    def _pick(self) -> int:
+    def _pick(self, replicas: list) -> int:
         """Power-of-two-choices over local in-flight counts
-        (reference: pow_2_router.py:27)."""
-        n = len(self._replicas)
+        (reference: pow_2_router.py:27). With a multiplexed model id,
+        rendezvous hashing over stable replica (actor) ids instead: same
+        model → same replica while it lives, so its weights stay
+        cache-hot (multiplex.py routing note). Operates on the caller's
+        SNAPSHOT of the replica list — the listener thread may swap
+        self._replicas concurrently."""
+        n = len(replicas)
         if n == 1:
             return 0
+        if self._model_id:
+            import hashlib
+            def score(i):
+                rid = replicas[i]._actor_id.hex()
+                return hashlib.md5(
+                    f"{self._model_id}:{rid}".encode()).digest()
+            return max(range(n), key=score)
         i, j = random.sample(range(n), 2)
         return i if self._inflight.get(i, 0) <= self._inflight.get(j, 0) \
             else j
@@ -123,6 +250,7 @@ class DeploymentHandle:
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         import ray_tpu
         self._refresh()
+        self._ensure_listener()
         deadline = time.monotonic() + 30.0
         while not self._replicas:
             if time.monotonic() > deadline:
@@ -135,20 +263,34 @@ class DeploymentHandle:
         kwargs = {k: (v._to_object_ref()
                       if isinstance(v, DeploymentResponse) else v)
                   for k, v in kwargs.items()}
-        idx = self._pick()
-        replica = self._replicas[idx]
+        replicas = self._replicas  # snapshot: listener may swap the list
+        idx = self._pick(replicas)
+        replica = replicas[idx]
         self._inflight[idx] = self._inflight.get(idx, 0) + 1
 
         def done(i=idx):
             self._inflight[i] = max(0, self._inflight.get(i, 1) - 1)
 
+        context = {"app_name": self.app_name,
+                   "deployment": self.deployment_name,
+                   "multiplexed_model_id": self._model_id}
+
+        if self._stream:
+            import ray_tpu
+            sid = ray_tpu.get(replica.handle_request_streaming.remote(
+                self._method, args, kwargs, context))
+            return DeploymentResponseGenerator(replica, sid, done)
+
         def retry():
             self._refresh(force=True)
-            if not self._replicas:
+            rs = self._replicas
+            if not rs:
                 raise RuntimeError(
                     f"no replicas for {self.deployment_name!r}")
-            r = self._replicas[self._pick()]
-            return r.handle_request.remote(self._method, args, kwargs)
+            r = rs[self._pick(rs)]
+            return r.handle_request.remote(self._method, args, kwargs,
+                                           context)
 
-        ref = replica.handle_request.remote(self._method, args, kwargs)
+        ref = replica.handle_request.remote(self._method, args, kwargs,
+                                            context)
         return DeploymentResponse(ref, done, retry)

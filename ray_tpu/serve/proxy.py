@@ -13,10 +13,38 @@ import json
 from typing import Optional
 
 
+_STREAM_END = object()
+
+
 class ProxyActor:
     def __init__(self, port: int):
         self._port = port
         self._runner = None
+        # handle cache: a DeploymentHandle per routing variant, NOT per
+        # request — each handle runs one long-poll listener thread, so
+        # per-request construction would leak threads/waiters. Bounded
+        # LRU; evicted handles are GC'd and their listener threads exit
+        # (weakref-based, see handle._ensure_listener)
+        from collections import OrderedDict
+        self._handles: "OrderedDict" = OrderedDict()
+        self._handles_max = 256
+
+    def _handle_for(self, ingress, app_name, stream, model_id):
+        from .handle import DeploymentHandle
+        import ray_tpu
+        from .api import CONTROLLER_NAME
+        key = (app_name, ingress, stream, model_id)
+        h = self._handles.get(key)
+        if h is None:
+            ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+            h = DeploymentHandle(ingress, app_name, ctrl, stream=stream,
+                                 multiplexed_model_id=model_id)
+            self._handles[key] = h
+            while len(self._handles) > self._handles_max:
+                self._handles.popitem(last=False)
+        else:
+            self._handles.move_to_end(key)
+        return h
 
     async def start(self) -> int:
         from aiohttp import web
@@ -32,7 +60,6 @@ class ProxyActor:
     async def _dispatch(self, request):
         from aiohttp import web
         import ray_tpu
-        from .handle import DeploymentHandle
         from .api import CONTROLLER_NAME
 
         path = request.match_info["tail"].strip("/")
@@ -61,16 +88,48 @@ class ProxyActor:
                 payload = {"body": (await request.read()).decode(
                     errors="replace")}
 
+        # streaming ingress: ?stream=1 or Accept: text/event-stream
+        # (reference: proxy.py streams ASGI responses chunk by chunk)
+        want_stream = (request.query.get("stream") in ("1", "true")
+                       or "text/event-stream" in
+                       request.headers.get("Accept", ""))
+        model_id = request.headers.get("serve_multiplexed_model_id", "")
+
+        handle = self._handle_for(ingress, app_name, want_stream, model_id)
+
         def call():
             # handle.remote() itself may block (replica-set refresh, cold
             # start wait) — keep ALL of it off the proxy's event loop
-            handle = DeploymentHandle(ingress, app_name, ctrl)
             resp = (handle.remote(payload) if payload is not None
                     else handle.remote())
+            if want_stream:
+                return resp  # a DeploymentResponseGenerator
             return resp.result(30.0)
 
         loop = asyncio.get_event_loop()
         out = await loop.run_in_executor(None, call)
+        if want_stream:
+            stream = web.StreamResponse()
+            stream.headers["Content-Type"] = "text/event-stream"
+            await stream.prepare(request)
+            it = iter(out)
+            try:
+                while True:
+                    chunk = await loop.run_in_executor(
+                        None, lambda: next(it, _STREAM_END))
+                    if chunk is _STREAM_END:
+                        break
+                    if not isinstance(chunk, (bytes, str)):
+                        chunk = json.dumps(chunk)
+                    if isinstance(chunk, str):
+                        chunk = chunk.encode()
+                    await stream.write(chunk)
+                await stream.write_eof()
+            finally:
+                # client disconnect / write error: release the
+                # replica-retained generator and its ongoing slot
+                await loop.run_in_executor(None, out.cancel)
+            return stream
         try:
             return web.json_response(out)
         except TypeError:
