@@ -23,6 +23,10 @@ from .core.api import (
     init,
     is_initialized,
     kill,
+    kv_del,
+    kv_get,
+    kv_keys,
+    kv_put,
     nodes,
     put,
     remote,
@@ -38,7 +42,7 @@ __all__ = [
     "remote", "get", "put", "wait", "kill", "cancel", "get_actor",
     "get_runtime_context", "head_address", "nodes", "cluster_resources",
     "available_resources", "timeline", "ObjectRef", "ActorHandle", "util",
-    "state",
+    "state", "kv_put", "kv_get", "kv_del", "kv_keys",
 ]
 
 from . import util  # noqa: E402  (needs the names above)

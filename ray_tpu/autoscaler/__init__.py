@@ -1,0 +1,12 @@
+"""ray_tpu.autoscaler — demand-driven cluster scaling.
+
+Reference parity: autoscaler v2 (autoscaler/v2/autoscaler.py:42,
+instance_manager/instance_manager.py:29, scheduler.py:632
+ResourceDemandScheduler) and the fake multi-node provider
+(autoscaler/_private/fake_multi_node/node_provider.py:236).
+"""
+from .autoscaler import Autoscaler, NodeTypeConfig
+from .node_provider import FakeNodeProvider, NodeProvider
+
+__all__ = ["Autoscaler", "NodeTypeConfig", "NodeProvider",
+           "FakeNodeProvider"]

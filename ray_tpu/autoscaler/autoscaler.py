@@ -1,0 +1,233 @@
+"""The autoscaler reconcile loop + demand bin-packing.
+
+Reference parity: autoscaler/v2/autoscaler.py:42 (update_autoscaling_state
+reading cluster resource state), scheduler.py:632 ResourceDemandScheduler
+(bin-packs pending demands onto node types), instance lifecycle
+(instance_manager.py:29). TPU inversion: demand is read straight off the
+head runtime's queues (pending tasks, unplaced actors, pending PG
+bundles) — there is no GCS/autoscaler RPC hop because the head IS the
+control plane.
+
+Scale-up: first-fit-decreasing bin-pack of unmet demands onto the
+configured node types (respecting per-type max_workers).
+Scale-down: a provider node with no busy/actor workers and no reserved PG
+bundle for `idle_timeout_s` is terminated (min_workers respected).
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Optional
+
+from .node_provider import NodeProvider
+
+
+@dataclasses.dataclass
+class NodeTypeConfig:
+    """(reference: available_node_types in the cluster YAML)"""
+    name: str
+    resources: dict
+    min_workers: int = 0
+    max_workers: int = 4
+
+
+def _fits(demand: dict, capacity: dict) -> bool:
+    return all(capacity.get(k, 0.0) >= v - 1e-9 for k, v in demand.items())
+
+
+def _sub(capacity: dict, demand: dict) -> None:
+    for k, v in demand.items():
+        capacity[k] = capacity.get(k, 0.0) - v
+
+
+class Autoscaler:
+    def __init__(self, node_types: list[NodeTypeConfig],
+                 provider: Optional[NodeProvider] = None,
+                 idle_timeout_s: float = 30.0,
+                 period_s: float = 1.0,
+                 runtime=None):
+        from ..core import runtime as rt_mod
+        self.rt = runtime or rt_mod.get_runtime_if_exists()
+        if self.rt is None:
+            raise RuntimeError("ray_tpu.init() first")
+        if provider is None:
+            from .node_provider import FakeNodeProvider
+            provider = FakeNodeProvider(self.rt)
+        self.provider = provider
+        self.node_types = {t.name: t for t in node_types}
+        self.idle_timeout_s = idle_timeout_s
+        self.period_s = period_s
+        # instance bookkeeping: iid -> type name; iid -> launch ts
+        self.instances: dict[str, str] = {}
+        self._launched_at: dict[str, float] = {}
+        self._idle_since: dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.events: list[dict] = []  # scale decisions, for observability
+
+    # -- demand collection -------------------------------------------- #
+
+    def pending_demands(self) -> list[dict]:
+        """Resource asks the cluster cannot currently place."""
+        rt = self.rt
+        demands: list[dict] = []
+        with rt.lock:
+            for spec in rt.pending:
+                demands.append(dict(spec.resources))
+            for a in rt.actors.values():
+                if a.state in ("pending", "restarting") and a.wid is None \
+                        and a.spec.pg_id is None:
+                    demands.append(dict(a.spec.resources))
+            for pg in rt.pgs.values():
+                if pg.state == "pending":
+                    demands.extend(dict(b.resources) for b in pg.bundles)
+        return [d for d in demands if d]
+
+    def _free_capacity(self) -> list[dict]:
+        """Per-alive-node free resources (head + agents)."""
+        return [dict(row["Available"]) for row in self.rt.node_table()
+                if row["Alive"]]
+
+    # -- the decision step --------------------------------------------- #
+
+    def plan(self) -> tuple[dict[str, int], list[str]]:
+        """One reconcile decision: ({type: count to launch},
+        [instance ids to terminate])."""
+        demands = self.pending_demands()
+        frees = self._free_capacity()
+        # in-flight launches count as future capacity so one burst of
+        # demand doesn't launch a node per tick while agents boot
+        for iid, tname in self.instances.items():
+            if self.provider.node_id_of(iid) is None:
+                frees.append(dict(self.node_types[tname].resources))
+
+        unmet: list[dict] = []
+        for d in sorted(demands, key=lambda d: -sum(d.values())):
+            for cap in frees:
+                if _fits(d, cap):
+                    _sub(cap, d)
+                    break
+            else:
+                unmet.append(d)
+
+        # bin-pack unmet onto new nodes, first-fit-decreasing by type order
+        to_launch: dict[str, int] = {}
+        live_by_type: dict[str, int] = {}
+        for iid, tname in self.instances.items():
+            live_by_type[tname] = live_by_type.get(tname, 0) + 1
+        new_caps: list[dict] = []
+        for d in unmet:
+            placed = False
+            for cap in new_caps:
+                if _fits(d, cap):
+                    _sub(cap, d)
+                    placed = True
+                    break
+            if placed:
+                continue
+            for t in self.node_types.values():
+                count = live_by_type.get(t.name, 0) + to_launch.get(
+                    t.name, 0)
+                if count >= t.max_workers:
+                    continue
+                if _fits(d, dict(t.resources)):
+                    cap = dict(t.resources)
+                    _sub(cap, d)
+                    new_caps.append(cap)
+                    to_launch[t.name] = to_launch.get(t.name, 0) + 1
+                    placed = True
+                    break
+            # unplaceable on ANY type: leave it pending (the task's own
+            # infeasibility timeout reports the error)
+
+        # min_workers floor
+        for t in self.node_types.values():
+            have = live_by_type.get(t.name, 0) + to_launch.get(t.name, 0)
+            if have < t.min_workers:
+                to_launch[t.name] = to_launch.get(t.name, 0) + (
+                    t.min_workers - have)
+
+        to_terminate = self._find_idle() if not demands else []
+        return to_launch, to_terminate
+
+    def _find_idle(self) -> list[str]:
+        rt = self.rt
+        now = time.monotonic()
+        out = []
+        with rt.lock:
+            busy_nodes = set()
+            for w in rt.workers.values():
+                if w.state in ("busy", "actor", "starting") or w.blocked:
+                    busy_nodes.add(w.node_id)
+            for pg in rt.pgs.values():
+                if pg.state == "created":
+                    for b in pg.bundles:
+                        if b.node_id is not None:
+                            busy_nodes.add(b.node_id)
+            busy_hex = {n.hex() for n in busy_nodes}
+        live_by_type: dict[str, int] = {}
+        for iid, tname in self.instances.items():
+            live_by_type[tname] = live_by_type.get(tname, 0) + 1
+        for iid, tname in list(self.instances.items()):
+            nid = self.provider.node_id_of(iid)
+            if nid is None:  # still booting
+                self._idle_since.pop(iid, None)
+                continue
+            if nid in busy_hex:
+                self._idle_since.pop(iid, None)
+                continue
+            first = self._idle_since.setdefault(iid, now)
+            t = self.node_types[tname]
+            if now - first >= self.idle_timeout_s and \
+                    live_by_type.get(tname, 0) > t.min_workers:
+                out.append(iid)
+                live_by_type[tname] -= 1
+        return out
+
+    # -- actuation ------------------------------------------------------ #
+
+    def reconcile_once(self) -> None:
+        to_launch, to_terminate = self.plan()
+        for tname, n in to_launch.items():
+            t = self.node_types[tname]
+            for _ in range(n):
+                iid = self.provider.create_node(tname, dict(t.resources))
+                self.instances[iid] = tname
+                self._launched_at[iid] = time.monotonic()
+                self.events.append({"event": "launch", "type": tname,
+                                    "instance": iid, "ts": time.time()})
+        for iid in to_terminate:
+            nid = self.provider.node_id_of(iid)
+            self.provider.terminate_node(iid)
+            self.instances.pop(iid, None)
+            self._idle_since.pop(iid, None)
+            self.events.append({"event": "terminate", "instance": iid,
+                                "node_id": nid, "ts": time.time()})
+        # drop instances whose process died outside our control
+        alive = set(self.provider.non_terminated_nodes())
+        for iid in [i for i in self.instances if i not in alive]:
+            self.instances.pop(iid, None)
+            self._idle_since.pop(iid, None)
+
+    def start(self) -> "Autoscaler":
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="rtpu-autoscaler")
+            self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self.period_s):
+            try:
+                self.reconcile_once()
+            except Exception:
+                import traceback
+                traceback.print_exc()
+
+    def stop(self, terminate_nodes: bool = True):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if terminate_nodes:
+            self.provider.shutdown()

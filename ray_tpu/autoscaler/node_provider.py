@@ -1,0 +1,116 @@
+"""Node providers: how the autoscaler actually obtains hosts.
+
+Reference parity: autoscaler NodeProvider ABC
+(autoscaler/node_provider.py) + the fake multi-node provider used by the
+reference's own tests (fake_multi_node/node_provider.py:236 — real raylet
+processes on one machine posing as separate nodes).
+
+Here a "node" is a node-agent process joined to the head over TCP
+(core/node_agent.py), so the fake provider launches REAL agents — the
+whole control path (register → schedule → spawn workers → heartbeat →
+remove on death) is exercised, not mocked. A cloud provider would replace
+``_launch`` with its instance API and run the agent via startup script.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Optional
+
+
+class NodeProvider:
+    """Minimal provider surface the autoscaler drives."""
+
+    def create_node(self, node_type: str, resources: dict) -> str:
+        """Launch a node of `node_type`; returns a provider instance id."""
+        raise NotImplementedError
+
+    def terminate_node(self, instance_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> list[str]:
+        raise NotImplementedError
+
+    def node_id_of(self, instance_id: str) -> Optional[str]:
+        """Cluster NodeID hex once the instance registered, else None."""
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        for iid in list(self.non_terminated_nodes()):
+            self.terminate_node(iid)
+
+
+class FakeNodeProvider(NodeProvider):
+    """Spawns real node agents as local subprocesses."""
+
+    def __init__(self, runtime=None):
+        from ..core import runtime as rt_mod
+        self._rt = runtime or rt_mod.get_runtime_if_exists()
+        if self._rt is None:
+            raise RuntimeError("ray_tpu.init() first")
+        self._lock = threading.Lock()
+        self._procs: dict[str, subprocess.Popen] = {}
+        self._node_ids: dict[str, str] = {}
+        self._seq = 0
+
+    def create_node(self, node_type: str, resources: dict) -> str:
+        with self._lock:
+            self._seq += 1
+            iid = f"fake-{node_type}-{self._seq}"
+        rt = self._rt
+        env = dict(os.environ)
+        env["RTPU_AUTHKEY"] = rt._authkey.hex()
+        extra = {k: v for k, v in resources.items() if k != "CPU"}
+        args = [sys.executable, "-m", "ray_tpu.core.node_agent",
+                "--head", f"127.0.0.1:{rt.tcp_port}",
+                "--num-cpus", str(resources.get("CPU", 1)),
+                "--resources", json.dumps(extra),
+                "--name", iid]
+        log = open(os.path.join(rt.session_dir, f"agent-{iid}.log"), "wb")
+        proc = subprocess.Popen(args, env=env, stdout=log,
+                                stderr=subprocess.STDOUT,
+                                start_new_session=True)
+        log.close()
+        with self._lock:
+            self._procs[iid] = proc
+        return iid
+
+    def node_id_of(self, instance_id: str) -> Optional[str]:
+        with self._lock:
+            nid = self._node_ids.get(instance_id)
+            if nid is not None:
+                return nid
+        # resolve by the node name the agent registered with
+        for row in self._rt.node_table():
+            if row["NodeName"] == instance_id and row["Alive"]:
+                with self._lock:
+                    self._node_ids[instance_id] = row["NodeID"]
+                return row["NodeID"]
+        return None
+
+    def terminate_node(self, instance_id: str) -> None:
+        with self._lock:
+            proc = self._procs.pop(instance_id, None)
+            self._node_ids.pop(instance_id, None)
+        if proc is not None:
+            try:
+                os.killpg(os.getpgid(proc.pid), 15)
+            except (ProcessLookupError, PermissionError):
+                proc.kill()
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    def non_terminated_nodes(self) -> list[str]:
+        with self._lock:
+            dead = [iid for iid, p in self._procs.items()
+                    if p.poll() is not None]
+            for iid in dead:
+                self._procs.pop(iid)
+                self._node_ids.pop(iid, None)
+            return list(self._procs)

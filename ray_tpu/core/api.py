@@ -28,6 +28,7 @@ def init(address: Optional[str] = None,
          ignore_reinit_error: bool = False,
          log_to_driver: bool = True,
          namespace: Optional[str] = None,
+         resume_from: Optional[str] = None,
          **_compat) -> dict:
     """Start the head runtime in this process, or — with ``address`` — attach
     to a running cluster as a driver client.
@@ -69,8 +70,15 @@ def init(address: Optional[str] = None,
                  object_store_memory=object_store_memory or None,
                  head_labels=labels)
     rt_mod.set_runtime(rt)
-    return {"node_id": rt.head_node.node_id.hex(),
-            "session_dir": rt.session_dir}
+    out = {"node_id": rt.head_node.node_id.hex(),
+           "session_dir": rt.session_dir}
+    if resume_from:
+        # GCS-fault-tolerance analog: resurrect durable state (named
+        # actors, placement groups, job table) from a previous session's
+        # snapshot (core/gcs_store.py restore)
+        from .gcs_store import restore
+        out["restored"] = restore(rt, resume_from)
+    return out
 
 
 def is_initialized() -> bool:
@@ -176,6 +184,39 @@ def timeline(filename: Optional[str] = None):
             json.dump(events, f)
         return None
     return events
+
+
+# --------------------------------------------------------------------- #
+# internal KV (reference: ray.experimental.internal_kv over
+# gcs_kv_manager.h) — durable, cluster-visible small metadata
+# --------------------------------------------------------------------- #
+
+def _kv_call(method: str, *args):
+    from .runtime import Runtime
+    rt = _runtime()
+    if isinstance(rt, Runtime):
+        return getattr(rt, method)(*args)
+    if hasattr(rt, "_rpc"):
+        return rt._rpc(method, *args)
+    raise RuntimeError("internal KV is not available in local_mode")
+
+
+def kv_put(key: str, value: bytes) -> None:
+    if isinstance(value, str):
+        value = value.encode()
+    _kv_call("kv_put", key, bytes(value))
+
+
+def kv_get(key: str) -> Optional[bytes]:
+    return _kv_call("kv_get", key)
+
+
+def kv_del(key: str) -> bool:
+    return _kv_call("kv_del", key)
+
+
+def kv_keys() -> list[str]:
+    return _kv_call("kv_keys")
 
 
 def head_address() -> dict:

@@ -212,6 +212,33 @@ class JobManager:
         except FileNotFoundError:
             return ""
 
+    def import_record(self, rec: dict) -> JobInfo | None:
+        """Adopt a job row from a previous session's snapshot (gcs_store
+        restore). RUNNING/PENDING become FAILED — their driver processes
+        died with the old head."""
+        with self.lock:
+            job_id = rec.get("job_id")
+            if not job_id or job_id in self.jobs:
+                return None
+            info = JobInfo(job_id, rec.get("entrypoint", ""),
+                           rec.get("log_path", ""), rec.get("metadata"))
+            info.status = rec.get("status", FAILED)
+            info.message = rec.get("message", "")
+            info.start_time = rec.get("start_time", 0.0)
+            info.end_time = rec.get("end_time")
+            if info.status in (PENDING, RUNNING):
+                info.status = FAILED
+                info.message = "head restarted while job was running"
+                info.end_time = info.end_time or time.time()
+            self.jobs[job_id] = info
+            # keep new ids past imported ones
+            try:
+                n = int(job_id.rsplit("-", 1)[1])
+                self._seq = max(self._seq, n)
+            except (IndexError, ValueError):
+                pass
+            return info
+
     def shutdown(self):
         with self.lock:
             procs = dict(self._procs)

@@ -375,8 +375,21 @@ class Runtime:
                      os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
         with os.fdopen(fd, "w") as f:
             json.dump(cf, f)
+        from .config import cfg as _cfg
+        from .gcs_store import GcsStore, start_snapshot_loop
         from .pubsub import Publisher
         self.pubsub = Publisher()
+        # durable metadata (redis_store_client.h analog): internal KV +
+        # restorable head-state snapshots
+        self.kv = GcsStore(os.path.join(self.session_dir, "gcs.sqlite"))
+        self._snapshot_stop = None
+        if _cfg.gcs_snapshot_period_s > 0:
+            self._snapshot_stop = start_snapshot_loop(
+                self, _cfg.gcs_snapshot_period_s)
+        # OOM protection (memory_monitor.h:52 analog); runs only when the
+        # refresh period is non-zero
+        from .memory_monitor import MemoryMonitor
+        self.memory_monitor = MemoryMonitor(self).start()
         self.jobs = JobManager(self.session_dir, self.cluster_file)
         self.jobs.on_status = lambda job_id, status: self.pubsub.publish(
             "jobs", {"job_id": job_id, "status": status})
@@ -638,8 +651,23 @@ class Runtime:
                     "create_placement_group_rpc", "remove_placement_group_rpc",
                     "timeline", "state_list", "state_summary",
                     "pubsub_poll",
+                    "kv_put", "kv_get", "kv_del", "kv_keys",
                     "job_submit", "job_list", "job_status", "job_logs",
                     "job_stop")
+
+    # internal KV (gcs_kv_manager.h / ray.experimental.internal_kv analog);
+    # user namespace is prefixed so snapshots can't be clobbered
+    def kv_put(self, key: str, value: bytes) -> None:
+        self.kv.put("user", key, value)
+
+    def kv_get(self, key: str):
+        return self.kv.get("user", key)
+
+    def kv_del(self, key: str) -> bool:
+        return self.kv.delete("user", key)
+
+    def kv_keys(self) -> list[str]:
+        return self.kv.keys("user")
 
     def state_list(self, kind, limit=1000, filters=None):
         """State-API rows for workers/driver clients (util/state/api.py)."""
@@ -770,6 +798,22 @@ class Runtime:
             pass
         self._on_worker_death(w.wid)
 
+    def _returns_complete_locked(self, spec) -> bool:
+        """All of a task's returns already produced (sealed in shm OR
+        spilled to disk, and not failed) — the call completed even if its
+        done message never arrived."""
+        if not spec.return_ids:
+            return False
+        for oid in spec.return_ids:
+            e = self.directory.get(oid)
+            if e is not None and e.state == FAILED:
+                return False
+            if e is not None and e.state == SPILLED:
+                continue
+            if not self.store.contains(oid):
+                return False
+        return True
+
     def _on_worker_death(self, wid: str):
         with self.lock:
             w = self.workers.get(wid)
@@ -793,9 +837,22 @@ class Runtime:
             # running normal task?
             spec = w.current
             if spec is not None and not spec.is_actor_task:
-                self._handle_failed_task_locked(
-                    spec, exc.WorkerCrashedError(
-                        f"worker {wid} died while running {spec.name}"))
+                if self._returns_complete_locked(spec):
+                    # results all sealed: the task completed, only its done
+                    # message lost the race with the death — don't clobber
+                    self.counters["tasks_finished"] += 1
+                    self._record_task_locked(spec, "FINISHED",
+                                             finished_at=time.time())
+                    for oid in spec.return_ids:
+                        e = self.directory.get(oid)
+                        if e is not None and e.state == PENDING:
+                            e.state = READY
+                        self._maybe_free_locked(oid)
+                    self._drop_task_dep_interest_locked(spec)
+                else:
+                    self._handle_failed_task_locked(
+                        spec, exc.WorkerCrashedError(
+                            f"worker {wid} died while running {spec.name}"))
             # actor hosted here?
             if w.actor_id is not None:
                 self._on_actor_worker_death_locked(w.actor_id, wid)
@@ -1497,6 +1554,21 @@ class Runtime:
         a.running.clear()
         can_restart = a.restarts_left != 0
         for spec in running:
+            # ray.get returns at object-seal; the done message may still be
+            # in flight when a kill lands. A call whose returns are ALL
+            # sealed DID complete — failing it would overwrite results a
+            # consumer already holds refs to.
+            if self._returns_complete_locked(spec):
+                self.counters["tasks_finished"] += 1
+                self._record_task_locked(spec, "FINISHED",
+                                         finished_at=time.time())
+                for oid in spec.return_ids:
+                    e = self.directory.get(oid)
+                    if e is not None and e.state == PENDING:
+                        e.state = READY
+                    self._maybe_free_locked(oid)
+                self._drop_task_dep_interest_locked(spec)
+                continue
             if can_restart and a.spec.max_task_retries != 0 and \
                     spec.retries_left > 0:
                 spec.retries_left -= 1
@@ -1882,6 +1954,17 @@ class Runtime:
                 return
             self._shutdown = True
             workers = list(self.workers.values())
+        # durable snapshot FIRST: killing workers below tears actors out
+        # of the tables (watch-proc death path), and a successor must see
+        # them as they were while alive
+        self.memory_monitor.stop()
+        if self._snapshot_stop is not None:
+            self._snapshot_stop.set()
+        try:
+            from .gcs_store import snapshot
+            snapshot(self)
+        except Exception:
+            pass
         self.jobs.shutdown()
         for w in workers:
             w.send({"t": "exit"})
@@ -1918,6 +2001,10 @@ class Runtime:
                     w.conn.close()
             except Exception:
                 pass
+        try:
+            self.kv.close()
+        except Exception:
+            pass
         self.store.close(unlink=True)
         try:
             os.unlink(self.cluster_file)  # address='auto' must not find us

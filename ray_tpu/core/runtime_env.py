@@ -85,6 +85,7 @@ def _zip_path(path: str) -> bytes:
     with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
         if os.path.isfile(path):
             zi = zipfile.ZipInfo(os.path.basename(path))
+            zi.compress_type = zipfile.ZIP_DEFLATED
             with open(path, "rb") as f:
                 z.writestr(zi, f.read())
         else:
@@ -97,6 +98,7 @@ def _zip_path(path: str) -> bytes:
                     entries.append((os.path.relpath(full, path), full))
             for rel, full in sorted(entries):
                 zi = zipfile.ZipInfo(rel)  # fixed date -> deterministic
+                zi.compress_type = zipfile.ZIP_DEFLATED
                 with open(full, "rb") as f:
                     z.writestr(zi, f.read())
     return buf.getvalue()

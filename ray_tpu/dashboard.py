@@ -1,0 +1,181 @@
+"""ray_tpu.dashboard — cluster overview over HTTP.
+
+Reference parity: the dashboard head + its API modules
+(dashboard/head.py:48, dashboard/modules/{node,job,actor,state,metrics})
+and the React frontend, reduced TPU-first: the head runtime IS the data
+source, so the dashboard is an in-process aiohttp thread serving the
+state API as JSON plus one self-contained HTML page — no separate
+process tree, no node agents, no build step.
+
+    import ray_tpu
+    from ray_tpu import dashboard
+    ray_tpu.init()
+    port = dashboard.start_dashboard(port=8265)
+    # GET /            -> HTML overview (auto-refreshing)
+    # GET /api/summary | /api/nodes | /api/actors | /api/tasks
+    #     /api/objects | /api/workers | /api/jobs | /api/config
+    # GET /metrics     -> Prometheus text (same as state.start_metrics_server)
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Optional
+
+_server = {"runner": None, "loop": None, "port": None, "thread": None}
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>ray_tpu dashboard</title>
+<style>
+ body { font-family: system-ui, sans-serif; margin: 2em; background: #fafafa; }
+ h1 { font-size: 1.3em; } h2 { font-size: 1.05em; margin-top: 1.4em; }
+ table { border-collapse: collapse; width: 100%%; background: #fff; }
+ th, td { border: 1px solid #ddd; padding: 4px 8px; font-size: 0.85em;
+          text-align: left; }
+ th { background: #f0f0f0; }
+ .pill { padding: 1px 8px; border-radius: 8px; font-size: 0.8em; }
+ .ALIVE, .FINISHED, .SUCCEEDED, .alive { background: #d4f7d4; }
+ .DEAD, .FAILED, .ERROR, .dead { background: #f7d4d4; }
+ .RUNNING, .PENDING, .busy { background: #fdf3cf; }
+</style></head>
+<body>
+<h1>ray_tpu dashboard</h1>
+<div id="summary"></div>
+<h2>Nodes</h2><table id="nodes"></table>
+<h2>Workers</h2><table id="workers"></table>
+<h2>Actors</h2><table id="actors"></table>
+<h2>Jobs</h2><table id="jobs"></table>
+<h2>Recent tasks</h2><table id="tasks"></table>
+<script>
+function row(tr, cells, tag) {
+  const r = document.createElement('tr');
+  for (const c of cells) {
+    const td = document.createElement(tag || 'td');
+    if (typeof c === 'object' && c && c.pill) {
+      const s = document.createElement('span');
+      s.className = 'pill ' + c.pill; s.textContent = c.pill;
+      td.appendChild(s);
+    } else td.textContent = c;
+    r.appendChild(td);
+  }
+  tr.appendChild(r);
+}
+function fill(id, header, rows) {
+  const t = document.getElementById(id);
+  t.innerHTML = '';
+  row(t, header, 'th');
+  for (const r of rows) row(t, r);
+}
+async function refresh() {
+  const s = await (await fetch('api/summary')).json();
+  document.getElementById('summary').textContent =
+    `nodes ${s.nodes_alive} | actors ${s.actors} | pending tasks ` +
+    `${s.pending_tasks} | finished ${s.tasks.tasks_finished} | failed ` +
+    `${s.tasks.tasks_failed} | store ` +
+    `${(s.object_store.bytes_in_use/1048576).toFixed(1)}MB/` +
+    `${(s.object_store.capacity/1048576).toFixed(0)}MB`;
+  const nodes = await (await fetch('api/nodes')).json();
+  fill('nodes', ['node', 'state', 'resources', 'available'],
+       nodes.map(n => [n.NodeName, {pill: n.Alive ? 'ALIVE' : 'DEAD'},
+                       JSON.stringify(n.Resources),
+                       JSON.stringify(n.Available)]));
+  const workers = await (await fetch('api/workers')).json();
+  fill('workers', ['id', 'state', 'pid', 'task/actor'],
+       workers.map(w => [w.worker_id, {pill: w.state}, w.pid,
+                         w.current_task || w.actor_id]));
+  const actors = await (await fetch('api/actors')).json();
+  fill('actors', ['id', 'class', 'state', 'name', 'pending', 'running'],
+       actors.map(a => [a.actor_id.slice(0, 12), a.class_name,
+                        {pill: a.state}, a.name, a.pending_calls,
+                        a.running_calls]));
+  const jobs = await (await fetch('api/jobs')).json();
+  fill('jobs', ['id', 'status', 'entrypoint'],
+       jobs.map(j => [j.job_id, {pill: j.status}, j.entrypoint]));
+  const tasks = await (await fetch('api/tasks?limit=25')).json();
+  fill('tasks', ['name', 'state', 'worker'],
+       tasks.map(t => [t.name, {pill: t.state}, t.worker || '']));
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
+
+def start_dashboard(port: int = 0, host: str = "127.0.0.1") -> int:
+    """Start the dashboard on the head; returns the bound port."""
+    from aiohttp import web
+
+    from . import state as state_api
+    from .core import runtime as rt_mod
+    from .core.config import cfg
+
+    rt = rt_mod.get_runtime_if_exists()
+    if rt is None or not isinstance(rt, rt_mod.Runtime):
+        raise RuntimeError("start_dashboard() runs on the head driver")
+    if _server["runner"] is not None:
+        return _server["port"]
+
+    async def page(request):
+        return web.Response(text=_PAGE, content_type="text/html")
+
+    async def api(request):
+        kind = request.match_info["kind"]
+        limit = int(request.query.get("limit", 1000))
+        try:
+            if kind == "summary":
+                out = state_api.summary()
+            elif kind == "config":
+                out = cfg.dump()
+            elif kind == "jobs":
+                out = state_api.list_jobs()
+            elif kind in ("tasks", "actors", "objects", "nodes", "workers"):
+                fn = getattr(state_api, f"list_{kind}")
+                out = fn(limit) if kind in ("tasks", "actors",
+                                            "objects") else fn()
+            else:
+                return web.json_response(
+                    {"error": f"unknown kind {kind}"}, status=404)
+        except Exception as e:  # noqa: BLE001
+            return web.json_response({"error": str(e)}, status=500)
+        return web.json_response(out, dumps=lambda o: json.dumps(
+            o, default=str))
+
+    async def metrics(request):
+        return web.Response(text=state_api._prometheus_text(),
+                            content_type="text/plain")
+
+    ready = threading.Event()
+
+    def run():
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        app = web.Application()
+        app.router.add_get("/", page)
+        app.router.add_get("/api/{kind}", api)
+        app.router.add_get("/metrics", metrics)
+        runner = web.AppRunner(app)
+
+        async def boot():
+            await runner.setup()
+            site = web.TCPSite(runner, host, port)
+            await site.start()
+            _server["port"] = site._server.sockets[0].getsockname()[1]
+            _server["runner"] = runner
+        loop.run_until_complete(boot())
+        _server["loop"] = loop
+        ready.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True, name="rtpu-dashboard")
+    t.start()
+    _server["thread"] = t
+    if not ready.wait(10):
+        raise RuntimeError("dashboard failed to start")
+    return _server["port"]
+
+
+def stop_dashboard() -> None:
+    loop = _server.get("loop")
+    if loop is not None:
+        loop.call_soon_threadsafe(loop.stop)
+    _server.update({"runner": None, "loop": None, "port": None,
+                    "thread": None})

@@ -10,7 +10,7 @@ blocks through the gang (executor.py). The TPU-facing surface is
 `streaming_split(n)` shards for Train worker gangs.
 """
 from .context import DataContext
-from .dataset import DataIterator, Dataset, Schema
+from .dataset import ActorPoolStrategy, DataIterator, Dataset, Schema
 from .read_api import (
     from_arrow,
     from_items,
@@ -24,6 +24,7 @@ from .read_api import (
 )
 
 __all__ = [
+    "ActorPoolStrategy",
     "DataContext", "Dataset", "DataIterator", "Schema", "from_arrow",
     "from_items", "from_numpy", "from_pandas", "range", "read_csv",
     "read_json", "read_parquet", "read_text",

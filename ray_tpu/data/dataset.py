@@ -8,6 +8,7 @@ accelerator hand-off (device_put onto the current mesh's batch sharding).
 """
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Any, Callable, Iterable, Iterator, Optional
 
@@ -50,6 +51,22 @@ class Schema:
 def _map_batches_block(fn, batch_format, batch):
     out = fn(B.format_batch(batch, batch_format))
     return B.from_batch(out)
+
+
+def _call_batch_block(batch_format, fn_instance, batch):
+    """Actor-pool variant of _map_batches_block: the callable is a
+    constructed instance living in the pool actor."""
+    out = fn_instance(B.format_batch(batch, batch_format))
+    return B.from_batch(out)
+
+
+@dataclasses.dataclass
+class ActorPoolStrategy:
+    """compute= strategy for stateful map_batches (reference:
+    ray.data.ActorPoolStrategy)."""
+    size: int = 2
+    min_size: Optional[int] = None   # accepted for API compat
+    max_size: Optional[int] = None
 
 
 def _map_rows_block(fn, batch):
@@ -116,10 +133,28 @@ class Dataset:
         return Dataset(BlockOp(self._plan, fn, name), self._ctx)
 
     def map_batches(self, fn: Callable, *, batch_format: str = "numpy",
+                    compute=None, concurrency: Optional[int] = None,
+                    fn_constructor_args: tuple = (),
+                    fn_constructor_kwargs: Optional[dict] = None,
                     **_ignored) -> "Dataset":
-        return self._block_op(
-            functools.partial(_map_batches_block, fn, batch_format),
-            "MapBatches")
+        """Stateless path: fn fuses into per-block tasks. Stateful path
+        (``compute=ActorPoolStrategy(size=n)`` / ``concurrency=n`` with a
+        callable CLASS): the class is constructed once per pool actor —
+        model weights load once, batches stream through (reference:
+        ActorPoolMapOperator)."""
+        if compute is None and concurrency is None:
+            return self._block_op(
+                functools.partial(_map_batches_block, fn, batch_format),
+                "MapBatches")
+        import cloudpickle
+
+        from .executor import ActorPoolOp
+        size = concurrency or getattr(compute, "size", None) or 2
+        wrap = functools.partial(_call_batch_block, batch_format)
+        blob = cloudpickle.dumps((fn, tuple(fn_constructor_args),
+                                  fn_constructor_kwargs or {}, wrap))
+        return Dataset(ActorPoolOp(self._plan, blob, int(size),
+                                   "MapBatches(actors)"), self._ctx)
 
     def map(self, fn: Callable[[dict], dict]) -> "Dataset":
         return self._block_op(functools.partial(_map_rows_block, fn), "Map")
@@ -167,6 +202,14 @@ class Dataset:
 
     def zip(self, other: "Dataset") -> "Dataset":
         return Dataset(Exchange([self._plan, other._plan], "zip"), self._ctx)
+
+    def join(self, other: "Dataset", on, how: str = "inner",
+             num_partitions: Optional[int] = None) -> "Dataset":
+        """Distributed hash join on key column(s) (reference:
+        Dataset.join / _internal/execution/operators/join.py)."""
+        return Dataset(Exchange([self._plan, other._plan], "join", on=on,
+                                how=how, num_partitions=num_partitions),
+                       self._ctx)
 
     def groupby(self, key: str) -> "GroupedData":
         from .grouped import GroupedData

@@ -68,12 +68,26 @@ class BlockOp(LogicalOp):
 
 class Exchange(LogicalOp):
     """All-to-all boundary. kind in {repartition, shuffle, sort, groupby,
-    limit, union, zip}; args carried per kind."""
+    limit, union, zip, join}; args carried per kind."""
 
     def __init__(self, inputs: list[LogicalOp], kind: str, **kwargs):
         super().__init__(f"Exchange[{kind}]", inputs)
         self.kind = kind
         self.kwargs = kwargs
+
+
+class ActorPoolOp(LogicalOp):
+    """map_batches over a pool of stateful actors (reference:
+    ActorPoolMapOperator, _internal/execution/operators/actor_map_operator.py
+    + ActorPoolStrategy). The fn is a CLASS: constructed once per actor
+    (model load happens once), called per batch. Breaks block-op fusion
+    above it; downstream block fns ride along into the actor call."""
+
+    def __init__(self, input_op: LogicalOp, fn_blob: bytes, size: int,
+                 name: str):
+        super().__init__(name, [input_op])
+        self.fn_blob = fn_blob      # cloudpickle((cls, args, kwargs, wrap))
+        self.size = size
 
 
 # ---------------------------------------------------------------------------
@@ -163,6 +177,61 @@ def _slice_task(block, start, end):
     return out, BlockMeta(B.num_rows(out), B.size_bytes(out))
 
 
+def _hash_partition_multi(block, keys, n_out):
+    """Hash-partition on one or more key columns (joins, multi-key ops)."""
+    if B.num_rows(block) == 0:
+        return tuple(block for _ in range(n_out))
+    cols = [B.column_to_numpy(block.column(k)) for k in keys]
+    hashes = np.array([_stable_hash(tuple(c[i] for c in cols)) % n_out
+                       for i in range(B.num_rows(block))])
+    return tuple(block.take(np.nonzero(hashes == i)[0])
+                 for i in range(n_out))
+
+
+def _join_partition(keys, how, lschema_names, rschema_names, n_left, *parts):
+    """Reduce side of a hash join: pandas merge of one co-partition."""
+    import pandas as pd
+
+    def side_df(blocks):
+        df = B.concat(list(blocks)).to_pandas() if blocks else pd.DataFrame()
+        if df.shape[1] == 0:
+            # an empty SIDE (zero blocks / zero columns) still needs the
+            # key columns for merge — and so outer joins emit the other
+            # side's rows
+            df = pd.DataFrame({k: pd.Series([], dtype="object")
+                               for k in keys})
+        return df
+
+    ldf = side_df(parts[:n_left])
+    rdf = side_df(parts[n_left:])
+    out = ldf.merge(rdf, on=list(keys), how=how,
+                    suffixes=("", "_right"))
+    if how != "inner":
+        # unmatched rows put NaN into int columns ONLY in partitions that
+        # have misses — convert to pandas nullable dtypes so every
+        # partition emits the same arrow schema (concat/sort need that)
+        out = out.convert_dtypes()
+    tbl = B.from_batch(out)
+    return tbl, BlockMeta(B.num_rows(tbl), B.size_bytes(tbl))
+
+
+class _ActorMapWorker:
+    """Actor body for ActorPoolOp: builds the user's callable once, maps
+    blocks through it (plus any fused downstream block fns) per call."""
+
+    def __init__(self, fn_blob: bytes):
+        import cloudpickle
+        cls, args, kwargs, wrap = cloudpickle.loads(fn_blob)
+        self._fn = cls(*args, **kwargs) if isinstance(cls, type) else cls
+        self._wrap = wrap
+
+    def map(self, fused_fns, block):
+        block = self._wrap(self._fn, block)
+        for fn in fused_fns:
+            block = fn(block)
+        return block, BlockMeta(B.num_rows(block), B.size_bytes(block))
+
+
 # ---------------------------------------------------------------------------
 # Executor
 # ---------------------------------------------------------------------------
@@ -227,6 +296,9 @@ class Executor:
                 for rt in node.read_tasks)
             yield from self._stream(thunks, window)
             return
+        if isinstance(node, ActorPoolOp):
+            yield from self._execute_actor_pool(node, fused, window)
+            return
         if isinstance(node, InputData):
             base = node.refs_and_meta
         elif isinstance(node, Exchange):
@@ -241,6 +313,34 @@ class Executor:
             (lambda ref=ref: remote_fused.remote(fused, ref))
             for ref, _ in base)
         yield from self._stream(thunks, window)
+
+    def _execute_actor_pool(self, node: ActorPoolOp, fused, window):
+        """Stream upstream blocks through a pool of stateful map actors,
+        round-robin, preserving plan order; pool lives for the run."""
+        ray = _ray()
+        worker_cls = ray.remote(_ActorMapWorker)
+        pool = [worker_cls.remote(node.fn_blob) for _ in range(node.size)]
+        try:
+            counter = {"i": 0}
+
+            def make_thunk(ref):
+                def thunk():
+                    i = counter["i"] % len(pool)
+                    counter["i"] += 1
+                    resp = pool[i].map.options(num_returns=2).remote(
+                        fused, ref)
+                    return resp
+                return thunk
+
+            upstream = self.execute_streaming(node.inputs[0], window=window)
+            thunks = (make_thunk(ref) for ref, _ in upstream)
+            yield from self._stream(thunks, window)
+        finally:
+            for a in pool:
+                try:
+                    ray.kill(a)
+                except Exception:
+                    pass
 
     def _stream(self, thunks, window=_DEFAULT):
         """Bounded-window submission loop (the scheduling loop of the
@@ -310,7 +410,34 @@ class Executor:
             return self._groupby(upstream, kind["key"], kind["agg_fn"])
         if k == "zip":
             return self._zip(upstream, self.execute(node.inputs[1]))
+        if k == "join":
+            return self._join(upstream, self.execute(node.inputs[1]),
+                              kind["on"], kind["how"],
+                              kind.get("num_partitions"))
         raise ValueError(f"unknown exchange {k!r}")
+
+    def _join(self, left, right, on, how, num_partitions=None):
+        """Distributed hash join (reference: operators/join.py +
+        hash_shuffle.py): both sides hash-partition on the key columns,
+        co-partitions merge with pandas."""
+        ray = _ray()
+        keys = [on] if isinstance(on, str) else list(on)
+        if how not in ("inner", "left", "right", "outer"):
+            raise ValueError(f"unsupported join how={how!r}")
+        n_out = num_partitions or max(1, max(len(left), len(right)))
+        part = ray.remote(_hash_partition_multi).options(num_returns=n_out)
+        lparts = [part.remote(ref, keys, n_out) for ref, _ in left]
+        rparts = [part.remote(ref, keys, n_out) for ref, _ in right]
+        lparts = [p if isinstance(p, list) else [p] for p in lparts]
+        rparts = [p if isinstance(p, list) else [p] for p in rparts]
+        joiner = ray.remote(_join_partition).options(num_returns=2)
+        out = []
+        for j in range(n_out):
+            lcol = [lparts[i][j] for i in range(len(lparts))]
+            rcol = [rparts[i][j] for i in range(len(rparts))]
+            out.append(joiner.remote(keys, how, None, None, len(lcol),
+                                     *lcol, *rcol))
+        return self._resolve(out)
 
     def _limit(self, upstream, n: int):
         ray = _ray()

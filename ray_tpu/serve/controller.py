@@ -123,9 +123,13 @@ class ReplicaActor:
                         pending = asyncio.ensure_future(gen.__anext__())
                     try:
                         if items:
-                            # only take immediately-ready items past the 1st
+                            # past the 1st item take only near-ready ones:
+                            # a tiny positive timeout lets a ready
+                            # __anext__ actually run (timeout=0 would just
+                            # check done() on the never-scheduled task and
+                            # defeat the batching)
                             item = await asyncio.wait_for(
-                                asyncio.shield(pending), 0)
+                                asyncio.shield(pending), 0.002)
                         else:
                             item = await pending
                     except asyncio.TimeoutError:

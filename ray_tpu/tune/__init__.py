@@ -8,6 +8,7 @@ core runtime; results stream over the same report bus the Train library
 uses (`tune.report` is `train.report`, matching the unified v2 API).
 """
 from .search import (
+    TPESearch,
     choice,
     grid_search,
     loguniform,
@@ -34,6 +35,6 @@ __all__ = [
     "Tuner", "TuneConfig", "ResultGrid", "grid_search", "choice", "uniform",
     "loguniform", "randint", "qrandint", "quniform", "sample_from",
     "FIFOScheduler", "ASHAScheduler", "MedianStoppingRule",
-    "PopulationBasedTraining", "report", "get_checkpoint", "get_context",
+    "PopulationBasedTraining", "TPESearch", "report", "get_checkpoint", "get_context",
     "Checkpoint",
 ]

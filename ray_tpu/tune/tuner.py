@@ -8,6 +8,7 @@ decisions, early-stop/perturb, collect terminal states).
 from __future__ import annotations
 
 import os
+import time
 import uuid
 from typing import Any, Callable, Optional
 
@@ -30,7 +31,8 @@ class TuneConfig:
     def __init__(self, metric: Optional[str] = None, mode: str = "max",
                  num_samples: int = 1, scheduler=None,
                  max_concurrent_trials: int = 2,
-                 stop: Optional[dict] = None, seed: int = 0):
+                 stop: Optional[dict] = None, seed: int = 0,
+                 search_alg=None):
         if mode not in ("max", "min"):
             raise ValueError("mode must be 'max' or 'min'")
         self.metric = metric
@@ -40,6 +42,9 @@ class TuneConfig:
         self.max_concurrent_trials = max_concurrent_trials
         self.stop = stop or {}
         self.seed = seed
+        # sequential suggester (TPESearch) — None = upfront variant
+        # generation (BasicVariantGenerator semantics)
+        self.search_alg = search_alg
 
 
 class Trial:
@@ -153,6 +158,74 @@ class Tuner:
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config or RunConfig()
         self.resources = resources_per_trial or {"CPU": 1}
+        self._restored_trials: Optional[list[Trial]] = None
+
+    # -- experiment persistence (reference: Tuner.restore + the
+    # experiment-state file tune writes under the run dir) --------------- #
+
+    _STATE_FILE = "tuner_state.pkl"
+
+    def _save_experiment(self, storage: str, trials: list[Trial],
+                         fn_blob: bytes) -> None:
+        import cloudpickle
+        state = {
+            "param_space": self.param_space,
+            "tune_config": self.tune_config,
+            "resources": self.resources,
+            "run_name": os.path.basename(storage),
+            "fn_blob": fn_blob,
+            "trials": [{
+                "index": t.index, "config": t.config, "status": t.status,
+                "results": t.results, "iteration": t.iteration,
+                "checkpoint": (t.last_checkpoint.path
+                               if t.last_checkpoint else None),
+                "error": repr(t.error) if t.error else None,
+            } for t in trials],
+        }
+        tmp = os.path.join(storage, self._STATE_FILE + ".tmp")
+        with open(tmp, "wb") as f:
+            cloudpickle.dump(state, f)
+        os.replace(tmp, os.path.join(storage, self._STATE_FILE))
+
+    @classmethod
+    def restore(cls, path: str, trainable: Optional[Callable] = None,
+                restore_errored: bool = False,
+                resume_unfinished: bool = True) -> "Tuner":
+        """Resume an experiment from its run dir (reference: Tuner.restore,
+        tune/tuner.py). Finished trials keep their results; unfinished
+        ones resume from their last checkpoint; errored ones re-run only
+        with ``restore_errored=True``."""
+        import cloudpickle
+        with open(os.path.join(path, cls._STATE_FILE), "rb") as f:
+            state = cloudpickle.load(f)
+        fn = trainable if trainable is not None else cloudpickle.loads(
+            state["fn_blob"])
+        tuner = cls(fn, param_space=state["param_space"],
+                    tune_config=state["tune_config"],
+                    run_config=RunConfig(
+                        name=state["run_name"],
+                        storage_path=os.path.dirname(path)),
+                    resources_per_trial=state["resources"])
+        trials = []
+        for row in state["trials"]:
+            t = Trial(row["index"], row["config"])
+            t.results = row["results"]
+            t.iteration = row["iteration"]
+            t.status = row["status"]
+            if row["checkpoint"]:
+                t.last_checkpoint = Checkpoint(row["checkpoint"])
+            # STOPPED is terminal: it's the scheduler's early-stop verdict
+            # (ASHA/median), not an interruption — never re-run those
+            if t.status in (Trial.RUNNING, Trial.PENDING) and \
+                    resume_unfinished:
+                t.status = Trial.PENDING
+                t.restore_from = t.last_checkpoint
+            elif t.status == Trial.ERROR and restore_errored:
+                t.status = Trial.PENDING
+                t.restore_from = t.last_checkpoint
+            trials.append(t)
+        tuner._restored_trials = trials
+        return tuner
 
     # -- controller -------------------------------------------------------
 
@@ -168,9 +241,16 @@ class Tuner:
                                run_name)
         os.makedirs(storage, exist_ok=True)
 
-        variants = generate_variants(self.param_space, tc.num_samples,
-                                     tc.seed)
-        trials = [Trial(i, cfg) for i, cfg in enumerate(variants)]
+        searcher = tc.search_alg
+        if self._restored_trials is not None:
+            trials = self._restored_trials
+        elif searcher is not None:
+            searcher.setup(self.param_space, tc.metric, tc.mode)
+            trials = []  # suggested lazily as capacity frees up
+        else:
+            variants = generate_variants(self.param_space, tc.num_samples,
+                                         tc.seed)
+            trials = [Trial(i, cfg) for i, cfg in enumerate(variants)]
         by_index = {t.index: t for t in trials}
         fn_blob = cloudpickle.dumps(self.trainable)
 
@@ -203,6 +283,11 @@ class Tuner:
                 except Exception:
                     pass
                 trial.actor = None
+            if searcher is not None and status in (
+                    Trial.TERMINATED, Trial.STOPPED, Trial.ERROR) and \
+                    not getattr(trial, "_searcher_told", False):
+                trial._searcher_told = True
+                searcher.on_trial_complete(trial.config, trial.last_result)
 
         def active():
             return [t for t in trials if t.status == Trial.RUNNING]
@@ -210,10 +295,19 @@ class Tuner:
         def pending():
             return [t for t in trials if t.status == Trial.PENDING]
 
+        last_save = 0.0
         try:
-            while pending() or active():
+            while pending() or active() or (
+                    searcher is not None and len(trials) < tc.num_samples):
                 while pending() and len(active()) < tc.max_concurrent_trials:
                     launch(pending()[0])
+                if searcher is not None:
+                    while len(trials) < tc.num_samples and \
+                            len(active()) < tc.max_concurrent_trials:
+                        t = Trial(len(trials), searcher.suggest())
+                        trials.append(t)
+                        by_index[t.index] = t
+                        launch(t)
 
                 # reap finished/stopped/crashed trial actors
                 live = [t for t in trials if t.actor is not None
@@ -264,6 +358,11 @@ class Tuner:
                             isinstance(sched, PopulationBasedTraining) and \
                             sched.should_perturb(t, metrics):
                         self._pbt_step(sched, t, trials, stop_trial, launch)
+
+                now = time.monotonic()
+                if now - last_save > 1.0:  # experiment-state checkpoint
+                    last_save = now
+                    self._save_experiment(storage, trials, fn_blob)
         finally:
             for t in trials:
                 if t.actor is not None:
@@ -271,6 +370,10 @@ class Tuner:
                                else Trial.STOPPED)
             try:
                 ray.kill(bus)
+            except Exception:
+                pass
+            try:
+                self._save_experiment(storage, trials, fn_blob)
             except Exception:
                 pass
 

@@ -159,3 +159,25 @@ def test_put_objects_not_reconstructable(shutdown_only):
     pressure = [ray.put(np.zeros(2 * 1024 * 1024, dtype=np.uint8))
                 for _ in range(4)]
     assert ray.get(ref) is not None
+
+
+def test_kill_right_after_get_does_not_clobber_result(ray_start_regular):
+    """ray.get returns at object-seal; the done message may still be in
+    flight when ray.kill lands. The sealed result must survive (the head
+    treats the call as completed, not failed)."""
+    ray = ray_start_regular
+
+    @ray.remote
+    class Maker:
+        def make(self, i):
+            return [i] * 1000
+
+    for round_i in range(5):
+        a = Maker.remote()
+        refs = [a.make.remote(i) for i in range(3)]
+        vals = ray.get(refs, timeout=60)   # seal observed
+        ray.kill(a)                        # races the done messages
+        # refs must still resolve to the values, not ActorDiedError
+        vals2 = ray.get(refs, timeout=60)
+        assert vals2 == vals
+        assert vals2[2][0] == 2

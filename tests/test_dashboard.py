@@ -1,0 +1,60 @@
+"""Dashboard tests (reference: dashboard/head.py + modules)."""
+import json
+import urllib.request
+
+import pytest
+
+
+@pytest.fixture
+def dash(ray_start_regular):
+    from ray_tpu import dashboard
+    port = dashboard.start_dashboard(port=0)
+    yield ray_start_regular, port
+    dashboard.stop_dashboard()
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                    timeout=10) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_dashboard_pages(dash):
+    ray, port = dash
+
+    @ray.remote
+    class Pinger:
+        def ping(self):
+            return 1
+
+    p = Pinger.options(name="dash-actor").remote()
+    assert ray.get(p.ping.remote(), timeout=60) == 1
+
+    status, html = _get(port, "/")
+    assert status == 200 and "ray_tpu dashboard" in html
+
+    status, body = _get(port, "/api/summary")
+    assert status == 200
+    s = json.loads(body)
+    assert s["nodes_alive"] >= 1 and "object_store" in s
+
+    status, body = _get(port, "/api/actors")
+    assert any(a["name"] == "dash-actor" for a in json.loads(body))
+
+    status, body = _get(port, "/api/nodes")
+    assert any(n["Alive"] for n in json.loads(body))
+
+    status, body = _get(port, "/api/config")
+    assert "worker_prestart" in json.loads(body)
+
+    status, body = _get(port, "/api/tasks?limit=5")
+    assert status == 200 and isinstance(json.loads(body), list)
+
+    status, text = _get(port, "/metrics")
+    assert "ray_tpu_nodes_alive" in text
+
+    status, body = _get(port, "/api/bogus")
+    assert status == 404 or "error" in body

@@ -1,0 +1,100 @@
+"""Data joins + actor-pool map tests (reference: operators/join.py,
+actor_map_operator.py + ActorPoolStrategy)."""
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def ray(ray_start_regular):
+    return ray_start_regular
+
+
+def _data():
+    from ray_tpu import data
+    return data
+
+
+def test_inner_join(ray):
+    data = _data()
+    left = data.from_items([{"id": i, "a": i * 10} for i in range(8)])
+    right = data.from_items([{"id": i, "b": i * 100} for i in range(4, 12)])
+    out = left.join(right, on="id").sort("id").take_all()
+    assert [r["id"] for r in out] == [4, 5, 6, 7]
+    assert all(r["b"] == r["id"] * 100 and r["a"] == r["id"] * 10
+               for r in out)
+
+
+def test_left_and_outer_join(ray):
+    data = _data()
+    left = data.from_items([{"id": i, "a": i} for i in range(4)])
+    right = data.from_items([{"id": i, "b": -i} for i in range(2, 6)])
+    lj = left.join(right, on="id", how="left").sort("id").take_all()
+    assert len(lj) == 4
+    assert [r["id"] for r in lj] == [0, 1, 2, 3]
+    oj = left.join(right, on="id", how="outer").sort("id").take_all()
+    assert [r["id"] for r in oj] == [0, 1, 2, 3, 4, 5]
+
+
+def test_multi_key_join(ray):
+    data = _data()
+    left = data.from_items(
+        [{"x": i % 2, "y": i % 3, "v": i} for i in range(12)])
+    right = data.from_items(
+        [{"x": 0, "y": 0, "w": 7}, {"x": 1, "y": 2, "w": 9}])
+    out = left.join(right, on=["x", "y"]).take_all()
+    for r in out:
+        assert (r["x"], r["y"]) in [(0, 0), (1, 2)]
+    assert len(out) == 4  # ids 0,6 match (0,0); 5,11 match (1,2)
+
+
+def test_join_with_blocks(ray):
+    """Join across multiple blocks on each side."""
+    data = _data()
+    left = data.range(100).map(lambda r: {"id": r["id"], "sq": r["id"] ** 2})
+    right = data.range(100).map(
+        lambda r: {"id": r["id"], "cube": r["id"] ** 3})
+    out = left.join(right, on="id", num_partitions=4).sort("id").take_all()
+    assert len(out) == 100
+    assert out[10]["sq"] == 100 and out[10]["cube"] == 1000
+
+
+class AddModel:
+    """Stateful callable for actor-pool map: 'loads' state once."""
+
+    def __init__(self, delta=1000):
+        self.delta = delta
+        self.calls = 0
+
+    def __call__(self, batch):
+        self.calls += 1
+        return {"id": batch["id"], "out": batch["id"] + self.delta}
+
+
+def test_actor_pool_map_batches(ray):
+    data = _data()
+    ds = data.range(64).map_batches(
+        AddModel, compute=data.ActorPoolStrategy(size=2),
+        fn_constructor_kwargs={"delta": 500})
+    rows = ds.sort("id").take_all()
+    assert len(rows) == 64
+    assert rows[3]["out"] == 503
+
+
+def test_actor_pool_concurrency_kwarg(ray):
+    data = _data()
+    ds = data.range(32).map_batches(AddModel, concurrency=2)
+    rows = ds.sort("id").take_all()
+    assert rows[0]["out"] == 1000
+
+
+def test_actor_pool_then_block_ops_fuse(ray):
+    """Block ops after the actor stage ride into the actor calls."""
+    data = _data()
+    ds = (data.range(20)
+          .map_batches(AddModel, concurrency=2)
+          .filter(lambda r: r["out"] % 2 == 0)
+          .map(lambda r: {"v": r["out"] * 2}))
+    rows = sorted(r["v"] for r in ds.take_all())
+    assert rows == [2 * v for v in range(1000, 1020, 2)]
