@@ -151,6 +151,14 @@ class ActorHandle:
         """Ref that resolves when the actor's __init__ finished."""
         return ObjectRef(self._ready_oid)
 
+    def _exec(self, fn, *args) -> ObjectRef:
+        """Run ``fn(actor_instance, *args)`` inside the actor's process
+        (internal; reference analog: __ray_call__). Used by compiled DAGs
+        to install their execution loops."""
+        import cloudpickle as _cp
+        method = ActorMethod(self, "__rtpu_exec__")
+        return method.remote(_cp.dumps(fn), *args)
+
     def __repr__(self):
         return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"
 

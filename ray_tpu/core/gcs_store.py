@@ -115,12 +115,14 @@ def restore(rt, old_session_dir: str) -> dict:
         named = pickle.loads(old.get("snapshot", "named_actors") or b"\x80\x04]\x94.")
         pgs = pickle.loads(old.get("snapshot", "placement_groups") or b"\x80\x04]\x94.")
         jobs = pickle.loads(old.get("snapshot", "jobs") or b"\x80\x04]\x94.")
+        user_kv = old.items("user")  # durable internal KV carries over
     finally:
         old.close()
 
-    restored = {"actors": 0, "placement_groups": 0, "jobs": 0}
+    restored = {"actors": 0, "placement_groups": 0, "jobs": 0, "kv_keys": 0}
     for pg_id, bundles, strategy, name in pgs:
-        rt.create_placement_group(bundles, strategy, name)
+        # keep the OLD id: restored actor specs reference it
+        rt.create_placement_group(bundles, strategy, name, pg_id=pg_id)
         restored["placement_groups"] += 1
     import dataclasses
     from .ids import ActorID, ObjectID
@@ -139,6 +141,9 @@ def restore(rt, old_session_dir: str) -> dict:
         info = rt.jobs.import_record(j)
         if info is not None:
             restored["jobs"] += 1
+    for key, value in user_kv:
+        rt.kv.put("user", key, value)
+        restored["kv_keys"] += 1
     return restored
 
 

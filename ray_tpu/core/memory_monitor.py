@@ -90,8 +90,14 @@ class MemoryMonitor:
             return False
         rt = self.rt
         with rt.lock:
-            # dispatch order ≈ insertion order of the workers dict
-            victim = pick_victim(list(rt.workers.values()))
+            # dispatch order ≈ insertion order of the workers dict.
+            # Victims come from THIS host only — the monitor reads head-
+            # host /proc/meminfo, and killing a remote agent's worker
+            # would not relieve it (per-node monitoring is the node
+            # agent's job on a multi-host cluster)
+            head_nid = rt.head_node.node_id
+            victim = pick_victim([w for w in rt.workers.values()
+                                  if w.node_id == head_nid])
             if victim is None:
                 return False
             name = victim.current.name if victim.current else "?"

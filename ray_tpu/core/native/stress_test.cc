@@ -1,0 +1,88 @@
+// Sanitizer stress harness for the shm object store.
+//
+// Reference parity: the reference runs its C++ object-store tests under
+// TSAN/ASAN in CI (SURVEY.md §5.2; .bazelrc sanitizer configs). The store
+// is cross-process shared memory — TSAN instruments the in-process side
+// (many threads hammering one attached handle) and ASAN catches
+// heap/region overruns on both paths.
+//
+// Build+run (tests/test_sanitizers.py drives this):
+//   g++ -fsanitize=thread  -O1 -g -std=c++17 stress_test.cc -o t_tsan -lpthread
+//   g++ -fsanitize=address -O1 -g -std=c++17 stress_test.cc -o t_asan -lpthread
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "objstore.cc"  // single-TU build: the store is one .cc by design
+
+namespace {
+
+void fill_id(uint8_t* id, int thread_i, int obj_i) {
+  std::memset(id, 0, 16);
+  std::memcpy(id, &thread_i, sizeof(int));
+  std::memcpy(id + 4, &obj_i, sizeof(int));
+}
+
+std::atomic<int> failures{0};
+
+void worker(void* h, int thread_i, int n_objs, int rounds) {
+  uint8_t id[16];
+  for (int r = 0; r < rounds; ++r) {
+    for (int i = 0; i < n_objs; ++i) {
+      fill_id(id, thread_i, i);
+      uint64_t off = os_create(h, id, 4096 + (i % 7) * 1024);
+      if (off == 0 || off == UINT64_MAX) continue;  // full or duplicate
+      auto* base = reinterpret_cast<uint8_t*>(
+          reinterpret_cast<Handle*>(h)->base);
+      std::memset(base + off, thread_i & 0xff, 4096);
+      if (os_seal(h, id) != 0) failures.fetch_add(1);
+    }
+    for (int i = 0; i < n_objs; ++i) {
+      fill_id(id, thread_i, i);
+      uint64_t off = 0, size = 0;
+      if (os_get(h, id, 0, &off, &size) == 0) {
+        auto* base = reinterpret_cast<uint8_t*>(
+            reinterpret_cast<Handle*>(h)->base);
+        volatile uint8_t sink = base[off];  // touch payload
+        (void)sink;
+        os_release(h, id);
+      }
+      if (i % 3 == 0) {
+        os_delete(h, id);
+      }
+    }
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const char* path = argc > 1 ? argv[1] : "/dev/shm/rtpu_stress";
+  int n_threads = argc > 2 ? std::atoi(argv[2]) : 8;
+  int rounds = argc > 3 ? std::atoi(argv[3]) : 20;
+  ::unlink(path);
+  // small store -> constant eviction + free-list churn
+  void* h = os_store_create(path, 1 << 20, 4096);
+  if (h == nullptr) {
+    std::fprintf(stderr, "store create failed\n");
+    return 2;
+  }
+  std::vector<std::thread> threads;
+  for (int t = 0; t < n_threads; ++t) {
+    threads.emplace_back(worker, h, t, 64, rounds);
+  }
+  for (auto& th : threads) th.join();
+  std::printf("stress done: seal_failures=%d objects=%llu in_use=%llu "
+              "evictions=%llu\n",
+              failures.load(),
+              (unsigned long long)os_num_objects(h),
+              (unsigned long long)os_bytes_in_use(h),
+              (unsigned long long)os_evictions(h));
+  os_store_close(h);
+  ::unlink(path);
+  return 0;
+}

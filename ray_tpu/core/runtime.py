@@ -566,6 +566,12 @@ class Runtime:
         elif t == "cancel":
             self.cancel(ObjectRef(ObjectID(msg["oid"])),
                         force=msg.get("force", False))
+        elif t == "device_fetch":
+            # device-object payload request (experimental/device_objects):
+            # route to the owner process; serving may serialize a large
+            # array, so keep it off this recv loop
+            self._rpc_pool.submit(self.device_fetch, msg["owner"],
+                                  msg["key"], msg["reply_oid"])
         elif t == "rpc":
             # Handled off-thread: rpcs like pg_wait block, and this recv loop
             # must keep draining the worker's other messages. A shared pool
@@ -668,6 +674,20 @@ class Runtime:
 
     def kv_keys(self) -> list[str]:
         return self.kv.keys("user")
+
+    def device_fetch(self, owner: str, key: str, reply_oid: bytes) -> None:
+        """Route a device-object fetch to its owner process
+        (experimental/device_objects.py; RDT transfer-request analog)."""
+        from ..experimental.device_objects import _serve_fetch
+        if owner == "driver":
+            _serve_fetch(self.store, key, reply_oid)
+            return
+        with self.lock:
+            w = self.workers.get(owner)
+        if w is None or w.state == "dead" or not w.send(
+                {"t": "device_get", "key": key, "reply_oid": reply_oid}):
+            self.store.put(ObjectID(reply_oid),
+                           ("err", f"device-object owner {owner} is gone"))
 
     def state_list(self, kind, limit=1000, filters=None):
         """State-API rows for workers/driver clients (util/state/api.py)."""
@@ -1646,9 +1666,13 @@ class Runtime:
     # ------------------------------------------------------------------ #
 
     def create_placement_group(self, bundles: list[dict[str, float]],
-                               strategy: str, name: str = "") -> PlacementGroupState:
-        pg = PlacementGroupState(PlacementGroupID.from_random(), bundles,
-                                 strategy, name)
+                               strategy: str, name: str = "",
+                               pg_id: PlacementGroupID | None = None,
+                               ) -> PlacementGroupState:
+        # pg_id is supplied on session restore so actor specs that
+        # reference the old group stay valid (gcs_store.restore)
+        pg = PlacementGroupState(pg_id or PlacementGroupID.from_random(),
+                                 bundles, strategy, name)
         with self.lock:
             self.pgs[pg.pg_id] = pg
             self._try_reserve_pg_locked(pg)

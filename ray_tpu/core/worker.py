@@ -430,8 +430,16 @@ class WorkerLoop:
     def _run_actor_task(self, spec: TaskSpec):
         t0 = time.time()
         try:
-            method = getattr(self.actor_instance, spec.method_name)
             args, kwargs = self._resolve_args(spec.args_blob)
+            if spec.method_name == "__rtpu_exec__":
+                # internal injection point: run an arbitrary function with
+                # the actor instance (compiled-DAG loops, debugging probes;
+                # reference analog: __ray_call__)
+                fn = cloudpickle.loads(args[0])
+                method = lambda *a, **kw: fn(self.actor_instance, *a, **kw)  # noqa: E731
+                args = args[1:]
+            else:
+                method = getattr(self.actor_instance, spec.method_name)
             if asyncio.iscoroutinefunction(method):
                 fut = asyncio.run_coroutine_threadsafe(
                     method(*args, **kwargs), self.aio_loop)
@@ -469,6 +477,13 @@ class WorkerLoop:
     def _exec_wrapper(self, fn, *a):
         self._exec_tid = threading.get_ident()
         fn(*a)
+
+    def _serve_device_get(self, msg: dict):
+        from ..experimental.device_objects import _serve_fetch
+        try:
+            _serve_fetch(self.store, msg["key"], msg["reply_oid"])
+        except Exception:
+            traceback.print_exc()
 
     def _apply_renv(self, msg: dict):
         from . import runtime_env as renv_mod
@@ -519,6 +534,12 @@ class WorkerLoop:
                 else:
                     pool.submit(self._exec_wrapper, self._run_actor_task,
                                 msg["spec"])
+            elif t == "device_get":
+                # serve a device-object fetch; serialization can be large,
+                # keep the recv loop free
+                threading.Thread(
+                    target=self._serve_device_get, args=(msg,),
+                    daemon=True).start()
             elif t == "cancel":
                 self._cancel_current(msg["task_id"])
             elif t == "exit":

@@ -1,0 +1,31 @@
+"""ray_tpu.dag — compiled graphs (aDAG analog).
+
+Reference parity: ray compiled graphs (python/ray/dag/compiled_dag_node.py:808
+CompiledDAG, schedule generation dag_node_operation.py:686, shared-memory
+channels experimental/channel/shared_memory_channel.py over the C++ mutable
+objects, experimental_mutable_object_manager.h:44).
+
+TPU-first redesign: the reference compiles DAGs to avoid per-call task
+overhead for GPU pipelines; here the same is achieved with
+**consume-once shm channels**: every DAG edge gets a ring of fixed object
+ids (one per in-flight slot), producers write a slot's object, consumers
+block-read then DELETE it (delete-then-recreate is the reuse protocol —
+objects stay immutable, matching the store's contract, where the reference
+needed a special mutable-object type with reader/writer semaphores).
+Each participating actor runs a compiled loop (installed via the internal
+``__rtpu_exec__`` injection) that steps its nodes in topological order;
+after compile, ``execute()`` never touches the head scheduler — the
+driver writes input channels and reads output channels directly.
+
+    with InputNode() as inp:
+        x = preproc.step.bind(inp)
+        out = trainer.step.bind(x)
+    cdag = out.experimental_compile(max_inflight=2)
+    for batch in data:
+        print(cdag.execute(batch).get())
+    cdag.teardown()
+"""
+from .compiled import CompiledDAG, CompiledDAGRef
+from .nodes import ClassMethodNode, InputNode
+
+__all__ = ["InputNode", "ClassMethodNode", "CompiledDAG", "CompiledDAGRef"]

@@ -1,0 +1,220 @@
+"""Compiled DAG execution (reference: dag/compiled_dag_node.py:808).
+
+See package docstring for the channel protocol. Compilation:
+
+1. topo-sort the graph; group ClassMethodNodes by owning actor;
+2. allocate channel id rings per cross-process edge (deterministic ids:
+   sha1(dag_id, producer, consumer) + slot byte);
+3. install one `_dag_actor_loop` per actor via `handle._exec` — a
+   long-running actor task stepping that actor's nodes in topo order
+   (same-actor edges pass values in-process, no shm hop);
+4. `execute()` writes the input channels and returns a CompiledDAGRef
+   over the output channel; the ring bounds in-flight executions
+   (auto-draining the oldest when full).
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import time
+from typing import Any, Optional
+
+from ..core.ids import ObjectID
+from .nodes import ClassMethodNode, DAGNode, InputNode
+
+_STOP = "__rtpu_dag_stop__"
+
+
+def _slot_oid(base: bytes, slot: int) -> ObjectID:
+    return ObjectID(base[:-1] + bytes([slot]))
+
+
+def _read_channel(store, oid: ObjectID, stop_oid: ObjectID,
+                  timeout_s: Optional[float] = None):
+    """Blocking consume-once read: wait for the object, read, DELETE.
+    Returns _STOP if the stop flag appears while waiting."""
+    from ..core.object_store import GetTimeoutError
+    deadline = None if timeout_s is None else time.monotonic() + timeout_s
+    while True:
+        try:
+            val = store.get(oid, timeout_ms=100)
+            store.delete(oid)
+            return val
+        except GetTimeoutError:
+            if store.contains(stop_oid):
+                return _STOP
+            if deadline is not None and time.monotonic() > deadline:
+                raise
+
+
+def _dag_actor_loop(instance, plan: list, stop_hex: str, max_inflight: int):
+    """Installed in each participating actor (via __rtpu_exec__): steps
+    this actor's nodes forever until the stop flag object appears."""
+    from ..core import runtime as rt_mod
+    rt = rt_mod.get_runtime_if_exists()
+    store = rt.store
+    stop_oid = ObjectID(bytes.fromhex(stop_hex))
+    seq = 0
+    while True:
+        slot = seq % max_inflight
+        local: dict[int, Any] = {}
+        for step in plan:
+            if store.contains(stop_oid):
+                return seq
+            args = []
+            for kind, val in step["args"]:
+                if kind == "const":
+                    args.append(val)
+                elif kind == "local":
+                    args.append(local[val])
+                else:  # chan
+                    v = _read_channel(store, _slot_oid(val, slot), stop_oid)
+                    if v is _STOP:
+                        return seq
+                    args.append(v)
+            out = getattr(instance, step["method"])(*args)
+            local[step["idx"]] = out
+            for base in step["out_chans"]:
+                store.put(_slot_oid(base, slot), out)
+        seq += 1
+
+
+class CompiledDAGRef:
+    """Result handle for one execute() (reference: CompiledDAGRef).
+    get() consumes the output channel; repeated get() returns the cache."""
+
+    def __init__(self, store, oid: ObjectID, stop_oid: ObjectID):
+        self._store = store
+        self._oid = oid
+        self._stop = stop_oid
+        self._value: Any = None
+        self._consumed = False
+
+    def get(self, timeout_s: Optional[float] = 60.0):
+        if not self._consumed:
+            v = _read_channel(self._store, self._oid, self._stop, timeout_s)
+            if v is _STOP:
+                raise RuntimeError("compiled DAG was torn down")
+            self._value = v
+            self._consumed = True
+        return self._value
+
+
+class CompiledDAG:
+    def __init__(self, output_node: DAGNode, max_inflight: int = 2):
+        import ray_tpu
+        from ..core import runtime as rt_mod
+        self._rt = rt_mod.get_runtime_if_exists()
+        if self._rt is None:
+            raise RuntimeError("ray_tpu.init() first")
+        self.store = self._rt.store
+        self.max_inflight = max_inflight
+        self.dag_id = os.urandom(8)
+        self._seq = 0
+        self._outstanding: list[CompiledDAGRef] = []
+        stop_digest = hashlib.sha1(self.dag_id + b"stop").digest()
+        self.stop_oid = ObjectID(stop_digest[:ObjectID.SIZE])
+        self._torn_down = False
+
+        # ---- topo order (args before node) --------------------------- #
+        order: list[ClassMethodNode] = []
+        seen: dict[int, int] = {}
+
+        def visit(n):
+            if isinstance(n, InputNode):
+                return
+            if not isinstance(n, ClassMethodNode):
+                return
+            if id(n) in seen:
+                return
+            for a in n.args:
+                visit(a)
+            seen[id(n)] = len(order)
+            order.append(n)
+
+        visit(output_node)
+        if not order:
+            raise ValueError("DAG has no actor-method nodes")
+        self.output_node = order[-1]
+        if output_node is not self.output_node:
+            raise ValueError("compile from the DAG's final node")
+
+        # ---- channels -------------------------------------------------- #
+        def chan_base(tag: str) -> bytes:
+            return hashlib.sha1(self.dag_id + tag.encode()).digest()[
+                :ObjectID.SIZE]
+
+        self.input_chans: list[bytes] = []
+        self.output_chan = chan_base("out")
+        # per-actor plans
+        plans: dict[bytes, list] = {}
+        actors: dict[bytes, Any] = {}
+        node_actor = {}
+        for idx, n in enumerate(order):
+            aid = n.actor._actor_id.binary()
+            actors[aid] = n.actor
+            node_actor[id(n)] = aid
+            step = {"idx": idx, "method": n.method_name, "args": [],
+                    "out_chans": []}
+            for a in n.args:
+                if isinstance(a, InputNode):
+                    base = chan_base(f"in->{idx}")
+                    self.input_chans.append(base)
+                    step["args"].append(("chan", base))
+                elif isinstance(a, ClassMethodNode):
+                    src_idx = seen[id(a)]
+                    if node_actor[id(a)] == aid:
+                        step["args"].append(("local", src_idx))
+                    else:
+                        base = chan_base(f"{src_idx}->{idx}")
+                        # producer writes this channel
+                        for s in plans[node_actor[id(a)]]:
+                            if s["idx"] == src_idx:
+                                s["out_chans"].append(base)
+                        step["args"].append(("chan", base))
+                else:
+                    step["args"].append(("const", a))
+            plans.setdefault(aid, []).append(step)
+        # final node also writes the driver-facing output channel
+        out_aid = node_actor[id(self.output_node)]
+        for s in plans[out_aid]:
+            if s["idx"] == seen[id(self.output_node)]:
+                s["out_chans"].append(self.output_chan)
+
+        # ---- install loops -------------------------------------------- #
+        self._loop_refs = []
+        for aid, plan in plans.items():
+            self._loop_refs.append(actors[aid]._exec(
+                _dag_actor_loop, plan, self.stop_oid.hex(), max_inflight))
+
+    # ------------------------------------------------------------------- #
+
+    def execute(self, value: Any) -> CompiledDAGRef:
+        if self._torn_down:
+            raise RuntimeError("DAG is torn down")
+        if len(self._outstanding) >= self.max_inflight:
+            # ring full: auto-drain the oldest so slots recycle
+            self._outstanding.pop(0).get()
+        slot = self._seq % self.max_inflight
+        self._seq += 1
+        for base in self.input_chans:
+            self.store.put(_slot_oid(base, slot), value)
+        ref = CompiledDAGRef(self.store, _slot_oid(self.output_chan, slot),
+                             self.stop_oid)
+        self._outstanding.append(ref)
+        return ref
+
+    def teardown(self, timeout_s: float = 30.0):
+        if self._torn_down:
+            return
+        self._torn_down = True
+        self.store.put(self.stop_oid, True)
+        import ray_tpu
+        try:
+            ray_tpu.get(self._loop_refs, timeout=timeout_s)
+        except Exception:
+            pass
+        try:
+            self.store.delete(self.stop_oid)
+        except Exception:
+            pass

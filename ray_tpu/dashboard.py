@@ -30,7 +30,7 @@ _PAGE = """<!DOCTYPE html>
 <style>
  body { font-family: system-ui, sans-serif; margin: 2em; background: #fafafa; }
  h1 { font-size: 1.3em; } h2 { font-size: 1.05em; margin-top: 1.4em; }
- table { border-collapse: collapse; width: 100%%; background: #fff; }
+ table { border-collapse: collapse; width: 100%; background: #fff; }
  th, td { border: 1px solid #ddd; padding: 4px 8px; font-size: 0.85em;
           text-align: left; }
  th { background: #f0f0f0; }

@@ -157,8 +157,15 @@ def _sample_block(block, key, n):
 
 def _stable_hash(x) -> int:
     # Python's str hash is per-process randomized (PYTHONHASHSEED); block
-    # tasks run in different workers, so partitioning must use a stable hash
+    # tasks run in different workers, so partitioning must use a stable
+    # hash. Numpy scalars normalize to Python values first: repr is dtype-
+    # tagged (np.int64(5) vs np.int32(5)), and a join across sides with
+    # different key widths must co-partition equal values.
     import zlib
+    if isinstance(x, tuple):
+        x = tuple(v.item() if hasattr(v, "item") else v for v in x)
+    elif hasattr(x, "item"):
+        x = x.item()
     return zlib.crc32(repr(x).encode())
 
 
@@ -188,7 +195,7 @@ def _hash_partition_multi(block, keys, n_out):
                  for i in range(n_out))
 
 
-def _join_partition(keys, how, lschema_names, rschema_names, n_left, *parts):
+def _join_partition(keys, how, n_left, *parts):
     """Reduce side of a hash join: pandas merge of one co-partition."""
     import pandas as pd
 
@@ -435,8 +442,7 @@ class Executor:
         for j in range(n_out):
             lcol = [lparts[i][j] for i in range(len(lparts))]
             rcol = [rparts[i][j] for i in range(len(rparts))]
-            out.append(joiner.remote(keys, how, None, None, len(lcol),
-                                     *lcol, *rcol))
+            out.append(joiner.remote(keys, how, len(lcol), *lcol, *rcol))
         return self._resolve(out)
 
     def _limit(self, upstream, n: int):

@@ -1,0 +1,4 @@
+"""ray_tpu.experimental — device objects (Ray Direct Transport analog)."""
+from .device_objects import DeviceObject, device_object_stats
+
+__all__ = ["DeviceObject", "device_object_stats"]

@@ -1,0 +1,148 @@
+"""Device objects: values whose payload stays on the accelerator.
+
+Reference parity: "Ray Direct Transport" / GPU objects
+(_private/gpu_object_manager.py:41 GPUObjectManager,
+@ray.method(tensor_transport=...)) — ObjectRefs whose tensor payload
+stays in device memory and moves via collective transports instead of
+plasma.
+
+TPU-first reduction: each worker process owns a device-object registry;
+``DeviceObject.wrap(x)`` records the jax.Array there and what travels
+through the object store is a tiny stub (owner wid + key + aval). A
+consumer in the SAME process gets the original array back with zero
+copies or transfers; a consumer elsewhere fetches the host representation
+from the owner over the control plane and re-places it on its own device.
+On a multi-host pod the cross-process path is where an ICI/DCN collective
+transport slots in (jax.experimental transfer — the single-chip image has
+no second device to exercise it, so host relay is the fallback the way
+the reference falls back to object-store copies for non-NCCL-able pairs).
+
+    @ray_tpu.remote
+    class Producer:
+        def make(self):
+            return DeviceObject.wrap(jnp.ones((1024, 1024)))
+
+    obj = ray_tpu.get(p.make.remote())   # a stub — no device transfer yet
+    x = obj.to_device()                  # local hit or owner fetch
+"""
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Any, Optional
+
+_registry: dict[str, Any] = {}
+_lock = threading.Lock()
+_stats = {"wrapped": 0, "local_hits": 0, "remote_fetches": 0,
+          "released": 0}
+_MAX_ENTRIES = 256
+
+
+def _my_wid() -> str:
+    from ..core import runtime as rt_mod
+    rt = rt_mod.get_runtime_if_exists()
+    wid = getattr(rt, "wid", None)
+    return wid if wid is not None else "driver"
+
+
+def device_object_stats() -> dict:
+    with _lock:
+        return dict(_stats, registered=len(_registry))
+
+
+class DeviceObject:
+    """Pickles as (owner, key, aval); the array never rides the pickle."""
+
+    def __init__(self, owner: str, key: str, shape, dtype):
+        self.owner = owner
+        self.key = key
+        self.shape = shape
+        self.dtype = dtype
+
+    # -- producer ------------------------------------------------------- #
+
+    @classmethod
+    def wrap(cls, array) -> "DeviceObject":
+        key = uuid.uuid4().hex
+        with _lock:
+            if len(_registry) >= _MAX_ENTRIES:
+                raise RuntimeError(
+                    f"device-object registry full ({_MAX_ENTRIES}); "
+                    f"release() finished objects")
+            _registry[key] = array
+            _stats["wrapped"] += 1
+        return cls(_my_wid(), key, tuple(array.shape), str(array.dtype))
+
+    # -- consumer ------------------------------------------------------- #
+
+    def to_device(self, timeout_s: float = 60.0):
+        """The array: zero-copy when this process owns it, owner fetch +
+        device_put otherwise."""
+        with _lock:
+            arr = _registry.get(self.key)
+        if arr is not None:
+            with _lock:
+                _stats["local_hits"] += 1
+            return arr
+        host = self._fetch_host(timeout_s)
+        import jax
+        arr = jax.device_put(host)
+        with _lock:
+            _stats["remote_fetches"] += 1
+        return arr
+
+    def _fetch_host(self, timeout_s: float):
+        from ..core import runtime as rt_mod
+        from ..core.ids import ObjectID
+        rt = rt_mod.get_runtime_if_exists()
+        if rt is None:
+            raise RuntimeError("ray_tpu.init() first")
+        reply = ObjectID.from_random()
+        if hasattr(rt, "_rpc"):      # worker / driver client
+            rt.send({"t": "device_fetch", "owner": self.owner,
+                     "key": self.key, "reply_oid": reply.binary()})
+        else:                        # head driver
+            rt.device_fetch(self.owner, self.key, reply.binary())
+        import time as _time
+        from ..core.object_store import GetTimeoutError
+        deadline = _time.monotonic() + timeout_s
+        while True:
+            try:
+                status, payload = rt.store.get(reply, timeout_ms=200)
+                break
+            except GetTimeoutError:
+                if _time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"device object fetch from {self.owner} timed out")
+        rt.store.delete(reply)
+        if status == "err":
+            raise RuntimeError(payload)
+        return payload
+
+    def release(self) -> bool:
+        """Drop the owner-side registration (owner process only)."""
+        with _lock:
+            hit = _registry.pop(self.key, None)
+            if hit is not None:
+                _stats["released"] += 1
+            return hit is not None
+
+    def __repr__(self):
+        return (f"DeviceObject(owner={self.owner}, shape={self.shape}, "
+                f"dtype={self.dtype})")
+
+
+def _serve_fetch(store, key: str, reply_oid_bytes: bytes) -> None:
+    """Owner-side: answer a device_fetch by writing the HOST copy of the
+    array into the store at the caller-chosen reply oid."""
+    import numpy as np
+
+    from ..core.ids import ObjectID
+    with _lock:
+        arr = _registry.get(key)
+    oid = ObjectID(reply_oid_bytes)
+    if arr is None:
+        store.put(oid, ("err", f"device object {key!r} not registered "
+                               f"(released or evicted)"))
+    else:
+        store.put(oid, ("ok", np.asarray(arr)))

@@ -24,6 +24,7 @@ from .tokenizer import ByteTokenizer, get_tokenizer
 
 __all__ = ["EngineConfig", "InferenceEngine", "SamplingParams",
            "PagedEngineConfig", "PagedInferenceEngine",
-           "ByteTokenizer", "get_tokenizer", "serving", "batch"]
+           "ByteTokenizer", "get_tokenizer", "serving", "batch", "lora",
+           "openai_api"]
 
-from . import serving, batch  # noqa: E402
+from . import serving, batch, lora, openai_api  # noqa: E402

@@ -27,12 +27,19 @@ class LLMConfig:
 
     `engine` may be an EngineConfig (dense slot cache) or a
     PagedEngineConfig (paged-KV continuous batching — the production path);
-    the default is paged."""
+    the default is paged.
+
+    LoRA: ``lora_dir`` holds ``<adapter_id>.npz`` adapters (llm/lora.py
+    format); a request carrying ``"lora": "<id>"`` (or
+    ``model="<model_id>:<id>"``) runs on an engine built from the merged
+    weights, cached per replica up to ``max_loras`` (LRU)."""
     model_id: str = "llama-tiny"
     engine: Optional[EngineConfig | PagedEngineConfig] = None
     num_replicas: int = 1
     max_ongoing_requests: int = 64
     tpus_per_replica: float = 0.0
+    lora_dir: Optional[str] = None
+    max_loras: int = 2
 
 
 class LLMServer:
@@ -40,60 +47,128 @@ class LLMServer:
     (reference: llm_server.py:409)."""
 
     def __init__(self, cfg: LLMConfig, params_ref=None):
+        from collections import OrderedDict
+
         from ..models import llama
-        engine_cfg = cfg.engine or PagedEngineConfig(
+        self.cfg = cfg
+        self.engine_cfg = cfg.engine or PagedEngineConfig(
             model=llama.llama_tiny())
         params = None
         if params_ref is not None:
             import ray_tpu
             params = ray_tpu.get(params_ref)
-        if isinstance(engine_cfg, PagedEngineConfig):
-            self.engine = PagedInferenceEngine(engine_cfg, params)
-        else:
-            self.engine = InferenceEngine(engine_cfg, params)
+        self.engine = self._build_engine(params)
+        self.base_params = self.engine.params
         self.model_id = cfg.model_id
+        # adapter-id -> engine over merged weights (lora.py docstring);
+        # OrderedDict is the LRU. _lora_lock guards every mutation AND the
+        # loop's snapshot: request threads (max_concurrency) race the
+        # engine thread here
+        self._lora_engines: "OrderedDict[str, Any]" = OrderedDict()
+        self._lora_lock = threading.Lock()
         self._wake = threading.Event()
         self._stop = False
         self._error: Optional[BaseException] = None
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
+    def _build_engine(self, params):
+        if isinstance(self.engine_cfg, PagedEngineConfig):
+            return PagedInferenceEngine(self.engine_cfg, params)
+        return InferenceEngine(self.engine_cfg, params)
+
+    def _engines(self):
+        with self._lora_lock:
+            return [self.engine, *self._lora_engines.values()]
+
+    def _engine_for(self, request: dict):
+        """Pick the engine for a request's LoRA id (None -> base)."""
+        lora_id = request.get("lora")
+        model = request.get("model", "")
+        if not lora_id and ":" in model:
+            lora_id = model.split(":", 1)[1]
+        if not lora_id:
+            return self.engine
+        with self._lora_lock:
+            eng = self._lora_engines.get(lora_id)
+            if eng is not None:
+                self._lora_engines.move_to_end(lora_id)
+                return eng
+        if not self.cfg.lora_dir:
+            raise ValueError(
+                f"request names LoRA {lora_id!r} but this deployment has "
+                f"no lora_dir configured")
+        import os
+
+        from . import lora
+        path = os.path.join(self.cfg.lora_dir, lora_id)
+        adapter = lora.load_adapter(path)
+        merged = lora.merge(self.base_params, adapter)
+        eng = self._build_engine(merged)
+        with self._lora_lock:
+            raced = self._lora_engines.get(lora_id)
+            if raced is not None:  # another thread built it concurrently
+                return raced
+            self._lora_engines[lora_id] = eng
+            # evict only IDLE engines: evicting one with in-flight
+            # requests would orphan them (their events never fire); if
+            # everything is busy, temporarily exceed the cap and retry on
+            # the next load
+            if len(self._lora_engines) > self.cfg.max_loras:
+                for lid in list(self._lora_engines):
+                    if lid == lora_id:
+                        continue
+                    if not self._lora_engines[lid].has_work():
+                        del self._lora_engines[lid]  # KV pool freed
+                        if len(self._lora_engines) <= self.cfg.max_loras:
+                            break
+        return eng
+
     def _loop(self):
         try:
             while not self._stop:
-                if self.engine.has_work():
-                    self.engine.step()
-                else:
+                worked = False
+                for eng in self._engines():
+                    if eng.has_work():
+                        eng.step()
+                        worked = True
+                if not worked:
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
         except BaseException as e:  # noqa: BLE001 — engine died: fail fast
             self._error = e
             # unblock every waiter; completions() re-raises the error, and
             # check_health makes the controller replace this replica
-            for req in (list(self.engine._active.values())
-                        + list(self.engine._pending)
-                        + list(getattr(self.engine, "_prefilling", []))):
-                req.event.set()
+            for eng in self._engines():
+                for req in (list(eng._active.values())
+                            + list(eng._pending)
+                            + list(getattr(eng, "_prefilling", []))):
+                    req.event.set()
 
     # -- OpenAI-ish surface ------------------------------------------------
 
-    def completions(self, request: dict) -> dict:
-        """{"prompt": str, "max_tokens": int, "temperature": float, ...}
-        -> completions response."""
+    def _submit(self, request: dict):
         prompt = request.get("prompt", "")
         sp = SamplingParams(
             max_tokens=int(request.get("max_tokens", 64)),
             temperature=float(request.get("temperature", 0.0)),
             top_k=int(request.get("top_k", 0)),
         )
-        req = self.engine.submit(prompt, sp)
+        eng = self._engine_for(request)
+        req = eng.submit(prompt, sp)
         self._wake.set()
+        return eng, req
+
+    def completions(self, request: dict) -> dict:
+        """{"prompt": str, "max_tokens": int, "temperature": float,
+        "lora": str, ...} -> completions response."""
+        eng, req = self._submit(request)
         while not req.event.wait(timeout=1.0):
             if self._error is not None:
                 raise RuntimeError("llm engine loop died") from self._error
         if self._error is not None and not req.done:
             raise RuntimeError("llm engine loop died") from self._error
-        out = self.engine._result(req)
+        out = eng._result(req)
         return {
             "object": "text_completion",
             "model": self.model_id,
@@ -107,6 +182,39 @@ class LLMServer:
                 "completion_tokens": len(out["token_ids"]),
             },
         }
+
+    def completions_stream(self, request: dict):
+        """Generator of token-delta dicts while the engine decodes
+        (reference: the streaming response path of llm_server.py; pairs
+        with handle.options(stream=True) / the SSE proxy path)."""
+        import time as _time
+        eng, req = self._submit(request)
+        sent = 0
+        last_text = ""
+        while True:
+            if self._error is not None and not req.done:
+                raise RuntimeError("llm engine loop died") from self._error
+            n = len(req.out_ids)
+            if n > sent:
+                text = eng.tokenizer.decode(list(req.out_ids))
+                delta, last_text = text[len(last_text):], text
+                sent = n
+                if delta:
+                    yield {"object": "text_completion.chunk",
+                           "model": self.model_id,
+                           "choices": [{"text": delta, "index": 0,
+                                        "finish_reason": None}]}
+            if req.done:
+                break
+            req.event.wait(timeout=0.02)
+        out = eng._result(req)
+        tail = out["text"][len(last_text):]
+        yield {"object": "text_completion.chunk", "model": self.model_id,
+               "choices": [{"text": tail, "index": 0,
+                            "finish_reason": out["finish_reason"]}]}
+
+    def loaded_loras(self) -> list:
+        return list(self._lora_engines)
 
     def __call__(self, request: dict) -> dict:
         return self.completions(request or {})

@@ -67,7 +67,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref,          # blocks
                 o_ref, lse_ref,               # outputs
                 acc_ref, m_ref, l_ref,        # VMEM scratch (carried over k)
                 *, causal: bool, scale: float, block_q: int, block_k: int,
-                num_k_blocks: int):
+                num_k_blocks: int, kv_valid: int = 0):
     from jax.experimental import pallas as pl
 
     iq = pl.program_id(2)
@@ -79,6 +79,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref,          # blocks
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
+    # kv_valid > 0: sequences were padded to the block grid; padded k
+    # columns must not contribute (static mask — kv_valid is a trace-time
+    # constant)
+    pad_mask = kv_valid > 0  # static: pad columns exist in SOME block
+
     def _compute():
         q = q_ref[:, :]                                        # [BQ, D]
         k = k_ref[:, :]                                        # [BK, D]
@@ -86,18 +91,23 @@ def _fwd_kernel(q_ref, k_ref, v_ref,          # blocks
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale        # [BQ, BK]
-        if causal:
+        keep = None
+        if causal or pad_mask:
             q_pos = iq * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = ik * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            keep = (q_pos >= k_pos) if causal else None
+            if pad_mask:
+                inb = k_pos < kv_valid
+                keep = inb if keep is None else (keep & inb)
+            s = jnp.where(keep, s, NEG_INF)
         m_prev, l_prev = m_ref[:], l_ref[:]
         m_cur = jnp.max(s, axis=-1)[:, None]                   # [BQ, 1]
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)                                 # [BQ, BK]
-        if causal:
-            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        if keep is not None:
+            p = jnp.where(keep, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)                        # [BQ, 1]
         l_ref[:] = l_prev * alpha + jnp.sum(p, axis=-1)[:, None]
         m_ref[:] = m_new
@@ -122,6 +132,30 @@ def _fwd_kernel(q_ref, k_ref, v_ref,          # blocks
         lse_ref[:] = m_ref[:] + jnp.log(l)                     # [BQ, 1]
 
 
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _pad_to_blocks(q, k, v, block_q: int, block_k: int):
+    """Zero-pad seq dims to the kernel's block grid (q rows to 8-aligned
+    q blocks, k columns to 128-aligned k blocks — the TPU tile shapes the
+    s = q @ k.T [BQ, BK] intermediate needs). Padded k columns are masked
+    in the kernels via kv_valid; padded q rows are sliced off after."""
+    b, sq, h, d = q.shape
+    _, sk, kvh, _ = k.shape
+    block_q = min(block_q, _round_up(sq, 8))
+    block_k = min(block_k, _round_up(sk, 128))
+    sq_pad = _round_up(sq, block_q)
+    sk_pad = _round_up(sk, block_k)
+    if sq_pad != sq:
+        q = jnp.pad(q, ((0, 0), (0, sq_pad - sq), (0, 0), (0, 0)))
+    if sk_pad != sk:
+        pad = ((0, 0), (0, sk_pad - sk), (0, 0), (0, 0))
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+    return q, k, v, block_q, block_k, sq_pad, sk_pad
+
+
 def _flash_fwd(q, k, v, causal: bool, scale: float,
                block_q: int, block_k: int, interpret: bool):
     from jax.experimental import pallas as pl
@@ -130,16 +164,14 @@ def _flash_fwd(q, k, v, causal: bool, scale: float,
     b, sq, h, d = q.shape
     _, sk, kvh, _ = k.shape
     groups = h // kvh
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
-    if sq % block_q or sk % block_k:
-        raise ValueError(
-            f"seq lengths ({sq},{sk}) must divide blocks ({block_q},{block_k})")
-    nq, nk = sq // block_q, sk // block_k
+    q, k, v, block_q, block_k, sq_pad, sk_pad = _pad_to_blocks(
+        q, k, v, block_q, block_k)
+    nq, nk = sq_pad // block_q, sk_pad // block_k
 
     kernel = functools.partial(
         _fwd_kernel, causal=causal, scale=scale, block_q=block_q,
-        block_k=block_k, num_k_blocks=nk)
+        block_k=block_k, num_k_blocks=nk,
+        kv_valid=sk if sk_pad != sk else 0)
 
     # Kernel layout is [B, H, S, D] with batch/head block dims squeezed
     # (None), so every ref is 2-D and the (8, 128)-tiling constraint falls
@@ -179,7 +211,12 @@ def _flash_fwd(q, k, v, causal: bool, scale: float,
         ],
         interpret=interpret,
     )(qt, kt, vt)
-    return jnp.swapaxes(out, 1, 2), lse[..., 0]
+    out = jnp.swapaxes(out, 1, 2)
+    lse = lse[..., 0]
+    if sq_pad != sq:
+        out = out[:, :sq]
+        lse = lse[:, :, :sq]
+    return out, lse
 
 
 # ---------------------------------------------------------------------------
@@ -201,7 +238,7 @@ def _flash_fwd(q, k, v, causal: bool, scale: float,
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, dq_acc_ref,
                    *, causal: bool, scale: float, block_q: int, block_k: int,
-                   num_k_blocks: int):
+                   num_k_blocks: int, kv_valid: int = 0):
     from jax.experimental import pallas as pl
 
     iq = pl.program_id(2)
@@ -210,6 +247,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     @pl.when(ik == 0)
     def _init():
         dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
+
+    pad_mask = kv_valid > 0  # static: pad columns exist in SOME block
 
     def _compute():
         q = q_ref[:, :]                                        # [BQ, D]
@@ -221,12 +260,16 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale        # [BQ, BK]
-        if causal:
+        if causal or pad_mask:
             q_pos = iq * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = ik * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            keep = (q_pos >= k_pos) if causal else None
+            if pad_mask:
+                inb = k_pos < kv_valid
+                keep = inb if keep is None else (keep & inb)
+            s = jnp.where(keep, s, NEG_INF)
         p = jnp.exp(s - lse)                                   # [BQ, BK]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -251,7 +294,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc_ref, dv_acc_ref,
                     *, causal: bool, scale: float, block_q: int, block_k: int,
-                    num_q_blocks: int):
+                    num_q_blocks: int, kv_valid: int = 0):
     from jax.experimental import pallas as pl
 
     ik = pl.program_id(2)
@@ -261,6 +304,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
+
+    pad_mask = kv_valid > 0  # static: pad columns exist in SOME block
 
     def _compute():
         q = q_ref[:, :]                                        # [BQ, D]
@@ -272,12 +317,16 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale        # [BQ, BK]
-        if causal:
+        if causal or pad_mask:
             q_pos = iq * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = ik * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            keep = (q_pos >= k_pos) if causal else None
+            if pad_mask:
+                inb = k_pos < kv_valid
+                keep = inb if keep is None else (keep & inb)
+            s = jnp.where(keep, s, NEG_INF)
         p = jnp.exp(s - lse)                                   # [BQ, BK]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -312,12 +361,17 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal: bool, scale: float,
     b, sq, h, d = q.shape
     _, sk, kvh, _ = k.shape
     groups = h // kvh
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
-    if sq % block_q or sk % block_k:
-        raise ValueError(
-            f"seq lengths ({sq},{sk}) must divide blocks ({block_q},{block_k})")
-    nq, nk = sq // block_q, sk // block_k
+    q, k, v, block_q, block_k, sq_pad, sk_pad = _pad_to_blocks(
+        q, k, v, block_q, block_k)
+    kv_valid = sk if sk_pad != sk else 0
+    if sq_pad != sq:
+        # padded q rows: zero grads; lse pad value is irrelevant (their
+        # p rows multiply a zero dO) but must be finite
+        pad_rows = ((0, 0), (0, sq_pad - sq), (0, 0), (0, 0))
+        out = jnp.pad(out, pad_rows)
+        g = jnp.pad(g, pad_rows)
+        lse = jnp.pad(lse, ((0, 0), (0, 0), (0, sq_pad - sq)))
+    nq, nk = sq_pad // block_q, sk_pad // block_k
 
     qt = jnp.swapaxes(q, 1, 2)                                 # [B,H,Sq,D]
     kt = jnp.swapaxes(k, 1, 2)                                 # [B,KVH,Sk,D]
@@ -337,7 +391,8 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal: bool, scale: float,
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal, scale=scale,
-                          block_q=block_q, block_k=block_k, num_k_blocks=nk),
+                          block_q=block_q, block_k=block_k, num_k_blocks=nk,
+                          kv_valid=kv_valid),
         grid=(b, h, nq, nk),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
         out_specs=[q_spec],
@@ -358,12 +413,13 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal: bool, scale: float,
 
     dk_h, dv_h = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale,
-                          block_q=block_q, block_k=block_k, num_q_blocks=nq),
+                          block_q=block_q, block_k=block_k, num_q_blocks=nq,
+                          kv_valid=kv_valid),
         grid=(b, h, nk, nq),
         in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2],
         out_specs=[dkv_out_spec, dkv_out_spec],
-        out_shape=[jax.ShapeDtypeStruct((b, h, sk, d), k.dtype),
-                   jax.ShapeDtypeStruct((b, h, sk, d), v.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((b, h, sk_pad, d), k.dtype),
+                   jax.ShapeDtypeStruct((b, h, sk_pad, d), v.dtype)],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
@@ -372,6 +428,11 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal: bool, scale: float,
     dq = jnp.swapaxes(dq, 1, 2)                                # [B,Sq,H,D]
     dk_h = jnp.swapaxes(dk_h, 1, 2)                            # [B,Sk,H,D]
     dv_h = jnp.swapaxes(dv_h, 1, 2)
+    if sq_pad != sq:
+        dq = dq[:, :sq]
+    if sk_pad != sk:
+        dk_h = dk_h[:, :sk]
+        dv_h = dv_h[:, :sk]
     if groups > 1:
         dk = dk_h.reshape(b, sk, kvh, groups, d).sum(axis=3)
         dv = dv_h.reshape(b, sk, kvh, groups, d).sum(axis=3)

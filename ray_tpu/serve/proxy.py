@@ -29,15 +29,17 @@ class ProxyActor:
         self._handles: "OrderedDict" = OrderedDict()
         self._handles_max = 256
 
-    def _handle_for(self, ingress, app_name, stream, model_id):
+    def _handle_for(self, ingress, app_name, stream, model_id,
+                    method="__call__"):
         from .handle import DeploymentHandle
         import ray_tpu
         from .api import CONTROLLER_NAME
-        key = (app_name, ingress, stream, model_id)
+        key = (app_name, ingress, stream, model_id, method)
         h = self._handles.get(key)
         if h is None:
             ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
-            h = DeploymentHandle(ingress, app_name, ctrl, stream=stream,
+            h = DeploymentHandle(ingress, app_name, ctrl, method,
+                                 stream=stream,
                                  multiplexed_model_id=model_id)
             self._handles[key] = h
             while len(self._handles) > self._handles_max:
@@ -64,6 +66,12 @@ class ProxyActor:
 
         path = request.match_info["tail"].strip("/")
         app_name = path.split("/", 1)[0] if path else "default"
+        # the rest of the path routes to an ingress METHOD: /llm/v1/chat/
+        # completions -> v1_chat_completions (reference: FastAPI ingress
+        # route decorators; here path segments map to method names)
+        subpath = path.split("/", 1)[1] if "/" in path else ""
+        method = subpath.strip("/").replace("/", "_").replace(
+            ".", "_").replace("-", "_") if subpath else "__call__"
         ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
         try:
             ingress = ray_tpu.get(ctrl.get_ingress.remote(app_name))
@@ -88,14 +96,18 @@ class ProxyActor:
                 payload = {"body": (await request.read()).decode(
                     errors="replace")}
 
-        # streaming ingress: ?stream=1 or Accept: text/event-stream
+        # streaming ingress: ?stream=1, Accept: text/event-stream, or an
+        # OpenAI-style {"stream": true} body field
         # (reference: proxy.py streams ASGI responses chunk by chunk)
         want_stream = (request.query.get("stream") in ("1", "true")
                        or "text/event-stream" in
-                       request.headers.get("Accept", ""))
+                       request.headers.get("Accept", "")
+                       or (isinstance(payload, dict)
+                           and payload.get("stream") is True))
         model_id = request.headers.get("serve_multiplexed_model_id", "")
 
-        handle = self._handle_for(ingress, app_name, want_stream, model_id)
+        handle = self._handle_for(ingress, app_name, want_stream, model_id,
+                                  method)
 
         def call():
             # handle.remote() itself may block (replica-set refresh, cold

@@ -1,0 +1,111 @@
+"""Compiled DAG tests (reference: python/ray/dag compiled graphs)."""
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode
+
+
+@pytest.fixture
+def ray(ray_start_regular):
+    return ray_start_regular
+
+
+def _actors(ray, n=2):
+    @ray.remote
+    class Stage:
+        def __init__(self, scale):
+            self.scale = scale
+            self.calls = 0
+
+        def step(self, x):
+            self.calls += 1
+            return x * self.scale
+
+        def add(self, a, b):
+            return a + b
+
+        def count(self):
+            return self.calls
+
+    return [Stage.remote(i + 2) for i in range(n)]
+
+
+def test_linear_pipeline(ray):
+    s1, s2 = _actors(ray)
+    with InputNode() as inp:
+        mid = s1.step.bind(inp)
+        out = s2.step.bind(mid)
+    cdag = out.experimental_compile(max_inflight=2)
+    try:
+        assert cdag.execute(5).get() == 5 * 2 * 3
+        assert cdag.execute(7).get() == 42
+        # pipelined: submit several before reading
+        refs = [cdag.execute(i) for i in range(2)]
+        assert [r.get() for r in refs] == [0, 6]
+    finally:
+        cdag.teardown()
+
+
+def test_fan_in(ray):
+    s1, s2 = _actors(ray)
+    with InputNode() as inp:
+        a = s1.step.bind(inp)          # x*2  on actor1
+        b = s2.step.bind(inp)          # x*3  on actor2
+        out = s2.add.bind(a, b)        # fan-in on actor2 (local edge b)
+    cdag = out.experimental_compile()
+    try:
+        assert cdag.execute(10).get() == 20 + 30
+        assert cdag.execute(1).get() == 5
+    finally:
+        cdag.teardown()
+
+
+def test_ring_auto_drains(ray):
+    (s1,) = _actors(ray, 1)
+    with InputNode() as inp:
+        out = s1.step.bind(inp)
+    cdag = out.experimental_compile(max_inflight=2)
+    try:
+        refs = [cdag.execute(i) for i in range(6)]  # > max_inflight
+        # earlier refs were auto-drained; all values correct
+        assert [r.get() for r in refs] == [i * 2 for i in range(6)]
+    finally:
+        cdag.teardown()
+
+
+def test_teardown_frees_actor(ray):
+    (s1,) = _actors(ray, 1)
+    with InputNode() as inp:
+        out = s1.step.bind(inp)
+    cdag = out.experimental_compile()
+    assert cdag.execute(3).get() == 6
+    cdag.teardown()
+    # the actor must serve normal calls again after teardown
+    assert ray.get(s1.count.remote(), timeout=60) == 1
+    assert ray.get(s1.step.remote(4), timeout=60) == 8
+
+
+def test_compiled_faster_than_remote_calls(ray):
+    """The point of compiling: repeated execution skips per-call task
+    submission. Not a strict benchmark — just a sanity margin."""
+    (s1,) = _actors(ray, 1)
+    n = 30
+    t0 = time.perf_counter()
+    for i in range(n):
+        ray.get(s1.step.remote(i), timeout=60)
+    remote_dt = time.perf_counter() - t0
+
+    with InputNode() as inp:
+        out = s1.step.bind(inp)
+    cdag = out.experimental_compile(max_inflight=2)
+    try:
+        cdag.execute(0).get()  # warm the loop
+        t0 = time.perf_counter()
+        for i in range(n):
+            cdag.execute(i).get()
+        dag_dt = time.perf_counter() - t0
+    finally:
+        cdag.teardown()
+    assert dag_dt < remote_dt * 1.5, (dag_dt, remote_dt)

@@ -98,3 +98,16 @@ def test_actor_pool_then_block_ops_fuse(ray):
           .map(lambda r: {"v": r["out"] * 2}))
     rows = sorted(r["v"] for r in ds.take_all())
     assert rows == [2 * v for v in range(1000, 1020, 2)]
+
+
+def test_join_mixed_key_dtypes(ray):
+    """int32 vs int64 key columns must co-partition equal values."""
+    import pandas as pd
+    data = _data()
+    left = data.from_pandas(pd.DataFrame(
+        {"id": np.arange(20, dtype=np.int64), "a": np.arange(20)}))
+    right = data.from_pandas(pd.DataFrame(
+        {"id": np.arange(10, 30, dtype=np.int32),
+         "b": np.arange(10, 30)}))
+    out = left.join(right, on="id", num_partitions=4).sort("id").take_all()
+    assert [r["id"] for r in out] == list(range(10, 20)), out

@@ -1,0 +1,91 @@
+"""Device-object (RDT analog) tests."""
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.experimental import DeviceObject, device_object_stats
+
+
+@pytest.fixture
+def ray(ray_start_regular):
+    return ray_start_regular
+
+
+def test_same_process_zero_copy(ray):
+    @ray.remote
+    class Owner:
+        def make(self):
+            import jax.numpy as jnp
+            self.arr = jnp.arange(16.0)
+            return DeviceObject.wrap(self.arr)
+
+        def same_object(self, obj):
+            # local hit must return the IDENTICAL array object
+            return obj.to_device() is self.arr
+
+    o = Owner.remote()
+    obj = ray.get(o.make.remote(), timeout=60)
+    assert obj.shape == (16,)
+    assert ray.get(o.same_object.remote(obj), timeout=60) is True
+
+
+def test_cross_process_fetch(ray):
+    @ray.remote
+    class Producer:
+        def make(self):
+            import jax.numpy as jnp
+            return DeviceObject.wrap(jnp.arange(8.0) * 3)
+
+    @ray.remote
+    class Consumer:
+        def total(self, obj):
+            x = obj.to_device()
+            return float(x.sum())
+
+    p = Producer.remote()
+    c = Consumer.remote()
+    obj = ray.get(p.make.remote(), timeout=60)
+    assert ray.get(c.total.remote(obj), timeout=60) == float(
+        np.arange(8.0).sum() * 3)
+
+
+def test_driver_owned_and_fetch_from_worker(ray):
+    import jax.numpy as jnp
+    obj = DeviceObject.wrap(jnp.ones((4, 4)))
+    try:
+        @ray.remote
+        def consume(o):
+            return float(o.to_device().sum())
+
+        assert ray.get(consume.remote(obj), timeout=60) == 16.0
+    finally:
+        assert obj.release() is True
+
+
+def test_released_object_fetch_errors(ray):
+    @ray.remote
+    class Producer:
+        def make_and_release(self):
+            import jax.numpy as jnp
+            o = DeviceObject.wrap(jnp.zeros(3))
+            o.release()
+            return o
+
+    @ray.remote
+    def consume(o):
+        o.to_device()
+
+    p = Producer.remote()
+    obj = ray.get(p.make_and_release.remote(), timeout=60)
+    with pytest.raises(Exception, match="not registered|released"):
+        ray.get(consume.remote(obj), timeout=60)
+
+
+def test_stats(ray):
+    import jax.numpy as jnp
+    before = device_object_stats()["wrapped"]
+    obj = DeviceObject.wrap(jnp.zeros(2))
+    assert device_object_stats()["wrapped"] == before + 1
+    assert obj.to_device() is not None
+    assert device_object_stats()["local_hits"] >= 1
+    obj.release()

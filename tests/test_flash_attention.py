@@ -79,3 +79,40 @@ def test_cpu_fallback_path():
     want = mha_reference(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("sq,sk", [(30, 30), (100, 100), (77, 140)])
+def test_flash_padded_seq_matches_reference(sq, sk):
+    """Non-block-divisible lengths run via the pad+mask path."""
+    rng = np.random.RandomState(3)
+    b, h, kvh, d = 2, 4, 2, 32
+    q = jnp.asarray(rng.randn(b, sq, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, sk, kvh, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, sk, kvh, d), jnp.float32)
+    causal = sq == sk
+    want = mha_reference(q, k, v, causal=causal, scale=d ** -0.5)
+    got = flash_attention(q, k, v, causal=causal, block_q=64, block_k=128,
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_flash_padded_grads_match_reference():
+    rng = np.random.RandomState(4)
+    b, s, h, kvh, d = 1, 30, 4, 2, 32
+    q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, s, kvh, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, s, kvh, d), jnp.float32)
+
+    def loss_flash(q, k, v):
+        return flash_attention(q, k, v, causal=True, block_q=16,
+                               block_k=128, interpret=True).sum()
+
+    def loss_ref(q, k, v):
+        return mha_reference(q, k, v, causal=True, scale=d ** -0.5).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=5e-3, atol=5e-3)

@@ -88,6 +88,8 @@ def test_head_restart_restores_state():
         assert info["restored"]["placement_groups"] == 1, info
         a = ray_tpu.get_actor("the-registry")
         assert ray_tpu.get(a.get_tag.remote(), timeout=60) == "persisted!"
+        # durable KV carries over
+        assert ray_tpu.kv_get("durable-key") == b"durable-value"
         ray_tpu.shutdown()
         print("SECOND_OK")
     """) % (session_dir,)

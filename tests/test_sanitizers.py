@@ -1,0 +1,40 @@
+"""TSAN/ASAN stress of the native object store (SURVEY §5.2 parity:
+the reference runs its C++ store tests under sanitizers in CI)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+NATIVE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "ray_tpu", "core", "native")
+
+
+def _build_and_run(sanitizer: str, tmp_path, threads=6, rounds=6):
+    exe = str(tmp_path / f"stress_{sanitizer}")
+    build = subprocess.run(
+        ["g++", f"-fsanitize={sanitizer}", "-O1", "-g", "-std=c++17",
+         os.path.join(NATIVE, "stress_test.cc"), "-o", exe, "-lpthread"],
+        capture_output=True, text=True, timeout=120)
+    if build.returncode != 0:
+        pytest.skip(f"{sanitizer} unavailable: {build.stderr[:200]}")
+    shm = f"/dev/shm/rtpu_stress_{sanitizer}_{os.getpid()}"
+    run = subprocess.run([exe, shm, str(threads), str(rounds)],
+                         capture_output=True, text=True, timeout=300)
+    assert run.returncode == 0, run.stdout + run.stderr
+    assert "stress done" in run.stdout
+    assert "seal_failures=0" in run.stdout
+    # sanitizers print WARNING/ERROR reports on stderr
+    assert "WARNING: ThreadSanitizer" not in run.stderr, run.stderr
+    assert "ERROR: AddressSanitizer" not in run.stderr, run.stderr
+    return run.stdout
+
+
+def test_objstore_under_asan(tmp_path):
+    out = _build_and_run("address", tmp_path)
+    assert "evictions=" in out
+
+
+def test_objstore_under_tsan(tmp_path):
+    out = _build_and_run("thread", tmp_path)
+    assert "evictions=" in out
