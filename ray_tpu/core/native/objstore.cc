@@ -319,6 +319,11 @@ extern "C" {
 // Create a new store region backing file at `path` with `capacity` bytes and
 // room for `max_entries` objects. Returns handle or nullptr.
 void* os_store_create(const char* path, uint64_t capacity, uint32_t max_entries) {
+  // the metadata (header + entry table) must FIT with heap to spare —
+  // otherwise the memsets below scribble past the mapping (segfault)
+  uint64_t meta = align8(sizeof(Header))
+      + align8((uint64_t)max_entries * sizeof(ObjEntry));
+  if (capacity < meta + (64 << 10)) return nullptr;
   int fd = open(path, O_RDWR | O_CREAT | O_TRUNC, 0600);
   if (fd < 0) return nullptr;
   if (ftruncate(fd, (off_t)capacity) != 0) { close(fd); return nullptr; }

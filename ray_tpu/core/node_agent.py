@@ -32,27 +32,58 @@ from multiprocessing.connection import Client
 
 class NodeAgent:
     def __init__(self, head: str, authkey: bytes, resources: dict,
-                 name: str = ""):
+                 name: str = "", own_store: bool = False,
+                 store_capacity: int = 1 << 30):
         host, port = head.rsplit(":", 1)
+        name = name or f"agent-{os.uname().nodename}"
         self.conn = Client((host, int(port)), authkey=authkey)
         self.head_host = host
         self.send_lock = threading.Lock()
+
+        # own-store mode: this node has its own shm store + spill dir +
+        # data server — the true multi-host shape (objects cross nodes via
+        # object_transfer pulls). Shared-store mode (default) requires the
+        # head's /dev/shm to be visible (same machine).
+        self.own_store = own_store
+        self.local_store = None
+        self.data_server = None
+        data_addr = None
+        if own_store:
+            from .object_store import SharedObjectStore, SpillStore
+            from .object_transfer import ObjectDataServer
+            from .runtime import host_ip
+            safe = "".join(c if c.isalnum() else "_" for c in name)
+            self._own_store_path = f"/dev/shm/rtpu_node_{safe}_{os.getpid()}"
+            self._own_spill_dir = f"/tmp/ray_tpu/node_{safe}_{os.getpid()}/spill"
+            self.local_store = SharedObjectStore(
+                self._own_store_path, capacity=store_capacity, create=True)
+            self.local_spill = SpillStore(self._own_spill_dir)
+            self.data_server = ObjectDataServer(
+                self.local_store, self.local_spill, host="0.0.0.0")
+            port_part = self.data_server.address.rsplit(":", 1)[1]
+            data_addr = f"{host_ip()}:{port_part}"
+
         self.conn.send({"t": "register_node", "resources": resources,
-                        "name": name or f"agent-{os.uname().nodename}"})
+                        "name": name, "own_store": own_store,
+                        "data_addr": data_addr})
         reply = self.conn.recv()
         if reply.get("t") != "registered":
             raise RuntimeError(f"head rejected registration: {reply}")
         self.node_id = reply["node_id"]
-        self.store_path = reply["store_path"]
-        self.spill_dir = reply.get("spill_dir", "")
+        if own_store:
+            self.store_path = self._own_store_path
+            self.spill_dir = self._own_spill_dir
+        else:
+            self.store_path = reply["store_path"]
+            self.spill_dir = reply.get("spill_dir", "")
+            if not os.path.exists(self.store_path):
+                raise RuntimeError(
+                    f"object store {self.store_path} is not visible from "
+                    f"this host; run with --own-store so objects move via "
+                    f"the transfer service")
         # the head never echoes the authkey; we authenticated with our copy
         self.authkey = authkey.hex()
         self.tcp_port = reply["tcp_port"]
-        if not os.path.exists(self.store_path):
-            raise RuntimeError(
-                f"object store {self.store_path} is not visible from this "
-                f"host; the DCN object transfer service is required for "
-                f"fully remote nodes")
         self.procs: dict[str, subprocess.Popen] = {}
 
     def send(self, msg):
@@ -67,7 +98,7 @@ class NodeAgent:
             head_addr=f"{self.head_host}:{self.tcp_port}",
             head_family="AF_INET", authkey_hex=self.authkey,
             wid=wid, node_id_hex=node_id, tpu=tpu,
-            spill_dir=self.spill_dir)
+            spill_dir=self.spill_dir, own_store=self.own_store)
         log_dir = os.environ.get("RTPU_AGENT_LOG_DIR", "/tmp/ray_tpu_agent")
         os.makedirs(log_dir, exist_ok=True)
         log = open(os.path.join(log_dir, f"worker-{wid}.log"), "wb")
@@ -101,6 +132,15 @@ class NodeAgent:
                         traceback.print_exc()
                         self.send({"t": "worker_exit", "wid": msg["wid"],
                                    "rc": -1})
+                elif t == "free_objects":
+                    if self.local_store is not None:
+                        from .ids import ObjectID
+                        for ob in msg["oids"]:
+                            try:
+                                self.local_store.delete(ObjectID(ob))
+                            except Exception:
+                                pass
+                            self.local_spill.delete(ObjectID(ob))
                 elif t == "kill_worker":
                     p = self.procs.get(msg["wid"])
                     if p is not None:
@@ -124,6 +164,10 @@ class NodeAgent:
                     p.wait(timeout=max(0.01, deadline - time.monotonic()))
                 except Exception:
                     pass
+            if self.data_server is not None:
+                self.data_server.stop()
+            if self.local_store is not None:
+                self.local_store.close(unlink=True)
 
 
 def main(argv=None):
@@ -135,10 +179,16 @@ def main(argv=None):
     ap.add_argument("--resources", default="{}",
                     help='extra resources JSON, e.g. \'{"TPU": 4}\'')
     ap.add_argument("--name", default="")
+    ap.add_argument("--own-store", action="store_true",
+                    help="node-local object store + transfer service "
+                         "(required off the head host)")
+    ap.add_argument("--store-capacity", type=int, default=1 << 30)
     args = ap.parse_args(argv)
     authkey = bytes.fromhex(args.authkey or os.environ["RTPU_AUTHKEY"])
     resources = {"CPU": args.num_cpus, **json.loads(args.resources)}
-    agent = NodeAgent(args.head, authkey, resources, args.name)
+    agent = NodeAgent(args.head, authkey, resources, args.name,
+                      own_store=args.own_store,
+                      store_capacity=args.store_capacity)
     print(f"node_agent: joined as node {agent.node_id}", flush=True)
     agent.run()
 

@@ -1,0 +1,204 @@
+"""Node-to-node object transfer: the cross-host data plane.
+
+Reference parity: src/ray/object_manager/object_manager.h:119 (Push :209 /
+Pull :217 — chunked object movement between per-node plasma stores over
+gRPC) + the ownership-based object directory locating copies.
+
+TPU-first reduction: one data server per node-local store serving whole
+frames over a raw TCP socket (objects move between HOSTS over DCN — the
+hot tensor path inside a slice is XLA collectives over ICI, so this
+service carries control-plane-adjacent payloads: task args/results,
+checkpoints, datasets blocks). A puller asks the head for locations
+(the directory tracks which node produced each object), dials the owner's
+data server, and writes the received frame into its LOCAL store — after
+which the object is served locally and the head records the new copy.
+
+Wire protocol (per request, connections are reused):
+  -> 16B object id
+  <- 8B little-endian frame length (0 = not here) + frame bytes
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Optional
+
+from .ids import ObjectID
+from .object_store import SharedObjectStore, SpillStore
+
+
+class ObjectDataServer:
+    """Serves frames out of a local store (+ its spill dir)."""
+
+    def __init__(self, store: SharedObjectStore,
+                 spill: Optional[SpillStore] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.store = store
+        self.spill = spill
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(32)
+        self.address = f"{host}:{self._sock.getsockname()[1]}"
+        self._stop = False
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="rtpu-objdata").start()
+
+    def _accept_loop(self):
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket):
+        try:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            while True:
+                oid_bytes = _recv_exact(conn, ObjectID.SIZE)
+                if oid_bytes is None:
+                    return
+                oid = ObjectID(oid_bytes)
+                view = None
+                try:
+                    view = self.store.get_raw(oid, timeout_ms=0)
+                    if view is not None:
+                        conn.sendall(struct.pack("<Q", len(view)))
+                        conn.sendall(view)
+                    elif self.spill is not None and self.spill.contains(oid):
+                        with open(self.spill._path(oid), "rb") as f:
+                            data = f.read()
+                        conn.sendall(struct.pack("<Q", len(data)))
+                        conn.sendall(data)
+                    else:
+                        conn.sendall(struct.pack("<Q", 0))
+                finally:
+                    if view is not None:
+                        del view
+                        self.store.release(oid)
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def stop(self):
+        self._stop = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+# per-process pool of puller connections, keyed by address (connections
+# are serially reused; pulls are infrequent enough that one socket per
+# peer is plenty)
+_conn_pool: dict[str, socket.socket] = {}
+_pool_lock = threading.Lock()
+
+
+def _recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_into_exact(conn: socket.socket, view: memoryview) -> bool:
+    got = 0
+    while got < len(view):
+        n = conn.recv_into(view[got:])
+        if n == 0:
+            return False
+        got += n
+    return True
+
+
+def fetch_object(addr: str, oid: ObjectID, local_store: SharedObjectStore,
+                 spill: Optional[SpillStore] = None,
+                 timeout_s: float = 30.0) -> bool:
+    """Pull one object from `addr` into the local store (spill fallback
+    when the local store can't hold it). Returns False if the peer does
+    not have the object; raises OSError on transport failure."""
+    with _pool_lock:
+        conn = _conn_pool.pop(addr, None)
+    try:
+        if conn is None:
+            host, port = addr.rsplit(":", 1)
+            conn = socket.create_connection((host, int(port)),
+                                            timeout=timeout_s)
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        conn.settimeout(timeout_s)
+        conn.sendall(oid.binary())
+        hdr = _recv_exact(conn, 8)
+        if hdr is None:
+            raise OSError("peer closed during fetch")
+        (length,) = struct.unpack("<Q", hdr)
+        if length == 0:
+            result = False
+        elif local_store.contains(oid):
+            _drain(conn, length)
+            result = True
+        else:
+            result = _receive_frame(conn, oid, length, local_store, spill)
+        # healthy exchange: keep the connection for the next pull
+        with _pool_lock:
+            if addr not in _conn_pool:
+                _conn_pool[addr] = conn
+                conn = None
+        return result
+    finally:
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+def _receive_frame(conn, oid, length, local_store, spill) -> bool:
+    from .object_store import ObjectStoreFullError
+    try:
+        buf = local_store.create_raw(oid, length)
+    except FileExistsError:
+        _drain(conn, length)
+        return True
+    except ObjectStoreFullError:
+        if spill is None:
+            raise
+        data = _recv_exact(conn, length)
+        if data is None:
+            raise OSError("peer closed mid-frame")
+        _write_spill_raw(spill, oid, data)
+        return True
+    ok = _recv_into_exact(conn, buf)
+    del buf
+    if not ok:
+        local_store.delete(oid)
+        raise OSError("peer closed mid-frame")
+    local_store.seal(oid)
+    return True
+
+
+def _drain(conn: socket.socket, n: int) -> None:
+    left = n
+    while left > 0:
+        chunk = conn.recv(min(65536, left))
+        if not chunk:
+            raise OSError("peer closed while draining")
+        left -= len(chunk)
+
+
+def _write_spill_raw(spill: SpillStore, oid: ObjectID, data: bytes) -> None:
+    import os
+    tmp = spill._path(oid) + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, spill._path(oid))

@@ -74,6 +74,11 @@ class NodeInfo:
         # set for agent-backed nodes (a node_agent process joined over TCP);
         # worker spawn/kill on this node routes through the agent
         self.agent: Optional["_AgentHandle"] = None
+        # cross-node data plane (object_transfer.py): the node's data-server
+        # address, and whether it runs its OWN store (no shared /dev/shm —
+        # objects move via fetch, RPC replies via the control conn)
+        self.data_addr: Optional[str] = None
+        self.own_store = False
         # allow one worker per CPU plus headroom for zero-cpu tasks
         self.max_workers = int(resources.get("CPU", 1)) + 4
 
@@ -129,7 +134,8 @@ def host_ip() -> str:
 
 def build_worker_env(*, store_path: str, head_addr: str, head_family: str,
                      authkey_hex: str, wid: str, node_id_hex: str,
-                     tpu: bool, spill_dir: str = "") -> dict:
+                     tpu: bool, spill_dir: str = "",
+                     own_store: bool = False) -> dict:
     """Environment for a `python -m ray_tpu.core.worker` process — the ONE
     definition shared by the head's local pool and node agents, so worker
     behavior cannot drift by host."""
@@ -146,6 +152,10 @@ def build_worker_env(*, store_path: str, head_addr: str, head_family: str,
     env["RTPU_STORE_PATH"] = store_path
     if spill_dir:
         env["RTPU_SPILL_DIR"] = spill_dir
+    if own_store:
+        # node-local store: object misses resolve via locate+fetch and RPC
+        # replies arrive over the control conn (object_transfer.py)
+        env["RTPU_OWN_STORE"] = "1"
     env["RTPU_HEAD_ADDR"] = head_addr
     if head_family != "AF_UNIX":
         env["RTPU_HEAD_FAMILY"] = head_family
@@ -222,12 +232,20 @@ class _ExternalProc:
 
 
 class DirEntry:
-    __slots__ = ("state", "lineage", "error_brief")
+    # `locations` (node-id hexes known to hold a copy) stays None on
+    # single-host clusters (object_transfer.py data plane)
+    __slots__ = ("state", "lineage", "error_brief", "locations")
 
     def __init__(self, state=PENDING, lineage: TaskSpec | None = None):
         self.state = state
         self.lineage = lineage
         self.error_brief: str | None = None
+        self.locations: set[str] | None = None
+
+    def add_location(self, node_hex: str) -> None:
+        if self.locations is None:
+            self.locations = set()
+        self.locations.add(node_hex)
 
 
 class ActorInfo:
@@ -395,6 +413,18 @@ class Runtime:
             "jobs", {"job_id": job_id, "status": status})
         self._driver_seq = 0
 
+        # cross-node data plane: serve this node's store to pullers
+        # (object_manager.h:119 Push/Pull analog; object_transfer.py)
+        from .object_transfer import ObjectDataServer
+        self.data_server = ObjectDataServer(
+            self.store, self.spill,
+            host=("0.0.0.0" if enable_remote_nodes else "127.0.0.1"))
+        if enable_remote_nodes:
+            self.head_node.data_addr = (
+                f"{host_ip()}:{self.data_server.address.rsplit(':', 1)[1]}")
+        else:
+            self.head_node.data_addr = self.data_server.address
+
         # prestart the worker pool so first tasks don't pay process cold-start
         # (reference: worker_pool.h:283 PrestartWorkers / idle pool)
         with self.lock:
@@ -513,7 +543,10 @@ class Runtime:
                 self.renv_registry.setdefault(msg["hash"], msg["blob"])
         elif t == "put":
             with self.lock:
-                self.directory[msg["oid"]] = DirEntry(READY)
+                e = self.directory[msg["oid"]] = DirEntry(READY)
+                w = self.workers.get(wid)
+                if w is not None:
+                    e.add_location(w.node_id.hex())
         elif t == "put_spilled":
             with self.lock:
                 oid = ObjectID(msg["oid"])
@@ -571,12 +604,16 @@ class Runtime:
             # route to the owner process; serving may serialize a large
             # array, so keep it off this recv loop
             self._rpc_pool.submit(self.device_fetch, msg["owner"],
-                                  msg["key"], msg["reply_oid"])
+                                  msg["key"], msg["reply_oid"], wid)
+        elif t == "device_payload":
+            # owner's answer to a device_fetch: deliver to the requester
+            self._deliver_payload(msg.get("requester", "driver"),
+                                  msg["reply_oid"], msg["payload"])
         elif t == "rpc":
             # Handled off-thread: rpcs like pg_wait block, and this recv loop
             # must keep draining the worker's other messages. A shared pool
             # replaces the former thread-per-rpc spawn (hot-path cost).
-            self._rpc_pool.submit(self._handle_worker_rpc, msg)
+            self._rpc_pool.submit(self._handle_worker_rpc, msg, wid)
         elif t == "rpc_abandon":
             # Worker timed out waiting for a reply. Mark abandoned FIRST,
             # then reclaim if already written — this order closes the race
@@ -598,6 +635,8 @@ class Runtime:
         node = NodeInfo(NodeID.from_random(), msg["resources"],
                         msg.get("labels"), name=msg.get("name", "agent"))
         node.agent = agent
+        node.data_addr = msg.get("data_addr")
+        node.own_store = bool(msg.get("own_store"))
         # reply BEFORE the node becomes schedulable: otherwise a pending
         # task could push a spawn_worker ahead of this reply and the agent's
         # registration recv would read the wrong message. The agent already
@@ -657,9 +696,26 @@ class Runtime:
                     "create_placement_group_rpc", "remove_placement_group_rpc",
                     "timeline", "state_list", "state_summary",
                     "pubsub_poll",
-                    "kv_put", "kv_get", "kv_del", "kv_keys",
+                    "kv_put", "kv_get", "kv_del", "kv_keys", "locate",
                     "job_submit", "job_list", "job_status", "job_logs",
                     "job_stop")
+
+    def locate(self, oid_bytes: bytes) -> list[str]:
+        """Data-server addresses of nodes holding the object (ownership
+        object directory analog, ownership_object_directory.h). The head's
+        own store/spill is always checked — errors and driver puts live
+        there."""
+        oid = ObjectID(oid_bytes)
+        out = []
+        with self.lock:
+            e = self.directory.get(oid)
+            locs = set(e.locations or ()) if e is not None else set()
+            if self.store.contains(oid) or self.spill.contains(oid):
+                locs.add(self.head_node.node_id.hex())
+            for n in self.nodes.values():
+                if n.alive and n.node_id.hex() in locs and n.data_addr:
+                    out.append(n.data_addr)
+        return out
 
     # internal KV (gcs_kv_manager.h / ray.experimental.internal_kv analog);
     # user namespace is prefixed so snapshots can't be clobbered
@@ -675,19 +731,42 @@ class Runtime:
     def kv_keys(self) -> list[str]:
         return self.kv.keys("user")
 
-    def device_fetch(self, owner: str, key: str, reply_oid: bytes) -> None:
+    def _deliver_payload(self, requester: str, reply_oid: bytes,
+                         payload) -> None:
+        """Hand an out-of-band reply to a requester: the head store for
+        the driver and shared-store workers, the control conn for
+        own-store workers (who cannot see the head store)."""
+        if requester != "driver":
+            with self.lock:
+                w = self.workers.get(requester)
+                n = self.nodes.get(w.node_id) if w is not None else None
+            if n is not None and n.own_store:
+                if w.send({"t": "rpc_reply", "reply_oid": reply_oid,
+                           "payload": payload}):
+                    return
+        try:
+            self.store.put(ObjectID(reply_oid), payload)
+        except Exception:
+            pass
+
+    def device_fetch(self, owner: str, key: str, reply_oid: bytes,
+                     requester: str = "driver") -> None:
         """Route a device-object fetch to its owner process
-        (experimental/device_objects.py; RDT transfer-request analog)."""
-        from ..experimental.device_objects import _serve_fetch
+        (experimental/device_objects.py; RDT transfer-request analog).
+        The payload travels owner -> head -> requester over the control
+        conns, so it works across per-node stores."""
         if owner == "driver":
-            _serve_fetch(self.store, key, reply_oid)
+            from ..experimental.device_objects import _fetch_payload
+            self._deliver_payload(requester, reply_oid, _fetch_payload(key))
             return
         with self.lock:
             w = self.workers.get(owner)
         if w is None or w.state == "dead" or not w.send(
-                {"t": "device_get", "key": key, "reply_oid": reply_oid}):
-            self.store.put(ObjectID(reply_oid),
-                           ("err", f"device-object owner {owner} is gone"))
+                {"t": "device_get", "key": key, "reply_oid": reply_oid,
+                 "requester": requester}):
+            self._deliver_payload(requester, reply_oid,
+                                  ("err", f"device-object owner {owner} "
+                                          f"is gone"))
 
     def state_list(self, kind, limit=1000, filters=None):
         """State-API rows for workers/driver clients (util/state/api.py)."""
@@ -712,21 +791,44 @@ class Runtime:
         # runs on the rpc pool (long-poll parks a pool thread, like pg_wait)
         return self.pubsub.poll(channel, cursor, timeout_s)
 
-    def _handle_worker_rpc(self, msg: dict):
+    def _reply_via_conn(self, wid: str | None) -> bool:
+        """Workers on own-store nodes can't see the head store; their RPC
+        replies ride the control connection instead."""
+        if wid is None:
+            return False
+        w = self.workers.get(wid)
+        if w is None:
+            return False
+        n = self.nodes.get(w.node_id)
+        return n is not None and n.own_store
+
+    def _handle_worker_rpc(self, msg: dict, wid: str | None = None):
         oid = ObjectID(msg["reply_oid"])
+        via_conn = self._reply_via_conn(wid)
+
+        def reply(payload):
+            if via_conn:
+                w = self.workers.get(wid)
+                if w is not None:
+                    w.send({"t": "rpc_reply", "reply_oid": oid.binary(),
+                            "payload": payload})
+            else:
+                self.store.put(oid, payload)
         try:
             m = msg["m"]
             if m not in self._RPC_METHODS:
                 raise ValueError(f"unknown rpc {m!r}")
             result = getattr(self, m)(*msg.get("args", ()))
-            self.store.put(oid, ("ok", result))
+            reply(("ok", result))
         except BaseException as e:  # noqa: BLE001 — reply with any failure
             try:
-                self.store.put(oid, ("err", e))
+                reply(("err", e))
             except BaseException:  # unpicklable exception/result
-                self.store.put(oid, ("err", RuntimeError(
+                reply(("err", RuntimeError(
                     f"rpc {msg.get('m')} failed with unpicklable error: "
                     f"{type(e).__name__}: {e!r}")))
+        if via_conn:
+            return
         # No directory entry: the worker polls the store directly and deletes
         # the reply once read. If the worker already gave up, reclaim now.
         with self.lock:
@@ -1020,7 +1122,17 @@ class Runtime:
         e = self.directory.get(oid)
         if e is None or e.state == PENDING:
             return
+        e_locs = e.locations
         self.directory.pop(oid, None)
+        # copies on own-store nodes are freed by their agents (the head
+        # can't reach those stores); reference: FreeObjects fanout
+        if e_locs:
+            head_hex = self.head_node.node_id.hex()
+            for n in self.nodes.values():
+                if (n.agent is not None and n.own_store
+                        and n.node_id.hex() in e_locs):
+                    n.agent.send({"t": "free_objects",
+                                  "oids": [oid.binary()]})
         if oid in self._pinned:
             self._pinned.discard(oid)
             try:
@@ -1057,6 +1169,16 @@ class Runtime:
             return
         if e is None or e.state != READY or self.store.contains(oid):
             return
+        if e.locations:
+            # a live copy on another node satisfies consumers via the
+            # transfer service — reconstruction would DOUBLE-RUN the
+            # producer (wrong for side-effecting tasks)
+            alive = {n.node_id.hex() for n in self.nodes.values()
+                     if n.alive}
+            live_copies = e.locations & alive
+            if live_copies:
+                return
+            e.locations = None  # every holder died: fall through to lineage
         if e.lineage is None:
             self._store_error(oid, exc.ObjectLostError(
                 f"object {oid} was evicted and has no lineage "
@@ -1189,6 +1311,13 @@ class Runtime:
                 # spill directory when the store misses
                 continue
             if not self.store.contains(d):
+                if e is not None and e.state == READY and e.locations and \
+                        any(n.alive and n.node_id.hex() in e.locations
+                            for n in self.nodes.values()):
+                    # a live copy on another node; the executing worker
+                    # pulls it via the transfer service (every worker can
+                    # fetch — see worker._try_fetch)
+                    continue
                 if e is not None and e.state == READY:
                     self._ensure_available_locked(d)  # evicted → reconstruct
                 return "wait"
@@ -1400,11 +1529,14 @@ class Runtime:
                     self._record_task_locked(spec, "FINISHED",
                                              finished_at=time.time(),
                                              duration_s=msg.get("dur"))
+                    node_hex = w.node_id.hex()
                     for oid in spec.return_ids:
                         e = self.directory.get(oid)
                         if e is not None and e.state == PENDING:
                             # (a SPILLED return must stay SPILLED)
                             e.state = READY
+                        if e is not None:
+                            e.add_location(node_hex)
                         # a consumer may have dropped its ref while we were
                         # still PENDING; re-check now that we're final
                         self._maybe_free_locked(oid)
@@ -1839,6 +1971,25 @@ class Runtime:
             out.append(self._get_one(r.id(), deadline))
         return out[0] if single else out
 
+    def _fetch_remote(self, oid: ObjectID) -> bool:
+        """Pull an object produced on an own-store node into the head's
+        store (object_transfer.py); False when no remote copy exists."""
+        with self.lock:
+            e = self.directory.get(oid)
+            locs = set(e.locations or ()) if e is not None else set()
+            locs.discard(self.head_node.node_id.hex())
+            addrs = [n.data_addr for n in self.nodes.values()
+                     if n.alive and n.own_store and n.data_addr
+                     and n.node_id.hex() in locs]
+        from .object_transfer import fetch_object
+        for addr in addrs:
+            try:
+                if fetch_object(addr, oid, self.store, self.spill):
+                    return True
+            except OSError:
+                continue
+        return False
+
     def _get_one(self, oid: ObjectID, deadline: float | None):
         while True:
             slice_ms = 200
@@ -1860,6 +2011,8 @@ class Runtime:
                         return self.spill.load(oid)
                     except exc.RayTaskError as e:
                         raise e.as_instanceof_cause() from None
+                if self._fetch_remote(oid):
+                    continue  # pulled into the local store; next get hits
                 with self.lock:
                     self._ensure_available_locked(oid)
                     self._schedule_locked()
@@ -2025,6 +2178,11 @@ class Runtime:
                     w.conn.close()
             except Exception:
                 pass
+        try:
+            from .usage import write_usage_file
+            write_usage_file(self.session_dir)
+        except Exception:
+            pass
         try:
             self.kv.close()
         except Exception:

@@ -56,6 +56,13 @@ class WorkerRuntime:
         self.func_registry: dict[str, object] = {}
         self._sent_fids: set[str] = set()
         self._sent_renvs: set[str] = set()
+        # own-store node: misses pull via object_transfer; RPC replies come
+        # over the conn into this dict instead of the (invisible) head store
+        self.own_store = os.environ.get("RTPU_OWN_STORE") == "1"
+        self._rpc_replies: dict[bytes, object] = {}
+        self._rpc_reply_evt = threading.Event()
+        self._rpc_abandoned: set[bytes] = set()
+        self._last_fetch: dict = {}
         self.current_task_name = ""
         # process-local ObjectRef counts; 0<->1 transitions notify the head
         # (reference_count.h:73 borrower protocol, simplified)
@@ -201,9 +208,34 @@ class WorkerRuntime:
                     on_wait()
                     self.send({"t": "ensure", "oids": [oid.binary()]})
                     first = False
+                # ANY worker may need a cross-node pull (a shared-store
+                # worker can consume an own-store node's output too)
+                self._try_fetch(oid)
                 continue
             except exc.RayTaskError as e:
                 raise e.as_instanceof_cause() from None
+
+    def _try_fetch(self, oid: ObjectID) -> bool:
+        """Pull a missing object from a holder node into the local store
+        (the reference's PullManager retry loop, pull_manager.h:49 —
+        throttled to one locate per object per second)."""
+        now = time.monotonic()
+        if now - self._last_fetch.get(oid, 0.0) < 1.0:
+            return False
+        self._last_fetch[oid] = now
+        try:
+            addrs = self._rpc("locate", oid.binary(), timeout=10.0)
+        except Exception:
+            return False
+        from .object_transfer import fetch_object
+        for addr in addrs:
+            try:
+                if fetch_object(addr, oid, self.store, self.spill):
+                    self._last_fetch.pop(oid, None)
+                    return True
+            except OSError:
+                continue
+        return False
 
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
         ref_list = list(refs)
@@ -225,6 +257,9 @@ class WorkerRuntime:
                 self.send({"t": "ensure",
                            "oids": [r.id().binary() for r in pending]})
                 notified = True
+            if fetch_local:
+                for r in pending:
+                    self._try_fetch(r.id())
             time.sleep(0.002)
         return ready, pending
 
@@ -262,9 +297,24 @@ class WorkerRuntime:
         self.send({"t": "rpc", "m": method, "args": args,
                    "reply_oid": reply.binary()})
         deadline = time.monotonic() + timeout
+        rb = reply.binary()
         while True:
+            got = self._rpc_replies.pop(rb, None)
+            if got is not None:
+                status, payload = got
+                break
+            if self.own_store:
+                # reply arrives over the conn; park on the event
+                self._rpc_reply_evt.wait(timeout=0.1)
+                self._rpc_reply_evt.clear()
+                if time.monotonic() > deadline:
+                    self._rpc_abandoned.add(rb)
+                    raise exc.GetTimeoutError(
+                        f"head rpc {method} timed out") from None
+                continue
             try:
                 status, payload = self.store.get(reply, timeout_ms=100)
+                self.store.delete(reply)
                 break
             except StoreTimeout:
                 if time.monotonic() > deadline:
@@ -273,7 +323,6 @@ class WorkerRuntime:
                                "reply_oid": reply.binary()})
                     raise exc.GetTimeoutError(
                         f"head rpc {method} timed out") from None
-        self.store.delete(reply)
         if status == "err":
             raise payload
         return payload
@@ -479,9 +528,12 @@ class WorkerLoop:
         fn(*a)
 
     def _serve_device_get(self, msg: dict):
-        from ..experimental.device_objects import _serve_fetch
+        from ..experimental.device_objects import _fetch_payload
         try:
-            _serve_fetch(self.store, msg["key"], msg["reply_oid"])
+            self.rt.send({"t": "device_payload",
+                          "reply_oid": msg["reply_oid"],
+                          "requester": msg.get("requester", "driver"),
+                          "payload": _fetch_payload(msg["key"])})
         except Exception:
             traceback.print_exc()
 
@@ -534,6 +586,12 @@ class WorkerLoop:
                 else:
                     pool.submit(self._exec_wrapper, self._run_actor_task,
                                 msg["spec"])
+            elif t == "rpc_reply":
+                if msg["reply_oid"] in self.rt._rpc_abandoned:
+                    self.rt._rpc_abandoned.discard(msg["reply_oid"])
+                else:
+                    self.rt._rpc_replies[msg["reply_oid"]] = msg["payload"]
+                    self.rt._rpc_reply_evt.set()
             elif t == "device_get":
                 # serve a device-object fetch; serialization can be large,
                 # keep the recv loop free

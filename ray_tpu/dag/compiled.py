@@ -181,6 +181,27 @@ class CompiledDAG:
             if s["idx"] == seen[id(self.output_node)]:
                 s["out_chans"].append(self.output_chan)
 
+        # channels are raw objects in the DRIVER's store: an actor on an
+        # own-store node polls a store that never sees them — refuse at
+        # compile time rather than hang at execute (cross-store channels =
+        # the transfer service + per-edge location routing, future work)
+        from ..core import runtime as rt_mod
+        from ..core.ids import ActorID
+        if isinstance(self._rt, rt_mod.Runtime):
+            with self._rt.lock:
+                for aid in plans:
+                    a = self._rt.actors.get(ActorID(aid))
+                    w = (self._rt.workers.get(a.wid)
+                         if a is not None and a.wid else None)
+                    n = (self._rt.nodes.get(w.node_id)
+                         if w is not None else None)
+                    if n is not None and n.own_store:
+                        raise NotImplementedError(
+                            "compiled DAGs require all actors to share the "
+                            "driver's object store; actor "
+                            f"{a.spec.name!r} lives on own-store node "
+                            f"{n.name!r}")
+
         # ---- install loops -------------------------------------------- #
         self._loop_refs = []
         for aid, plan in plans.items():

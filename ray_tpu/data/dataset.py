@@ -123,6 +123,8 @@ class Dataset:
     """Lazy distributed dataset (reference: dataset.py Dataset)."""
 
     def __init__(self, plan: LogicalOp, ctx: Optional[DataContext] = None):
+        from ..core.usage import record_library_usage
+        record_library_usage("data")
         self._plan = plan
         self._ctx = ctx or DataContext.get_current()
         self._cached: Optional[list[tuple[Any, BlockMeta]]] = None

@@ -92,29 +92,48 @@ class DeviceObject:
         return arr
 
     def _fetch_host(self, timeout_s: float):
+        import time as _time
+
         from ..core import runtime as rt_mod
         from ..core.ids import ObjectID
+        from ..core.object_store import GetTimeoutError
         rt = rt_mod.get_runtime_if_exists()
         if rt is None:
             raise RuntimeError("ray_tpu.init() first")
         reply = ObjectID.from_random()
+        rb = reply.binary()
+        deadline = _time.monotonic() + timeout_s
         if hasattr(rt, "_rpc"):      # worker / driver client
             rt.send({"t": "device_fetch", "owner": self.owner,
-                     "key": self.key, "reply_oid": reply.binary()})
+                     "key": self.key, "reply_oid": rb})
+            # the payload may come back over the conn (own-store nodes)
+            # or through the shared store — poll both
+            while True:
+                got = rt._rpc_replies.pop(rb, None)
+                if got is not None:
+                    status, payload = got
+                    break
+                try:
+                    status, payload = rt.store.get(reply, timeout_ms=200)
+                    rt.store.delete(reply)
+                    break
+                except GetTimeoutError:
+                    if _time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"device object fetch from {self.owner} "
+                            f"timed out") from None
         else:                        # head driver
-            rt.device_fetch(self.owner, self.key, reply.binary())
-        import time as _time
-        from ..core.object_store import GetTimeoutError
-        deadline = _time.monotonic() + timeout_s
-        while True:
-            try:
-                status, payload = rt.store.get(reply, timeout_ms=200)
-                break
-            except GetTimeoutError:
-                if _time.monotonic() > deadline:
-                    raise TimeoutError(
-                        f"device object fetch from {self.owner} timed out")
-        rt.store.delete(reply)
+            rt.device_fetch(self.owner, self.key, rb, requester="driver")
+            while True:
+                try:
+                    status, payload = rt.store.get(reply, timeout_ms=200)
+                    rt.store.delete(reply)
+                    break
+                except GetTimeoutError:
+                    if _time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"device object fetch from {self.owner} "
+                            f"timed out") from None
         if status == "err":
             raise RuntimeError(payload)
         return payload
@@ -132,17 +151,13 @@ class DeviceObject:
                 f"dtype={self.dtype})")
 
 
-def _serve_fetch(store, key: str, reply_oid_bytes: bytes) -> None:
-    """Owner-side: answer a device_fetch by writing the HOST copy of the
-    array into the store at the caller-chosen reply oid."""
+def _fetch_payload(key: str):
+    """Owner-side: the (status, host-array) payload for a device_fetch
+    (delivery is the runtime's job — store or conn, per requester)."""
     import numpy as np
-
-    from ..core.ids import ObjectID
     with _lock:
         arr = _registry.get(key)
-    oid = ObjectID(reply_oid_bytes)
     if arr is None:
-        store.put(oid, ("err", f"device object {key!r} not registered "
-                               f"(released or evicted)"))
-    else:
-        store.put(oid, ("ok", np.asarray(arr)))
+        return ("err", f"device object {key!r} not registered "
+                       f"(released or evicted)")
+    return ("ok", np.asarray(arr))

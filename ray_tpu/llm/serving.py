@@ -49,6 +49,9 @@ class LLMServer:
     def __init__(self, cfg: LLMConfig, params_ref=None):
         from collections import OrderedDict
 
+        from ..core.usage import record_library_usage
+        record_library_usage("llm")
+
         from ..models import llama
         self.cfg = cfg
         self.engine_cfg = cfg.engine or PagedEngineConfig(
@@ -155,7 +158,19 @@ class LLMServer:
             top_k=int(request.get("top_k", 0)),
         )
         eng = self._engine_for(request)
-        req = eng.submit(prompt, sp)
+        # submit UNDER the lora lock: eviction (also lock-guarded) only
+        # removes idle engines, so once submit lands the engine has work
+        # and cannot be evicted out from under this request; re-insert if
+        # an eviction won the race between selection and here
+        with self._lora_lock:
+            if eng is not self.engine:
+                lora_id = next((lid for lid, e in self._lora_engines.items()
+                                if e is eng), None)
+                if lora_id is None:
+                    rid = request.get("lora") or request.get(
+                        "model", ":").split(":", 1)[1]
+                    self._lora_engines[rid] = eng
+            req = eng.submit(prompt, sp)
         self._wake.set()
         return eng, req
 

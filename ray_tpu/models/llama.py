@@ -43,6 +43,11 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     # parallel/perf knobs
     remat: bool = True                # jax.checkpoint each layer
+    # "full" recomputes everything in the backward; "save_attn" keeps the
+    # flash-attention output+lse (ops/flash_attention.py checkpoint_name
+    # tags) so attention's forward is NOT replayed — more memory, fewer
+    # FLOPs: the right default for MFU on HBM-rich chips
+    remat_policy: str = "save_attn"
     use_flash: bool = True            # Pallas flash attention (vs reference)
     attn_block_q: int = 512
     attn_block_k: int = 512
@@ -361,7 +366,12 @@ def apply_with_aux(params: dict, tokens: jax.Array, cfg: LlamaConfig,
         return (y, aux + a), None
 
     if cfg.remat:
-        body = jax.checkpoint(body)
+        if cfg.remat_policy == "save_attn":
+            policy = jax.checkpoint_policies.save_only_these_names(
+                "flash_out", "flash_lse")
+            body = jax.checkpoint(body, policy=policy)
+        else:
+            body = jax.checkpoint(body)
     (x, aux), _ = jax.lax.scan(body, (x, jnp.float32(0.0)),
                                params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)

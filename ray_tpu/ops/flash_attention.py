@@ -550,6 +550,14 @@ def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):
 
 def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret):
     out, lse = _fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    # Tag the residuals so a `save_attn` remat policy (models/llama.py)
+    # keeps them across the layer checkpoint: the backward then reads the
+    # saved out/lse instead of replaying the whole attention forward —
+    # the standard large-model policy (save softmax stats, recompute the
+    # cheap projections).
+    from jax.ad_checkpoint import checkpoint_name
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return out, (q, k, v, out, lse)
 
 

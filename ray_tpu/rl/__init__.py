@@ -6,11 +6,14 @@ core/rl_module/rl_module.py:258). PPO is the first algorithm (north-star
 config 3: PPO EnvRunner actors + jitted JAX learner over the mesh).
 """
 from .algorithm import PPO, AlgorithmConfig
+from .dqn import (DQN, DQNAlgorithmConfig, DQNConfig, DQNLearner,
+                  ReplayBuffer)
 from .env_runner import EnvRunner, make_gym_env
 from .learner import PPOConfig, PPOLearner, compute_gae
 from .module import MLPConfig
 
 __all__ = [
+    "DQN", "DQNAlgorithmConfig", "DQNConfig", "DQNLearner", "ReplayBuffer",
     "PPO", "AlgorithmConfig", "EnvRunner", "make_gym_env",
     "PPOConfig", "PPOLearner", "compute_gae", "MLPConfig",
 ]

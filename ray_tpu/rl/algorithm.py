@@ -67,6 +67,9 @@ class PPO:
     def __init__(self, config: AlgorithmConfig):
         import ray_tpu as ray
 
+        from ..core.usage import record_library_usage
+        record_library_usage("rl")
+
         if config.env_fn is None:
             raise ValueError("config.environment(...) is required")
         self.config = config

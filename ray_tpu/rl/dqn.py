@@ -1,0 +1,383 @@
+"""DQN: off-policy value learning with replay (double-DQN by default).
+
+Reference parity: rllib/algorithms/dqn/ (dqn.py training_step: sample →
+replay buffer add → sample minibatches → TD update → target sync) with
+the new-API-stack roles: DQNRunner = single_agent_env_runner.py:68 doing
+epsilon-greedy exploration, DQNLearner = dqn_learner / torch_dqn_learner
+loss. TPU-first: the TD update over a K-minibatch scan is ONE jitted
+program (replay indices are inputs), so the learner does one
+device round-trip per train() regardless of num_updates; the replay
+buffer is host-side numpy (it's bandwidth-bound bookkeeping, not FLOPs).
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from . import module as module_lib
+from .module import MLPConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class DQNConfig:
+    """(reference: dqn.py DQNConfig.training(...))"""
+    lr: float = 1e-3
+    gamma: float = 0.99
+    buffer_size: int = 50_000
+    batch_size: int = 64
+    num_updates_per_iter: int = 64
+    target_update_freq: int = 500      # in gradient updates
+    double_q: bool = True
+    # epsilon-greedy schedule over env steps
+    eps_start: float = 1.0
+    eps_end: float = 0.05
+    eps_decay_steps: int = 10_000
+    learning_starts: int = 1_000       # env steps before updates begin
+    huber_delta: float = 1.0
+
+
+class ReplayBuffer:
+    """Uniform ring buffer over transitions (reference:
+    utils/replay_buffers/episode_replay_buffer.py, reduced to the uniform
+    case)."""
+
+    def __init__(self, capacity: int, obs_dim: int):
+        self.capacity = capacity
+        self.obs = np.empty((capacity, obs_dim), np.float32)
+        self.next_obs = np.empty((capacity, obs_dim), np.float32)
+        self.actions = np.empty((capacity,), np.int32)
+        self.rewards = np.empty((capacity,), np.float32)
+        self.dones = np.empty((capacity,), np.float32)
+        self.size = 0
+        self.pos = 0
+
+    def add_batch(self, obs, actions, rewards, next_obs, dones):
+        n = len(actions)
+        idx = (self.pos + np.arange(n)) % self.capacity
+        self.obs[idx] = obs
+        self.next_obs[idx] = next_obs
+        self.actions[idx] = actions
+        self.rewards[idx] = rewards
+        self.dones[idx] = dones
+        self.pos = int((self.pos + n) % self.capacity)
+        self.size = int(min(self.size + n, self.capacity))
+
+    def sample_indices(self, rng: np.random.Generator, batch: int,
+                      k: int) -> np.ndarray:
+        return rng.integers(0, self.size, size=(k, batch))
+
+
+class DQNRunner:
+    """Epsilon-greedy transition collector over a vector env."""
+
+    def __init__(self, env_fn: Callable, num_envs: int, rollout_len: int,
+                 seed: int = 0):
+        import gymnasium as gym
+        self._venv = gym.vector.SyncVectorEnv(
+            [(lambda f=env_fn: f()) for _ in range(num_envs)],
+            autoreset_mode=gym.vector.AutoresetMode.SAME_STEP)
+        self._num_envs = num_envs
+        self._rollout_len = rollout_len
+        self._obs, _ = self._venv.reset(seed=seed)
+        self._rng = np.random.default_rng(seed + 1)
+        self._q_fn = None
+        self._ep_return = np.zeros(num_envs, np.float64)
+        self._completed: list[float] = []
+
+    def sample(self, params, eps: float) -> dict:
+        import jax
+        if self._q_fn is None:
+            self._q_fn = jax.jit(module_lib.deterministic_action)
+        T, E = self._rollout_len, self._num_envs
+        obs_dim = self._obs.shape[1]
+        obs_b = np.empty((T * E, obs_dim), np.float32)
+        nxt_b = np.empty((T * E, obs_dim), np.float32)
+        act_b = np.empty((T * E,), np.int32)
+        rew_b = np.empty((T * E,), np.float32)
+        done_b = np.empty((T * E,), np.float32)
+        n_actions = self._venv.single_action_space.n
+        for t in range(T):
+            greedy = np.asarray(self._q_fn(
+                params, self._obs.astype(np.float32)))
+            explore = self._rng.random(E) < eps
+            random_a = self._rng.integers(0, n_actions, size=E)
+            action = np.where(explore, random_a, greedy).astype(np.int32)
+            nxt, rew, term, trunc, _ = self._venv.step(action)
+            # bootstrap through time-limit truncation, not termination
+            done_for_td = term.astype(np.float32)
+            sl = slice(t * E, (t + 1) * E)
+            obs_b[sl] = self._obs
+            nxt_b[sl] = nxt
+            act_b[sl] = action
+            rew_b[sl] = rew
+            done_b[sl] = done_for_td
+            self._ep_return += rew
+            for i in np.nonzero(np.logical_or(term, trunc))[0]:
+                self._completed.append(float(self._ep_return[i]))
+                self._ep_return[i] = 0.0
+            self._obs = nxt
+        episodes, self._completed = self._completed, []
+        return {"obs": obs_b, "actions": act_b, "rewards": rew_b,
+                "next_obs": nxt_b, "dones": done_b,
+                "episode_returns": episodes}
+
+    def evaluate(self, params, num_episodes: int = 5) -> dict:
+        import jax
+        det = jax.jit(module_lib.deterministic_action)
+        env = self._venv.envs[0]
+        returns = []
+        for ep in range(num_episodes):
+            obs, _ = env.reset(seed=20_000 + ep)
+            total, done = 0.0, False
+            while not done:
+                a = int(np.asarray(det(params, obs.astype(np.float32))))
+                obs, rew, term, trunc, _ = env.step(a)
+                total += float(rew)
+                done = bool(term or trunc)
+            returns.append(total)
+        self._obs, _ = self._venv.reset()
+        return {"episode_returns": returns,
+                "mean_return": float(np.mean(returns))}
+
+
+class DQNLearner:
+    """Jitted K-minibatch TD update (one compiled program per train())."""
+
+    def __init__(self, module_cfg: MLPConfig, cfg: DQNConfig, seed: int = 0,
+                 mesh=None):
+        import jax
+        import optax
+        self.cfg = cfg
+        self.module_cfg = module_cfg
+        self.params = module_lib.init(jax.random.PRNGKey(seed), module_cfg)
+        self.target_params = jax.tree.map(lambda x: x, self.params)
+        self.optimizer = optax.adam(cfg.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self.updates_done = 0
+        self._update = jax.jit(self._build_update())
+
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+        cfg = self.cfg
+
+        def q_values(params, obs):
+            logits, _ = module_lib.logits_and_value(params, obs)
+            return logits  # the pi head doubles as the Q head
+
+        def loss_fn(params, target_params, batch):
+            q = q_values(params, batch["obs"])
+            q_a = jnp.take_along_axis(
+                q, batch["actions"][:, None].astype(jnp.int32), 1)[:, 0]
+            q_next_t = q_values(target_params, batch["next_obs"])
+            if cfg.double_q:
+                # action from the ONLINE net, value from the target net
+                a_star = jnp.argmax(
+                    q_values(params, batch["next_obs"]), axis=-1)
+                q_next = jnp.take_along_axis(
+                    q_next_t, a_star[:, None], 1)[:, 0]
+            else:
+                q_next = q_next_t.max(axis=-1)
+            target = batch["rewards"] + cfg.gamma * (
+                1.0 - batch["dones"]) * jax.lax.stop_gradient(q_next)
+            td = q_a - target
+            # huber
+            adelta = jnp.abs(td)
+            loss = jnp.where(
+                adelta <= cfg.huber_delta,
+                0.5 * td ** 2,
+                cfg.huber_delta * (adelta - 0.5 * cfg.huber_delta))
+            return loss.mean(), (jnp.abs(td).mean(), q_a.mean())
+
+        def k_updates(params, target_params, opt_state, data, idx):
+            def one(carry, i):
+                params, opt_state = carry
+                batch = {k: v[i] for k, v in data.items()}
+                (loss, (td, qm)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, target_params, batch)
+                updates, opt_state = self.optimizer.update(
+                    grads, opt_state, params)
+                import optax
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), (loss, td, qm)
+
+            (params, opt_state), (losses, tds, qms) = jax.lax.scan(
+                one, (params, opt_state), jnp.arange(idx.shape[0]))
+            return params, opt_state, losses.mean(), tds.mean(), qms.mean()
+
+        def update(params, target_params, opt_state, obs, actions, rewards,
+                   next_obs, dones, idx):
+            data = {
+                "obs": obs[idx], "actions": actions[idx],
+                "rewards": rewards[idx], "next_obs": next_obs[idx],
+                "dones": dones[idx],
+            }
+            return k_updates(params, target_params, opt_state, data, idx)
+
+        return update
+
+    def update_from_buffer(self, buf: ReplayBuffer,
+                           rng: np.random.Generator) -> dict:
+        import jax.numpy as jnp
+        cfg = self.cfg
+        idx = buf.sample_indices(rng, cfg.batch_size,
+                                 cfg.num_updates_per_iter)
+        # full-capacity arrays: fixed shapes -> ONE compile for the whole
+        # run (indices never reach past buf.size)
+        self.params, self.opt_state, loss, td, qm = self._update(
+            self.params, self.target_params, self.opt_state,
+            jnp.asarray(buf.obs), jnp.asarray(buf.actions),
+            jnp.asarray(buf.rewards), jnp.asarray(buf.next_obs),
+            jnp.asarray(buf.dones), jnp.asarray(idx))
+        self.updates_done += cfg.num_updates_per_iter
+        if self.updates_done % cfg.target_update_freq < \
+                cfg.num_updates_per_iter:
+            import jax
+            self.target_params = jax.tree.map(lambda x: x, self.params)
+        return {"loss": float(loss), "td_error": float(td),
+                "q_mean": float(qm)}
+
+
+class DQN:
+    """The Algorithm driver (reference: dqn.py DQN.training_step)."""
+
+    def __init__(self, config: "DQNAlgorithmConfig"):
+        import ray_tpu as ray
+
+        from ..core.usage import record_library_usage
+        record_library_usage("rl")
+        if config.env_fn is None:
+            raise ValueError("config.environment(...) is required")
+        self.config = config
+        probe = config.env_fn()
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        num_actions = int(probe.action_space.n)
+        probe.close()
+        self.module_cfg = MLPConfig(obs_dim=obs_dim,
+                                    num_actions=num_actions,
+                                    hidden=tuple(config.hidden))
+        self.learner = DQNLearner(self.module_cfg, config.dqn,
+                                  seed=config.seed)
+        self.buffer = ReplayBuffer(config.dqn.buffer_size, obs_dim)
+        RunnerCls = ray.remote(DQNRunner)
+        self._runners = [
+            RunnerCls.options(num_cpus=config.runner_resources.get(
+                "CPU", 1)).remote(
+                config.env_fn, config.num_envs_per_runner,
+                config.rollout_len, seed=config.seed + 1000 * (i + 1))
+            for i in range(config.num_env_runners)]
+        self._ray = ray
+        self._np_rng = np.random.default_rng(config.seed)
+        self.iteration = 0
+        self._total_env_steps = 0
+        self._recent_returns: list[float] = []
+
+    def _epsilon(self) -> float:
+        cfg = self.config.dqn
+        frac = min(1.0, self._total_env_steps / max(1, cfg.eps_decay_steps))
+        return cfg.eps_start + frac * (cfg.eps_end - cfg.eps_start)
+
+    def train(self) -> dict:
+        ray = self._ray
+        t0 = time.perf_counter()
+        eps = self._epsilon()
+        weights_ref = ray.put(self.learner.params)
+        samples = ray.get([r.sample.remote(weights_ref, eps)
+                           for r in self._runners])
+        for s in samples:
+            self.buffer.add_batch(s["obs"], s["actions"], s["rewards"],
+                                  s["next_obs"], s["dones"])
+            self._recent_returns.extend(s["episode_returns"])
+        self._recent_returns = self._recent_returns[-100:]
+        steps = sum(len(s["actions"]) for s in samples)
+        self._total_env_steps += steps
+
+        stats = {}
+        if self._total_env_steps >= self.config.dqn.learning_starts:
+            stats = self.learner.update_from_buffer(self.buffer,
+                                                    self._np_rng)
+        self.iteration += 1
+        mean_ret = (float(np.mean(self._recent_returns))
+                    if self._recent_returns else float("nan"))
+        dt = time.perf_counter() - t0
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": mean_ret,
+            "epsilon": eps,
+            "num_env_steps_sampled": steps,
+            "num_env_steps_sampled_lifetime": self._total_env_steps,
+            "env_steps_per_sec": steps / dt,
+            "buffer_size": self.buffer.size,
+            **{f"learner/{k}": v for k, v in stats.items()},
+        }
+
+    def evaluate(self, num_episodes: int = 5) -> dict:
+        ray = self._ray
+        weights_ref = ray.put(self.learner.params)
+        return ray.get(self._runners[0].evaluate.remote(
+            weights_ref, num_episodes))
+
+    def save_checkpoint(self) -> dict:
+        import jax
+        return {"params": jax.device_get(self.learner.params),
+                "target_params": jax.device_get(self.learner.target_params),
+                "opt_state": jax.device_get(self.learner.opt_state),
+                "iteration": self.iteration,
+                "total_env_steps": self._total_env_steps}
+
+    def restore_checkpoint(self, state: dict) -> None:
+        import jax
+        import jax.numpy as jnp
+        self.learner.params = jax.tree.map(jnp.asarray, state["params"])
+        self.learner.target_params = jax.tree.map(
+            jnp.asarray, state["target_params"])
+        self.learner.opt_state = jax.tree.map(
+            jnp.asarray, state["opt_state"])
+        self.iteration = state["iteration"]
+        self._total_env_steps = state["total_env_steps"]
+
+    def stop(self):
+        for r in self._runners:
+            try:
+                self._ray.kill(r)
+            except Exception:
+                pass
+
+
+class DQNAlgorithmConfig:
+    """Fluent config mirroring AlgorithmConfig (PPO) for the DQN family."""
+
+    def __init__(self):
+        self.env_fn: Optional[Callable] = None
+        self.num_env_runners = 2
+        self.num_envs_per_runner = 4
+        self.rollout_len = 32
+        self.dqn = DQNConfig()
+        self.hidden = (64, 64)
+        self.seed = 0
+        self.runner_resources = {"CPU": 1}
+
+    def environment(self, env, **kwargs) -> "DQNAlgorithmConfig":
+        from .env_runner import make_gym_env
+        self.env_fn = make_gym_env(env, **kwargs) if isinstance(env, str) \
+            else env
+        return self
+
+    def env_runners(self, num_env_runners: int = 2,
+                    num_envs_per_env_runner: int = 4,
+                    rollout_fragment_length: int = 32
+                    ) -> "DQNAlgorithmConfig":
+        self.num_env_runners = num_env_runners
+        self.num_envs_per_runner = num_envs_per_env_runner
+        self.rollout_len = rollout_fragment_length
+        return self
+
+    def training(self, **dqn_kwargs) -> "DQNAlgorithmConfig":
+        self.dqn = dataclasses.replace(self.dqn, **dqn_kwargs)
+        return self
+
+    def build(self) -> DQN:
+        return DQN(self)

@@ -156,6 +156,8 @@ def run(app: Application, *, name: str = "default",
     """Deploy an application; returns the ingress handle
     (reference: serve/api.py:691)."""
     import cloudpickle
+    from ..core.usage import record_library_usage
+    record_library_usage("serve")
     ray = _ray()
     ctrl = _controller()
     specs_blob = cloudpickle.dumps(

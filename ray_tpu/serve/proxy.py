@@ -72,6 +72,11 @@ class ProxyActor:
         subpath = path.split("/", 1)[1] if "/" in path else ""
         method = subpath.strip("/").replace("/", "_").replace(
             ".", "_").replace("-", "_") if subpath else "__call__"
+        if method != "__call__" and (
+                method.startswith("_") or not method.isidentifier()):
+            # never expose private/dunder attributes over HTTP
+            return web.json_response(
+                {"error": f"no route {subpath!r}"}, status=404)
         ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
         try:
             ingress = ray_tpu.get(ctrl.get_ingress.remote(app_name))

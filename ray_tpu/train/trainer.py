@@ -298,6 +298,8 @@ class DataParallelTrainer:
     # -- fit ---------------------------------------------------------------
 
     def fit(self) -> Result:
+        from ..core.usage import record_library_usage
+        record_library_usage("train")
         import ray_tpu as ray
         run_name = self.run_config.name or f"train_{uuid.uuid4().hex[:8]}"
         storage = os.path.join(self.run_config.resolved_storage_path(),

@@ -233,6 +233,9 @@ class Tuner:
         import cloudpickle
         import ray_tpu as ray
 
+        from ..core.usage import record_library_usage
+        record_library_usage("tune")
+
         tc = self.tune_config
         sched = tc.scheduler
         sched.setup(tc.metric, tc.mode)

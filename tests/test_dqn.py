@@ -1,0 +1,90 @@
+"""DQN tests (reference: rllib/algorithms/dqn/)."""
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rl import DQNAlgorithmConfig, DQNConfig, DQNLearner, ReplayBuffer
+from ray_tpu.rl.module import MLPConfig
+
+
+def test_replay_buffer_ring():
+    buf = ReplayBuffer(capacity=8, obs_dim=2)
+    for i in range(3):
+        buf.add_batch(np.full((4, 2), i, np.float32),
+                      np.full((4,), i, np.int32),
+                      np.full((4,), float(i), np.float32),
+                      np.full((4, 2), i + 1, np.float32),
+                      np.zeros((4,), np.float32))
+    assert buf.size == 8          # wrapped
+    assert buf.pos == 4
+    # oldest batch (i=0) was overwritten by i=2
+    assert not (buf.actions == 0).any()
+    rng = np.random.default_rng(0)
+    idx = buf.sample_indices(rng, batch=16, k=3)
+    assert idx.shape == (3, 16)
+    assert idx.max() < buf.size
+
+
+def test_learner_reduces_td_error():
+    """On a fixed synthetic batch the TD loss must drop."""
+    rng = np.random.default_rng(0)
+    buf = ReplayBuffer(1024, obs_dim=4)
+    obs = rng.normal(size=(1024, 4)).astype(np.float32)
+    act = rng.integers(0, 2, 1024).astype(np.int32)
+    # deterministic reward structure: r = obs[0] * (2a-1)
+    rew = (obs[:, 0] * (2 * act - 1)).astype(np.float32)
+    buf.add_batch(obs, act, rew, obs, np.ones(1024, np.float32))
+
+    lrn = DQNLearner(MLPConfig(obs_dim=4, num_actions=2),
+                     DQNConfig(lr=3e-3, num_updates_per_iter=32,
+                               batch_size=64))
+    first = lrn.update_from_buffer(buf, rng)
+    for _ in range(10):
+        last = lrn.update_from_buffer(buf, rng)
+    assert last["td_error"] < first["td_error"] * 0.5, (first, last)
+
+
+def test_dqn_cartpole_learns(ray_start_regular):
+    """End-to-end: DQN clearly beats random play on CartPole within a
+    tight budget (random ~20; threshold 100 on the 100-episode mean)."""
+    cfg = (DQNAlgorithmConfig()
+           .environment("CartPole-v1")
+           .env_runners(num_env_runners=2, num_envs_per_env_runner=4,
+                        rollout_fragment_length=32)
+           .training(lr=1e-3, eps_decay_steps=4000, learning_starts=500,
+                     num_updates_per_iter=48, target_update_freq=400))
+    algo = cfg.build()
+    try:
+        best = 0.0
+        for i in range(110):
+            r = algo.train()
+            best = max(best, r["episode_return_mean"])
+            if best >= 100:
+                break
+        assert best >= 100, best
+        # checkpoint round-trip mid-training
+        state = algo.save_checkpoint()
+        algo.restore_checkpoint(state)
+        r = algo.train()
+        assert r["training_iteration"] == state["iteration"] + 1
+    finally:
+        algo.stop()
+
+
+def test_double_q_flag_changes_targets():
+    """double_q=False vs True produce different updates on the same data."""
+    rng = np.random.default_rng(1)
+    buf = ReplayBuffer(256, obs_dim=3)
+    obs = rng.normal(size=(256, 3)).astype(np.float32)
+    buf.add_batch(obs, rng.integers(0, 3, 256).astype(np.int32),
+                  rng.normal(size=256).astype(np.float32),
+                  rng.normal(size=(256, 3)).astype(np.float32),
+                  np.zeros(256, np.float32))
+    outs = []
+    for dq in (True, False):
+        lrn = DQNLearner(MLPConfig(obs_dim=3, num_actions=3),
+                         DQNConfig(double_q=dq, num_updates_per_iter=8),
+                         seed=7)
+        lrn.update_from_buffer(buf, np.random.default_rng(2))
+        outs.append(np.asarray(lrn.params["pi"]["head"]["w"]))
+    assert not np.allclose(outs[0], outs[1])

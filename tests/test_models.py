@@ -95,3 +95,26 @@ def test_resnet18_forward_and_train_step():
     logits2, new_state = resnet.apply_train(variables, images, cfg)
     assert logits2.shape == (4, 10)
     assert "batch_stats" in new_state
+
+
+def test_remat_save_attn_matches_full():
+    """The save_attn remat policy must not change gradients."""
+    import dataclasses
+    base = llama.llama_tiny(n_layers=2, dim=64, mlp_dim=128, n_heads=4,
+                            n_kv_heads=2, max_seq_len=128)
+    toks = jnp.asarray(np.random.RandomState(0).randint(
+        0, base.vocab_size, (2, 33)), jnp.int32)
+    grads = {}
+    for pol in ("full", "save_attn"):
+        cfg = dataclasses.replace(base, remat=True, remat_policy=pol,
+                                  use_flash=True)
+        params = llama.init(jax.random.PRNGKey(0), cfg)
+
+        def loss(p, cfg=cfg):
+            return llama.cross_entropy_loss(
+                llama.apply(p, toks[:, :-1], cfg), toks[:, 1:])
+        grads[pol] = jax.grad(loss)(params)
+    for g1, g2 in zip(jax.tree_util.tree_leaves(grads["full"]),
+                      jax.tree_util.tree_leaves(grads["save_attn"])):
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=1e-4, atol=1e-5)

@@ -1,0 +1,80 @@
+"""Object transfer (node↔node data plane) tests
+(reference: object_manager.h Push/Pull)."""
+import numpy as np
+import pytest
+
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_store import SharedObjectStore, SpillStore
+from ray_tpu.core.object_transfer import ObjectDataServer, fetch_object
+
+
+@pytest.fixture
+def two_stores(tmp_path):
+    a = SharedObjectStore(f"/dev/shm/rtpu_xfer_a_{id(tmp_path)}",
+                          capacity=8 << 20, create=True)
+    b = SharedObjectStore(f"/dev/shm/rtpu_xfer_b_{id(tmp_path)}",
+                          capacity=8 << 20, create=True)
+    spill_a = SpillStore(str(tmp_path / "spill_a"))
+    spill_b = SpillStore(str(tmp_path / "spill_b"))
+    server = ObjectDataServer(a, spill_a)
+    yield a, b, spill_a, spill_b, server
+    server.stop()
+    a.close(unlink=True)
+    b.close(unlink=True)
+
+
+def test_fetch_roundtrip(two_stores):
+    a, b, _, _, server = two_stores
+    oid = ObjectID.from_random()
+    value = {"arr": np.arange(1000), "tag": "hello"}
+    a.put(oid, value)
+    assert fetch_object(server.address, oid, b) is True
+    got = b.get(oid, timeout_ms=0)
+    assert got["tag"] == "hello"
+    np.testing.assert_array_equal(got["arr"], value["arr"])
+
+
+def test_fetch_missing_returns_false(two_stores):
+    a, b, _, _, server = two_stores
+    assert fetch_object(server.address, ObjectID.from_random(), b) is False
+
+
+def test_fetch_from_spill(two_stores):
+    a, b, spill_a, _, server = two_stores
+    oid = ObjectID.from_random()
+    spill_a.spill(oid, [1, 2, 3])
+    assert fetch_object(server.address, oid, b) is True
+    assert b.get(oid, timeout_ms=0) == [1, 2, 3]
+
+
+def test_fetch_reuses_connection(two_stores):
+    a, b, _, _, server = two_stores
+    for i in range(5):
+        oid = ObjectID.from_random()
+        a.put(oid, i)
+        assert fetch_object(server.address, oid, b) is True
+        assert b.get(oid, timeout_ms=0) == i
+
+
+def test_fetch_spills_when_local_store_full(two_stores, tmp_path):
+    a, _, _, _, server = two_stores
+    tiny = SharedObjectStore(f"/dev/shm/rtpu_xfer_tiny_{id(tmp_path)}",
+                             capacity=1 << 20, max_entries=512, create=True)
+    try:
+        spill = SpillStore(str(tmp_path / "spill_tiny"))
+        oid = ObjectID.from_random()
+        a.put(oid, np.zeros(2_000_000, np.uint8))  # 2MB > tiny capacity
+        assert fetch_object(server.address, oid, tiny, spill) is True
+        assert spill.contains(oid)
+        assert len(spill.load(oid)) == 2_000_000
+    finally:
+        tiny.close(unlink=True)
+
+
+def test_exception_frames_transfer(two_stores):
+    a, b, _, _, server = two_stores
+    oid = ObjectID.from_random()
+    a.put(oid, ValueError("remote error"), is_exception=True)
+    assert fetch_object(server.address, oid, b) is True
+    with pytest.raises(ValueError, match="remote error"):
+        b.get(oid, timeout_ms=0)
