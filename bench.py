@@ -16,15 +16,32 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def _model_and_batch(on_tpu: bool):
+def _tpu_configs():
+    """Candidate configs, best-first; the runner falls back on OOM.
+    Larger dims feed the MXU better (VERDICT r02: dim-1024/315M leaves
+    utilization on the table); save_attn remat (the default) keeps
+    attention out of the recompute path."""
     from ray_tpu.models import llama
-    if on_tpu:
-        cfg = llama.LlamaConfig(
+    return [
+        # ~560M @ dim 1536: ~8 GB params+opt in HBM, activations remat'd
+        (llama.LlamaConfig(
+            vocab_size=32000, dim=1536, n_layers=14, n_heads=16,
+            n_kv_heads=8, mlp_dim=6144, max_seq_len=1024,
+            dtype=jnp.bfloat16, remat=True, use_flash=True,
+            attn_block_q=512, attn_block_k=512), 8, 1024),
+        # r02-proven fallback (~315M @ dim 1024, MFU 0.3657 pre-kernels)
+        (llama.LlamaConfig(
             vocab_size=32000, dim=1024, n_layers=16, n_heads=16,
             n_kv_heads=8, mlp_dim=4096, max_seq_len=1024,
             dtype=jnp.bfloat16, remat=True, use_flash=True,
-            attn_block_q=512, attn_block_k=512)
-        batch, seq = 8, 1024
+            attn_block_q=512, attn_block_k=512), 8, 1024),
+    ]
+
+
+def _model_and_batch(on_tpu: bool, candidate: int = 0):
+    from ray_tpu.models import llama
+    if on_tpu:
+        cfg, batch, seq = _tpu_configs()[candidate]
     else:  # CPU smoke configuration — numbers are not meaningful
         cfg = llama.llama_tiny(n_layers=2, dim=64, mlp_dim=128,
                                max_seq_len=128)
@@ -35,18 +52,11 @@ def _model_and_batch(on_tpu: bool):
     return cfg, tokens
 
 
-def main():
+def _run_candidate(on_tpu: bool, candidate: int):
     import optax
     from ray_tpu.models import llama
-    from ray_tpu.parallel.mesh import tpu_topology
 
-    dev = jax.devices()[0]
-    on_tpu = dev.platform == "tpu"
-    topo = tpu_topology([dev])
-    cfg, tokens = _model_and_batch(on_tpu)
-    batch, seqp1 = tokens.shape
-    seq = seqp1 - 1
-
+    cfg, tokens = _model_and_batch(on_tpu, candidate)
     params = llama.init(jax.random.PRNGKey(0), cfg)
     opt = optax.adamw(3e-4, weight_decay=0.0)
     opt_state = opt.init(params)
@@ -65,6 +75,27 @@ def main():
     for _ in range(3):
         params, opt_state, loss = train_step(params, opt_state, tokens)
     float(loss)
+    return cfg, tokens, params, opt_state, train_step
+
+
+def main():
+    from ray_tpu.parallel.mesh import tpu_topology
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    topo = tpu_topology([dev])
+    n_candidates = len(_tpu_configs()) if on_tpu else 1
+    for candidate in range(n_candidates):
+        try:
+            cfg, tokens, params, opt_state, train_step = _run_candidate(
+                on_tpu, candidate)
+            break
+        except Exception as e:  # OOM on the big config -> proven fallback
+            if candidate + 1 >= n_candidates or \
+                    "RESOURCE_EXHAUSTED" not in repr(e).upper():
+                raise
+    batch, seqp1 = tokens.shape
+    seq = seqp1 - 1
 
     n_steps = 10 if on_tpu else 3
     t0 = time.perf_counter()

@@ -333,6 +333,13 @@ class Runtime:
         # GCS task-event store, gcs_task_manager.h:94); bounded FIFO
         self.task_records: "OrderedDict" = OrderedDict()
         self.task_records_max = cfg.task_records_max
+        # optional task-event export stream (reference: the export-events
+        # schemas + task-event files the dashboard consumes)
+        self._event_file = None
+        if cfg.event_export_enabled:
+            self._event_file = open(
+                os.path.join(self.session_dir, "events.jsonl"), "a",
+                buffering=1)
         self.counters = {"tasks_submitted": 0, "tasks_finished": 0,
                          "tasks_failed": 0, "tasks_retried": 0,
                          "actors_created": 0}
@@ -545,16 +552,29 @@ class Runtime:
             with self.lock:
                 e = self.directory[msg["oid"]] = DirEntry(READY)
                 w = self.workers.get(wid)
-                if w is not None:
-                    e.add_location(w.node_id.hex())
+                loc = self._own_store_loc_locked(w)
+                if loc is not None:
+                    e.add_location(loc)
+        elif t == "object_copied":
+            # a puller holds a copy now (object_transfer): free fanout and
+            # locate() must know (reference: object-directory location add)
+            with self.lock:
+                e = self.directory.get(ObjectID(msg["oid"]))
+                w = self.workers.get(wid)
+                loc = self._own_store_loc_locked(w)
+                if e is not None and loc is not None:
+                    e.add_location(loc)
         elif t == "put_spilled":
             with self.lock:
                 oid = ObjectID(msg["oid"])
                 e = self.directory.get(oid)
                 if e is None:
-                    self.directory[oid] = DirEntry(SPILLED)
+                    e = self.directory[oid] = DirEntry(SPILLED)
                 else:
                     e.state = SPILLED  # keep lineage for later recovery
+                loc = self._own_store_loc_locked(self.workers.get(wid))
+                if loc is not None:
+                    e.add_location(loc)  # spill lives on that node's disk
         elif t == "contained":
             with self.lock:
                 self._register_contained_locked(
@@ -730,6 +750,18 @@ class Runtime:
 
     def kv_keys(self) -> list[str]:
         return self.kv.keys("user")
+
+    def _own_store_loc_locked(self, w) -> str | None:
+        """Node hex for location tracking — ONLY own-store nodes:
+        shared-store copies live in the head store the directory already
+        checks directly, and recording them would make eviction look like
+        a live remote copy (blocking lineage reconstruction)."""
+        if w is None:
+            return None
+        n = self.nodes.get(w.node_id)
+        if n is not None and n.own_store:
+            return n.node_id.hex()
+        return None
 
     def _deliver_payload(self, requester: str, reply_oid: bytes,
                          payload) -> None:
@@ -1127,7 +1159,6 @@ class Runtime:
         # copies on own-store nodes are freed by their agents (the head
         # can't reach those stores); reference: FreeObjects fanout
         if e_locs:
-            head_hex = self.head_node.node_id.hex()
             for n in self.nodes.values():
                 if (n.agent is not None and n.own_store
                         and n.node_id.hex() in e_locs):
@@ -1231,6 +1262,15 @@ class Runtime:
                 self.task_records.popitem(last=False)
         rec["state"] = state
         rec.update(extra)
+        if self._event_file is not None:
+            try:
+                self._event_file.write(json.dumps(
+                    {"ts": time.time(), "task_id": rec["task_id"],
+                     "name": rec["name"], "state": state, **{
+                         k: v for k, v in extra.items()
+                         if isinstance(v, (int, float, str))}}) + "\n")
+            except (OSError, ValueError):
+                self._event_file = None  # disk gone: stop exporting
 
     def _submit_locked(self, spec: TaskSpec):
         self.counters["tasks_submitted"] += 1
@@ -1529,14 +1569,14 @@ class Runtime:
                     self._record_task_locked(spec, "FINISHED",
                                              finished_at=time.time(),
                                              duration_s=msg.get("dur"))
-                    node_hex = w.node_id.hex()
+                    loc = self._own_store_loc_locked(w)
                     for oid in spec.return_ids:
                         e = self.directory.get(oid)
                         if e is not None and e.state == PENDING:
                             # (a SPILLED return must stay SPILLED)
                             e.state = READY
-                        if e is not None:
-                            e.add_location(node_hex)
+                        if e is not None and loc is not None:
+                            e.add_location(loc)
                         # a consumer may have dropped its ref while we were
                         # still PENDING; re-check now that we're final
                         self._maybe_free_locked(oid)
@@ -2009,6 +2049,11 @@ class Runtime:
                     # objects bigger than the store never leave disk
                     try:
                         return self.spill.load(oid)
+                    except FileNotFoundError:
+                        # spilled on an own-store NODE: pull it over
+                        if not self._fetch_remote(oid):
+                            continue
+                        continue
                     except exc.RayTaskError as e:
                         raise e.as_instanceof_cause() from None
                 if self._fetch_remote(oid):

@@ -232,6 +232,11 @@ class WorkerRuntime:
             try:
                 if fetch_object(addr, oid, self.store, self.spill):
                     self._last_fetch.pop(oid, None)
+                    if self.own_store:
+                        # the head must know this node holds a copy now
+                        # (free fanout + future locates)
+                        self.send({"t": "object_copied",
+                                   "oid": oid.binary()})
                     return True
             except OSError:
                 continue

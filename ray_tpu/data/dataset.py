@@ -290,6 +290,11 @@ class Dataset:
         return DataIterator(self._stream_pairs()).iter_jax_batches(
             batch_size=batch_size, drop_last=drop_last, sharding=sharding)
 
+    def iter_torch_batches(self, *, batch_size: Optional[int] = 256,
+                           drop_last: bool = False) -> Iterator:
+        return DataIterator(self._stream_pairs()).iter_torch_batches(
+            batch_size=batch_size, drop_last=drop_last)
+
     def streaming_split(self, n: int) -> list["DataIterator"]:
         """n iterators sharing ONE streaming execution, one per Train
         worker (reference: dataset.py:1731 + the output-splitter operator).
@@ -442,6 +447,17 @@ class DataIterator:
                 carry = B.slice_block(blk, start, blk.num_rows)
         if carry is not None and carry.num_rows and not drop_last:
             yield B.format_batch(carry, batch_format)
+
+    def iter_torch_batches(self, *, batch_size: Optional[int] = 256,
+                           drop_last: bool = False) -> Iterator:
+        """numpy batches -> torch tensors (reference:
+        dataset.py:4732 iter_torch_batches; torch-cpu in this image)."""
+        import torch
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy",
+                                       drop_last=drop_last):
+            yield {k: torch.from_numpy(np.ascontiguousarray(v))
+                   for k, v in batch.items()}
 
     def iter_jax_batches(self, *, batch_size: int = 256,
                          drop_last: bool = True,

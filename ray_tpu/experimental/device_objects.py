@@ -119,6 +119,9 @@ class DeviceObject:
                     break
                 except GetTimeoutError:
                     if _time.monotonic() > deadline:
+                        # a late conn-delivered payload must be dropped,
+                        # not parked forever (worker._rpc does the same)
+                        rt._rpc_abandoned.add(rb)
                         raise TimeoutError(
                             f"device object fetch from {self.owner} "
                             f"timed out") from None

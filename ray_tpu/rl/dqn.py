@@ -105,12 +105,27 @@ class DQNRunner:
             explore = self._rng.random(E) < eps
             random_a = self._rng.integers(0, n_actions, size=E)
             action = np.where(explore, random_a, greedy).astype(np.int32)
-            nxt, rew, term, trunc, _ = self._venv.step(action)
-            # bootstrap through time-limit truncation, not termination
-            done_for_td = term.astype(np.float32)
+            nxt, rew, term, trunc, info = self._venv.step(action)
+            # bootstrap through time-limit truncation, not termination —
+            # but with the TRUE final observation: under SAME_STEP
+            # autoreset `nxt` already holds the next episode's reset obs
+            # for ended envs (gymnasium puts the real one in info)
+            nxt_td = nxt
+            ended = np.logical_or(term, trunc)
+            final = info.get("final_obs") if isinstance(info, dict) else None
+            if final is not None and ended.any():
+                nxt_td = nxt.copy()
+                for i in np.nonzero(ended)[0]:
+                    if final[i] is not None:
+                        nxt_td[i] = final[i]
+                done_for_td = term.astype(np.float32)
+            else:
+                # no final obs available: treat truncation as terminal
+                # rather than bootstrapping from a reset state
+                done_for_td = ended.astype(np.float32)
             sl = slice(t * E, (t + 1) * E)
             obs_b[sl] = self._obs
-            nxt_b[sl] = nxt
+            nxt_b[sl] = nxt_td
             act_b[sl] = action
             rew_b[sl] = rew
             done_b[sl] = done_for_td
@@ -139,6 +154,7 @@ class DQNRunner:
                 done = bool(term or trunc)
             returns.append(total)
         self._obs, _ = self._venv.reset()
+        self._ep_return[:] = 0.0  # in-progress episodes were discarded
         return {"episode_returns": returns,
                 "mean_return": float(np.mean(returns))}
 

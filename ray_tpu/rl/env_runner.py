@@ -127,6 +127,9 @@ class EnvRunner:
         # note: env state is shared with sampling; reset on exit
             returns.append(total)
         self._obs, _ = self._venv.reset()
+        # in-progress episodes were discarded with the reset
+        self._ep_return[:] = 0.0
+        self._ep_len[:] = 0
         return {"episode_returns": returns,
                 "mean_return": float(np.mean(returns))}
 

@@ -22,6 +22,14 @@ class ScalingConfig:
     tpus_per_worker: float = 0.0
     resources_per_worker: Optional[dict] = None
     placement_strategy: str = "PACK"
+    # Elastic gangs (reference: v2 scaling_policy/elastic — min/max worker
+    # range): None = fixed size. With min_workers set, the trainer sizes
+    # each (re)start to the LARGEST reservable gang in
+    # [min_workers, num_workers] — training resumes from the latest
+    # checkpoint at reduced width instead of stalling when the cluster
+    # shrinks. Per-size reservation wait: elastic_timeout_s.
+    min_workers: Optional[int] = None
+    elastic_timeout_s: float = 30.0
     # Multi-host gang: when True the trainer allocates a coordinator port and
     # every worker calls jax.distributed.initialize before the train fn, so
     # all workers' local chips form ONE global mesh (jax.devices() = global).

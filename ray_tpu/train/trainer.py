@@ -231,16 +231,30 @@ class DataParallelTrainer:
 
     # -- worker-group lifecycle -------------------------------------------
 
+    def _reserve_gang(self, n_max: int):
+        """Reserve the largest gang the cluster can hold right now
+        (elastic path; fixed configs insist on num_workers)."""
+        from ..util.placement_group import (placement_group,
+                                            remove_placement_group)
+        sc = self.scaling
+        n_min = sc.min_workers if sc.min_workers is not None else n_max
+        timeout = 120.0 if n_min == n_max else sc.elastic_timeout_s
+        for n in range(n_max, n_min - 1, -1):
+            pg = placement_group([sc.bundle() for _ in range(n)],
+                                 strategy=sc.placement_strategy)
+            if pg.wait(timeout):
+                return n, pg
+            try:
+                remove_placement_group(pg)
+            except Exception:
+                pass
+        raise TrainingFailedError(
+            f"no gang of {n_min}..{n_max} × {sc.bundle()} workers became "
+            f"ready (cluster too small?)")
+
     def _start_group(self, ray, run_name, bus, restore: Optional[Checkpoint]):
         import cloudpickle
-        from ..util.placement_group import placement_group
-        n = self.scaling.num_workers
-        pg = placement_group([self.scaling.bundle() for _ in range(n)],
-                             strategy=self.scaling.placement_strategy)
-        if not pg.wait(120):
-            raise TrainingFailedError(
-                f"placement group for {n} workers never became ready "
-                f"(cluster too small for {self.scaling.bundle()} × {n}?)")
+        n, pg = self._reserve_gang(self.scaling.num_workers)
         WorkerCls = ray.remote(_TrainWorker)
         shards = self._split_datasets(n)
         workers, run_refs = [], []

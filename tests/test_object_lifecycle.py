@@ -166,3 +166,22 @@ def test_spilled_exception_converts_to_cause(ray):
     ref = ObjectRef(oid)
     with pytest.raises(ValueError):
         ray.get(ref, timeout=30)
+
+
+def test_evicted_result_reconstructs_via_lineage(ray_start_regular):
+    """Regression: location tracking (multihost data plane) must not make
+    an evicted SHARED-store object look like a live remote copy — lineage
+    re-execution has to kick in."""
+    ray = ray_start_regular
+    from ray_tpu.core import runtime as rt_mod
+    rt = rt_mod.get_runtime_if_exists()
+
+    @ray.remote(max_retries=2)
+    def produce():
+        return list(range(500))
+
+    ref = produce.remote()
+    assert ray.get(ref, timeout=60)[-1] == 499
+    # simulate LRU eviction of the sealed result
+    rt.store.delete(ref.id())
+    assert ray.get(ref, timeout=120)[-1] == 499  # reconstructed

@@ -96,3 +96,48 @@ def test_prometheus_endpoint_scrapeable(ray):
                 assert float(line.split()[-1]) >= 3
     finally:
         state.stop_metrics_server()
+
+
+def test_event_export_jsonl():
+    """RTPU_EVENT_EXPORT_ENABLED writes task events to the session dir."""
+    import json
+    import os
+    import subprocess
+    import sys
+    import textwrap
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = textwrap.dedent("""
+        import ray_tpu
+        info = ray_tpu.init(num_cpus=1)
+        print("SESSION", info["session_dir"])
+
+        @ray_tpu.remote
+        def tick(i):
+            return i
+
+        assert ray_tpu.get([tick.remote(i) for i in range(3)],
+                           timeout=60) == [0, 1, 2]
+        ray_tpu.shutdown()
+    """)
+    env = dict(os.environ)
+    env["RTPU_EVENT_EXPORT_ENABLED"] = "1"
+    env["RTPU_WORKER_PRESTART"] = "0"
+    r = subprocess.run([sys.executable, "-c", script], env=env, cwd=repo,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    session = [ln.split()[1] for ln in r.stdout.splitlines()
+               if ln.startswith("SESSION")][0]
+    with open(os.path.join(session, "events.jsonl")) as f:
+        events = [json.loads(ln) for ln in f]
+    states = {e["state"] for e in events if e["name"] == "tick"}
+    assert {"PENDING", "RUNNING", "FINISHED"} <= states, states
+
+
+def test_iter_torch_batches(ray_start_regular):
+    from ray_tpu import data
+    ds = data.range(10)
+    batches = list(ds.iter_torch_batches(batch_size=4))
+    import torch
+    assert all(isinstance(b["id"], torch.Tensor) for b in batches)
+    total = torch.cat([b["id"] for b in batches])
+    assert sorted(total.tolist()) == list(range(10))

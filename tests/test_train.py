@@ -162,3 +162,27 @@ def test_trainer_jax_distributed_global_mesh(ray, tmp_path):
     assert result.error is None
     assert result.metrics["process_count"] == 2
     assert np.isfinite(result.metrics["loss"])
+
+
+def test_elastic_gang_downsizes(ray_start_regular):
+    """ScalingConfig(min_workers=) sizes the gang to what the cluster can
+    actually reserve (reference: v2 elastic scaling policy)."""
+    from ray_tpu import train
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    def loop(config=None):
+        ctx = train.get_context()
+        train.report({"world": ctx.world_size, "rank": ctx.rank})
+
+    avail = int(ray_start_regular.cluster_resources().get("CPU", 1))
+    want = avail + 4  # infeasible at full size
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=want, min_workers=1,
+                                     cpus_per_worker=1.0,
+                                     elastic_timeout_s=2.0),
+        run_config=RunConfig(name="elastic-test"))
+    result = trainer.fit()
+    world = result.metrics["world"]
+    assert 1 <= world <= avail, (world, avail)
+    assert world < want
