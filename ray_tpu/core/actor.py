@@ -24,7 +24,7 @@ _DEFAULT_ACTOR_OPTS = dict(
     max_restarts=0, max_task_retries=0, max_concurrency=1,
     lifetime=None, scheduling_strategy="DEFAULT", placement_group=None,
     placement_group_bundle_index=-1, _node_id=None, _node_soft=False,
-    runtime_env=None,
+    runtime_env=None, concurrency_groups=None,
 )
 
 
@@ -83,6 +83,7 @@ class ActorClass:
             named=o["name"],
             ready_oid=ready_oid,
             runtime_env=prepare_runtime_env(rt, o["runtime_env"]),
+            concurrency_groups=o["concurrency_groups"],
         )
         rt.create_actor(spec)
         methods = sorted(
@@ -98,13 +99,22 @@ class ActorClass:
 
 
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1,
+                 concurrency_group=None):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
+        self._concurrency_group = concurrency_group
 
-    def options(self, num_returns: int = 1, **_ignored) -> "ActorMethod":
-        return ActorMethod(self._handle, self._name, num_returns)
+    def options(self, num_returns: int = 1, concurrency_group=None,
+                **_ignored) -> "ActorMethod":
+        if num_returns == "dynamic":
+            raise ValueError(
+                "num_returns='dynamic' is supported for TASKS only; have "
+                "the actor method return a list and iterate it, or spawn "
+                "a task for generator semantics")
+        return ActorMethod(self._handle, self._name, num_returns,
+                           concurrency_group)
 
     def remote(self, *args, **kwargs):
         rt = _runtime()
@@ -122,6 +132,7 @@ class ActorMethod:
             retries_left=max(0, h._max_task_retries),
             actor_id=h._actor_id,
             method_name=self._name,
+            concurrency_group=self._concurrency_group,
         )
         refs = rt.submit_actor_task_spec(spec)
         if nret == 0:

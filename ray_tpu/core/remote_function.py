@@ -123,6 +123,9 @@ class RemoteFunction:
             **(o["resources"] or {})})
         strat = resolve_strategy(o)
         nret = o["num_returns"]
+        dynamic = nret == "dynamic"
+        if dynamic:
+            nret = 1  # one ref resolving to a list of per-item refs
         spec = TaskSpec(
             task_id=TaskID.from_random(),
             func_id=self._fid,
@@ -134,6 +137,7 @@ class RemoteFunction:
             retries_left=max(0, o["max_retries"]),
             retry_exceptions=bool(o["retry_exceptions"]),
             runtime_env=prepare_runtime_env(rt, o["runtime_env"]),
+            dynamic_returns=dynamic,
             **strat,
         )
         refs = rt.submit_task(spec)

@@ -2011,6 +2011,28 @@ class Runtime:
             out.append(self._get_one(r.id(), deadline))
         return out[0] if single else out
 
+    def _recover_lost_spill(self, oid: ObjectID) -> None:
+        """A SPILLED object's file is gone and no live node holds a copy:
+        flip to the lineage path (reconstructable) or FAILED (loud)."""
+        with self.lock:
+            e = self.directory.get(oid)
+            if e is None or e.state != SPILLED:
+                return
+            alive = {n.node_id.hex() for n in self.nodes.values()
+                     if n.alive}
+            if (e.locations or set()) & alive or self.spill.contains(oid):
+                return  # a holder is still up; keep pulling
+            if e.lineage is not None:
+                e.state = READY      # reuse the evicted-object recovery
+                e.locations = None
+                self._ensure_available_locked(oid)
+                self._schedule_locked()
+            else:
+                self._store_error(oid, exc.ObjectLostError(
+                    f"object {oid} was spilled on a node that died and "
+                    f"has no lineage to reconstruct from"))
+                e.state = FAILED
+
     def _fetch_remote(self, oid: ObjectID) -> bool:
         """Pull an object produced on an own-store node into the head's
         store (object_transfer.py); False when no remote copy exists."""
@@ -2050,9 +2072,11 @@ class Runtime:
                     try:
                         return self.spill.load(oid)
                     except FileNotFoundError:
-                        # spilled on an own-store NODE: pull it over
+                        # spilled on an own-store NODE: pull it over; if
+                        # every holder died, reconstruct via lineage or
+                        # fail loudly — never spin silently
                         if not self._fetch_remote(oid):
-                            continue
+                            self._recover_lost_spill(oid)
                         continue
                     except exc.RayTaskError as e:
                         raise e.as_instanceof_cause() from None
@@ -2290,7 +2314,10 @@ class LocalModeRuntime:
         try:
             res = fn(*args, **kwargs)
             n = len(spec.return_ids)
-            vals = (list(res) if n > 1 else [res])
+            if getattr(spec, "dynamic_returns", False):
+                vals = [[self.put(item) for item in res]]
+            else:
+                vals = (list(res) if n > 1 else [res])
             for oid, v in zip(spec.return_ids, vals):
                 self.objects[oid] = ("ok", v)
         except BaseException as e:  # noqa: BLE001

@@ -38,6 +38,12 @@ class TaskSpec:
     owner: str = "driver"              # "driver" or worker-id hex
     # prepared runtime env (hashes, not blobs — core/runtime_env.py)
     runtime_env: Optional[dict] = None
+    # num_returns="dynamic": the single return holds a list of ObjectRefs,
+    # one per yielded item (reference: dynamic generators)
+    dynamic_returns: bool = False
+    # actor concurrency group this call runs in (transport
+    # concurrency_group_manager.h analog)
+    concurrency_group: Optional[str] = None
 
     @property
     def is_actor_task(self) -> bool:
@@ -60,6 +66,8 @@ class ActorSpec:
     node_affinity: Optional[bytes] = None
     node_affinity_soft: bool = False
     named: Optional[str] = None        # ray.get_actor() name
+    # named method pools: {"io": 2, ...} (concurrency groups)
+    concurrency_groups: Optional[dict] = None
     # creation-readiness object: resolves when the actor __init__ finished
     ready_oid: Optional[ObjectID] = None
     runtime_env: Optional[dict] = None

@@ -384,6 +384,7 @@ class WorkerLoop:
         self.executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="task-exec")
         self.actor_pool: concurrent.futures.ThreadPoolExecutor | None = None
+        self.group_pools: dict[str, concurrent.futures.ThreadPoolExecutor] = {}
         self.aio_loop: asyncio.AbstractEventLoop | None = None
         self._exec_tid: int | None = None
         self._current_task_id = None
@@ -409,6 +410,20 @@ class WorkerLoop:
     def _store_returns(self, spec: TaskSpec, result):
         n = len(spec.return_ids)
         if n == 0:
+            return
+        if getattr(spec, "dynamic_returns", False):
+            # generator task: each yielded item becomes its own object;
+            # the declared return resolves to the list of refs (the outer
+            # object's containment edges keep the items alive)
+            if self.store.contains(spec.return_ids[0]):
+                return  # a retry re-executed an already-stored return
+            item_refs = [self.rt.put_at(ObjectID.from_random(), item)
+                         for item in result]
+            try:
+                self._store_value(spec.return_ids[0], item_refs)
+            except FileExistsError:
+                pass  # lost the race with another attempt; dropping
+                # item_refs frees this attempt's items via refcounting
             return
         if n == 1:
             vals = [result]
@@ -469,6 +484,14 @@ class WorkerLoop:
                 self.actor_pool = concurrent.futures.ThreadPoolExecutor(
                     max_workers=spec.max_concurrency,
                     thread_name_prefix="actor-exec")
+            # named concurrency groups: independent pools so one group's
+            # long calls never block another's
+            # (transport/concurrency_group_manager.h analog)
+            for gname, width in (spec.concurrency_groups or {}).items():
+                self.group_pools[gname] = \
+                    concurrent.futures.ThreadPoolExecutor(
+                        max_workers=max(1, int(width)),
+                        thread_name_prefix=f"cg-{gname}")
             if any(asyncio.iscoroutinefunction(getattr(cls, m, None))
                    for m in dir(cls) if not m.startswith("__")):
                 self.aio_loop = asyncio.new_event_loop()
@@ -484,6 +507,12 @@ class WorkerLoop:
     def _run_actor_task(self, spec: TaskSpec):
         t0 = time.time()
         try:
+            group = getattr(spec, "concurrency_group", None)
+            if group is not None and group not in self.group_pools:
+                raise ValueError(
+                    f"unknown concurrency group {group!r}; declare it via "
+                    f"Actor.options(concurrency_groups={{...}}) "
+                    f"(have: {sorted(self.group_pools)})")
             args, kwargs = self._resolve_args(spec.args_blob)
             if spec.method_name == "__rtpu_exec__":
                 # internal injection point: run an arbitrary function with
@@ -580,7 +609,9 @@ class WorkerLoop:
                 self.executor.submit(self._exec_wrapper,
                                      self._run_actor_create, msg["spec"])
             elif t == "actor_task":
-                pool = self.actor_pool or self.executor
+                group = getattr(msg["spec"], "concurrency_group", None)
+                pool = (self.group_pools.get(group)
+                        or self.actor_pool or self.executor)
                 if self.aio_loop is not None and asyncio.iscoroutinefunction(
                         getattr(type(self.actor_instance),
                                 msg["spec"].method_name, None)):

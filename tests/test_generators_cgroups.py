@@ -1,0 +1,91 @@
+"""Dynamic generator tasks + actor concurrency groups
+(reference: num_returns='dynamic' generators; concurrency_group_manager.h)."""
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def ray(ray_start_regular):
+    return ray_start_regular
+
+
+def test_dynamic_generator_task(ray):
+    @ray.remote(num_returns="dynamic")
+    def gen(n):
+        for i in range(n):
+            yield i * i
+
+    refs = ray.get(gen.remote(5), timeout=60)
+    assert len(refs) == 5
+    assert ray.get(refs, timeout=60) == [0, 1, 4, 9, 16]
+
+
+def test_dynamic_generator_refs_survive_outer(ray):
+    """Items stay alive through the outer list's containment edges."""
+    @ray.remote(num_returns="dynamic")
+    def gen():
+        yield {"big": list(range(10_000))}
+        yield {"big": list(range(10_000, 20_000))}
+
+    refs = ray.get(gen.remote(), timeout=60)
+    time.sleep(0.5)
+    assert ray.get(refs[1], timeout=60)["big"][0] == 10_000
+
+
+def test_dynamic_generator_local_mode():
+    ray_tpu.shutdown()
+    ray_tpu.init(local_mode=True)
+    try:
+        @ray_tpu.remote(num_returns="dynamic")
+        def gen():
+            yield "a"
+            yield "b"
+
+        refs = ray_tpu.get(gen.remote())
+        assert [ray_tpu.get(r) for r in refs] == ["a", "b"]
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_concurrency_groups_isolate(ray):
+    """A long call in one group must not block another group."""
+    @ray.remote(max_concurrency=1)
+    class Service:
+        def __init__(self):
+            self.events = []
+
+        def slow(self):
+            time.sleep(2.0)
+            return "slow-done"
+
+        def ping(self):
+            return "pong"
+
+    svc = Service.options(
+        concurrency_groups={"background": 1, "health": 1}).remote()
+    slow_ref = svc.slow.options(concurrency_group="background").remote()
+    t0 = time.time()
+    out = ray.get(svc.ping.options(concurrency_group="health").remote(),
+                  timeout=60)
+    elapsed = time.time() - t0
+    assert out == "pong"
+    assert elapsed < 1.5, f"health ping waited on background: {elapsed}"
+    assert ray.get(slow_ref, timeout=60) == "slow-done"
+
+
+def test_default_group_still_serial(ray):
+    @ray.remote
+    class Ordered:
+        def __init__(self):
+            self.log = []
+
+        def add(self, x):
+            self.log.append(x)
+            return list(self.log)
+
+    o = Ordered.remote()
+    outs = [o.add.remote(i) for i in range(5)]
+    assert ray.get(outs[-1], timeout=60) == [0, 1, 2, 3, 4]
