@@ -1577,6 +1577,17 @@ class Runtime:
                             e.state = READY
                         if e is not None and loc is not None:
                             e.add_location(loc)
+                    # dynamic-generator items: deterministic ids + the
+                    # producing spec as lineage, so they reconstruct like
+                    # regular returns
+                    for ob in msg.get("dynamic_items", ()):  # bytes
+                        ioid = ObjectID(ob)
+                        ie = self.directory.get(ioid)
+                        if ie is None:
+                            ie = self.directory[ioid] = DirEntry(READY)
+                        ie.lineage = spec
+                        if loc is not None:
+                            ie.add_location(loc)
                         # a consumer may have dropped its ref while we were
                         # still PENDING; re-check now that we're final
                         self._maybe_free_locked(oid)

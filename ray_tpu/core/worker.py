@@ -390,6 +390,7 @@ class WorkerLoop:
         self._current_task_id = None
         self._cancel_lock = threading.Lock()
         self._renv_error: BaseException | None = None
+        self._dynamic_items = None
 
     # -- arg resolution ----------------------------------------------------
 
@@ -412,13 +413,26 @@ class WorkerLoop:
         if n == 0:
             return
         if getattr(spec, "dynamic_returns", False):
-            # generator task: each yielded item becomes its own object;
-            # the declared return resolves to the list of refs (the outer
-            # object's containment edges keep the items alive)
+            # generator task: each yielded item becomes its own object at a
+            # DETERMINISTIC id derived from the task id, so a lineage
+            # re-execution regenerates the SAME ids and in-hand item refs
+            # resolve again (reference reconstructs dynamic returns too);
+            # the declared return resolves to the list of refs (containment
+            # edges keep items alive); the head links item lineage from the
+            # dynamic_items field of the done message
+            import hashlib as _h
             if self.store.contains(spec.return_ids[0]):
                 return  # a retry re-executed an already-stored return
-            item_refs = [self.rt.put_at(ObjectID.from_random(), item)
-                         for item in result]
+            item_refs = []
+            for idx, item in enumerate(result):
+                oid = ObjectID(_h.sha1(
+                    spec.task_id.binary() + b"dyn%d" % idx).digest()[:16])
+                try:
+                    self.rt.put_at(oid, item)
+                except FileExistsError:
+                    pass  # retry: the item is already there
+                item_refs.append(ObjectRef(oid))
+            self._dynamic_items = [r.id().binary() for r in item_refs]
             try:
                 self._store_value(spec.return_ids[0], item_refs)
             except FileExistsError:
@@ -468,9 +482,13 @@ class WorkerLoop:
         finally:
             self._current_task_id = None
         self.rt._did_block = False
-        self.rt.send({"t": "done", "task_id": spec.task_id, "ok": ok,
-                      "err": err, "retryable": retryable, "name": spec.name,
-                      "dur": time.time() - t0})
+        done_msg = {"t": "done", "task_id": spec.task_id, "ok": ok,
+                    "err": err, "retryable": retryable, "name": spec.name,
+                    "dur": time.time() - t0}
+        if getattr(self, "_dynamic_items", None):
+            done_msg["dynamic_items"] = self._dynamic_items
+            self._dynamic_items = None
+        self.rt.send(done_msg)
 
     def _run_actor_create(self, spec: ActorSpec):
         try:
@@ -513,6 +531,13 @@ class WorkerLoop:
                     f"unknown concurrency group {group!r}; declare it via "
                     f"Actor.options(concurrency_groups={{...}}) "
                     f"(have: {sorted(self.group_pools)})")
+            if group is not None and asyncio.iscoroutinefunction(
+                    getattr(type(self.actor_instance), spec.method_name,
+                            None)):
+                raise ValueError(
+                    "concurrency groups bound sync methods only; async "
+                    "methods all share the actor's event loop (use an "
+                    "asyncio.Semaphore inside the actor to bound them)")
             args, kwargs = self._resolve_args(spec.args_blob)
             if spec.method_name == "__rtpu_exec__":
                 # internal injection point: run an arbitrary function with

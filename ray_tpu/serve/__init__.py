@@ -32,11 +32,12 @@ from .batching import batch
 from .context import get_multiplexed_model_id, get_request_context
 from .handle import (DeploymentHandle, DeploymentResponse,
                      DeploymentResponseGenerator)
+from .grpc_proxy import start_grpc_proxy
 from .multiplex import multiplexed
 
 __all__ = [
     "Application", "Deployment", "deployment", "run", "shutdown", "delete",
     "status", "get_app_handle", "DeploymentHandle", "DeploymentResponse",
     "DeploymentResponseGenerator", "batch", "multiplexed",
-    "get_multiplexed_model_id", "get_request_context",
+    "get_multiplexed_model_id", "get_request_context", "start_grpc_proxy",
 ]

@@ -199,6 +199,15 @@ def delete(name: str = "default") -> None:
 def shutdown() -> None:
     ray = _ray()
     try:
+        gp = ray.get_actor("rtpu:serve:grpc-proxy")
+        try:
+            ray.get(gp.stop.remote())
+        except Exception:
+            pass
+        ray.kill(gp)
+    except ValueError:
+        pass
+    try:
         ctrl = _controller(create=False)
     except ValueError:
         return

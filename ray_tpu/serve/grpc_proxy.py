@@ -1,0 +1,153 @@
+"""gRPC ingress for Serve.
+
+Reference parity: the Serve gRPC proxy (serve/_private/proxy.py gRPCProxy +
+user-supplied proto servicers). This image ships the grpc RUNTIME but not
+protoc codegen, so the ingress is a *generic* service registered with
+``GenericRpcHandler`` — no generated stubs on either side:
+
+  method  /raytpu.Serve/Call         unary-unary
+  method  /raytpu.Serve/CallStream   unary-stream
+  request/response payloads: JSON bytes
+  request envelope: {"app": str, "method": str, "payload": any,
+                     "multiplexed_model_id": str}
+
+Client (pure grpc, no stubs):
+
+    ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+    call = ch.unary_unary("/raytpu.Serve/Call")
+    out = json.loads(call(json.dumps({"app": "llm",
+                                      "method": "v1_models"}).encode()))
+"""
+from __future__ import annotations
+
+import json
+import threading
+from collections import OrderedDict
+from concurrent import futures
+from typing import Optional
+
+
+class GrpcProxyActor:
+    """Serve deployment-routing gRPC server (one per cluster, started by
+    serve.start_grpc_proxy)."""
+
+    def __init__(self, port: int = 0):
+        self._requested_port = port
+        self._server = None
+        self.port: Optional[int] = None
+        self._handles: "OrderedDict" = OrderedDict()
+        self._handles_max = 256
+        # the 16-thread gRPC executor mutates the cache concurrently
+        # (unlike the HTTP proxy, which lives on one event-loop thread)
+        self._handles_lock = threading.Lock()
+
+    def start(self) -> int:
+        import grpc
+
+        if self._server is not None:   # idempotent: start-or-return
+            return self.port
+
+        outer = self
+
+        class Handler(grpc.GenericRpcHandler):
+            def service(self, handler_call_details):
+                method = handler_call_details.method
+                if method == "/raytpu.Serve/Call":
+                    return grpc.unary_unary_rpc_method_handler(
+                        outer._call)
+                if method == "/raytpu.Serve/CallStream":
+                    return grpc.unary_stream_rpc_method_handler(
+                        outer._call_stream)
+                return None
+
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=16))
+        self._server.add_generic_rpc_handlers((Handler(),))
+        self.port = self._server.add_insecure_port(
+            f"127.0.0.1:{self._requested_port}")
+        self._server.start()
+        return self.port
+
+    # -- routing (mirrors the HTTP proxy's handle cache) ----------------- #
+
+    def _handle_for(self, app: str, method: str, stream: bool,
+                    model_id: str):
+        import ray_tpu
+
+        from .api import CONTROLLER_NAME
+        from .handle import DeploymentHandle
+        # re-resolve the ingress EVERY request and key on it: a redeployed
+        # app must not route to the old ingress (matches the HTTP proxy)
+        ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+        ingress = ray_tpu.get(ctrl.get_ingress.remote(app))
+        key = (app, ingress, method, stream, model_id)
+        with self._handles_lock:
+            h = self._handles.get(key)
+            if h is None:
+                h = DeploymentHandle(ingress, app, ctrl, method,
+                                     stream=stream,
+                                     multiplexed_model_id=model_id)
+                self._handles[key] = h
+                while len(self._handles) > self._handles_max:
+                    self._handles.popitem(last=False)
+            else:
+                self._handles.move_to_end(key)
+        return h
+
+    @staticmethod
+    def _parse(request_bytes: bytes):
+        req = json.loads(request_bytes or b"{}")
+        app = req.get("app", "default")
+        method = req.get("method", "__call__")
+        if method != "__call__" and (
+                method.startswith("_") or not method.isidentifier()):
+            raise ValueError(f"no route {method!r}")
+        return (app, method, req.get("payload"),
+                req.get("multiplexed_model_id", ""))
+
+    def _call(self, request_bytes: bytes, context) -> bytes:
+        import grpc
+        try:
+            app, method, payload, model_id = self._parse(request_bytes)
+            h = self._handle_for(app, method, False, model_id)
+            resp = (h.remote(payload) if payload is not None
+                    else h.remote())
+            out = resp.result(timeout_s=300)
+            return json.dumps(out, default=str).encode()
+        except Exception as e:  # noqa: BLE001 — map to grpc status
+            context.abort(grpc.StatusCode.INTERNAL, repr(e))
+
+    def _call_stream(self, request_bytes: bytes, context):
+        import grpc
+        try:
+            app, method, payload, model_id = self._parse(request_bytes)
+            h = self._handle_for(app, method, True, model_id)
+            gen = (h.remote(payload) if payload is not None
+                   else h.remote())
+            try:
+                for chunk in gen:
+                    yield json.dumps(chunk, default=str).encode()
+            finally:
+                gen.cancel()
+        except Exception as e:  # noqa: BLE001
+            context.abort(grpc.StatusCode.INTERNAL, repr(e))
+
+    def stop(self):
+        if self._server is not None:
+            self._server.stop(grace=1.0)
+
+
+def start_grpc_proxy(port: int = 0):
+    """Start (or return) the cluster's gRPC proxy actor; returns
+    (handle, bound_port)."""
+    import ray_tpu
+    name = "rtpu:serve:grpc-proxy"
+    try:
+        actor = ray_tpu.get_actor(name)
+        return actor, ray_tpu.get(actor.start.remote())
+    except ValueError:
+        pass
+    cls = ray_tpu.remote(GrpcProxyActor)
+    actor = cls.options(name=name, max_concurrency=32).remote(port)
+    bound = ray_tpu.get(actor.start.remote())
+    return actor, bound

@@ -89,3 +89,35 @@ def test_default_group_still_serial(ray):
     o = Ordered.remote()
     outs = [o.add.remote(i) for i in range(5)]
     assert ray.get(outs[-1], timeout=60) == [0, 1, 2, 3, 4]
+
+
+def test_dynamic_items_reconstruct_after_eviction(ray):
+    """Deterministic item ids + lineage: an evicted yielded item comes
+    back via re-execution and the ORIGINAL ref still resolves."""
+    from ray_tpu.core import runtime as rt_mod
+    rt = rt_mod.get_runtime_if_exists()
+
+    @ray.remote(num_returns="dynamic", max_retries=2)
+    def gen():
+        for i in range(3):
+            yield {"i": i, "pad": list(range(2000))}
+
+    refs = ray.get(gen.remote(), timeout=60)
+    assert ray.get(refs[2], timeout=60)["i"] == 2
+    rt.store.delete(refs[2].id())          # simulate eviction
+    got = ray.get(refs[2], timeout=120)    # reconstructed, same id
+    assert got["i"] == 2
+
+
+def test_async_method_rejects_concurrency_group(ray):
+    @ray.remote
+    class Aio:
+        async def coro(self):
+            return 1
+
+    a = Aio.options(concurrency_groups={"g": 2}).remote()
+    import pytest as _pytest
+    with _pytest.raises(Exception, match="sync methods"):
+        ray.get(a.coro.options(concurrency_group="g").remote(), timeout=60)
+    # async WITHOUT a group still works
+    assert ray.get(a.coro.remote(), timeout=60) == 1

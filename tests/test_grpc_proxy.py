@@ -1,0 +1,61 @@
+"""gRPC proxy tests (reference: serve gRPC ingress)."""
+import json
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def ray(ray_start_regular):
+    yield ray_start_regular
+    serve.shutdown()
+
+
+def test_grpc_unary_and_stream(ray):
+    grpc = pytest.importorskip("grpc")
+
+    @serve.deployment
+    class Api:
+        def __call__(self, payload):
+            return {"echo": payload, "n": (payload or {}).get("n", 0) * 2}
+
+        def tokens(self, n):
+            for i in range(n):
+                yield {"tok": i}
+
+    serve.run(Api.bind(), name="gapp")
+    _, port = serve.start_grpc_proxy()
+
+    ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+    call = ch.unary_unary("/raytpu.Serve/Call")
+    out = json.loads(call(json.dumps(
+        {"app": "gapp", "payload": {"n": 21}}).encode(), timeout=60))
+    assert out == {"echo": {"n": 21}, "n": 42}
+
+    stream = ch.unary_stream("/raytpu.Serve/CallStream")
+    chunks = [json.loads(c) for c in stream(json.dumps(
+        {"app": "gapp", "method": "tokens", "payload": 3}).encode(),
+        timeout=60)]
+    assert chunks == [{"tok": 0}, {"tok": 1}, {"tok": 2}]
+
+
+def test_grpc_errors_map_to_status(ray):
+    grpc = pytest.importorskip("grpc")
+
+    @serve.deployment
+    def boom(payload=None):
+        raise RuntimeError("nope")
+
+    serve.run(boom.bind(), name="gerr")
+    _, port = serve.start_grpc_proxy()
+    ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+    call = ch.unary_unary("/raytpu.Serve/Call")
+    with pytest.raises(grpc.RpcError) as ei:
+        call(json.dumps({"app": "gerr"}).encode(), timeout=60)
+    assert ei.value.code() == grpc.StatusCode.INTERNAL
+    # private methods are not routable
+    with pytest.raises(grpc.RpcError):
+        call(json.dumps({"app": "gerr", "method": "_handle"}).encode(),
+             timeout=60)
