@@ -8,12 +8,16 @@ config 3: PPO EnvRunner actors + jitted JAX learner over the mesh).
 from .algorithm import PPO, AlgorithmConfig
 from .dqn import (DQN, DQNAlgorithmConfig, DQNConfig, DQNLearner,
                   ReplayBuffer)
+from .impala import (IMPALA, ImpalaAlgorithmConfig, ImpalaConfig,
+                     ImpalaLearner, vtrace)
 from .env_runner import EnvRunner, make_gym_env
 from .learner import PPOConfig, PPOLearner, compute_gae
 from .module import MLPConfig
 
 __all__ = [
     "DQN", "DQNAlgorithmConfig", "DQNConfig", "DQNLearner", "ReplayBuffer",
+    "IMPALA", "ImpalaAlgorithmConfig", "ImpalaConfig", "ImpalaLearner",
+    "vtrace",
     "PPO", "AlgorithmConfig", "EnvRunner", "make_gym_env",
     "PPOConfig", "PPOLearner", "compute_gae", "MLPConfig",
 ]

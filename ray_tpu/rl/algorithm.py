@@ -20,6 +20,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from .base import AlgorithmBase
 from .env_runner import EnvRunner, make_gym_env
 from .learner import PPOConfig, PPOLearner
 from .module import MLPConfig
@@ -61,40 +62,15 @@ class AlgorithmConfig:
         return PPO(self)
 
 
-class PPO:
+class PPO(AlgorithmBase):
     """Proximal Policy Optimization over EnvRunner actors + a JAX learner."""
 
+    HPARAM_FIELD = "ppo"
+
     def __init__(self, config: AlgorithmConfig):
-        import ray_tpu as ray
-
-        from ..core.usage import record_library_usage
-        record_library_usage("rl")
-
-        if config.env_fn is None:
-            raise ValueError("config.environment(...) is required")
-        self.config = config
-        probe = config.env_fn()
-        obs_dim = int(np.prod(probe.observation_space.shape))
-        num_actions = int(probe.action_space.n)
-        probe.close()
-
-        self.module_cfg = MLPConfig(obs_dim=obs_dim, num_actions=num_actions,
-                                    hidden=tuple(config.hidden))
+        self._setup(config, EnvRunner)
         self.learner = PPOLearner(self.module_cfg, config.ppo,
                                   seed=config.seed, mesh=config.mesh)
-
-        RunnerCls = ray.remote(EnvRunner)
-        self._runners = [
-            RunnerCls.options(**{
-                "num_cpus": config.runner_resources.get("CPU", 1)}).remote(
-                config.env_fn, config.num_envs_per_runner,
-                config.rollout_len, seed=config.seed + 1000 * (i + 1))
-            for i in range(config.num_env_runners)
-        ]
-        self._ray = ray
-        self.iteration = 0
-        self._total_env_steps = 0
-        self._recent_returns: list[float] = []
 
     # -- the training_step loop (reference algorithm.py:2004) --------------
 
@@ -114,11 +90,8 @@ class PPO:
         steps = (self.config.rollout_len * self.config.num_envs_per_runner
                  * self.config.num_env_runners)
         self._total_env_steps += steps
-        for s in samples:
-            self._recent_returns.extend(s["episode_returns"])
-        self._recent_returns = self._recent_returns[-100:]
-        mean_ret = (float(np.mean(self._recent_returns))
-                    if self._recent_returns else float("nan"))
+        mean_ret = self._note_returns(
+            [r for s in samples for r in s["episode_returns"]])
         dt = time.perf_counter() - t0
         return {
             "training_iteration": self.iteration,
@@ -131,67 +104,3 @@ class PPO:
             **{f"learner/{k}": v for k, v in stats.items()},
         }
 
-    def evaluate(self, num_episodes: int = 5) -> dict:
-        ray = self._ray
-        weights_ref = ray.put(self.learner.get_params())
-        return ray.get(self._runners[0].evaluate.remote(
-            weights_ref, num_episodes))
-
-    def get_weights(self):
-        return self.learner.get_params()
-
-    def set_weights(self, weights):
-        self.learner.set_params(weights)
-
-    def save_checkpoint(self) -> dict:
-        import jax
-        return {"params": jax.device_get(self.learner.params),
-                "opt_state": jax.device_get(self.learner.opt_state),
-                "iteration": self.iteration,
-                "total_env_steps": self._total_env_steps}
-
-    def restore_checkpoint(self, state: dict) -> None:
-        import jax.numpy as jnp
-        import jax
-        self.learner.params = jax.tree.map(jnp.asarray, state["params"])
-        self.learner.opt_state = jax.tree.map(
-            jnp.asarray, state["opt_state"])
-        self.iteration = state["iteration"]
-        self._total_env_steps = state["total_env_steps"]
-
-    def stop(self):
-        for r in self._runners:
-            try:
-                self._ray.kill(r)
-            except Exception:
-                pass
-
-    # -- Tune integration ---------------------------------------------------
-
-    @classmethod
-    def as_trainable(cls, config: AlgorithmConfig,
-                     stop_iters: int = 100) -> Callable:
-        """A Tune function-trainable running this algorithm (reference:
-        Algorithm IS a Trainable; here the adapter is explicit)."""
-
-        def trainable(tune_config: dict):
-            from ..tune import report
-            import copy
-            import dataclasses
-            cfg = copy.copy(config)  # don't leak overrides across trials
-            if tune_config:
-                unknown = [k for k in tune_config
-                           if not hasattr(cfg.ppo, k)]
-                if unknown:
-                    raise ValueError(
-                        f"unknown PPO hyperparameters in search space: "
-                        f"{unknown}")
-                cfg.ppo = dataclasses.replace(cfg.ppo, **tune_config)
-            algo = cls(cfg)
-            try:
-                for _ in range(stop_iters):
-                    report(algo.train())
-            finally:
-                algo.stop()
-
-        return trainable

@@ -1,0 +1,146 @@
+"""Shared Algorithm scaffolding for the RL family.
+
+Reference parity: the common half of rllib/algorithms/algorithm.py —
+every Algorithm builds an env probe + a runner-actor group, exposes
+evaluate/save/restore/stop, and plugs into Tune as a trainable. PPO, DQN
+and IMPALA subclass this and keep only their training_step logic.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+from .module import MLPConfig
+
+
+class AlgorithmBase:
+    """Subclass contract: set ``self.learner`` (with ``.params`` or
+    ``get_params()``), call ``_setup(config, runner_cls)`` in __init__,
+    implement ``train()``; set class attr ``HPARAM_FIELD`` to the config
+    attribute holding the per-algorithm dataclass (for as_trainable)."""
+
+    HPARAM_FIELD: str = ""
+
+    def _setup(self, config, runner_cls) -> None:
+        import ray_tpu as ray
+
+        from ..core.usage import record_library_usage
+        record_library_usage("rl")
+        if config.env_fn is None:
+            raise ValueError("config.environment(...) is required")
+        self.config = config
+        probe = config.env_fn()
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        num_actions = int(probe.action_space.n)
+        probe.close()
+        self.module_cfg = MLPConfig(obs_dim=obs_dim,
+                                    num_actions=num_actions,
+                                    hidden=tuple(config.hidden))
+        RunnerCls = ray.remote(runner_cls)
+        self._runners = [
+            RunnerCls.options(num_cpus=config.runner_resources.get(
+                "CPU", 1)).remote(
+                config.env_fn, config.num_envs_per_runner,
+                config.rollout_len, seed=config.seed + 1000 * (i + 1))
+            for i in range(config.num_env_runners)]
+        self._ray = ray
+        self.iteration = 0
+        self._total_env_steps = 0
+        self._recent_returns: list[float] = []
+
+    # -- weights ---------------------------------------------------------- #
+
+    def get_weights(self):
+        lrn = self.learner
+        return lrn.get_params() if hasattr(lrn, "get_params") \
+            else lrn.params
+
+    def set_weights(self, weights) -> None:
+        lrn = self.learner
+        if hasattr(lrn, "set_params"):
+            lrn.set_params(weights)
+        else:
+            lrn.params = weights
+
+    # -- lifecycle --------------------------------------------------------- #
+
+    def evaluate(self, num_episodes: int = 5) -> dict:
+        ray = self._ray
+        weights_ref = ray.put(self.get_weights())
+        return ray.get(self._runners[0].evaluate.remote(
+            weights_ref, num_episodes))
+
+    def _extra_state(self) -> dict:
+        """Algorithm-specific checkpoint fields (e.g. DQN target net)."""
+        return {}
+
+    def _load_extra_state(self, state: dict) -> None:
+        pass
+
+    def save_checkpoint(self) -> dict:
+        import jax
+        return {"params": jax.device_get(self.learner.params),
+                "opt_state": jax.device_get(self.learner.opt_state),
+                "iteration": self.iteration,
+                "total_env_steps": self._total_env_steps,
+                **{k: jax.device_get(v)
+                   for k, v in self._extra_state().items()}}
+
+    def restore_checkpoint(self, state: dict) -> None:
+        import jax
+        import jax.numpy as jnp
+        self.learner.params = jax.tree.map(jnp.asarray, state["params"])
+        self.learner.opt_state = jax.tree.map(
+            jnp.asarray, state["opt_state"])
+        self.iteration = state["iteration"]
+        self._total_env_steps = state["total_env_steps"]
+        self._load_extra_state(state)
+
+    def stop(self) -> None:
+        for r in self._runners:
+            try:
+                self._ray.kill(r)
+            except Exception:
+                pass
+
+    # -- bookkeeping shared by training_steps ------------------------------ #
+
+    def _note_returns(self, episode_returns) -> float:
+        self._recent_returns.extend(episode_returns)
+        self._recent_returns = self._recent_returns[-100:]
+        return (float(np.mean(self._recent_returns))
+                if self._recent_returns else float("nan"))
+
+    # -- Tune integration --------------------------------------------------- #
+
+    @classmethod
+    def as_trainable(cls, config, stop_iters: int = 100) -> Callable:
+        """A Tune function-trainable for this algorithm (reference:
+        Algorithm IS a Trainable; here the adapter is explicit). Search
+        space keys override fields of the ``HPARAM_FIELD`` dataclass."""
+        field = cls.HPARAM_FIELD
+
+        def trainable(tune_config: dict):
+            import copy
+            import dataclasses
+
+            from ..tune import report
+            cfg = copy.copy(config)  # don't leak overrides across trials
+            if tune_config:
+                hp = getattr(cfg, field)
+                unknown = [k for k in tune_config if not hasattr(hp, k)]
+                if unknown:
+                    raise ValueError(
+                        f"unknown {field} hyperparameters in search "
+                        f"space: {unknown}")
+                setattr(cfg, field,
+                        dataclasses.replace(hp, **tune_config))
+            algo = cls(cfg)
+            try:
+                for _ in range(stop_iters):
+                    report(algo.train())
+            finally:
+                algo.stop()
+
+        return trainable

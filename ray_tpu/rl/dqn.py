@@ -18,6 +18,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from . import module as module_lib
+from .base import AlgorithmBase
 from .module import MLPConfig
 
 
@@ -257,39 +258,18 @@ class DQNLearner:
                 "q_mean": float(qm)}
 
 
-class DQN:
+class DQN(AlgorithmBase):
     """The Algorithm driver (reference: dqn.py DQN.training_step)."""
 
-    def __init__(self, config: "DQNAlgorithmConfig"):
-        import ray_tpu as ray
+    HPARAM_FIELD = "dqn"
 
-        from ..core.usage import record_library_usage
-        record_library_usage("rl")
-        if config.env_fn is None:
-            raise ValueError("config.environment(...) is required")
-        self.config = config
-        probe = config.env_fn()
-        obs_dim = int(np.prod(probe.observation_space.shape))
-        num_actions = int(probe.action_space.n)
-        probe.close()
-        self.module_cfg = MLPConfig(obs_dim=obs_dim,
-                                    num_actions=num_actions,
-                                    hidden=tuple(config.hidden))
+    def __init__(self, config: "DQNAlgorithmConfig"):
+        self._setup(config, DQNRunner)
         self.learner = DQNLearner(self.module_cfg, config.dqn,
                                   seed=config.seed)
-        self.buffer = ReplayBuffer(config.dqn.buffer_size, obs_dim)
-        RunnerCls = ray.remote(DQNRunner)
-        self._runners = [
-            RunnerCls.options(num_cpus=config.runner_resources.get(
-                "CPU", 1)).remote(
-                config.env_fn, config.num_envs_per_runner,
-                config.rollout_len, seed=config.seed + 1000 * (i + 1))
-            for i in range(config.num_env_runners)]
-        self._ray = ray
+        self.buffer = ReplayBuffer(config.dqn.buffer_size,
+                                   self.module_cfg.obs_dim)
         self._np_rng = np.random.default_rng(config.seed)
-        self.iteration = 0
-        self._total_env_steps = 0
-        self._recent_returns: list[float] = []
 
     def _epsilon(self) -> float:
         cfg = self.config.dqn
@@ -306,8 +286,8 @@ class DQN:
         for s in samples:
             self.buffer.add_batch(s["obs"], s["actions"], s["rewards"],
                                   s["next_obs"], s["dones"])
-            self._recent_returns.extend(s["episode_returns"])
-        self._recent_returns = self._recent_returns[-100:]
+        mean_ret = self._note_returns(
+            [r for s in samples for r in s["episode_returns"]])
         steps = sum(len(s["actions"]) for s in samples)
         self._total_env_steps += steps
 
@@ -316,8 +296,6 @@ class DQN:
             stats = self.learner.update_from_buffer(self.buffer,
                                                     self._np_rng)
         self.iteration += 1
-        mean_ret = (float(np.mean(self._recent_returns))
-                    if self._recent_returns else float("nan"))
         dt = time.perf_counter() - t0
         return {
             "training_iteration": self.iteration,
@@ -330,37 +308,14 @@ class DQN:
             **{f"learner/{k}": v for k, v in stats.items()},
         }
 
-    def evaluate(self, num_episodes: int = 5) -> dict:
-        ray = self._ray
-        weights_ref = ray.put(self.learner.params)
-        return ray.get(self._runners[0].evaluate.remote(
-            weights_ref, num_episodes))
+    def _extra_state(self) -> dict:
+        return {"target_params": self.learner.target_params}
 
-    def save_checkpoint(self) -> dict:
-        import jax
-        return {"params": jax.device_get(self.learner.params),
-                "target_params": jax.device_get(self.learner.target_params),
-                "opt_state": jax.device_get(self.learner.opt_state),
-                "iteration": self.iteration,
-                "total_env_steps": self._total_env_steps}
-
-    def restore_checkpoint(self, state: dict) -> None:
+    def _load_extra_state(self, state: dict) -> None:
         import jax
         import jax.numpy as jnp
-        self.learner.params = jax.tree.map(jnp.asarray, state["params"])
         self.learner.target_params = jax.tree.map(
             jnp.asarray, state["target_params"])
-        self.learner.opt_state = jax.tree.map(
-            jnp.asarray, state["opt_state"])
-        self.iteration = state["iteration"]
-        self._total_env_steps = state["total_env_steps"]
-
-    def stop(self):
-        for r in self._runners:
-            try:
-                self._ray.kill(r)
-            except Exception:
-                pass
 
 
 class DQNAlgorithmConfig:

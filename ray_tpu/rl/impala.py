@@ -1,0 +1,220 @@
+"""IMPALA: asynchronous actor-critic with V-trace off-policy correction.
+
+Reference parity: rllib/algorithms/impala/ (async EnvRunner sampling
+decoupled from the learner, V-trace per Espeholt et al. 2018 correcting
+the policy lag). The driver keeps every runner busy via ray.wait —
+sample fragments stream in as they finish, the learner updates on each,
+and refreshed weights ship to a runner only when it starts its next
+fragment (so behaviour policies genuinely lag, which V-trace corrects
+with clipped importance ratios).
+
+TPU-first: the whole V-trace computation (reverse scan over the fragment)
++ policy/value update is ONE jitted program; runners stay cheap CPU
+actors (rl/env_runner.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from . import module as module_lib
+from .base import AlgorithmBase
+from .env_runner import EnvRunner, make_gym_env
+from .module import MLPConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class ImpalaConfig:
+    """(reference: impala.py IMPALAConfig.training)"""
+    lr: float = 5e-4
+    gamma: float = 0.99
+    rho_bar: float = 1.0          # importance-ratio clip for targets
+    c_bar: float = 1.0            # trace-cutting clip
+    vf_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    grad_clip: float = 40.0
+
+
+def vtrace(behaviour_logp, target_logp, rewards, values, dones,
+           bootstrap_value, gamma, rho_bar, c_bar):
+    """V-trace targets + pg advantages (time-major [T, B] arrays).
+
+    Returns (vs [T, B], pg_adv [T, B]) per Espeholt et al. eq. (1).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    rhos = jnp.exp(target_logp - behaviour_logp)
+    clipped_rhos = jnp.minimum(rho_bar, rhos)
+    cs = jnp.minimum(c_bar, rhos)
+    discounts = gamma * (1.0 - dones)
+
+    values_tp1 = jnp.concatenate(
+        [values[1:], bootstrap_value[None]], axis=0)
+    deltas = clipped_rhos * (rewards + discounts * values_tp1 - values)
+
+    def step(acc, xs):
+        delta, discount, c = xs
+        acc = delta + discount * c * acc
+        return acc, acc
+
+    _, vs_minus_v = jax.lax.scan(
+        step, jnp.zeros_like(bootstrap_value),
+        (deltas, discounts, cs), reverse=True)
+    vs = vs_minus_v + values
+    vs_tp1 = jnp.concatenate([vs[1:], bootstrap_value[None]], axis=0)
+    pg_adv = clipped_rhos * (rewards + discounts * vs_tp1 - values)
+    return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
+
+
+class ImpalaLearner:
+    def __init__(self, module_cfg: MLPConfig, cfg: ImpalaConfig,
+                 seed: int = 0):
+        import jax
+        import optax
+        self.cfg = cfg
+        self.params = module_lib.init(jax.random.PRNGKey(seed), module_cfg)
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(cfg.grad_clip),
+            optax.rmsprop(cfg.lr, decay=0.99, eps=0.1))
+        self.opt_state = self.optimizer.init(self.params)
+        self._update = jax.jit(self._build_update())
+
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+        cfg = self.cfg
+
+        def loss_fn(params, batch):
+            logits, values = module_lib.logits_and_value(
+                params, batch["obs"])                       # [T, B, A]/[T, B]
+            logp_all = jax.nn.log_softmax(logits, axis=-1)
+            target_logp = jnp.take_along_axis(
+                logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+            vs, pg_adv = vtrace(
+                batch["logp"], target_logp, batch["rewards"], values,
+                batch["dones"], batch["bootstrap_value"],
+                cfg.gamma, cfg.rho_bar, cfg.c_bar)
+            pg_loss = -jnp.mean(target_logp * pg_adv)
+            vf_loss = 0.5 * jnp.mean((vs - values) ** 2)
+            entropy = -jnp.mean(
+                jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+            total = (pg_loss + cfg.vf_coeff * vf_loss
+                     - cfg.entropy_coeff * entropy)
+            return total, (pg_loss, vf_loss, entropy)
+
+        def update(params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = self.optimizer.update(
+                grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss, aux
+
+        return update
+
+    def update(self, sample: dict) -> dict:
+        import jax.numpy as jnp
+        batch = {
+            "obs": jnp.asarray(sample["obs"]),
+            "actions": jnp.asarray(sample["actions"]),
+            "logp": jnp.asarray(sample["logp"]),
+            "rewards": jnp.asarray(sample["rewards"]),
+            "dones": jnp.asarray(sample["dones"], jnp.float32),
+            "bootstrap_value": jnp.asarray(sample["last_value"]),
+        }
+        self.params, self.opt_state, loss, (pg, vf, ent) = self._update(
+            self.params, self.opt_state, batch)
+        return {"loss": float(loss), "pg_loss": float(pg),
+                "vf_loss": float(vf), "entropy": float(ent)}
+
+
+class IMPALA(AlgorithmBase):
+    """The async driver loop (reference: impala.py training_step)."""
+
+    HPARAM_FIELD = "impala"
+
+    def __init__(self, config: "ImpalaAlgorithmConfig"):
+        self._setup(config, EnvRunner)
+        self.learner = ImpalaLearner(self.module_cfg, config.impala,
+                                     seed=config.seed)
+        # async pipeline: every runner always has a sample in flight,
+        # started with the weights current at ITS dispatch time
+        self._inflight: dict = {}
+        weights_ref = self._ray.put(self.learner.params)
+        for r in self._runners:
+            self._inflight[r.sample.remote(weights_ref)] = r
+
+    def train(self) -> dict:
+        """One iteration = one learner update per runner fragment, taken
+        in completion order (true IMPALA asynchrony)."""
+        ray = self._ray
+        t0 = time.perf_counter()
+        stats: dict = {}
+        fragments = 0
+        while fragments < len(self._runners):
+            done, _ = ray.wait(list(self._inflight), num_returns=1,
+                               timeout=30.0)
+            if not done:
+                break
+            ref = done[0]
+            runner = self._inflight.pop(ref)
+            sample = ray.get(ref)
+            # redispatch IMMEDIATELY with fresh weights — the learner
+            # update below overlaps the runner's next fragment
+            weights_ref = ray.put(self.learner.params)
+            self._inflight[runner.sample.remote(weights_ref)] = runner
+            stats = self.learner.update(sample)
+            fragments += 1
+            steps = int(np.prod(sample["actions"].shape))
+            self._total_env_steps += steps
+            self._note_returns(sample["episode_returns"])
+        mean_ret = self._note_returns(())
+        self.iteration += 1
+        dt = time.perf_counter() - t0
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": mean_ret,
+            "num_env_steps_sampled_lifetime": self._total_env_steps,
+            "env_steps_per_sec": (
+                fragments * self.config.rollout_len
+                * self.config.num_envs_per_runner / max(dt, 1e-9)),
+            **{f"learner/{k}": v for k, v in stats.items()},
+        }
+
+
+
+class ImpalaAlgorithmConfig:
+    def __init__(self):
+        self.env_fn: Optional[Callable] = None
+        self.num_env_runners = 2
+        self.num_envs_per_runner = 4
+        self.rollout_len = 32
+        self.impala = ImpalaConfig()
+        self.hidden = (64, 64)
+        self.seed = 0
+        self.runner_resources = {"CPU": 1}
+
+    def environment(self, env, **kwargs) -> "ImpalaAlgorithmConfig":
+        self.env_fn = make_gym_env(env, **kwargs) if isinstance(env, str) \
+            else env
+        return self
+
+    def env_runners(self, num_env_runners: int = 2,
+                    num_envs_per_env_runner: int = 4,
+                    rollout_fragment_length: int = 32
+                    ) -> "ImpalaAlgorithmConfig":
+        self.num_env_runners = num_env_runners
+        self.num_envs_per_runner = num_envs_per_env_runner
+        self.rollout_len = rollout_fragment_length
+        return self
+
+    def training(self, **kwargs) -> "ImpalaAlgorithmConfig":
+        self.impala = dataclasses.replace(self.impala, **kwargs)
+        return self
+
+    def build(self) -> IMPALA:
+        return IMPALA(self)

@@ -32,11 +32,13 @@ from .session import (
     report,
     TrainContext,
 )
-from .trainer import DataParallelTrainer, JaxTrainer, Result, TrainingFailedError
+from .trainer import (DataParallelTrainer, JaxTrainer, Result,
+                      TorchTrainer, TrainingFailedError)
 
 __all__ = [
     "Checkpoint", "CheckpointConfig", "FailureConfig", "RunConfig",
     "ScalingConfig", "get_checkpoint", "get_context", "get_dataset_shard",
-    "report", "TrainContext", "DataParallelTrainer", "JaxTrainer", "Result",
+    "report", "TrainContext", "DataParallelTrainer", "JaxTrainer",
+    "TorchTrainer", "Result",
     "TrainingFailedError",
 ]

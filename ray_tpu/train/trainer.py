@@ -135,6 +135,7 @@ class _TrainWorker:
         train_fn, train_cfg = cloudpickle.loads(fn_and_cfg)
         run_name, rank, world = self._ctx_args
         dist = self._init_jax_distributed(rank, world)
+        tdist = self._init_torch_distributed(rank, world)
         ctx = session_mod.TrainContext(
             run_name=run_name, rank=rank, world_size=world,
             restored_checkpoint=(Checkpoint(restore_path)
@@ -154,6 +155,12 @@ class _TrainWorker:
                     jax.distributed.shutdown()
                 except Exception:
                     pass
+            if tdist:
+                try:
+                    import torch.distributed as td
+                    td.destroy_process_group()
+                except Exception:
+                    pass
         return "done"
 
     def _init_jax_distributed(self, rank: int, world: int) -> bool:
@@ -169,29 +176,49 @@ class _TrainWorker:
         restarted gangs from a dead predecessor's address."""
         if os.environ.get("RTPU_JAX_DIST") != "1" or world <= 1:
             return False
+        coord = self._rendezvous_coord("coord", rank, "jax.distributed")
+        import jax
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=world, process_id=rank)
+        return True
+
+    def _rendezvous_coord(self, prefix: str, rank: int, what: str) -> str:
+        """Gen-keyed coordinator rendezvous over the result bus: rank 0
+        binds a port on ITS host and publishes; peers poll (shared by the
+        jax.distributed and torch.distributed gangs)."""
         import time as _time
 
         import ray_tpu as ray
 
-        key = f"coord:{os.environ.get('RTPU_TRAIN_GEN', '0')}"
+        key = f"{prefix}:{os.environ.get('RTPU_TRAIN_GEN', '0')}"
         if rank == 0:
             from ..core.runtime import host_ip
             coord = f"{host_ip()}:{_free_port()}"
             ray.get(self._bus.set_kv.remote(key, coord))
-        else:
-            deadline = _time.monotonic() + 60
-            while True:
-                coord = ray.get(self._bus.get_kv.remote(key))
-                if coord:
-                    break
-                if _time.monotonic() > deadline:
-                    raise TrainingFailedError(
-                        "rank 0 never published the jax.distributed "
-                        "coordinator address")
-                _time.sleep(0.05)
-        import jax
-        jax.distributed.initialize(coordinator_address=coord,
-                                   num_processes=world, process_id=rank)
+            return coord
+        deadline = _time.monotonic() + 60
+        while True:
+            coord = ray.get(self._bus.get_kv.remote(key))
+            if coord:
+                return coord
+            if _time.monotonic() > deadline:
+                raise TrainingFailedError(
+                    f"rank 0 never published the {what} "
+                    f"coordinator address")
+            _time.sleep(0.05)
+
+
+    def _init_torch_distributed(self, rank: int, world: int) -> bool:
+        """torch.distributed gloo gang (the reference TorchTrainer's
+        backend setup, train/torch/config.py:115 — dist.init_process_group
+        over a rendezvous rank 0 publishes). CPU gloo in this image; on
+        GPU fleets the reference swaps in nccl the same way."""
+        if os.environ.get("RTPU_TORCH_DIST") != "1" or world <= 1:
+            return False
+        coord = self._rendezvous_coord("tcoord", rank, "torch.distributed")
+        import torch.distributed as td
+        td.init_process_group("gloo", init_method=f"tcp://{coord}",
+                              rank=rank, world_size=world)
         return True
 
 
@@ -399,3 +426,18 @@ class JaxTrainer(DataParallelTrainer):
     """The flagship trainer (reference analog: TorchTrainer,
     train/torch/torch_trainer.py — here the worker gang runs jax SPMD
     programs over the gang's global mesh)."""
+
+
+class TorchTrainer(DataParallelTrainer):
+    """Torch data-parallel trainer (reference: TorchTrainer,
+    train/torch/torch_trainer.py): the worker gang forms one
+    torch.distributed gloo process group before the train fn runs — use
+    torch DDP / all_reduce inside as usual. (The JAX path is the flagship
+    on TPU; this exists for torch-based workloads and API parity.)"""
+
+    def _worker_env(self, rank: int, world: int) -> dict:
+        env = super()._worker_env(rank, world)
+        if world > 1:
+            env["RTPU_TORCH_DIST"] = "1"
+            env.setdefault("RTPU_TRAIN_GEN", str(self._start_count))
+        return env

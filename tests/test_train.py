@@ -186,3 +186,36 @@ def test_elastic_gang_downsizes(ray_start_regular):
     world = result.metrics["world"]
     assert 1 <= world <= avail, (world, avail)
     assert world < want
+
+
+def test_torch_trainer_gloo_gang(ray_start_regular):
+    """TorchTrainer forms a gloo process group across the gang
+    (reference: train/torch/config.py dist.init_process_group)."""
+    from ray_tpu import train
+    from ray_tpu.train import RunConfig, ScalingConfig, TorchTrainer
+
+    def loop(config=None):
+        import torch
+        import torch.distributed as dist
+        ctx = train.get_context()
+        t = torch.tensor([float(ctx.rank + 1)])
+        dist.all_reduce(t)             # 1 + 2 = 3 across the gang
+        # a real DDP step proves gradient sync works end to end
+        model = torch.nn.Linear(4, 1)
+        ddp = torch.nn.parallel.DistributedDataParallel(model)
+        x = torch.ones(2, 4) * (ctx.rank + 1)
+        loss = ddp(x).sum()
+        loss.backward()
+        g = model.weight.grad.clone()
+        train.report({"allreduce": float(t.item()),
+                      "grad0": float(g[0, 0].item()),
+                      "world": ctx.world_size})
+
+    result = TorchTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2, cpus_per_worker=1.0),
+        run_config=RunConfig(name="torch-gang")).fit()
+    assert result.metrics["allreduce"] == 3.0
+    assert result.metrics["world"] == 2
+    # DDP averages grads: rank0 sees (2*1 + 2*2)/2 = 3
+    assert abs(result.metrics["grad0"] - 3.0) < 1e-5
