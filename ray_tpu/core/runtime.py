@@ -1577,6 +1577,9 @@ class Runtime:
                             e.state = READY
                         if e is not None and loc is not None:
                             e.add_location(loc)
+                        # a consumer may have dropped its ref while we were
+                        # still PENDING; re-check now that we're final
+                        self._maybe_free_locked(oid)
                     # dynamic-generator items: deterministic ids + the
                     # producing spec as lineage, so they reconstruct like
                     # regular returns
@@ -1588,9 +1591,7 @@ class Runtime:
                         ie.lineage = spec
                         if loc is not None:
                             ie.add_location(loc)
-                        # a consumer may have dropped its ref while we were
-                        # still PENDING; re-check now that we're final
-                        self._maybe_free_locked(oid)
+                        self._maybe_free_locked(ioid)
                     self._drop_task_dep_interest_locked(spec)
                 elif msg.get("retryable"):
                     self._handle_failed_task_locked(
