@@ -119,7 +119,21 @@ class NodeAgent:
         except Exception:
             pass
 
+    def _heartbeat_loop(self):
+        from .config import cfg
+        period = cfg.health_check_period_ms / 1000.0
+        if period <= 0:
+            return
+        while True:
+            time.sleep(period)
+            try:
+                self.send({"t": "heartbeat"})
+            except Exception:
+                return  # conn gone; run() is tearing down
+
     def run(self):
+        threading.Thread(target=self._heartbeat_loop, daemon=True,
+                         name="agent-heartbeat").start()
         try:
             while True:
                 msg = self.conn.recv()

@@ -420,6 +420,11 @@ class Runtime:
             "jobs", {"job_id": job_id, "status": status})
         self._driver_seq = 0
 
+        # agent liveness: heartbeats guard against HUNG agents (conn EOF
+        # already covers dead processes) — gcs_health_check_manager.h:45
+        threading.Thread(target=self._health_check_loop, daemon=True,
+                         name="rtpu-healthcheck").start()
+
         # cross-node data plane: serve this node's store to pullers
         # (object_manager.h:119 Push/Pull analog; object_transfer.py)
         from .object_transfer import ObjectDataServer
@@ -443,6 +448,39 @@ class Runtime:
     # ------------------------------------------------------------------ #
     # connection plumbing
     # ------------------------------------------------------------------ #
+
+    def _health_check_loop(self):
+        from .config import cfg
+        period = cfg.health_check_period_ms / 1000.0
+        timeout = cfg.health_check_timeout_s
+        if period <= 0:
+            return
+        while not self._shutdown:
+            time.sleep(period)
+            now = time.monotonic()
+            with self.lock:
+                stale = [n for n in self.nodes.values()
+                         if n.agent is not None and n.alive
+                         and getattr(n, "last_heartbeat", None) is not None
+                         and now - n.last_heartbeat > timeout]
+            for n in stale:
+                # declare the node dead DIRECTLY: closing the conn would
+                # not wake the agent loop's blocked read (Linux read()
+                # survives a concurrent close), so run the removal here —
+                # the loop's eventual EOF cleanup double-calls remove_node,
+                # which no-ops on a dead node
+                for wid in list(n.workers):
+                    w = self.workers.get(wid)
+                    if w is not None and isinstance(w.proc, _RemoteProc):
+                        w.proc.mark_exited(-1)
+                try:
+                    self.remove_node(n.node_id)
+                except Exception:
+                    pass
+                try:
+                    n.agent.conn.close()
+                except Exception:
+                    pass
 
     def _accept_loop(self, listener):
         while not self._shutdown:
@@ -670,11 +708,14 @@ class Runtime:
             self._schedule_locked()
         self.pubsub.publish("nodes", {"node_id": node.node_id.hex(),
                                       "event": "added", "name": node.name})
+        node.last_heartbeat = time.monotonic()
         try:
             while True:
                 m = conn.recv()
                 t = m.get("t")
-                if t == "worker_spawned":
+                if t == "heartbeat":
+                    node.last_heartbeat = time.monotonic()
+                elif t == "worker_spawned":
                     with self.lock:
                         w = self.workers.get(m["wid"])
                         if w is not None and isinstance(w.proc, _RemoteProc):

@@ -100,3 +100,50 @@ def test_agent_death_removes_node_and_fails_over(agent_cluster):
         return "pong"
 
     assert ray.get(ping.remote(), timeout=60) == "pong"
+
+
+def test_hung_agent_detected_by_heartbeat_timeout(ray_start_regular):
+    """A node agent that stops heartbeating (hung, not dead) is removed
+    after health_check_timeout_s (gcs_health_check_manager analog)."""
+    import signal
+
+    ray = ray_start_regular
+    from ray_tpu.core.config import cfg
+    cfg.override(health_check_timeout_s=3.0, health_check_period_ms=500)
+    try:
+        info = ray.head_address()
+        env = dict(os.environ)
+        env["RTPU_AUTHKEY"] = info["authkey"]
+        env["RTPU_HEALTH_CHECK_PERIOD_MS"] = "500"
+        agent = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.node_agent",
+             "--head", info["address"], "--num-cpus", "1",
+             "--name", "hangable"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        try:
+            deadline = time.time() + 30
+            nid = None
+            while time.time() < deadline and nid is None:
+                for n in ray.nodes():
+                    if n["NodeName"] == "hangable" and n["Alive"]:
+                        nid = n["NodeID"]
+                time.sleep(0.2)
+            assert nid, "agent never registered"
+
+            os.kill(agent.pid, signal.SIGSTOP)  # hang it (conn stays open)
+            deadline = time.time() + 30
+            gone = False
+            while time.time() < deadline and not gone:
+                gone = not any(n["NodeID"] == nid and n["Alive"]
+                               for n in ray.nodes())
+                time.sleep(0.5)
+            assert gone, "hung agent never declared dead"
+        finally:
+            try:
+                os.kill(agent.pid, signal.SIGCONT)
+            except OSError:
+                pass
+            agent.terminate()
+            agent.wait(timeout=10)
+    finally:
+        cfg.reset("health_check_timeout_s", "health_check_period_ms")
