@@ -1,20 +1,24 @@
-"""ray_tpu.util — placement groups, scheduling strategies, collectives.
+"""ray_tpu.util — placement groups, scheduling strategies, collectives,
+actor pools, distributed queues.
 
-Reference parity: python/ray/util/.
+Reference parity: python/ray/util/ (placement_group.py,
+scheduling_strategies.py, collective/, actor_pool.py, queue.py).
 """
 import importlib
 
+from .actor_pool import ActorPool
 from .placement_group import (
     PlacementGroup,
     placement_group,
     placement_group_table,
     remove_placement_group,
 )
-from . import scheduling_strategies
+from . import queue, scheduling_strategies
 
 __all__ = [
-    "PlacementGroup", "placement_group", "placement_group_table",
-    "remove_placement_group", "scheduling_strategies", "collective",
+    "ActorPool", "PlacementGroup", "placement_group",
+    "placement_group_table", "remove_placement_group", "queue",
+    "scheduling_strategies", "collective",
 ]
 
 
