@@ -13,6 +13,7 @@ from .context import DataContext
 from .dataset import ActorPoolStrategy, DataIterator, Dataset, Schema
 from .read_api import (
     from_arrow,
+    from_huggingface,
     from_items,
     from_numpy,
     from_pandas,
@@ -26,6 +27,7 @@ from .read_api import (
 __all__ = [
     "ActorPoolStrategy",
     "DataContext", "Dataset", "DataIterator", "Schema", "from_arrow",
+    "from_huggingface",
     "from_items", "from_numpy", "from_pandas", "range", "read_csv",
     "read_json", "read_parquet", "read_text",
 ]

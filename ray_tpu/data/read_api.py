@@ -58,6 +58,31 @@ def from_pandas(df) -> Dataset:
     return from_arrow(tbl)
 
 
+def from_huggingface(hf_dataset, *, override_num_blocks: int | None = None
+                     ) -> Dataset:
+    """A huggingface ``datasets.Dataset`` -> ray_tpu Dataset (reference:
+    ray.data.from_huggingface). HF datasets are arrow-backed; blocks come
+    straight from the underlying table, split for parallelism."""
+    import ray_tpu
+    from .executor import BlockMeta, InputData
+    tbl = hf_dataset.data.table if hasattr(hf_dataset, "data") else None
+    if tbl is None:
+        raise TypeError(
+            f"expected a datasets.Dataset, got {type(hf_dataset).__name__}")
+    n = min(_n_blocks(override_num_blocks), max(1, tbl.num_rows))
+    pairs = []
+    import builtins
+    step = max(1, (tbl.num_rows + n - 1) // n)  # 0-row datasets: no blocks
+    for start in builtins.range(0, tbl.num_rows, step):  # range is shadowed
+
+        # slice() is zero-copy; only the block being shipped is combined
+        block = tbl.slice(start, min(step, tbl.num_rows - start))
+        block = block.combine_chunks()
+        ref = ray_tpu.put(block)
+        pairs.append((ref, BlockMeta(block.num_rows, block.nbytes)))
+    return Dataset(InputData(pairs))
+
+
 def from_arrow(table) -> Dataset:
     import ray_tpu
     from .executor import BlockMeta

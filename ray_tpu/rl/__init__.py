@@ -10,6 +10,7 @@ from .dqn import (DQN, DQNAlgorithmConfig, DQNConfig, DQNLearner,
                   ReplayBuffer)
 from .impala import (IMPALA, ImpalaAlgorithmConfig, ImpalaConfig,
                      ImpalaLearner, vtrace)
+from .sac import SAC, SACAlgorithmConfig, SACConfig, SACLearner
 from .env_runner import EnvRunner, make_gym_env
 from .learner import PPOConfig, PPOLearner, compute_gae
 from .module import MLPConfig
@@ -17,7 +18,7 @@ from .module import MLPConfig
 __all__ = [
     "DQN", "DQNAlgorithmConfig", "DQNConfig", "DQNLearner", "ReplayBuffer",
     "IMPALA", "ImpalaAlgorithmConfig", "ImpalaConfig", "ImpalaLearner",
-    "vtrace",
+    "vtrace", "SAC", "SACAlgorithmConfig", "SACConfig", "SACLearner",
     "PPO", "AlgorithmConfig", "EnvRunner", "make_gym_env",
     "PPOConfig", "PPOLearner", "compute_gae", "MLPConfig",
 ]

@@ -22,6 +22,14 @@ class AlgorithmBase:
 
     HPARAM_FIELD: str = ""
 
+    def _make_module_cfg(self, probe):
+        """Module config from a probe env; override for non-discrete
+        action spaces (SAC builds a continuous config here)."""
+        return MLPConfig(
+            obs_dim=int(np.prod(probe.observation_space.shape)),
+            num_actions=int(probe.action_space.n),
+            hidden=tuple(self.config.hidden))
+
     def _setup(self, config, runner_cls) -> None:
         import ray_tpu as ray
 
@@ -31,12 +39,8 @@ class AlgorithmBase:
             raise ValueError("config.environment(...) is required")
         self.config = config
         probe = config.env_fn()
-        obs_dim = int(np.prod(probe.observation_space.shape))
-        num_actions = int(probe.action_space.n)
+        self.module_cfg = self._make_module_cfg(probe)
         probe.close()
-        self.module_cfg = MLPConfig(obs_dim=obs_dim,
-                                    num_actions=num_actions,
-                                    hidden=tuple(config.hidden))
         RunnerCls = ray.remote(runner_cls)
         self._runners = [
             RunnerCls.options(num_cpus=config.runner_resources.get(

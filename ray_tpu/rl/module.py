@@ -78,3 +78,74 @@ def sample_action(params: dict, obs: jax.Array, rng: jax.Array):
 def deterministic_action(params: dict, obs: jax.Array):
     logits, _ = logits_and_value(params, obs)
     return jnp.argmax(logits, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# Continuous control (SAC): tanh-squashed Gaussian policy + twin Q critics
+# (reference: rllib/core sac catalog / sac_torch_model)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class ContinuousMLPConfig:
+    obs_dim: int
+    action_dim: int
+    hidden: Sequence[int] = (128, 128)
+    # scalar or per-dimension tuple (asymmetric Box bounds supported)
+    action_low: float | Sequence[float] = -1.0
+    action_high: float | Sequence[float] = 1.0
+    log_std_min: float = -10.0
+    log_std_max: float = 2.0
+
+
+def init_sac(rng: jax.Array, cfg: ContinuousMLPConfig) -> dict:
+    k_pi, k_q1, k_q2 = jax.random.split(rng, 3)
+    pi_dims = (cfg.obs_dim, *cfg.hidden)
+    q_dims = (cfg.obs_dim + cfg.action_dim, *cfg.hidden)
+    return {
+        "pi": _mlp_init(k_pi, pi_dims, 2 * cfg.action_dim, 0.01),
+        "q1": _mlp_init(k_q1, q_dims, 1, 1.0),
+        "q2": _mlp_init(k_q2, q_dims, 1, 1.0),
+    }
+
+
+def _bounds(cfg: ContinuousMLPConfig):
+    low = jnp.asarray(cfg.action_low, jnp.float32)
+    high = jnp.asarray(cfg.action_high, jnp.float32)
+    return (high - low) / 2.0, (high + low) / 2.0
+
+
+def _squash(cfg: ContinuousMLPConfig, u: jax.Array) -> jax.Array:
+    """tanh squash then scale into [low, high] (per-dim bounds ok)."""
+    half, mid = _bounds(cfg)
+    return jnp.tanh(u) * half + mid
+
+
+def sample_action_continuous(params: dict, obs: jax.Array, rng: jax.Array,
+                             cfg: ContinuousMLPConfig):
+    """(action in env bounds, logp) with the tanh-Gaussian correction."""
+    out = _mlp_apply(params["pi"], obs)
+    mu, log_std = jnp.split(out, 2, axis=-1)
+    log_std = jnp.clip(log_std, cfg.log_std_min, cfg.log_std_max)
+    std = jnp.exp(log_std)
+    u = mu + std * jax.random.normal(rng, mu.shape)
+    # base normal logp
+    logp = -0.5 * (((u - mu) / std) ** 2 + 2 * log_std
+                   + math.log(2 * math.pi))
+    # tanh change of variables (numerically stable softplus form)
+    logp = logp - 2.0 * (math.log(2.0) - u - jax.nn.softplus(-2.0 * u))
+    half, _ = _bounds(cfg)
+    logp = logp - jnp.log(half)
+    return _squash(cfg, u), jnp.sum(logp, axis=-1)
+
+
+def deterministic_action_continuous(params: dict, obs: jax.Array,
+                                    cfg: ContinuousMLPConfig) -> jax.Array:
+    mu, _ = jnp.split(_mlp_apply(params["pi"], obs), 2, axis=-1)
+    return _squash(cfg, mu)
+
+
+def q_values_continuous(params: dict, obs: jax.Array, action: jax.Array):
+    """(q1, q2) for obs/action batches."""
+    x = jnp.concatenate([obs, action], axis=-1)
+    return (_mlp_apply(params["q1"], x)[..., 0],
+            _mlp_apply(params["q2"], x)[..., 0])

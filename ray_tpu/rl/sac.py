@@ -1,0 +1,358 @@
+"""SAC: soft actor-critic for continuous control.
+
+Reference parity: rllib/algorithms/sac/ (off-policy replay, twin Q
+critics with min-target, tanh-Gaussian policy, entropy temperature;
+Haarnoja et al. 2018). Mirrors the DQN driver shape: runners collect
+transitions, the learner does K jitted minibatch updates per train()
+(one device round-trip), targets track via polyak averaging.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from . import module as module_lib
+from .base import AlgorithmBase
+from .dqn import ReplayBuffer
+from .module import ContinuousMLPConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class SACConfig:
+    """(reference: sac.py SACConfig.training)"""
+    actor_lr: float = 3e-4
+    critic_lr: float = 3e-4
+    gamma: float = 0.99
+    tau: float = 0.005            # polyak target rate
+    alpha: float = 0.2            # entropy temperature (fixed)
+    buffer_size: int = 100_000
+    batch_size: int = 128
+    num_updates_per_iter: int = 32
+    learning_starts: int = 1_000
+    random_steps: int = 500       # uniform exploration before the policy
+
+
+class ContinuousReplayBuffer(ReplayBuffer):
+    """ReplayBuffer with float action vectors."""
+
+    def __init__(self, capacity: int, obs_dim: int, action_dim: int):
+        super().__init__(capacity, obs_dim)
+        self.actions = np.empty((capacity, action_dim), np.float32)
+
+
+class SACRunner:
+    """Transition collector sampling from the tanh-Gaussian policy."""
+
+    def __init__(self, env_fn: Callable, num_envs: int, rollout_len: int,
+                 seed: int = 0):
+        import gymnasium as gym
+        self._venv = gym.vector.SyncVectorEnv(
+            [(lambda f=env_fn: f()) for _ in range(num_envs)],
+            autoreset_mode=gym.vector.AutoresetMode.SAME_STEP)
+        self._num_envs = num_envs
+        self._rollout_len = rollout_len
+        self._obs, _ = self._venv.reset(seed=seed)
+        self._rng = np.random.default_rng(seed + 1)
+        self._sample_fn = None
+        self._cfg = None
+        self._ep_return = np.zeros(num_envs, np.float64)
+        self._completed: list[float] = []
+        self._steps = 0
+
+    def sample(self, params, cfg: ContinuousMLPConfig,
+               random_steps: int) -> dict:
+        import jax
+        if self._sample_fn is None:
+            self._cfg = cfg
+            self._sample_fn = jax.jit(
+                lambda p, o, k: module_lib.sample_action_continuous(
+                    p, o, k, cfg))
+        T, E = self._rollout_len, self._num_envs
+        obs_dim = self._obs.shape[1]
+        adim = int(np.prod(self._venv.single_action_space.shape))
+        obs_b = np.empty((T * E, obs_dim), np.float32)
+        nxt_b = np.empty((T * E, obs_dim), np.float32)
+        act_b = np.empty((T * E, adim), np.float32)
+        rew_b = np.empty((T * E,), np.float32)
+        done_b = np.empty((T * E,), np.float32)
+        key = jax.random.PRNGKey(int(self._rng.integers(2 ** 31)))
+        space = self._venv.single_action_space
+        for t in range(T):
+            if self._steps < random_steps:
+                action = self._rng.uniform(
+                    space.low, space.high,
+                    size=(E,) + space.shape).astype(np.float32)
+            else:
+                key, sub = jax.random.split(key)
+                action, _ = self._sample_fn(
+                    params, self._obs.astype(np.float32), sub)
+                action = np.asarray(action)
+            nxt, rew, term, trunc, info = self._venv.step(action)
+            nxt_td = nxt
+            ended = np.logical_or(term, trunc)
+            final = info.get("final_obs") if isinstance(info, dict) else None
+            if final is not None and ended.any():
+                nxt_td = nxt.copy()
+                for i in np.nonzero(ended)[0]:
+                    if final[i] is not None:
+                        nxt_td[i] = final[i]
+                done_for_td = term.astype(np.float32)
+            else:
+                done_for_td = ended.astype(np.float32)
+            sl = slice(t * E, (t + 1) * E)
+            obs_b[sl] = self._obs
+            nxt_b[sl] = nxt_td
+            act_b[sl] = action.reshape(E, adim)
+            rew_b[sl] = rew
+            done_b[sl] = done_for_td
+            self._ep_return += rew
+            for i in np.nonzero(ended)[0]:
+                self._completed.append(float(self._ep_return[i]))
+                self._ep_return[i] = 0.0
+            self._obs = nxt
+            self._steps += E
+        episodes, self._completed = self._completed, []
+        return {"obs": obs_b, "actions": act_b, "rewards": rew_b,
+                "next_obs": nxt_b, "dones": done_b,
+                "episode_returns": episodes}
+
+    def evaluate(self, params, num_episodes: int = 5,
+                 cfg: Optional[ContinuousMLPConfig] = None) -> dict:
+        import jax
+        cfg = cfg or self._cfg
+        det = jax.jit(
+            lambda p, o: module_lib.deterministic_action_continuous(
+                p, o, cfg))
+        env = self._venv.envs[0]
+        returns = []
+        for ep in range(num_episodes):
+            obs, _ = env.reset(seed=30_000 + ep)
+            total, done = 0.0, False
+            while not done:
+                a = np.asarray(det(params, obs.astype(np.float32)))
+                obs, rew, term, trunc, _ = env.step(a)
+                total += float(rew)
+                done = bool(term or trunc)
+            returns.append(total)
+        self._obs, _ = self._venv.reset()
+        self._ep_return[:] = 0.0
+        return {"episode_returns": returns,
+                "mean_return": float(np.mean(returns))}
+
+
+class SACLearner:
+    def __init__(self, module_cfg: ContinuousMLPConfig, cfg: SACConfig,
+                 seed: int = 0):
+        import jax
+        import optax
+        self.cfg = cfg
+        self.module_cfg = module_cfg
+        self.params = module_lib.init_sac(jax.random.PRNGKey(seed),
+                                          module_cfg)
+        self.target_q = {"q1": self.params["q1"], "q2": self.params["q2"]}
+        self.actor_opt = optax.adam(cfg.actor_lr)
+        self.critic_opt = optax.adam(cfg.critic_lr)
+        self.actor_state = self.actor_opt.init(self.params["pi"])
+        self.critic_state = self.critic_opt.init(
+            {"q1": self.params["q1"], "q2": self.params["q2"]})
+        self._update = jax.jit(self._build_update())
+
+    @property
+    def opt_state(self):  # AlgorithmBase checkpoint contract
+        return {"actor": self.actor_state, "critic": self.critic_state}
+
+    @opt_state.setter
+    def opt_state(self, v):
+        self.actor_state = v["actor"]
+        self.critic_state = v["critic"]
+
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+        cfg, mcfg = self.cfg, self.module_cfg
+
+        def critic_loss(qs, pi, target_q, batch, key):
+            a_next, logp_next = module_lib.sample_action_continuous(
+                {"pi": pi}, batch["next_obs"], key, mcfg)
+            tq1, tq2 = module_lib.q_values_continuous(
+                target_q | {"pi": pi}, batch["next_obs"], a_next)
+            target_v = jnp.minimum(tq1, tq2) - cfg.alpha * logp_next
+            target = batch["rewards"] + cfg.gamma * (
+                1.0 - batch["dones"]) * target_v
+            target = jax.lax.stop_gradient(target)
+            q1, q2 = module_lib.q_values_continuous(
+                qs | {"pi": pi}, batch["obs"], batch["actions"])
+            return ((q1 - target) ** 2 + (q2 - target) ** 2).mean(), (
+                q1.mean())
+
+        def actor_loss(pi, qs, batch, key):
+            a, logp = module_lib.sample_action_continuous(
+                {"pi": pi}, batch["obs"], key, mcfg)
+            q1, q2 = module_lib.q_values_continuous(
+                qs | {"pi": pi}, batch["obs"], a)
+            return (cfg.alpha * logp - jnp.minimum(q1, q2)).mean(), (
+                -logp.mean())
+
+        def make_one(data):
+            def one(carry, xs):
+                params, target_q, a_state, c_state = carry
+                idx, key = xs
+                kc, ka = jax.random.split(key)
+                batch = {k: v[idx] for k, v in data.items()}
+                qs = {"q1": params["q1"], "q2": params["q2"]}
+                (closs, qmean), cgrads = jax.value_and_grad(
+                    critic_loss, has_aux=True)(qs, params["pi"], target_q,
+                                               batch, kc)
+                cupd, c_state = self.critic_opt.update(cgrads, c_state, qs)
+                qs = optax.apply_updates(qs, cupd)
+                params = params | qs
+                (aloss, ent), agrads = jax.value_and_grad(
+                    actor_loss, has_aux=True)(params["pi"], qs, batch, ka)
+                aupd, a_state = self.actor_opt.update(
+                    agrads, a_state, params["pi"])
+                params = params | {"pi": optax.apply_updates(
+                    params["pi"], aupd)}
+                target_q = jax.tree.map(
+                    lambda t, o: (1 - cfg.tau) * t + cfg.tau * o,
+                    target_q, qs)
+                return (params, target_q, a_state, c_state), (
+                    closs, aloss, ent, qmean)
+            return one
+
+        def update(params, target_q, a_state, c_state, data, idx, key):
+            keys = jax.random.split(key, idx.shape[0])
+            (params, target_q, a_state, c_state), (cl, al, ent, qm) = \
+                jax.lax.scan(make_one(data),
+                             (params, target_q, a_state, c_state),
+                             (idx, keys))
+            return (params, target_q, a_state, c_state,
+                    cl.mean(), al.mean(), ent.mean(), qm.mean())
+
+        return update
+
+    def update_from_buffer(self, buf, rng: np.random.Generator) -> dict:
+        import jax
+        import jax.numpy as jnp
+        cfg = self.cfg
+        idx = buf.sample_indices(rng, cfg.batch_size,
+                                 cfg.num_updates_per_iter)
+        data = {"obs": jnp.asarray(buf.obs),
+                "actions": jnp.asarray(buf.actions),
+                "rewards": jnp.asarray(buf.rewards),
+                "next_obs": jnp.asarray(buf.next_obs),
+                "dones": jnp.asarray(buf.dones)}
+        key = jax.random.PRNGKey(int(rng.integers(2 ** 31)))
+        (self.params, self.target_q, self.actor_state, self.critic_state,
+         cl, al, ent, qm) = self._update(
+            self.params, self.target_q, self.actor_state,
+            self.critic_state, data, jnp.asarray(idx), key)
+        return {"critic_loss": float(cl), "actor_loss": float(al),
+                "entropy": float(ent), "q_mean": float(qm)}
+
+
+class SAC(AlgorithmBase):
+    """The Algorithm driver (reference: sac.py training_step)."""
+
+    HPARAM_FIELD = "sac"
+
+    def _make_module_cfg(self, probe):
+        space = probe.action_space
+        return ContinuousMLPConfig(
+            obs_dim=int(np.prod(probe.observation_space.shape)),
+            action_dim=int(np.prod(space.shape)),
+            hidden=tuple(self.config.hidden),
+            # PER-DIM bounds: asymmetric Box spaces squash correctly
+            action_low=tuple(np.asarray(space.low).reshape(-1).tolist()),
+            action_high=tuple(np.asarray(space.high).reshape(-1).tolist()))
+
+    def __init__(self, config: "SACAlgorithmConfig"):
+        self._setup(config, SACRunner)
+        self.learner = SACLearner(self.module_cfg, config.sac,
+                                  seed=config.seed)
+        self.buffer = ContinuousReplayBuffer(
+            config.sac.buffer_size, self.module_cfg.obs_dim,
+            self.module_cfg.action_dim)
+        self._np_rng = np.random.default_rng(config.seed)
+
+    def train(self) -> dict:
+        ray = self._ray
+        t0 = time.perf_counter()
+        weights_ref = ray.put(self.learner.params)
+        samples = ray.get([
+            r.sample.remote(weights_ref, self.module_cfg,
+                            self.config.sac.random_steps)
+            for r in self._runners])
+        for s in samples:
+            self.buffer.add_batch(s["obs"], s["actions"], s["rewards"],
+                                  s["next_obs"], s["dones"])
+        mean_ret = self._note_returns(
+            [r for s in samples for r in s["episode_returns"]])
+        steps = sum(len(s["rewards"]) for s in samples)
+        self._total_env_steps += steps
+        stats = {}
+        if self._total_env_steps >= self.config.sac.learning_starts:
+            stats = self.learner.update_from_buffer(self.buffer,
+                                                    self._np_rng)
+        self.iteration += 1
+        dt = time.perf_counter() - t0
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": mean_ret,
+            "num_env_steps_sampled_lifetime": self._total_env_steps,
+            "env_steps_per_sec": steps / dt,
+            "buffer_size": self.buffer.size,
+            **{f"learner/{k}": v for k, v in stats.items()},
+        }
+
+    def evaluate(self, num_episodes: int = 5) -> dict:
+        ray = self._ray
+        weights_ref = ray.put(self.learner.params)
+        return ray.get(self._runners[0].evaluate.remote(
+            weights_ref, num_episodes, self.module_cfg))
+
+    def _extra_state(self) -> dict:
+        return {"target_q": self.learner.target_q}
+
+    def _load_extra_state(self, state: dict) -> None:
+        import jax
+        import jax.numpy as jnp
+        self.learner.target_q = jax.tree.map(
+            jnp.asarray, state["target_q"])
+
+
+class SACAlgorithmConfig:
+    def __init__(self):
+        self.env_fn: Optional[Callable] = None
+        self.num_env_runners = 1
+        self.num_envs_per_runner = 4
+        self.rollout_len = 32
+        self.sac = SACConfig()
+        self.hidden = (128, 128)
+        self.seed = 0
+        self.runner_resources = {"CPU": 1}
+
+    def environment(self, env, **kwargs) -> "SACAlgorithmConfig":
+        from .env_runner import make_gym_env
+        self.env_fn = make_gym_env(env, **kwargs) if isinstance(env, str) \
+            else env
+        return self
+
+    def env_runners(self, num_env_runners: int = 1,
+                    num_envs_per_env_runner: int = 4,
+                    rollout_fragment_length: int = 32
+                    ) -> "SACAlgorithmConfig":
+        self.num_env_runners = num_env_runners
+        self.num_envs_per_runner = num_envs_per_env_runner
+        self.rollout_len = rollout_fragment_length
+        return self
+
+    def training(self, **kwargs) -> "SACAlgorithmConfig":
+        self.sac = dataclasses.replace(self.sac, **kwargs)
+        return self
+
+    def build(self) -> SAC:
+        return SAC(self)

@@ -260,3 +260,18 @@ class TestStreamingExecutor:
         # after full iteration, count works from the cache
         n0 = sum(1 for _ in shards[0].iter_rows())
         assert shards[0].count() == n0
+
+
+def test_from_huggingface(ray_start_regular):
+    """HF arrow tables become blocks directly (ray.data.from_huggingface)."""
+    import datasets as hf
+
+    from ray_tpu import data
+    hfds = hf.Dataset.from_dict(
+        {"text": [f"doc {i}" for i in range(20)],
+         "label": list(range(20))})
+    ds = data.from_huggingface(hfds, override_num_blocks=4)
+    assert ds.count() == 20
+    rows = ds.filter(lambda r: r["label"] % 2 == 0).take_all()
+    assert len(rows) == 10
+    assert rows[0]["text"] == "doc 0"
