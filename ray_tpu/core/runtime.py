@@ -463,6 +463,7 @@ class Runtime:
                          if n.agent is not None and n.alive
                          and getattr(n, "last_heartbeat", None) is not None
                          and now - n.last_heartbeat > timeout]
+            self._reap_idle_workers()
             for n in stale:
                 # declare the node dead DIRECTLY: closing the conn would
                 # not wake the agent loop's blocked read (Linux read()
@@ -481,6 +482,31 @@ class Runtime:
                     n.agent.conn.close()
                 except Exception:
                     pass
+
+    def _reap_idle_workers(self):
+        """Idle workers beyond the prestart floor exit after
+        worker_idle_timeout_s (worker_pool.h idle-eviction analog);
+        runtime-env-dedicated workers reap the same way."""
+        from .config import cfg
+        timeout = cfg.worker_idle_timeout_s
+        if timeout <= 0:
+            return
+        now = time.monotonic()
+        with self.lock:
+            head_id = self.head_node.node_id
+            floor = min(int(self.head_node.resources_total.get("CPU", 1)),
+                        cfg.worker_prestart)
+            # head-pool scope only: agent nodes manage their own workers
+            head_workers = [w for w in self.workers.values()
+                            if w.node_id == head_id]
+            idle = [w for w in head_workers
+                    if w.state == "idle" and w.conn is not None
+                    and now - getattr(w, "idle_since", now) > timeout]
+            n_idle = sum(1 for w in head_workers if w.state == "idle")
+            victims = idle[:max(0, n_idle - floor)]
+            for w in victims:
+                w.send({"t": "exit"})
+                self._on_worker_death_locked_prep(w)
 
     def _accept_loop(self, listener):
         while not self._shutdown:
@@ -1373,7 +1399,9 @@ class Runtime:
             return None
         # hybrid: pack onto head/local until 50% utilized, then least-utilized
         head = self.head_node
-        if self._has_avail(head, res) and head.utilization() < 0.5:
+        from .config import cfg as _cfg
+        if self._has_avail(head, res) and \
+                head.utilization() < _cfg.scheduler_spread_threshold:
             return head
         best, best_u = None, 2.0
         for n in alive:
@@ -1553,10 +1581,21 @@ class Runtime:
     def _handle_failed_task_locked(self, spec, err: BaseException,
                                    retryable: bool = True):
         if retryable and spec.retries_left > 0:
+            from .config import cfg as _cfg
             spec.retries_left -= 1
             self.counters["tasks_retried"] += 1
             self._record_task_locked(spec, "RETRYING", error=repr(err))
-            if spec.is_actor_task:
+            delay = _cfg.task_retry_delay_ms / 1000.0
+            if delay > 0 and not spec.is_actor_task:
+                # backoff off-lock; resubmission re-enters under it
+                def _later(s=spec):
+                    time.sleep(delay)
+                    with self.lock:
+                        if not self._shutdown:
+                            self.pending.append(s)
+                            self._schedule_locked()
+                threading.Thread(target=_later, daemon=True).start()
+            elif spec.is_actor_task:
                 self._route_actor_task_locked(spec)
             else:
                 self.pending.append(spec)
@@ -1601,6 +1640,7 @@ class Runtime:
                 w.holding = {}
                 w.holding_bundle = None
                 w.state = "idle"
+                w.idle_since = time.monotonic()
             self.events.append({"name": msg.get("name", "task"), "cat": "task",
                                 "ph": "E", "pid": wid, "ts": time.time() * 1e6,
                                 "tid": task_id.hex()[:8]})
@@ -1697,7 +1737,11 @@ class Runtime:
         w.state = "starting"
         w.pending_actor = a
 
-    def _retry_actor_schedule(self, a: ActorInfo, timeout: float = 120.0):
+    def _retry_actor_schedule(self, a: ActorInfo,
+                              timeout: float | None = None):
+        from .config import cfg as _cfg
+        if timeout is None:
+            timeout = _cfg.pg_retry_timeout_s
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             time.sleep(0.05)
@@ -1975,7 +2019,11 @@ class Runtime:
         pg.ready_event.set()
         return True
 
-    def _retry_pg(self, pg: PlacementGroupState, timeout: float = 120.0):
+    def _retry_pg(self, pg: PlacementGroupState,
+                  timeout: float | None = None):
+        from .config import cfg as _cfg
+        if timeout is None:
+            timeout = _cfg.pg_retry_timeout_s
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             time.sleep(0.05)

@@ -65,6 +65,10 @@ def from_huggingface(hf_dataset, *, override_num_blocks: int | None = None
     straight from the underlying table, split for parallelism."""
     import ray_tpu
     from .executor import BlockMeta, InputData
+    if getattr(hf_dataset, "_indices", None) is not None:
+        # select()/shuffle() views keep an index mapping over the raw
+        # table; materialize it or we'd ship the WRONG rows
+        hf_dataset = hf_dataset.flatten_indices()
     tbl = hf_dataset.data.table if hasattr(hf_dataset, "data") else None
     if tbl is None:
         raise TypeError(

@@ -148,3 +148,48 @@ class AlgorithmBase:
                 algo.stop()
 
         return trainable
+
+
+class AlgorithmConfigBase:
+    """Fluent config shared by the algorithm family (reference:
+    algorithm_config.py). Subclasses set ``HPARAM_FIELD`` (matching their
+    Algorithm), ``HPARAM_FACTORY`` (the per-algo dataclass), ``ALGO_CLS``,
+    and any extra defaults in __init__ AFTER calling super().__init__()."""
+
+    HPARAM_FIELD: str = ""
+    HPARAM_FACTORY = None
+    ALGO_CLS = None
+
+    def __init__(self):
+        from typing import Callable, Optional  # noqa: F401
+        self.env_fn = None
+        self.num_env_runners = 2
+        self.num_envs_per_runner = 4
+        self.rollout_len = 32
+        self.hidden = (64, 64)
+        self.seed = 0
+        self.runner_resources = {"CPU": 1}
+        setattr(self, self.HPARAM_FIELD, self.HPARAM_FACTORY())
+
+    def environment(self, env, **kwargs):
+        from .env_runner import make_gym_env
+        self.env_fn = make_gym_env(env, **kwargs) if isinstance(env, str) \
+            else env
+        return self
+
+    def env_runners(self, num_env_runners: int = 2,
+                    num_envs_per_env_runner: int = 4,
+                    rollout_fragment_length: int = 32):
+        self.num_env_runners = num_env_runners
+        self.num_envs_per_runner = num_envs_per_env_runner
+        self.rollout_len = rollout_fragment_length
+        return self
+
+    def training(self, **kwargs):
+        import dataclasses
+        hp = getattr(self, self.HPARAM_FIELD)
+        setattr(self, self.HPARAM_FIELD, dataclasses.replace(hp, **kwargs))
+        return self
+
+    def build(self):
+        return self.ALGO_CLS(self)

@@ -18,7 +18,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from . import module as module_lib
-from .base import AlgorithmBase
+from .base import AlgorithmBase, AlgorithmConfigBase
 from .module import MLPConfig
 
 
@@ -318,37 +318,12 @@ class DQN(AlgorithmBase):
             jnp.asarray, state["target_params"])
 
 
-class DQNAlgorithmConfig:
-    """Fluent config mirroring AlgorithmConfig (PPO) for the DQN family."""
+class DQNAlgorithmConfig(AlgorithmConfigBase):
+    """Fluent config for the DQN family (base: AlgorithmConfigBase)."""
 
-    def __init__(self):
-        self.env_fn: Optional[Callable] = None
-        self.num_env_runners = 2
-        self.num_envs_per_runner = 4
-        self.rollout_len = 32
-        self.dqn = DQNConfig()
-        self.hidden = (64, 64)
-        self.seed = 0
-        self.runner_resources = {"CPU": 1}
+    HPARAM_FIELD = "dqn"
+    HPARAM_FACTORY = DQNConfig
 
-    def environment(self, env, **kwargs) -> "DQNAlgorithmConfig":
-        from .env_runner import make_gym_env
-        self.env_fn = make_gym_env(env, **kwargs) if isinstance(env, str) \
-            else env
-        return self
-
-    def env_runners(self, num_env_runners: int = 2,
-                    num_envs_per_env_runner: int = 4,
-                    rollout_fragment_length: int = 32
-                    ) -> "DQNAlgorithmConfig":
-        self.num_env_runners = num_env_runners
-        self.num_envs_per_runner = num_envs_per_env_runner
-        self.rollout_len = rollout_fragment_length
-        return self
-
-    def training(self, **dqn_kwargs) -> "DQNAlgorithmConfig":
-        self.dqn = dataclasses.replace(self.dqn, **dqn_kwargs)
-        return self
-
-    def build(self) -> DQN:
-        return DQN(self)
+    @property
+    def ALGO_CLS(self):
+        return DQN

@@ -21,7 +21,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from . import module as module_lib
-from .base import AlgorithmBase
+from .base import AlgorithmBase, AlgorithmConfigBase
 from .env_runner import EnvRunner, make_gym_env
 from .module import MLPConfig
 
@@ -187,34 +187,12 @@ class IMPALA(AlgorithmBase):
 
 
 
-class ImpalaAlgorithmConfig:
-    def __init__(self):
-        self.env_fn: Optional[Callable] = None
-        self.num_env_runners = 2
-        self.num_envs_per_runner = 4
-        self.rollout_len = 32
-        self.impala = ImpalaConfig()
-        self.hidden = (64, 64)
-        self.seed = 0
-        self.runner_resources = {"CPU": 1}
+class ImpalaAlgorithmConfig(AlgorithmConfigBase):
+    """Fluent config for IMPALA (base: AlgorithmConfigBase)."""
 
-    def environment(self, env, **kwargs) -> "ImpalaAlgorithmConfig":
-        self.env_fn = make_gym_env(env, **kwargs) if isinstance(env, str) \
-            else env
-        return self
+    HPARAM_FIELD = "impala"
+    HPARAM_FACTORY = ImpalaConfig
 
-    def env_runners(self, num_env_runners: int = 2,
-                    num_envs_per_env_runner: int = 4,
-                    rollout_fragment_length: int = 32
-                    ) -> "ImpalaAlgorithmConfig":
-        self.num_env_runners = num_env_runners
-        self.num_envs_per_runner = num_envs_per_env_runner
-        self.rollout_len = rollout_fragment_length
-        return self
-
-    def training(self, **kwargs) -> "ImpalaAlgorithmConfig":
-        self.impala = dataclasses.replace(self.impala, **kwargs)
-        return self
-
-    def build(self) -> IMPALA:
-        return IMPALA(self)
+    @property
+    def ALGO_CLS(self):
+        return IMPALA

@@ -15,7 +15,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from . import module as module_lib
-from .base import AlgorithmBase
+from .base import AlgorithmBase, AlgorithmConfigBase
 from .dqn import ReplayBuffer
 from .module import ContinuousMLPConfig
 
@@ -57,6 +57,7 @@ class SACRunner:
         self._obs, _ = self._venv.reset(seed=seed)
         self._rng = np.random.default_rng(seed + 1)
         self._sample_fn = None
+        self._det_fn = None
         self._cfg = None
         self._ep_return = np.zeros(num_envs, np.float64)
         self._completed: list[float] = []
@@ -123,9 +124,11 @@ class SACRunner:
                  cfg: Optional[ContinuousMLPConfig] = None) -> dict:
         import jax
         cfg = cfg or self._cfg
-        det = jax.jit(
-            lambda p, o: module_lib.deterministic_action_continuous(
-                p, o, cfg))
+        if self._det_fn is None:
+            self._det_fn = jax.jit(
+                lambda p, o: module_lib.deterministic_action_continuous(
+                    p, o, cfg))
+        det = self._det_fn
         env = self._venv.envs[0]
         returns = []
         for ep in range(num_episodes):
@@ -324,35 +327,17 @@ class SAC(AlgorithmBase):
             jnp.asarray, state["target_q"])
 
 
-class SACAlgorithmConfig:
+class SACAlgorithmConfig(AlgorithmConfigBase):
+    """Fluent config for SAC (base: AlgorithmConfigBase)."""
+
+    HPARAM_FIELD = "sac"
+    HPARAM_FACTORY = SACConfig
+
     def __init__(self):
-        self.env_fn: Optional[Callable] = None
+        super().__init__()
         self.num_env_runners = 1
-        self.num_envs_per_runner = 4
-        self.rollout_len = 32
-        self.sac = SACConfig()
         self.hidden = (128, 128)
-        self.seed = 0
-        self.runner_resources = {"CPU": 1}
 
-    def environment(self, env, **kwargs) -> "SACAlgorithmConfig":
-        from .env_runner import make_gym_env
-        self.env_fn = make_gym_env(env, **kwargs) if isinstance(env, str) \
-            else env
-        return self
-
-    def env_runners(self, num_env_runners: int = 1,
-                    num_envs_per_env_runner: int = 4,
-                    rollout_fragment_length: int = 32
-                    ) -> "SACAlgorithmConfig":
-        self.num_env_runners = num_env_runners
-        self.num_envs_per_runner = num_envs_per_env_runner
-        self.rollout_len = rollout_fragment_length
-        return self
-
-    def training(self, **kwargs) -> "SACAlgorithmConfig":
-        self.sac = dataclasses.replace(self.sac, **kwargs)
-        return self
-
-    def build(self) -> SAC:
-        return SAC(self)
+    @property
+    def ALGO_CLS(self):
+        return SAC

@@ -77,3 +77,50 @@ def test_flag_reaches_runtime():
                          capture_output=True, text=True, timeout=60)
     assert out.returncode == 0, out.stderr
     assert "OK" in out.stdout
+
+
+def test_idle_workers_reaped_beyond_prestart():
+    """Idle workers above the prestart floor exit after
+    worker_idle_timeout_s (worker_pool idle eviction)."""
+    import subprocess
+    import sys
+    import textwrap
+    script = textwrap.dedent("""
+        import time
+        import ray_tpu
+        from ray_tpu.core import runtime as rt_mod
+        ray_tpu.init(num_cpus=4)
+        rt = rt_mod.get_runtime_if_exists()
+
+        @ray_tpu.remote
+        def work(i):
+            time.sleep(0.3)
+            return i
+
+        # force 4 concurrent workers
+        assert ray_tpu.get([work.remote(i) for i in range(4)],
+                           timeout=120) == [0, 1, 2, 3]
+        time.sleep(1.0)
+        live0 = sum(1 for w in rt.workers.values() if w.state == "idle")
+        assert live0 >= 3, live0
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            live = sum(1 for w in rt.workers.values()
+                       if w.state == "idle")
+            if live <= 2:
+                break
+            time.sleep(0.5)
+        assert live <= 2, live
+        ray_tpu.shutdown()
+        print("REAP_OK")
+    """)
+    env = dict(os.environ)
+    env["RTPU_WORKER_IDLE_TIMEOUT_S"] = "2.0"
+    env["RTPU_WORKER_PRESTART"] = "2"
+    env["RTPU_HEALTH_CHECK_PERIOD_MS"] = "500"
+    r = subprocess.run([sys.executable, "-c", script], env=env,
+                       capture_output=True, text=True, timeout=120,
+                       cwd=os.path.dirname(os.path.dirname(
+                           os.path.abspath(__file__))))
+    assert r.returncode == 0, r.stderr
+    assert "REAP_OK" in r.stdout
