@@ -21,7 +21,15 @@ def timed(n, fn):
 def main():
     import ray_tpu as ray
 
-    ray.init(num_cpus=4, object_store_memory=1 << 30)
+    # size the pool to the machine: on few-core hosts extra workers just
+    # contend (the reference's ray_perf tunes workers per host the same
+    # way); prestart them all so cold-start never lands in a timed region
+    import os
+
+    from ray_tpu.core.config import cfg
+    n_cpus = min(4, max(2, (os.cpu_count() or 2)))
+    cfg.override(worker_prestart=n_cpus)
+    ray.init(num_cpus=n_cpus, object_store_memory=1 << 30)
 
     @ray.remote
     def nop():

@@ -16,50 +16,30 @@ on RL runs exactly as the reference's Tuner(Algorithm) path does.
 from __future__ import annotations
 
 import time
-from typing import Callable, Optional
 
 import numpy as np
 
-from .base import AlgorithmBase
-from .env_runner import EnvRunner, make_gym_env
+from .base import AlgorithmBase, AlgorithmConfigBase
+from .env_runner import EnvRunner
 from .learner import PPOConfig, PPOLearner
 from .module import MLPConfig
 
 
-class AlgorithmConfig:
-    """Builder-style config (reference: algorithm_config.py fluent API)."""
+class AlgorithmConfig(AlgorithmConfigBase):
+    """Builder-style PPO config (reference: algorithm_config.py fluent
+    API; base: AlgorithmConfigBase)."""
+
+    HPARAM_FIELD = "ppo"
+    HPARAM_FACTORY = PPOConfig
 
     def __init__(self):
-        self.env_fn: Optional[Callable] = None
-        self.num_env_runners = 2
-        self.num_envs_per_runner = 4
+        super().__init__()
         self.rollout_len = 64
-        self.ppo = PPOConfig()
-        self.hidden = (64, 64)
-        self.seed = 0
         self.mesh = None
-        self.runner_resources = {"CPU": 1}
 
-    def environment(self, env: str | Callable, **kwargs) -> "AlgorithmConfig":
-        self.env_fn = make_gym_env(env, **kwargs) if isinstance(env, str) \
-            else env
-        return self
-
-    def env_runners(self, num_env_runners: int = 2,
-                    num_envs_per_env_runner: int = 4,
-                    rollout_fragment_length: int = 64) -> "AlgorithmConfig":
-        self.num_env_runners = num_env_runners
-        self.num_envs_per_runner = num_envs_per_env_runner
-        self.rollout_len = rollout_fragment_length
-        return self
-
-    def training(self, **ppo_kwargs) -> "AlgorithmConfig":
-        import dataclasses
-        self.ppo = dataclasses.replace(self.ppo, **ppo_kwargs)
-        return self
-
-    def build(self) -> "PPO":
-        return PPO(self)
+    @property
+    def ALGO_CLS(self):
+        return PPO
 
 
 class PPO(AlgorithmBase):
