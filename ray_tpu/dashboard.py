@@ -127,6 +127,11 @@ def start_dashboard(port: int = 0, host: str = "127.0.0.1") -> int:
                 out = cfg.dump()
             elif kind == "jobs":
                 out = state_api.list_jobs()
+            elif kind == "serve":
+                from . import serve as serve_api
+                # remote round-trip: keep it off the dashboard event loop
+                loop = asyncio.get_event_loop()
+                out = await loop.run_in_executor(None, serve_api.status)
             elif kind in ("tasks", "actors", "objects", "nodes", "workers"):
                 fn = getattr(state_api, f"list_{kind}")
                 out = fn(limit) if kind in ("tasks", "actors",

@@ -177,12 +177,17 @@ class AlgorithmConfigBase:
             else env
         return self
 
-    def env_runners(self, num_env_runners: int = 2,
-                    num_envs_per_env_runner: int = 4,
-                    rollout_fragment_length: int = 32):
-        self.num_env_runners = num_env_runners
-        self.num_envs_per_runner = num_envs_per_env_runner
-        self.rollout_len = rollout_fragment_length
+    def env_runners(self, num_env_runners: int | None = None,
+                    num_envs_per_env_runner: int | None = None,
+                    rollout_fragment_length: int | None = None):
+        # None keeps the config's default — a PPO config initialized with
+        # rollout_len=64 must not silently drop to a base-class constant
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_len = rollout_fragment_length
         return self
 
     def training(self, **kwargs):

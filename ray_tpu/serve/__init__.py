@@ -27,6 +27,7 @@ from .api import (
     run,
     shutdown,
     status,
+    update_user_config,
 )
 from .batching import batch
 from .context import get_multiplexed_model_id, get_request_context
@@ -40,4 +41,5 @@ __all__ = [
     "status", "get_app_handle", "DeploymentHandle", "DeploymentResponse",
     "DeploymentResponseGenerator", "batch", "multiplexed",
     "get_multiplexed_model_id", "get_request_context", "start_grpc_proxy",
+    "update_user_config",
 ]

@@ -36,6 +36,9 @@ class DeploymentSpec:
     autoscaling_config: Optional[AutoscalingConfig] = None
     init_args: tuple = ()
     init_kwargs: dict = dataclasses.field(default_factory=dict)
+    # pushed to replicas' reconfigure(user_config) at boot and on
+    # update_user_config — lightweight updates without restarts
+    user_config: Any = None
 
 
 class Application:
@@ -85,7 +88,8 @@ class Deployment:
 
     def options(self, **kwargs) -> "Deployment":
         allowed = {"name", "num_replicas", "max_ongoing_requests",
-                   "ray_actor_options", "autoscaling_config"}
+                   "ray_actor_options", "autoscaling_config",
+                   "user_config"}
         bad = set(kwargs) - allowed
         if bad:
             raise ValueError(f"unknown deployment options {sorted(bad)}")
@@ -105,6 +109,7 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
                num_replicas: int = 1, max_ongoing_requests: int = 16,
                ray_actor_options: Optional[dict] = None,
                autoscaling_config: Optional[dict | AutoscalingConfig] = None,
+               user_config: Any = None,
                **_ignored) -> Any:
     """@serve.deployment decorator (reference: serve/api.py:deployment)."""
     if isinstance(autoscaling_config, dict):
@@ -121,6 +126,7 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
             max_ongoing_requests=max_ongoing_requests,
             ray_actor_options=ray_actor_options or {},
             autoscaling_config=autoscaling_config,
+            user_config=user_config,
         ))
     if _func_or_class is not None:
         return wrap(_func_or_class)
@@ -176,6 +182,17 @@ def get_app_handle(name: str = "default") -> DeploymentHandle:
     ctrl = _controller(create=False)
     ingress = ray.get(ctrl.get_ingress.remote(name))
     return DeploymentHandle(ingress, name, ctrl)
+
+
+def update_user_config(app: str, deployment_name: str,
+                       user_config: Any) -> None:
+    """Push a new user_config to a deployment's live replicas without
+    restarting them (reference: lightweight config updates via
+    reconfigure())."""
+    ray = _ray()
+    ctrl = _controller(create=False)
+    ray.get(ctrl.update_user_config.remote(app, deployment_name,
+                                           user_config))
 
 
 def status() -> dict:

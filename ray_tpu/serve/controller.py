@@ -41,6 +41,9 @@ class ReplicaActor:
             if handle_args or handle_kwargs:
                 raise TypeError("function deployments take no init args")
             self._callable = fc
+        # user_config is applied by the controller through the async
+        # reconfigure() path right after creation (supports async def
+        # reconfigure too; a sync __init__ could not await it)
 
     async def _invoke(self, method: str, args: tuple, kwargs: dict,
                       context: Optional[dict]):
@@ -176,7 +179,9 @@ class ReplicaActor:
 
     async def reconfigure(self, user_config: Any):
         if hasattr(self._callable, "reconfigure"):
-            self._callable.reconfigure(user_config)
+            res = self._callable.reconfigure(user_config)
+            if asyncio.iscoroutine(res):
+                await res
 
     async def health_check(self) -> bool:
         if hasattr(self._callable, "check_health"):
@@ -275,6 +280,9 @@ class ServeController:
             resources=opts.get("resources"),
             max_concurrency=max(st.spec.max_ongoing_requests, 1),
         ).remote(self._replica_blob(st.spec))
+        if st.spec.user_config is not None:
+            # configured BEFORE the replica enters routing (async-aware)
+            await actor.reconfigure.remote(st.spec.user_config)
         st.replicas.append(actor)
         st.bump()
 
@@ -320,6 +328,35 @@ class ServeController:
                 raise ValueError(
                     f"deployment {deployment!r} was deleted from {app!r}")
         return st.version, list(st.replicas)
+
+    async def update_user_config(self, app: str, deployment: str,
+                                 user_config) -> None:
+        """Lightweight update: push reconfigure() to every live replica
+        concurrently, then persist for future replicas. Application
+        errors SURFACE (and the old config stays for future replicas);
+        only dying-replica errors are ignored — the reconcile loop
+        replaces those."""
+        import dataclasses
+
+        from ..exceptions import ActorDiedError, WorkerCrashedError
+        st = self._apps.get(app, {}).get(deployment)
+        if st is None:
+            raise ValueError(f"no deployment {deployment!r} in app {app!r}")
+        refs = [r.reconfigure.remote(user_config) for r in st.replicas]
+        app_error = None
+        for ref in refs:
+            try:
+                await asyncio.wait_for(ref, timeout=30)
+            except (ActorDiedError, WorkerCrashedError,
+                    asyncio.TimeoutError):
+                continue  # dying replica: reconcile will replace it
+            except Exception as e:  # noqa: BLE001 — user reconfigure bug
+                app_error = e
+        if app_error is not None:
+            raise RuntimeError(
+                f"reconfigure({user_config!r}) raised on a replica; "
+                f"config NOT persisted") from app_error
+        st.spec = dataclasses.replace(st.spec, user_config=user_config)
 
     async def set_target(self, app: str, deployment: str, n: int) -> None:
         """Manually retarget a deployment's replica count (ops escape

@@ -148,3 +148,53 @@ def test_multiplexed_requires_id():
 
     with pytest.raises(ValueError, match="no multiplexed model id"):
         asyncio.new_event_loop().run_until_complete(main())
+
+
+def test_user_config_and_reconfigure(ray):
+    """user_config applies at replica boot and updates live via
+    reconfigure() without restarts (reference: lightweight updates)."""
+    @serve.deployment(num_replicas=2, user_config={"threshold": 5})
+    class Thresholder:
+        def __init__(self):
+            import os
+            self.threshold = None
+            self.pid = os.getpid()
+
+        def reconfigure(self, config):
+            self.threshold = config["threshold"]
+
+        def __call__(self, x):
+            return {"over": x > self.threshold, "pid": self.pid}
+
+    h = serve.run(Thresholder.bind(), name="ucfg")
+    assert h.remote(7).result(timeout_s=60)["over"] is True
+    assert h.remote(3).result(timeout_s=60)["over"] is False
+    pid0 = h.remote(0).result(timeout_s=60)["pid"]
+
+    serve.update_user_config("ucfg", "Thresholder", {"threshold": 100})
+    time.sleep(0.3)
+    outs = [h.remote(7).result(timeout_s=60) for _ in range(6)]
+    assert all(o["over"] is False for o in outs)   # new threshold live
+    assert any(o["pid"] == pid0 for o in outs)     # same replicas (no restart)
+
+
+def test_update_user_config_surfaces_errors(ray):
+    """A reconfigure() that raises fails the update and does NOT persist
+    the bad config for future replicas."""
+    @serve.deployment(user_config={"k": 1})
+    class Cfg:
+        def __init__(self):
+            self.k = None
+
+        def reconfigure(self, config):
+            self.k = config["k"]   # KeyError on bad config
+
+        def __call__(self, _=None):
+            return self.k
+
+    h = serve.run(Cfg.bind(), name="ucfg-err")
+    assert h.remote().result(timeout_s=60) == 1
+    with pytest.raises(Exception):
+        serve.update_user_config("ucfg-err", "Cfg", {"wrong": 9})
+    # old config still live and still what future replicas would get
+    assert h.remote().result(timeout_s=60) == 1
