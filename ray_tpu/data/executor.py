@@ -272,6 +272,22 @@ class Executor:
         # high-water mark of concurrently in-flight tasks (observable by
         # tests and stats)
         self.max_in_flight_seen = 0
+        # ticks where store pressure shrank the submission window
+        self.backpressure_events = 0
+
+    @staticmethod
+    def _store_pressured(ray) -> bool:
+        from ..core import runtime as rt_mod
+        from ..core.config import cfg
+        rt = rt_mod.get_runtime_if_exists()
+        store = getattr(rt, "store", None)
+        if store is None:
+            return False
+        try:
+            return (store.bytes_in_use()
+                    > cfg.object_spilling_threshold * store.capacity())
+        except Exception:
+            return False
 
     def _peel(self, op: LogicalOp):
         """Split a plan top into (fused block fns, source node)."""
@@ -351,7 +367,11 @@ class Executor:
 
     def _stream(self, thunks, window=_DEFAULT):
         """Bounded-window submission loop (the scheduling loop of the
-        reference's StreamingExecutor, _scheduling_loop_step)."""
+        reference's StreamingExecutor, _scheduling_loop_step) with
+        object-store backpressure: past the spill threshold, submission
+        halves down to 1 in flight so consumption can drain the store
+        before producers flood it (reference: the memory-aware admission
+        of streaming_executor_state.py:646 select_operator_to_run)."""
         from collections import deque
 
         ray = _ray()
@@ -361,8 +381,12 @@ class Executor:
         it = iter(thunks)
         exhausted = False
         while True:
-            while not exhausted and (window is None
-                                     or len(pending) < window):
+            limit = window
+            if window is not None and self._store_pressured(ray):
+                limit = max(1, window // 2)
+                self.backpressure_events += 1
+            while not exhausted and (limit is None
+                                     or len(pending) < limit):
                 try:
                     thunk = next(it)
                 except StopIteration:
