@@ -238,6 +238,11 @@ def _free_port() -> int:
 class DataParallelTrainer:
     """Gang-schedules `train_loop_per_worker` over a placement group and
     supervises it (reference: v2/api/data_parallel_trainer.py:55, fit :103).
+
+    With `datasets=`, leave CPU headroom outside the gang: placement
+    groups RESERVE their resources, and the streaming data tasks run
+    outside the PG (reference guidance is identical — data-loading CPUs
+    are provisioned beside the training gang).
     """
 
     def __init__(self,
