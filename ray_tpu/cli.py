@@ -260,6 +260,37 @@ def _follow(rt, job_id: str) -> int:
 # state / timeline
 # --------------------------------------------------------------------- #
 
+def cmd_serve(args) -> int:
+    """`serve run module:app` (reference: the serve CLI)."""
+    import importlib
+
+    ray, rt, _ = _client(args.address)
+    from . import serve as serve_api
+    mod_name, _, attr = args.target.partition(":")
+    if not attr:
+        print("target must be module.path:app_variable", file=sys.stderr)
+        return 2
+    sys.path.insert(0, os.getcwd())
+    mod = importlib.import_module(mod_name)
+    # the app's module only exists on THIS machine: ship its code by
+    # value so replicas never try to import it (the jobs path solves the
+    # same problem with working_dir)
+    import cloudpickle
+    cloudpickle.register_pickle_by_value(mod)
+    app = getattr(mod, attr)
+    serve_api.run(app, name=args.name, route_prefix=args.route_prefix,
+                  http_port=args.http_port)
+    print(f"serving {args.target!r} as app {args.name!r} on "
+          f"http://127.0.0.1:{args.http_port} (Ctrl-C to stop)")
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        serve_api.shutdown()
+        ray.shutdown()
+        return 0
+
+
 def cmd_state(args) -> int:
     ray, rt, _ = _client(args.address)
     try:
@@ -333,6 +364,16 @@ def build_parser() -> argparse.ArgumentParser:
     j.add_argument("--follow", action="store_true")
     j.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_job)
+
+    sp = sub.add_parser("serve", help="deploy a serve application")
+    ssub = sp.add_subparsers(dest="serve_cmd", required=True)
+    sr = ssub.add_parser("run", help="import module:app and serve it")
+    sr.add_argument("target", help="module.path:app_variable")
+    sr.add_argument("--name", default="default")
+    sr.add_argument("--route-prefix", default="/")
+    sr.add_argument("--http-port", type=int, default=8000)
+    sr.add_argument("--address", default=None)
+    sr.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser("state", help="list cluster state")
     sp.add_argument("kind", choices=["tasks", "actors", "nodes", "objects",

@@ -68,7 +68,8 @@ def init(address: Optional[str] = None,
         res["TPU"] = float(num_tpus)
     rt = Runtime(res,
                  object_store_memory=object_store_memory or None,
-                 head_labels=labels)
+                 head_labels=labels,
+                 log_to_driver=log_to_driver)
     rt_mod.set_runtime(rt)
     out = {"node_id": rt.head_node.node_id.hex(),
            "session_dir": rt.session_dir}

@@ -286,7 +286,8 @@ class Runtime:
                  object_store_memory: int | None = None,
                  session_dir: str | None = None,
                  head_labels: dict[str, str] | None = None,
-                 enable_remote_nodes: bool = False):
+                 enable_remote_nodes: bool = False,
+                 log_to_driver: bool = True):
         from .config import cfg
         if object_store_memory is None:
             object_store_memory = cfg.object_store_memory
@@ -420,6 +421,12 @@ class Runtime:
             "jobs", {"job_id": job_id, "status": status})
         self._driver_seq = 0
 
+        # worker stdout/stderr -> the driver console (reference:
+        # log_to_driver / the log monitor tailing worker files)
+        if log_to_driver:
+            threading.Thread(target=self._log_tail_loop, daemon=True,
+                             name="rtpu-logtail").start()
+
         # agent liveness: heartbeats guard against HUNG agents (conn EOF
         # already covers dead processes) — gcs_health_check_manager.h:45
         threading.Thread(target=self._health_check_loop, daemon=True,
@@ -448,6 +455,44 @@ class Runtime:
     # ------------------------------------------------------------------ #
     # connection plumbing
     # ------------------------------------------------------------------ #
+
+    def _log_tail_loop(self):
+        """Follow head-pool worker logs, echoing new output with a
+        (worker) prefix (reference: the log monitor pushing worker
+        stdout/stderr to the driver). shutdown() runs one final scan so
+        late prints aren't dropped."""
+        self._logtail_state = ({}, {})  # offsets, partial-line carries
+        while not self._shutdown:
+            time.sleep(0.5)
+            self._log_tail_scan()
+
+    def _log_tail_scan(self):
+        import glob
+        offsets, carries = self._logtail_state
+        for path in glob.glob(os.path.join(self.session_dir,
+                                           "worker-*.log")):
+            try:
+                size = os.path.getsize(path)
+                seen = offsets.get(path, 0)
+                if size <= seen:
+                    continue
+                with open(path, "rb") as f:
+                    f.seek(seen)
+                    chunk = f.read(size - seen)
+                offsets[path] = size
+                # emit only COMPLETE lines: carry the trailing partial so
+                # split lines / bisected UTF-8 chars are never printed
+                data = carries.get(path, b"") + chunk
+                head, nl, tail = data.rpartition(b"\n")
+                carries[path] = tail
+                if not nl:
+                    continue
+                wid = os.path.basename(path)[len("worker-"):-len(".log")]
+                for line in head.decode(errors="replace").splitlines():
+                    if line.strip():
+                        print(f"({wid}) {line}", flush=True)
+            except OSError:
+                continue
 
     def _health_check_loop(self):
         from .config import cfg
@@ -2301,6 +2346,12 @@ class Runtime:
                 return
             self._shutdown = True
             workers = list(self.workers.values())
+        # flush any worker output the tailer hasn't echoed yet
+        if getattr(self, "_logtail_state", None) is not None:
+            try:
+                self._log_tail_scan()
+            except Exception:
+                pass
         # durable snapshot FIRST: killing workers below tears actors out
         # of the tables (watch-proc death path), and a successor must see
         # them as they were while alive

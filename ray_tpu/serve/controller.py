@@ -220,6 +220,8 @@ class ServeController:
         import itertools
         self._apps: dict[str, dict[str, _DeploymentState]] = {}
         self._ingress: dict[str, str] = {}
+        # app -> URL route prefix (reference: route_prefix in serve.run)
+        self._routes: dict[str, str] = {}
         self._proxy = None
         self._reconcile_task = None
         self._shutdown = False
@@ -240,6 +242,22 @@ class ServeController:
                                                  self._version_counter)
         self._apps[app_name] = states
         self._ingress[app_name] = ingress
+        # "/" (the default) means app-name addressing (/<app>/...); only
+        # EXPLICIT prefixes join the longest-match route table
+        if route_prefix and route_prefix != "/":
+            if not route_prefix.startswith("/"):
+                raise ValueError(
+                    f"route_prefix must start with '/', got "
+                    f"{route_prefix!r}")
+            owner = next((a for a, p in self._routes.items()
+                          if p == route_prefix and a != app_name), None)
+            if owner is not None:
+                raise ValueError(
+                    f"route_prefix {route_prefix!r} is already used by "
+                    f"app {owner!r}")
+            self._routes[app_name] = route_prefix
+        else:
+            self._routes.pop(app_name, None)
         for st in states.values():
             await self._scale_to_target(st)
         if http_port is not None:
@@ -367,6 +385,10 @@ class ServeController:
         st.target = max(0, int(n))
         await self._scale_to_target(st)
 
+    async def get_routes(self) -> dict:
+        """{route_prefix: app} for the proxy's longest-prefix matching."""
+        return {v: k for k, v in self._routes.items()}
+
     async def get_ingress(self, app: str) -> str:
         if app not in self._ingress:
             raise ValueError(f"no application {app!r}")
@@ -387,6 +409,7 @@ class ServeController:
         return out
 
     async def delete_application(self, app: str) -> None:
+        self._routes.pop(app, None)
         import ray_tpu
         states = self._apps.pop(app, None)
         self._ingress.pop(app, None)

@@ -28,6 +28,10 @@ class ProxyActor:
         from collections import OrderedDict
         self._handles: "OrderedDict" = OrderedDict()
         self._handles_max = 256
+        # route table cache: refreshed off-loop on a short TTL — a
+        # per-request controller round-trip would block the event loop
+        self._routes: dict = {}
+        self._routes_ts = 0.0
 
     def _handle_for(self, ingress, app_name, stream, model_id,
                     method="__call__"):
@@ -65,11 +69,34 @@ class ProxyActor:
         from .api import CONTROLLER_NAME
 
         path = request.match_info["tail"].strip("/")
-        app_name = path.split("/", 1)[0] if path else "default"
-        # the rest of the path routes to an ingress METHOD: /llm/v1/chat/
-        # completions -> v1_chat_completions (reference: FastAPI ingress
-        # route decorators; here path segments map to method names)
-        subpath = path.split("/", 1)[1] if "/" in path else ""
+        # route_prefix longest-match first (reference: the proxy's route
+        # table); falls back to /<app_name> addressing
+        app_name, subpath = None, ""
+        import time as _time
+        loop0 = asyncio.get_event_loop()
+        if _time.monotonic() - self._routes_ts > 1.0:
+            def _fetch_routes():
+                try:
+                    ctrl0 = ray_tpu.get_actor(CONTROLLER_NAME)
+                    return ray_tpu.get(ctrl0.get_routes.remote())
+                except Exception:
+                    return {}
+            self._routes = await loop0.run_in_executor(None, _fetch_routes)
+            self._routes_ts = _time.monotonic()
+        routes = self._routes
+        full = "/" + path
+        for prefix, app in sorted(routes.items(), key=lambda kv:
+                                  -len(kv[0])):
+            p = prefix.rstrip("/")
+            if not p:
+                continue  # "/" prefixes never reach the route table
+            if full == p or full.startswith(p + "/"):
+                app_name = app
+                subpath = full[len(p):].strip("/")
+                break
+        if app_name is None:
+            app_name = path.split("/", 1)[0] if path else "default"
+            subpath = path.split("/", 1)[1] if "/" in path else ""
         method = subpath.strip("/").replace("/", "_").replace(
             ".", "_").replace("-", "_") if subpath else "__call__"
         if method != "__call__" and (

@@ -204,3 +204,45 @@ def test_stop_command():
         alive = False
     assert not alive
     assert not os.path.exists(f"/tmp/ray_tpu/named_{name}.json")
+
+
+def test_serve_run_cli(cluster, tmp_path):
+    """`python -m ray_tpu serve run module:app` deploys and serves HTTP."""
+    import urllib.request
+
+    app_py = tmp_path / "myapp.py"
+    app_py.write_text(textwrap.dedent("""
+        from ray_tpu import serve
+
+        @serve.deployment
+        def hello(payload=None):
+            return {"hi": True}
+
+        app = hello.bind()
+    """))
+    env = dict(os.environ)
+    env["RTPU_WORKER_PRESTART"] = "0"
+    env.pop("RTPU_ADDRESS", None)
+    # cwd is tmp_path (the app module lives there); the framework isn't
+    # pip-installed, so put the repo on the path explicitly
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.cli", "serve", "run",
+         "myapp:app", "--name", "cliapp", "--http-port", "18371",
+         "--address", cluster["cluster_file"]],
+        cwd=str(tmp_path), env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    try:
+        deadline = time.time() + 90
+        out = None
+        while time.time() < deadline and out is None:
+            try:
+                with urllib.request.urlopen(
+                        "http://127.0.0.1:18371/cliapp", timeout=5) as r:
+                    out = json.loads(r.read())
+            except Exception:
+                time.sleep(0.5)
+        assert out == {"hi": True}, out
+    finally:
+        proc.terminate()
+        proc.wait(timeout=15)

@@ -4,6 +4,7 @@ Reference parity model: python/ray/tests/test_basic.py, test_actor.py — the
 same behaviors (task chaining, error propagation, num_returns, wait,
 actors, nesting, handle passing) exercised against the TPU-build runtime.
 """
+import os
 import time
 
 import numpy as np
@@ -308,3 +309,33 @@ def test_local_mode(shutdown_only):
 
     c = C.remote()
     assert ray.get(c.m.remote()) == "local"
+
+
+def test_log_to_driver_streams_worker_prints():
+    """Worker prints surface on the driver console with a (wid) prefix
+    (reference: the log monitor / log_to_driver)."""
+    import subprocess
+    import sys
+    import textwrap
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = textwrap.dedent("""
+        import time
+        import ray_tpu
+        ray_tpu.init(num_cpus=1, log_to_driver=True)
+
+        @ray_tpu.remote
+        def shout():
+            print("HELLO-FROM-WORKER")
+            return 1
+
+        assert ray_tpu.get(shout.remote(), timeout=60) == 1
+        time.sleep(1.5)   # give the tailer a tick
+        ray_tpu.shutdown()
+    """)
+    env = dict(os.environ)
+    env["RTPU_WORKER_PRESTART"] = "1"
+    r = subprocess.run([sys.executable, "-c", script], env=env, cwd=repo,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "HELLO-FROM-WORKER" in r.stdout
+    assert "(w" in r.stdout  # the worker-id prefix

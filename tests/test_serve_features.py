@@ -198,3 +198,49 @@ def test_update_user_config_surfaces_errors(ray):
         serve.update_user_config("ucfg-err", "Cfg", {"wrong": 9})
     # old config still live and still what future replicas would get
     assert h.remote().result(timeout_s=60) == 1
+
+
+def test_route_prefix_http(ray):
+    """Explicit route_prefix maps URL paths to apps (longest match);
+    default '/' keeps app-name addressing."""
+    import json as _json
+    import urllib.request
+
+    @serve.deployment
+    def api_v2(payload=None):
+        return {"v": 2, "got": payload}
+
+    @serve.deployment
+    def plain(payload=None):
+        return {"v": 1}
+
+    serve.run(api_v2.bind(), name="v2app", route_prefix="/api/v2",
+              http_port=18223)
+    serve.run(plain.bind(), name="plainapp")
+
+    req = urllib.request.Request(
+        "http://127.0.0.1:18223/api/v2",
+        data=_json.dumps({"q": 1}).encode(),
+        headers={"Content-Type": "application/json"})
+    out = _json.loads(urllib.request.urlopen(req, timeout=60).read())
+    assert out == {"v": 2, "got": {"q": 1}}
+    # app-name addressing still works for the default-prefix app
+    out = _json.loads(urllib.request.urlopen(
+        "http://127.0.0.1:18223/plainapp", timeout=60).read())
+    assert out == {"v": 1}
+
+
+def test_route_prefix_validation(ray):
+    @serve.deployment
+    def f1(p=None):
+        return 1
+
+    @serve.deployment
+    def f2(p=None):
+        return 2
+
+    serve.run(f1.bind(), name="rp-a", route_prefix="/shared")
+    with pytest.raises(Exception, match="already used"):
+        serve.run(f2.bind(), name="rp-b", route_prefix="/shared")
+    with pytest.raises(Exception, match="start with"):
+        serve.run(f2.bind(), name="rp-c", route_prefix="oops")
