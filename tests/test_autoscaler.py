@@ -106,3 +106,28 @@ def test_pg_demand_triggers_scale(small_head):
         assert len(asc.instances) >= 1
     finally:
         asc.stop()
+
+
+def test_autoscaler_satisfies_training_gang(small_head):
+    """End-to-end: a trainer gang bigger than the cluster drives scale-up
+    (pending PG bundles are autoscaler demand), then trains."""
+    from ray_tpu import train
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    asc = Autoscaler([NodeTypeConfig("cpu2", {"CPU": 2}, max_workers=2)],
+                     provider=FakeNodeProvider(),
+                     idle_timeout_s=120.0, period_s=0.5).start()
+    try:
+        def loop(config=None):
+            ctx = train.get_context()
+            train.report({"world": ctx.world_size, "rank": ctx.rank})
+
+        result = JaxTrainer(
+            loop,
+            scaling_config=ScalingConfig(num_workers=3,
+                                         cpus_per_worker=1.0),
+            run_config=RunConfig(name="autoscaled-gang")).fit()
+        assert result.metrics["world"] == 3
+        assert len(asc.instances) >= 1   # agents were launched for it
+    finally:
+        asc.stop()
