@@ -30,7 +30,16 @@ LOGICAL_AXIS_RULES: tuple[tuple[str, object], ...] = (
     ("q_seq", "sp"),
     ("kv_seq", None),
     ("head_dim", None),
-    ("vocab", "tp"),
+    # Vocab rows shard over (tp, fsdp): Megatron-style vocab-parallel
+    # embedding. The gather from a row-sharded table partitions cleanly
+    # (clamp+mask+psum over tp·fsdp) and its output inherits the *index*
+    # sharding (batch over dp·fsdp) — no feature-dim→batch-dim reshard. The
+    # old rule (embed dim over fsdp) made every embedding lookup flip a
+    # feature-sharded gather output to batch-sharded, which XLA can only do
+    # by involuntary full rematerialization (replicate + repartition), fwd
+    # and bwd. On lm_head ("embed", "vocab") the embed dim claims fsdp
+    # first, so logits stay tp-sharded exactly as before.
+    ("vocab", ("tp", "fsdp")),
     ("expert", "ep"),
     ("stage", "pp"),
     ("channel", None),

@@ -58,13 +58,19 @@ class TestSharding:
             assert logical_spec(("batch", "sequence", "embed")) == \
                 P(("dp", "fsdp"), "sp")
             assert logical_spec(("embed", "mlp")) == P("fsdp", "tp")
+            # vocab-parallel embedding table: rows over (tp, fsdp),
+            # embed dim replicated (fsdp already claimed by vocab)
+            assert logical_spec(("vocab", "embed")) == P(("tp", "fsdp"))
+            # lm_head: embed claims fsdp first, vocab keeps tp (as before)
+            assert logical_spec(("embed", "vocab")) == P("fsdp", "tp")
             assert logical_spec((None, "heads", "head_dim")) == P(None, "tp")
 
     def test_axis_used_once(self):
         mesh = build_mesh(MeshSpec(tp=2, dp=-1))
         with use_mesh(mesh):
-            # vocab and mlp both want tp; only the first gets it
-            assert logical_spec(("mlp", "vocab")) == P("tp")
+            # vocab and mlp both want tp; only the first gets it. vocab
+            # falls back to its secondary (size-1, harmless) fsdp axis.
+            assert logical_spec(("mlp", "vocab")) == P("tp", "fsdp")
 
     def test_named_sharding_and_constrain(self):
         mesh = build_mesh(MeshSpec(dp=-1))
