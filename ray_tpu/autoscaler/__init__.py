@@ -6,7 +6,8 @@ ResourceDemandScheduler) and the fake multi-node provider
 (autoscaler/_private/fake_multi_node/node_provider.py:236).
 """
 from .autoscaler import Autoscaler, NodeTypeConfig
+from .gce_tpu import GceTpuVmProvider
 from .node_provider import FakeNodeProvider, NodeProvider
 
 __all__ = ["Autoscaler", "NodeTypeConfig", "NodeProvider",
-           "FakeNodeProvider"]
+           "FakeNodeProvider", "GceTpuVmProvider"]

@@ -25,11 +25,15 @@ from .node_provider import NodeProvider
 
 @dataclasses.dataclass
 class NodeTypeConfig:
-    """(reference: available_node_types in the cluster YAML)"""
+    """(reference: available_node_types in the cluster YAML; TPU slice
+    types additionally carry a host count, like the reference's
+    tpu-pod worker groups)"""
     name: str
-    resources: dict
+    resources: dict          # PER-HOST resources
     min_workers: int = 0
-    max_workers: int = 4
+    max_workers: int = 4     # counted in INSTANCES (slices), not hosts
+    hosts: int = 1           # hosts per instance (>1 = TPU slice type)
+    labels: Optional[dict] = None  # labels stamped on every host
 
 
 def _fits(demand: dict, capacity: dict) -> bool:
@@ -39,6 +43,21 @@ def _fits(demand: dict, capacity: dict) -> bool:
 def _sub(capacity: dict, demand: dict) -> None:
     for k, v in demand.items():
         capacity[k] = capacity.get(k, 0.0) - v
+
+
+def _gang_fits(gang: list[dict], hosts: int, per_host: dict) -> bool:
+    """Can `gang`'s bundles bin-pack onto `hosts` hosts of `per_host`
+    resources? (First-fit-decreasing — PACK-style gangs may put several
+    bundles on one host, not just one-bundle-per-host.)"""
+    bins = [dict(per_host) for _ in range(hosts)]
+    for b in sorted(gang, key=lambda d: -sum(d.values())):
+        for cap in bins:
+            if _fits(b, cap):
+                _sub(cap, b)
+                break
+        else:
+            return False
+    return True
 
 
 class Autoscaler:
@@ -80,9 +99,18 @@ class Autoscaler:
                         and a.spec.pg_id is None:
                     demands.append(dict(a.spec.resources))
             for pg in rt.pgs.values():
-                if pg.state == "pending":
+                if pg.state == "pending" and not pg.same_label:
                     demands.extend(dict(b.resources) for b in pg.bundles)
         return [d for d in demands if d]
+
+    def pending_gangs(self) -> list[list[dict]]:
+        """Bundle lists of pending same-label (slice-constrained) PGs.
+        These can only be satisfied by launching a whole slice instance,
+        so they are planned as units, never as loose bundles."""
+        with self.rt.lock:
+            return [[dict(b.resources) for b in pg.bundles]
+                    for pg in self.rt.pgs.values()
+                    if pg.state == "pending" and pg.same_label]
 
     def _free_capacity(self) -> list[dict]:
         """Per-alive-node free resources (head + agents)."""
@@ -98,9 +126,13 @@ class Autoscaler:
         frees = self._free_capacity()
         # in-flight launches count as future capacity so one burst of
         # demand doesn't launch a node per tick while agents boot
+        booting_types: list[str] = []
         for iid, tname in self.instances.items():
             if self.provider.node_id_of(iid) is None:
-                frees.append(dict(self.node_types[tname].resources))
+                t = self.node_types[tname]
+                booting_types.append(tname)
+                for _ in range(t.hosts):
+                    frees.append(dict(t.resources))
 
         unmet: list[dict] = []
         for d in sorted(demands, key=lambda d: -sum(d.values())):
@@ -141,6 +173,29 @@ class Autoscaler:
             # unplaceable on ANY type: leave it pending (the task's own
             # infeasibility timeout reports the error)
 
+        # slice gangs: each pending same-label PG needs ONE instance with
+        # enough hosts, every bundle fitting the type's per-host resources
+        # (one bundle per host, the slice_placement_group shape). A booting
+        # slice-capable instance covers a gang so bursts don't launch one
+        # slice per tick.
+        gangs = self.pending_gangs()
+        in_flight = list(booting_types)
+        for gang in gangs:
+            def covers(t: NodeTypeConfig) -> bool:
+                return _gang_fits(gang, t.hosts, t.resources)
+            hit = next((tn for tn in in_flight
+                        if covers(self.node_types[tn])), None)
+            if hit is not None:
+                in_flight.remove(hit)
+                continue
+            for t in self.node_types.values():
+                count = live_by_type.get(t.name, 0) + to_launch.get(
+                    t.name, 0)
+                if count >= t.max_workers or not covers(t):
+                    continue
+                to_launch[t.name] = to_launch.get(t.name, 0) + 1
+                break
+
         # min_workers floor
         for t in self.node_types.values():
             have = live_by_type.get(t.name, 0) + to_launch.get(t.name, 0)
@@ -148,7 +203,7 @@ class Autoscaler:
                 to_launch[t.name] = to_launch.get(t.name, 0) + (
                     t.min_workers - have)
 
-        to_terminate = self._find_idle() if not demands else []
+        to_terminate = self._find_idle() if not (demands or gangs) else []
         return to_launch, to_terminate
 
     def _find_idle(self) -> list[str]:
@@ -174,7 +229,8 @@ class Autoscaler:
             if nid is None:  # still booting
                 self._idle_since.pop(iid, None)
                 continue
-            if nid in busy_hex:
+            # a slice instance is idle only when EVERY host is idle
+            if any(h in busy_hex for h in self.provider.nodes_of(iid)):
                 self._idle_since.pop(iid, None)
                 continue
             first = self._idle_since.setdefault(iid, now)
@@ -192,10 +248,13 @@ class Autoscaler:
         for tname, n in to_launch.items():
             t = self.node_types[tname]
             for _ in range(n):
-                iid = self.provider.create_node(tname, dict(t.resources))
+                iid = self.provider.create_slice(
+                    tname, dict(t.resources), t.hosts,
+                    dict(t.labels) if t.labels else None)
                 self.instances[iid] = tname
                 self._launched_at[iid] = time.monotonic()
                 self.events.append({"event": "launch", "type": tname,
+                                    "hosts": t.hosts,
                                     "instance": iid, "ts": time.time()})
         for iid in to_terminate:
             nid = self.provider.node_id_of(iid)

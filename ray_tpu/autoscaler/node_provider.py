@@ -25,9 +25,21 @@ from typing import Optional
 class NodeProvider:
     """Minimal provider surface the autoscaler drives."""
 
-    def create_node(self, node_type: str, resources: dict) -> str:
+    def create_node(self, node_type: str, resources: dict,
+                    labels: Optional[dict] = None) -> str:
         """Launch a node of `node_type`; returns a provider instance id."""
         raise NotImplementedError
+
+    def create_slice(self, node_type: str, resources: dict, hosts: int,
+                     labels: Optional[dict] = None) -> str:
+        """Launch one multi-host accelerator slice (all hosts share the
+        instance id and any slice-identity labels). A cloud TPU provider
+        creates the whole slice in one API call; the default is only valid
+        for single-host types."""
+        if hosts != 1:
+            raise NotImplementedError(
+                f"{type(self).__name__} cannot launch {hosts}-host slices")
+        return self.create_node(node_type, resources, labels)
 
     def terminate_node(self, instance_id: str) -> None:
         raise NotImplementedError
@@ -36,8 +48,15 @@ class NodeProvider:
         raise NotImplementedError
 
     def node_id_of(self, instance_id: str) -> Optional[str]:
-        """Cluster NodeID hex once the instance registered, else None."""
+        """Cluster NodeID hex once the instance registered, else None.
+        Multi-host instances report None until EVERY host registered."""
         raise NotImplementedError
+
+    def nodes_of(self, instance_id: str) -> list[str]:
+        """All cluster NodeID hexes belonging to the instance (one per
+        host). Default: the single node_id_of."""
+        nid = self.node_id_of(instance_id)
+        return [nid] if nid is not None else []
 
     def shutdown(self) -> None:
         for iid in list(self.non_terminated_nodes()):
@@ -45,7 +64,10 @@ class NodeProvider:
 
 
 class FakeNodeProvider(NodeProvider):
-    """Spawns real node agents as local subprocesses."""
+    """Spawns real node agents as local subprocesses. Multi-host slices
+    launch `hosts` agents sharing one instance id and one slice label —
+    the fake analog of a TPU pod slice (reference:
+    fake_multi_node/node_provider.py:236)."""
 
     def __init__(self, runtime=None):
         from ..core import runtime as rt_mod
@@ -53,11 +75,18 @@ class FakeNodeProvider(NodeProvider):
         if self._rt is None:
             raise RuntimeError("ray_tpu.init() first")
         self._lock = threading.Lock()
-        self._procs: dict[str, subprocess.Popen] = {}
-        self._node_ids: dict[str, str] = {}
+        # iid -> {host name -> Popen}
+        self._procs: dict[str, dict[str, subprocess.Popen]] = {}
+        self._node_ids: dict[str, dict[str, str]] = {}
         self._seq = 0
 
-    def create_node(self, node_type: str, resources: dict) -> str:
+    def create_node(self, node_type: str, resources: dict,
+                    labels: Optional[dict] = None) -> str:
+        return self.create_slice(node_type, resources, 1, labels)
+
+    def create_slice(self, node_type: str, resources: dict, hosts: int,
+                     labels: Optional[dict] = None) -> str:
+        from ..util.tpu import SLICE_LABEL, WORKER_ID_LABEL
         with self._lock:
             self._seq += 1
             iid = f"fake-{node_type}-{self._seq}"
@@ -65,42 +94,66 @@ class FakeNodeProvider(NodeProvider):
         env = dict(os.environ)
         env["RTPU_AUTHKEY"] = rt._authkey.hex()
         extra = {k: v for k, v in resources.items() if k != "CPU"}
-        args = [sys.executable, "-m", "ray_tpu.core.node_agent",
-                "--head", f"127.0.0.1:{rt.tcp_port}",
-                "--num-cpus", str(resources.get("CPU", 1)),
-                "--resources", json.dumps(extra),
-                "--name", iid]
-        log = open(os.path.join(rt.session_dir, f"agent-{iid}.log"), "wb")
-        proc = subprocess.Popen(args, env=env, stdout=log,
-                                stderr=subprocess.STDOUT,
-                                start_new_session=True)
-        log.close()
+        base_labels = dict(labels or {})
+        if hosts > 1:
+            base_labels.setdefault(SLICE_LABEL, iid)
+        procs: dict[str, subprocess.Popen] = {}
+        for h in range(hosts):
+            name = iid if hosts == 1 else f"{iid}-h{h}"
+            node_labels = dict(base_labels)
+            if hosts > 1:
+                node_labels[WORKER_ID_LABEL] = str(h)
+            args = [sys.executable, "-m", "ray_tpu.core.node_agent",
+                    "--head", f"127.0.0.1:{rt.tcp_port}",
+                    "--num-cpus", str(resources.get("CPU", 1)),
+                    "--resources", json.dumps(extra),
+                    "--labels", json.dumps(node_labels),
+                    "--name", name]
+            log = open(os.path.join(rt.session_dir, f"agent-{name}.log"),
+                       "wb")
+            procs[name] = subprocess.Popen(args, env=env, stdout=log,
+                                           stderr=subprocess.STDOUT,
+                                           start_new_session=True)
+            log.close()
         with self._lock:
-            self._procs[iid] = proc
+            self._procs[iid] = procs
         return iid
+
+    def _resolve_locked(self, instance_id: str) -> dict[str, str]:
+        """host name -> NodeID hex for every registered host so far."""
+        known = self._node_ids.setdefault(instance_id, {})
+        names = set(self._procs.get(instance_id, ())) - set(known)
+        if names:
+            for row in self._rt.node_table():
+                if row["NodeName"] in names and row["Alive"]:
+                    known[row["NodeName"]] = row["NodeID"]
+        return known
 
     def node_id_of(self, instance_id: str) -> Optional[str]:
         with self._lock:
-            nid = self._node_ids.get(instance_id)
-            if nid is not None:
-                return nid
-        # resolve by the node name the agent registered with
-        for row in self._rt.node_table():
-            if row["NodeName"] == instance_id and row["Alive"]:
-                with self._lock:
-                    self._node_ids[instance_id] = row["NodeID"]
-                return row["NodeID"]
-        return None
+            procs = self._procs.get(instance_id)
+            if not procs:
+                return None
+            known = self._resolve_locked(instance_id)
+            if len(known) < len(procs):
+                return None  # still booting (multi-host: ALL must join)
+            first = sorted(procs)[0]
+            return known.get(first)
+
+    def nodes_of(self, instance_id: str) -> list[str]:
+        with self._lock:
+            return list(self._resolve_locked(instance_id).values())
 
     def terminate_node(self, instance_id: str) -> None:
         with self._lock:
-            proc = self._procs.pop(instance_id, None)
+            procs = self._procs.pop(instance_id, None) or {}
             self._node_ids.pop(instance_id, None)
-        if proc is not None:
+        for proc in procs.values():
             try:
                 os.killpg(os.getpgid(proc.pid), 15)
             except (ProcessLookupError, PermissionError):
                 proc.kill()
+        for proc in procs.values():
             try:
                 proc.wait(timeout=5)
             except subprocess.TimeoutExpired:
@@ -108,8 +161,9 @@ class FakeNodeProvider(NodeProvider):
 
     def non_terminated_nodes(self) -> list[str]:
         with self._lock:
-            dead = [iid for iid, p in self._procs.items()
-                    if p.poll() is not None]
+            dead = [iid for iid, procs in self._procs.items()
+                    if procs and all(p.poll() is not None
+                                     for p in procs.values())]
             for iid in dead:
                 self._procs.pop(iid)
                 self._node_ids.pop(iid, None)

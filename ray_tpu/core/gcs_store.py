@@ -94,7 +94,8 @@ def snapshot(rt) -> None:
                 continue
             named.append((name, a.spec, blob))
         pgs = [(pg.pg_id, [dict(b.resources) for b in pg.bundles],
-                pg.strategy, pg.name)
+                pg.strategy, pg.name, pg.same_label,
+                list(pg.bundle_selectors))
                for pg in rt.pgs.values() if pg.state != "removed"]
     jobs = rt.jobs.list()
     kv.put("snapshot", "named_actors", pickle.dumps(named))
@@ -120,9 +121,15 @@ def restore(rt, old_session_dir: str) -> dict:
         old.close()
 
     restored = {"actors": 0, "placement_groups": 0, "jobs": 0, "kv_keys": 0}
-    for pg_id, bundles, strategy, name in pgs:
-        # keep the OLD id: restored actor specs reference it
-        rt.create_placement_group(bundles, strategy, name, pg_id=pg_id)
+    for row in pgs:
+        # keep the OLD id: restored actor specs reference it. Rows may be
+        # 4-tuples (pre-slice-scheduling snapshots) or 6-tuples.
+        pg_id, bundles, strategy, name = row[:4]
+        same_label = row[4] if len(row) > 4 else None
+        selectors = row[5] if len(row) > 5 else None
+        rt.create_placement_group(bundles, strategy, name, pg_id=pg_id,
+                                  same_label=same_label,
+                                  bundle_selectors=selectors)
         restored["placement_groups"] += 1
     import dataclasses
     from .ids import ActorID, ObjectID

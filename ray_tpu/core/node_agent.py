@@ -33,7 +33,8 @@ from multiprocessing.connection import Client
 class NodeAgent:
     def __init__(self, head: str, authkey: bytes, resources: dict,
                  name: str = "", own_store: bool = False,
-                 store_capacity: int = 1 << 30):
+                 store_capacity: int = 1 << 30,
+                 labels: dict | None = None):
         host, port = head.rsplit(":", 1)
         name = name or f"agent-{os.uname().nodename}"
         self.conn = Client((host, int(port)), authkey=authkey)
@@ -63,9 +64,14 @@ class NodeAgent:
             port_part = self.data_server.address.rsplit(":", 1)[1]
             data_addr = f"{host_ip()}:{port_part}"
 
+        # TPU VM identity labels come from the environment (TPU_NAME etc.,
+        # set by the TPU runtime) — never from a jax import, which would
+        # touch the accelerator tunnel during agent startup.
+        from ..util.tpu import discover_tpu_labels
+        all_labels = {**discover_tpu_labels(), **(labels or {})}
         self.conn.send({"t": "register_node", "resources": resources,
                         "name": name, "own_store": own_store,
-                        "data_addr": data_addr})
+                        "data_addr": data_addr, "labels": all_labels})
         reply = self.conn.recv()
         if reply.get("t") != "registered":
             raise RuntimeError(f"head rejected registration: {reply}")
@@ -193,6 +199,9 @@ def main(argv=None):
     ap.add_argument("--resources", default="{}",
                     help='extra resources JSON, e.g. \'{"TPU": 4}\'')
     ap.add_argument("--name", default="")
+    ap.add_argument("--labels", default="{}",
+                    help='node labels JSON, e.g. '
+                         '\'{"rtpu.tpu.slice": "pod-0"}\'')
     ap.add_argument("--own-store", action="store_true",
                     help="node-local object store + transfer service "
                          "(required off the head host)")
@@ -202,7 +211,8 @@ def main(argv=None):
     resources = {"CPU": args.num_cpus, **json.loads(args.resources)}
     agent = NodeAgent(args.head, authkey, resources, args.name,
                       own_store=args.own_store,
-                      store_capacity=args.store_capacity)
+                      store_capacity=args.store_capacity,
+                      labels=json.loads(args.labels))
     print(f"node_agent: joined as node {agent.node_id}", flush=True)
     agent.run()
 

@@ -270,11 +270,20 @@ class BundleState:
 
 class PlacementGroupState:
     def __init__(self, pg_id: PlacementGroupID, bundles: list[dict[str, float]],
-                 strategy: str, name: str = ""):
+                 strategy: str, name: str = "",
+                 same_label: str | None = None,
+                 bundle_selectors: list[dict | None] | None = None):
         self.pg_id = pg_id
         self.bundles = [BundleState(i, b) for i, b in enumerate(bundles)]
         self.strategy = strategy
         self.name = name
+        # same_label: every bundle must land on nodes sharing ONE value of
+        # this node-label key — how whole TPU slices (ICI domains) are
+        # gang-reserved (reference encodes this as TPU-{pod}-head resources,
+        # _private/accelerators/tpu.py:110).
+        self.same_label = same_label
+        # per-bundle exact-match node label requirements (or None)
+        self.bundle_selectors = list(bundle_selectors or [])
         self.state = "pending"           # pending|created|removed
         self.ready_event = threading.Event()
 
@@ -776,6 +785,7 @@ class Runtime:
                     "tcp_port": self.tcp_port})
         with self.lock:
             self.nodes[node.node_id] = node
+            self._retry_pending_pgs_locked()
             self._schedule_locked()
         self.pubsub.publish("nodes", {"node_id": node.node_id.hex(),
                                       "event": "added", "name": node.name})
@@ -999,8 +1009,11 @@ class Runtime:
     def job_stop(self, job_id):
         return self.jobs.stop(job_id)
 
-    def create_placement_group_rpc(self, bundles, strategy, name=""):
-        pg = self.create_placement_group(bundles, strategy, name)
+    def create_placement_group_rpc(self, bundles, strategy, name="",
+                                   same_label=None, bundle_selectors=None):
+        pg = self.create_placement_group(
+            bundles, strategy, name,
+            same_label=same_label, bundle_selectors=bundle_selectors)
         return (pg.pg_id, [dict(b.resources) for b in pg.bundles])
 
     def remove_placement_group_rpc(self, pg_id):
@@ -1982,11 +1995,15 @@ class Runtime:
     def create_placement_group(self, bundles: list[dict[str, float]],
                                strategy: str, name: str = "",
                                pg_id: PlacementGroupID | None = None,
+                               same_label: str | None = None,
+                               bundle_selectors: list[dict | None] | None = None,
                                ) -> PlacementGroupState:
         # pg_id is supplied on session restore so actor specs that
         # reference the old group stay valid (gcs_store.restore)
         pg = PlacementGroupState(pg_id or PlacementGroupID.from_random(),
-                                 bundles, strategy, name)
+                                 bundles, strategy, name,
+                                 same_label=same_label,
+                                 bundle_selectors=bundle_selectors)
         with self.lock:
             self.pgs[pg.pg_id] = pg
             self._try_reserve_pg_locked(pg)
@@ -1997,8 +2014,50 @@ class Runtime:
 
     def _try_reserve_pg_locked(self, pg: PlacementGroupState) -> bool:
         alive = [n for n in self.nodes.values() if n.alive]
+        if pg.same_label:
+            # gang-to-one-label-group (whole-slice) placement: only nodes
+            # carrying the label compete, and all bundles must land inside
+            # one label value's node group (one ICI domain).
+            groups: dict[str, list[NodeInfo]] = {}
+            for n in alive:
+                val = n.labels.get(pg.same_label)
+                if val is not None:
+                    groups.setdefault(val, []).append(n)
+            plan = None
+            # prefer the busiest feasible group so idle slices stay whole
+            # for future gangs (pack-onto-used, SURVEY §2.4)
+            for val in sorted(
+                    groups,
+                    key=lambda v: -max(n.utilization() for n in groups[v])):
+                plan = self._plan_pg_locked(groups[val], pg)
+                if plan is not None:
+                    break
+        else:
+            plan = self._plan_pg_locked(alive, pg)
+        if plan is None:
+            return False
+        # commit
+        for b, n in plan:
+            b.node_id = n.node_id
+            b.avail = dict(b.resources)
+            for k, v in b.resources.items():
+                n.resources_avail[k] = n.resources_avail.get(k, 0) - v
+        pg.state = "created"
+        pg.ready_event.set()
+        return True
+
+    def _plan_pg_locked(self, nodes: list[NodeInfo], pg: PlacementGroupState,
+                        ) -> Optional[list[tuple[BundleState, NodeInfo]]]:
+        """Bundle→node assignment over `nodes` per pg.strategy, or None if
+        infeasible. Does not mutate node state."""
         plan: list[tuple[BundleState, NodeInfo]] = []
-        avail = {n.node_id: dict(n.resources_avail) for n in alive}
+        avail = {n.node_id: dict(n.resources_avail) for n in nodes}
+        selectors = pg.bundle_selectors
+
+        def eligible(n: NodeInfo, bi: int) -> bool:
+            sel = selectors[bi] if bi < len(selectors) else None
+            return sel is None or all(
+                n.labels.get(k) == v for k, v in sel.items())
 
         def fits(nid, res):
             return all(avail[nid].get(k, 0) >= v - 1e-9 for k, v in res.items())
@@ -2011,12 +2070,13 @@ class Runtime:
         if strategy in ("PACK", "STRICT_PACK"):
             # try to fit all bundles on one node (requirement for STRICT_PACK)
             packed = False
-            for n in sorted(alive, key=lambda n: n.utilization()):
+            for n in sorted(nodes, key=lambda n: n.utilization()):
                 trial = dict(avail[n.node_id])
                 ok = True
                 for b in pg.bundles:
-                    if all(trial.get(k, 0) >= v - 1e-9
-                           for k, v in b.resources.items()):
+                    if eligible(n, b.index) and all(
+                            trial.get(k, 0) >= v - 1e-9
+                            for k, v in b.resources.items()):
                         for k, v in b.resources.items():
                             trial[k] = trial.get(k, 0) - v
                     else:
@@ -2030,39 +2090,43 @@ class Runtime:
                     break
             if not packed:
                 if strategy == "STRICT_PACK":
-                    return False
+                    return None
                 # soft PACK: greedy spill
                 for b in pg.bundles:
-                    tgt = next((n for n in alive
-                                if fits(n.node_id, b.resources)), None)
+                    tgt = next((n for n in nodes
+                                if eligible(n, b.index)
+                                and fits(n.node_id, b.resources)), None)
                     if tgt is None:
-                        return False
+                        return None
                     plan.append((b, tgt))
                     take(tgt.node_id, b.resources)
         else:  # SPREAD / STRICT_SPREAD
             used_nodes: set[NodeID] = set()
             for b in pg.bundles:
-                cands = [n for n in alive if fits(n.node_id, b.resources)]
+                cands = [n for n in nodes
+                         if eligible(n, b.index)
+                         and fits(n.node_id, b.resources)]
                 fresh = [n for n in cands if n.node_id not in used_nodes]
                 if strategy == "STRICT_SPREAD":
                     cands = fresh
                 elif fresh:
                     cands = fresh
                 if not cands:
-                    return False
+                    return None
                 tgt = min(cands, key=lambda n: n.utilization())
                 plan.append((b, tgt))
                 take(tgt.node_id, b.resources)
                 used_nodes.add(tgt.node_id)
-        # commit
-        for b, n in plan:
-            b.node_id = n.node_id
-            b.avail = dict(b.resources)
-            for k, v in b.resources.items():
-                n.resources_avail[k] = n.resources_avail.get(k, 0) - v
-        pg.state = "created"
-        pg.ready_event.set()
-        return True
+        return plan
+
+    def _retry_pending_pgs_locked(self) -> None:
+        """Re-attempt every pending PG. Called when a node registers: the
+        _retry_pg polling thread gives up after pg_retry_timeout_s, but a
+        cloud TPU slice can take minutes to boot — registration must be
+        able to place gangs that outlived the poller."""
+        for pg in self.pgs.values():
+            if pg.state == "pending":
+                self._try_reserve_pg_locked(pg)
 
     def _retry_pg(self, pg: PlacementGroupState,
                   timeout: float | None = None):
@@ -2105,6 +2169,7 @@ class Runtime:
         node = NodeInfo(NodeID.from_random(), resources, labels, name)
         with self.lock:
             self.nodes[node.node_id] = node
+            self._retry_pending_pgs_locked()
             self._schedule_locked()
         self.pubsub.publish("nodes", {"node_id": node.node_id.hex(),
                                       "event": "added", "name": node.name})
@@ -2564,9 +2629,11 @@ class LocalModeRuntime:
     def timeline(self):
         return []
 
-    def create_placement_group(self, bundles, strategy, name=""):
+    def create_placement_group(self, bundles, strategy, name="",
+                               same_label=None, bundle_selectors=None):
         pg = PlacementGroupState(PlacementGroupID.from_random(), bundles,
-                                 strategy, name)
+                                 strategy, name, same_label=same_label,
+                                 bundle_selectors=bundle_selectors)
         pg.state = "created"
         pg.ready_event.set()
         return pg

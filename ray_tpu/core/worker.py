@@ -335,10 +335,12 @@ class WorkerRuntime:
     def get_actor_by_name(self, name):
         return self._rpc("get_actor_by_name", name)
 
-    def create_placement_group(self, bundles, strategy, name=""):
+    def create_placement_group(self, bundles, strategy, name="",
+                               same_label=None, bundle_selectors=None):
         from ..util.placement_group import PlacementGroup
         pg_id, specs = self._rpc("create_placement_group_rpc",
-                                 bundles, strategy, name)
+                                 bundles, strategy, name,
+                                 same_label, bundle_selectors)
         return PlacementGroup(pg_id, specs)
 
     def remove_placement_group(self, pg_id):

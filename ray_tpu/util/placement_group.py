@@ -90,7 +90,20 @@ class PlacementGroup:
 def placement_group(bundles: list[dict[str, float]],
                     strategy: str = "PACK",
                     name: str = "",
-                    lifetime: Optional[str] = None) -> PlacementGroup:
+                    lifetime: Optional[str] = None,
+                    same_label: Optional[str] = None,
+                    bundle_label_selectors:
+                        Optional[list[Optional[dict]]] = None,
+                    ) -> PlacementGroup:
+    """Gang-reserve `bundles` of resources.
+
+    `same_label`: a node-label key — all bundles must land on nodes that
+    share ONE value of it (e.g. ``util.tpu.SLICE_LABEL`` to keep a gang
+    inside one TPU slice / ICI domain). `bundle_label_selectors[i]` further
+    restricts bundle i to nodes whose labels contain every given key=value.
+    Reference analog: the TPU-{pod}-head resource encoding
+    (_private/accelerators/tpu.py:110) and bundle label selectors.
+    """
     if strategy not in VALID_STRATEGIES:
         raise ValueError(f"strategy must be one of {VALID_STRATEGIES}")
     if not bundles:
@@ -98,9 +111,14 @@ def placement_group(bundles: list[dict[str, float]],
     for b in bundles:
         if not b or any(v < 0 for v in b.values()):
             raise ValueError(f"invalid bundle {b}")
+    if bundle_label_selectors is not None \
+            and len(bundle_label_selectors) != len(bundles):
+        raise ValueError("bundle_label_selectors must have one entry "
+                         "(dict or None) per bundle")
     rt = _runtime()
     result = rt.create_placement_group(
-        [dict(b) for b in bundles], strategy, name)
+        [dict(b) for b in bundles], strategy, name,
+        same_label=same_label, bundle_selectors=bundle_label_selectors)
     if isinstance(result, PlacementGroup):  # worker: head rpc wraps already
         return result
     # driver / local mode: direct call returns the internal state

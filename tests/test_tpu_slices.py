@@ -1,0 +1,316 @@
+"""Slice-aware gang scheduling + TPU-VM provisioning.
+
+Reference parity: TPUAcceleratorManager pod-resource encoding
+(_private/accelerators/tpu.py:110) as `same_label` placement-group
+constraints, the GCP TPU provider (autoscaler/_private/gcp/node_provider.py
++ tpu_command_runner.py) as GceTpuVmProvider, and fake_multi_node's
+real-agent provider as slice-capable FakeNodeProvider.
+"""
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (Autoscaler, FakeNodeProvider,
+                                GceTpuVmProvider, NodeTypeConfig)
+from ray_tpu.util.placement_group import placement_group, placement_group_table
+from ray_tpu.util.tpu import (GENERATION_LABEL, SLICE_LABEL,
+                              accelerator_generation, discover_tpu_labels,
+                              slice_placement_group)
+
+
+@pytest.fixture
+def head():
+    ray_tpu.init(num_cpus=1)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def _wait_agents(ray, n, timeout=90):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        agents = [r for r in ray.nodes()
+                  if r["Alive"] and r["NodeName"] != "head"]
+        if len(agents) >= n:
+            return agents
+        time.sleep(0.25)
+    raise TimeoutError(f"only {len(agents)}/{n} agents joined")
+
+
+def _labels_by_node(ray):
+    return {r["NodeID"]: r["Labels"] for r in ray.nodes() if r["Alive"]}
+
+
+class TestDiscovery:
+    def test_env_labels(self):
+        labels = discover_tpu_labels({
+            "TPU_NAME": "pod-7", "TPU_WORKER_ID": "3",
+            "TPU_ACCELERATOR_TYPE": "v5litepod-16"})
+        assert labels[SLICE_LABEL] == "pod-7"
+        assert labels["rtpu.tpu.worker_id"] == "3"
+        assert labels[GENERATION_LABEL] == "v5e"
+        assert labels["rtpu.tpu.topology"] == "v5litepod-16"
+        assert discover_tpu_labels({}) == {}
+
+    def test_generation_table(self):
+        assert accelerator_generation("v5litepod-16") == "v5e"
+        assert accelerator_generation("v4-8") == "v4"
+        assert accelerator_generation("v6e-64") == "v6e"
+
+    def test_slice_chip_and_host_counts(self):
+        from ray_tpu.util.tpu import slice_chips, slice_hosts
+        # v4/v5p suffixes count TensorCores (2/chip); v5e/v6e count chips
+        assert slice_chips("v4-8") == 4
+        assert slice_chips("v5p-16") == 8
+        assert slice_chips("v5litepod-8") == 8
+        assert slice_chips("v6e-16") == 16
+        assert slice_hosts("v4-8") == 1       # single-host slice
+        assert slice_hosts("v5litepod-16") == 4
+        assert slice_hosts("v5p-16", chips_per_host=4) == 2
+
+
+class TestSliceScheduling:
+    def test_gang_lands_on_one_slice(self, head):
+        """Two 2-host fake slices; a 2-bundle same-label gang must not
+        straddle them even though plain STRICT_SPREAD would."""
+        provider = FakeNodeProvider()
+        try:
+            provider.create_slice("podA", {"CPU": 1, "TPU": 4}, hosts=2)
+            provider.create_slice("podB", {"CPU": 1, "TPU": 4}, hosts=2)
+            _wait_agents(head, 4)
+
+            pg = slice_placement_group(num_hosts=2, chips_per_host=4)
+            assert pg.wait(timeout_seconds=60), "slice gang never placed"
+            table = placement_group_table()[pg.id.hex()]
+            nodes = list(table["bundle_nodes"].values())
+            assert len(set(nodes)) == 2          # STRICT_SPREAD: 2 hosts
+            labels = _labels_by_node(head)
+            slices = {labels[n][SLICE_LABEL] for n in nodes}
+            assert len(slices) == 1, f"gang straddles slices {slices}"
+        finally:
+            provider.shutdown()
+
+    def test_gang_bigger_than_any_slice_stays_pending(self, head):
+        """3 same-slice bundles can't fit 2-host slices — even though the
+        hosts exist cross-slice (a plain SPREAD pg of the same shape
+        places)."""
+        provider = FakeNodeProvider()
+        try:
+            provider.create_slice("podA", {"CPU": 1, "TPU": 4}, hosts=2)
+            provider.create_slice("podB", {"CPU": 1, "TPU": 4}, hosts=2)
+            _wait_agents(head, 4)
+
+            plain = placement_group([{"TPU": 4}] * 3,
+                                    strategy="STRICT_SPREAD")
+            assert plain.wait(timeout_seconds=60)
+
+            gang = slice_placement_group(num_hosts=3, chips_per_host=4)
+            assert not gang.wait(timeout_seconds=2)
+            from ray_tpu.util.placement_group import remove_placement_group
+            remove_placement_group(gang)
+        finally:
+            provider.shutdown()
+
+    def test_bundle_label_selectors(self, head):
+        """Selectors pin bundles to nodes with matching labels."""
+        provider = FakeNodeProvider()
+        try:
+            provider.create_node("gen5", {"CPU": 1, "TPU": 4},
+                                 labels={GENERATION_LABEL: "v5e",
+                                         SLICE_LABEL: "s5"})
+            provider.create_node("gen6", {"CPU": 1, "TPU": 4},
+                                 labels={GENERATION_LABEL: "v6e",
+                                         SLICE_LABEL: "s6"})
+            _wait_agents(head, 2)
+
+            pg = placement_group(
+                [{"TPU": 4}], strategy="PACK",
+                bundle_label_selectors=[{GENERATION_LABEL: "v6e"}])
+            assert pg.wait(timeout_seconds=60)
+            table = placement_group_table()[pg.id.hex()]
+            nid = table["bundle_nodes"][0]
+            assert _labels_by_node(head)[nid][GENERATION_LABEL] == "v6e"
+        finally:
+            provider.shutdown()
+
+    def test_selector_validation(self, head):
+        with pytest.raises(ValueError, match="one entry"):
+            placement_group([{"CPU": 1}, {"CPU": 1}],
+                            bundle_label_selectors=[{"a": "b"}])
+
+
+class TestLateSliceBoot:
+    def test_gang_places_after_retry_poller_expires(self):
+        """A slice that boots slower than pg_retry_timeout_s must still
+        receive its gang: node registration re-attempts pending PGs."""
+        from ray_tpu.core.config import cfg
+        cfg.override(pg_retry_timeout_s=0.5)
+        ray_tpu.init(num_cpus=1)
+        provider = FakeNodeProvider()
+        try:
+            pg = slice_placement_group(num_hosts=2, chips_per_host=4)
+            assert not pg.wait(timeout_seconds=1.5)   # poller now expired
+            provider.create_slice("late", {"CPU": 1, "TPU": 4}, hosts=2)
+            assert pg.wait(timeout_seconds=90), \
+                "gang not placed by registration retry"
+        finally:
+            cfg.reset("pg_retry_timeout_s")
+            provider.shutdown()
+            ray_tpu.shutdown()
+
+
+class TestSliceAutoscaling:
+    def test_pack_gang_plans_by_binpacking(self, head):
+        """8x{TPU:1} PACK-style same-slice bundles fit a 2-host x 4-chip
+        slice type by packing 4 bundles per host — the planner must not
+        require one-bundle-per-host."""
+        pg = placement_group([{"TPU": 1}] * 8, strategy="PACK",
+                             same_label=SLICE_LABEL)
+        time.sleep(0.2)
+        asc = Autoscaler(
+            [NodeTypeConfig("v5e-8", {"CPU": 1, "TPU": 4}, max_workers=2,
+                            hosts=2)],
+            provider=FakeNodeProvider())
+        to_launch, _ = asc.plan()
+        assert to_launch == {"v5e-8": 1}, to_launch
+        from ray_tpu.util.placement_group import remove_placement_group
+        remove_placement_group(pg)
+
+    def test_autoscaler_launches_whole_slice_for_gang(self, head):
+        """A pending slice gang makes the autoscaler launch ONE multi-host
+        slice instance (not loose nodes), and the gang then places on it."""
+        asc = Autoscaler(
+            [NodeTypeConfig("v5e-8", {"CPU": 1, "TPU": 4}, max_workers=2,
+                            hosts=2, labels={GENERATION_LABEL: "v5e"})],
+            provider=FakeNodeProvider(),
+            idle_timeout_s=120.0, period_s=0.5).start()
+        try:
+            pg = slice_placement_group(num_hosts=2, chips_per_host=4,
+                                       generation="v5e")
+            assert pg.wait(timeout_seconds=120), "gang never placed"
+            launches = [e for e in asc.events if e["event"] == "launch"]
+            assert len(launches) == 1, launches   # ONE slice, not 2 nodes
+            assert launches[0]["hosts"] == 2
+            table = placement_group_table()[pg.id.hex()]
+            nodes = list(table["bundle_nodes"].values())
+            labels = _labels_by_node(head)
+            assert len({labels[n][SLICE_LABEL] for n in nodes}) == 1
+            assert all(labels[n][GENERATION_LABEL] == "v5e" for n in nodes)
+        finally:
+            asc.stop()
+
+
+class _FakeRun:
+    def __init__(self, log):
+        self.log = log
+
+    def __call__(self, cmd, **kw):
+        self.log.append(cmd)
+        import types
+        return types.SimpleNamespace(returncode=0, stdout="", stderr="")
+
+
+class TestGceTpuVmProvider:
+    def test_create_slice_commands(self):
+        log = []
+        p = GceTpuVmProvider(
+            project="proj", zone="us-central2-b",
+            head_address="10.0.0.2:7777", authkey_hex="ab12",
+            accelerator_type="v5litepod-16", chips_per_host=4,
+            runner=_FakeRun(log))
+        assert p.hosts_per_slice == 4     # 16 chips / 4 per host
+        iid = p.create_slice("v5e-16", {"CPU": 8, "TPU": 4}, hosts=4)
+        assert iid == "rtpu-v5e-16-1"
+        create, ssh = log
+        assert create[:6] == ["gcloud", "compute", "tpus", "tpu-vm",
+                              "create", iid]
+        assert "--accelerator-type" in create \
+            and "v5litepod-16" in create
+        assert "--project" in create and "proj" in create
+        assert ssh[4] == "ssh" and ssh[5] == iid
+        assert "--worker=all" in ssh
+        cmd = ssh[ssh.index("--command") + 1]
+        assert "ray_tpu.core.node_agent" in cmd
+        assert "--head 10.0.0.2:7777" in cmd
+        assert "--authkey ab12" in cmd
+        assert "--own-store" in cmd
+        assert SLICE_LABEL in cmd and iid in cmd
+        assert p.non_terminated_nodes() == [iid]
+
+    def test_terminate(self):
+        log = []
+        p = GceTpuVmProvider(
+            project="proj", zone="z", head_address="h:1",
+            authkey_hex="00", accelerator_type="v5litepod-8",
+            runner=_FakeRun(log))
+        iid = p.create_slice("t", {"CPU": 1}, hosts=2)
+        p.terminate_node(iid)
+        assert log[-1][:6] == ["gcloud", "compute", "tpus", "tpu-vm",
+                               "delete", iid]
+        assert "--quiet" in log[-1]
+        assert p.non_terminated_nodes() == []
+
+    def test_oversize_slice_rejected(self):
+        p = GceTpuVmProvider(
+            project="p", zone="z", head_address="h:1", authkey_hex="00",
+            accelerator_type="v5litepod-8", runner=_FakeRun([]))
+        with pytest.raises(ValueError, match="hosts"):
+            p.create_slice("t", {"CPU": 1}, hosts=5)
+
+    def test_v4_hosts_derivation(self):
+        # v4-8 = 4 chips = ONE host; the TensorCore suffix must not
+        # double the host count (that would wedge node_id_of forever)
+        p = GceTpuVmProvider(
+            project="p", zone="z", head_address="h:1", authkey_hex="00",
+            accelerator_type="v4-8", runner=_FakeRun([]))
+        assert p.hosts_per_slice == 1
+
+    def test_failed_terminate_keeps_instance_tracked(self):
+        log = []
+        calls = {"n": 0}
+
+        def flaky(cmd, **kw):
+            import types
+            log.append(cmd)
+            if cmd[4] == "delete":
+                calls["n"] += 1
+                if calls["n"] == 1:
+                    return types.SimpleNamespace(returncode=1, stdout="",
+                                                 stderr="quota")
+            return types.SimpleNamespace(returncode=0, stdout="",
+                                         stderr="")
+        p = GceTpuVmProvider(
+            project="p", zone="z", head_address="h:1", authkey_hex="00",
+            accelerator_type="v5litepod-8", runner=flaky)
+        iid = p.create_slice("t", {"CPU": 1}, hosts=2)
+        with pytest.raises(RuntimeError):
+            p.terminate_node(iid)
+        # still tracked -> a retried terminate can find it (no leak)
+        assert p.non_terminated_nodes() == [iid]
+        p.terminate_node(iid)
+        assert p.non_terminated_nodes() == []
+
+    def test_failed_bootstrap_keeps_instance_tracked(self):
+        def ssh_fails(cmd, **kw):
+            import types
+            rc = 1 if cmd[4] == "ssh" else 0
+            return types.SimpleNamespace(returncode=rc, stdout="",
+                                         stderr="ssh down")
+        p = GceTpuVmProvider(
+            project="p", zone="z", head_address="h:1", authkey_hex="00",
+            accelerator_type="v5litepod-8", runner=ssh_fails)
+        with pytest.raises(RuntimeError):
+            p.create_slice("t", {"CPU": 1}, hosts=2)
+        # the slice WAS created before ssh failed; it must stay visible
+        assert len(p.non_terminated_nodes()) == 1
+
+    def test_failed_gcloud_raises(self):
+        def bad(cmd, **kw):
+            import types
+            return types.SimpleNamespace(returncode=1, stdout="",
+                                         stderr="boom")
+        p = GceTpuVmProvider(
+            project="p", zone="z", head_address="h:1", authkey_hex="00",
+            accelerator_type="v5litepod-8", runner=bad)
+        with pytest.raises(RuntimeError, match="boom"):
+            p.create_slice("t", {"CPU": 1}, hosts=1)
