@@ -86,6 +86,19 @@ class Config:
                         f"{type(val).__name__}")
                 self._overrides[name] = f.type(val)
 
+    def overrides_for_env(self) -> dict[str, str]:
+        """Current programmatic overrides as {RTPU_* env name: str value},
+        for shipping driver-side cfg.override()s to spawned workers."""
+        with self._lock:
+            out = {}
+            for name, val in self._overrides.items():
+                f = self._flags[name]
+                if f.type is bool:
+                    out[f.env] = "1" if val else "0"
+                else:
+                    out[f.env] = str(val)
+            return out
+
     def reset(self, *names: str) -> None:
         """Drop overrides/cache (all flags when called with no names)."""
         with self._lock:
@@ -117,6 +130,18 @@ _FLAGS = [
          "store fill fraction above which sealed objects spill to disk"),
     Flag("min_spilling_size", 1 << 20,
          "don't spill objects smaller than this (bytes)"),
+    Flag("collective_inline_bytes", 64 << 10,
+         "collective payloads up to this size ride inside the rendezvous "
+         "actor message (one round trip); larger ones move store-to-store "
+         "as ObjectRefs so bulk bytes never funnel through one process"),
+    Flag("zero_copy_get", False,
+         "deserialize large buffers as read-only views pinned into the shm "
+         "store (released when the arrays are GC'd) instead of copying "
+         "them out — plasma's semantics; arrays come back non-writable"),
+    Flag("store_prefault", False,
+         "fault in the whole store mapping at create (one-time cost "
+         "~0.4s/GiB) so big puts run at warm-memcpy speed; production "
+         "long-lived clusters want this on"),
     Flag("memory_monitor_refresh_ms", 250,
          "memory-monitor poll interval; 0 disables the monitor"),
     Flag("memory_usage_threshold", 0.95,

@@ -42,6 +42,7 @@
 #include <climits>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <ctime>
 #include <fcntl.h>
@@ -54,13 +55,14 @@
 
 namespace {
 
-constexpr uint64_t kMagic = 0x7452617954505530ULL;  // "tRayTPU0"
+constexpr uint64_t kMagic = 0x7452617954505531ULL;  // "tRayTPU1"
 constexpr uint32_t kIdSize = 16;
 
 enum ObjState : int32_t {
-  kFree = 0,      // entry slot unused
+  kFree = 0,      // entry slot never used (probe chains END here)
   kCreated = 1,   // allocated, writer filling
   kSealed = 2,    // immutable, readable
+  kTomb = 3,      // deleted; keeps probe chains intact (swept by rehash)
 };
 
 // Per-pid pin bookkeeping so pins leaked by a SIGKILLed process can be
@@ -106,6 +108,7 @@ struct Header {
   uint64_t bytes_in_use;
   uint64_t num_objects;
   uint64_t evictions;       // stat: count of evicted objects
+  uint64_t num_tombs;       // tombstoned entry slots awaiting rehash
   // ObjEntry table follows, then heap.
 };
 
@@ -175,29 +178,75 @@ void unpin(ObjEntry* e, int32_t pid) {
 }
 
 ObjEntry* find(Handle* h, const uint8_t* id) {
-  // Linear-probed open addressing over the entry table, hashed by id prefix.
+  // Linear-probed open addressing over the entry table, hashed by id
+  // prefix. Deleted slots become kTomb (NOT kFree) so probe chains stay
+  // intact and an absent-key lookup stops at the first never-used slot
+  // instead of scanning all max_entries — absent lookups are the common
+  // case (every os_create probes its fresh random id) and a full 64k-slot
+  // scan cost ~0.4 ms per create before tombstones.
   Header* hdr = h->hdr;
   uint64_t hash;
   memcpy(&hash, id, 8);
   uint32_t n = hdr->max_entries;
   for (uint32_t i = 0; i < n; i++) {
     ObjEntry* e = &h->entries[(hash + i) % n];
-    if (e->state != kFree && memcmp(e->id, id, kIdSize) == 0) return e;
+    if (e->state == kFree) return nullptr;
+    if (e->state != kTomb && memcmp(e->id, id, kIdSize) == 0) return e;
   }
   return nullptr;
 }
 
 ObjEntry* find_slot(Handle* h, const uint8_t* id) {
+  // Insertion slot: first tombstone on the probe path if any (reuse keeps
+  // chains short), else the terminating free slot; nullptr if the id
+  // already exists or the table is full of live entries.
   Header* hdr = h->hdr;
   uint64_t hash;
   memcpy(&hash, id, 8);
   uint32_t n = hdr->max_entries;
+  ObjEntry* tomb = nullptr;
   for (uint32_t i = 0; i < n; i++) {
     ObjEntry* e = &h->entries[(hash + i) % n];
-    if (e->state == kFree) return e;
+    if (e->state == kFree) return tomb ? tomb : e;
+    if (e->state == kTomb) {
+      if (!tomb) tomb = e;
+      continue;
+    }
     if (memcmp(e->id, id, kIdSize) == 0) return nullptr;  // exists
   }
-  return nullptr;  // table full
+  return tomb;  // table has no never-used slots left
+}
+
+// Tombstone a live entry slot (caller already dealloc'd its payload).
+inline void tombstone(Header* hdr, ObjEntry* e) {
+  e->state = kTomb;
+  hdr->num_objects--;
+  hdr->num_tombs++;
+}
+
+// Sweep tombstones by rebuilding the table once they pile up (they
+// lengthen every probe chain). O(max_entries) but amortized across the
+// >= n/4 deletions that accumulated them. Caller holds the lock and must
+// not use ObjEntry pointers obtained before the call.
+void maybe_rehash(Handle* h) {
+  Header* hdr = h->hdr;
+  uint32_t n = hdr->max_entries;
+  if (hdr->num_tombs < 64 || hdr->num_tombs < n / 4) return;
+  ObjEntry* scratch =
+      (ObjEntry*)malloc((size_t)hdr->num_objects * sizeof(ObjEntry));
+  if (!scratch && hdr->num_objects > 0) return;  // slow beats failing
+  uint64_t live = 0;
+  for (uint32_t i = 0; i < n; i++) {
+    ObjEntry* e = &h->entries[i];
+    if (e->state == kCreated || e->state == kSealed) scratch[live++] = *e;
+  }
+  memset(h->entries, 0, (size_t)n * sizeof(ObjEntry));
+  hdr->num_tombs = 0;
+  for (uint64_t j = 0; j < live; j++) {
+    ObjEntry* slot = find_slot(h, scratch[j].id);
+    *slot = scratch[j];  // table was just cleared: slot is never null
+  }
+  free(scratch);
 }
 
 // First-fit allocation from the free list. Each allocated block carries an
@@ -305,8 +354,7 @@ bool evict_lru(Handle* h, uint64_t need) {
     }
     if (!victim) return any;
     dealloc(h, victim->offset);
-    victim->state = kFree;
-    hdr->num_objects--;
+    tombstone(hdr, victim);
     hdr->evictions++;
     any = true;
   }
@@ -392,6 +440,7 @@ uint64_t os_create(void* hv, const uint8_t* id, uint64_t size) {
   if (!off) { unlock(h); return 0; }
   ObjEntry* e = find_slot(h, id);
   if (!e) { dealloc(h, off); unlock(h); return 0; }
+  if (e->state == kTomb) h->hdr->num_tombs--;
   memcpy(e->id, id, kIdSize);
   e->offset = off;
   e->size = size;
@@ -402,6 +451,9 @@ uint64_t os_create(void* hv, const uint8_t* id, uint64_t size) {
   memset(e->pins, 0, sizeof(e->pins));
   e->state = kCreated;
   h->hdr->num_objects++;
+  // churn workloads (eviction-heavy, no explicit deletes) accumulate
+  // tombstones here; sweep before they degrade probes
+  maybe_rehash(h);
   unlock(h);
   return off;
 }
@@ -485,8 +537,7 @@ int os_reclaim_pid(void* hv, int32_t pid) {
     ObjEntry* e = &h->entries[i];
     if (e->state == kCreated && e->creator_pid == pid) {
       dealloc(h, e->offset);
-      e->state = kFree;
-      hdr->num_objects--;
+      tombstone(hdr, e);
       touched++;
       continue;
     }
@@ -502,6 +553,7 @@ int os_reclaim_pid(void* hv, int32_t pid) {
       }
     }
   }
+  maybe_rehash(h);
   // a worker that died mid-create will never seal: wake blocked getters so
   // their timeouts can fire against a now-consistent table
   bump_seal_seq(h);
@@ -519,8 +571,8 @@ int os_delete(void* hv, const uint8_t* id) {
   if (!e) { unlock(h); return -1; }
   if (e->refcnt <= (e->state == kCreated ? 1 : 0)) {
     dealloc(h, e->offset);
-    e->state = kFree;
-    h->hdr->num_objects--;
+    tombstone(h->hdr, e);
+    maybe_rehash(h);
     // keep the documented contract: every removal wakes waiters so a
     // delete-then-recreate (error overwrite) never strands a blocked get
     bump_seal_seq(h);

@@ -13,9 +13,14 @@ checkpoints, datasets blocks). A puller asks the head for locations
 data server, and writes the received frame into its LOCAL store — after
 which the object is served locally and the head records the new copy.
 
-Wire protocol (per request, connections are reused):
-  -> 16B object id
-  <- 8B little-endian frame length (0 = not here) + frame bytes
+Wire protocol (per request, connections are reused; 1-byte verb first):
+  G (get):  -> 'G' + 16B object id
+            <- 8B little-endian frame length (0 = not here) + frame bytes
+  P (push): -> 'P' + 16B object id + 8B frame length + frame bytes
+            <- 1B status (1 = stored/already-present, 0 = failed)
+Push is how producers place data INTO a peer store without a directory
+round-trip — compiled-DAG channels and bulk broadcast use it (reference
+Push: object_manager.h:209).
 """
 from __future__ import annotations
 
@@ -58,27 +63,17 @@ class ObjectDataServer:
         try:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             while True:
-                oid_bytes = _recv_exact(conn, ObjectID.SIZE)
-                if oid_bytes is None:
+                verb = _recv_exact(conn, 1)
+                if verb is None:
                     return
-                oid = ObjectID(oid_bytes)
-                view = None
-                try:
-                    view = self.store.get_raw(oid, timeout_ms=0)
-                    if view is not None:
-                        conn.sendall(struct.pack("<Q", len(view)))
-                        conn.sendall(view)
-                    elif self.spill is not None and self.spill.contains(oid):
-                        with open(self.spill._path(oid), "rb") as f:
-                            data = f.read()
-                        conn.sendall(struct.pack("<Q", len(data)))
-                        conn.sendall(data)
-                    else:
-                        conn.sendall(struct.pack("<Q", 0))
-                finally:
-                    if view is not None:
-                        del view
-                        self.store.release(oid)
+                if verb == b"G":
+                    if not self._serve_get(conn):
+                        return
+                elif verb == b"P":
+                    if not self._serve_push(conn):
+                        return
+                else:
+                    return  # unknown verb: drop the connection
         except OSError:
             pass
         finally:
@@ -86,6 +81,61 @@ class ObjectDataServer:
                 conn.close()
             except OSError:
                 pass
+
+    def _serve_get(self, conn: socket.socket) -> bool:
+        oid_bytes = _recv_exact(conn, ObjectID.SIZE)
+        if oid_bytes is None:
+            return False
+        oid = ObjectID(oid_bytes)
+        view = None
+        try:
+            view = self.store.get_raw(oid, timeout_ms=0)
+            if view is not None:
+                conn.sendall(struct.pack("<Q", len(view)))
+                conn.sendall(view)
+            elif self.spill is not None and self.spill.contains(oid):
+                with open(self.spill._path(oid), "rb") as f:
+                    data = f.read()
+                conn.sendall(struct.pack("<Q", len(data)))
+                conn.sendall(data)
+            else:
+                conn.sendall(struct.pack("<Q", 0))
+        finally:
+            if view is not None:
+                del view
+                self.store.release(oid)
+        return True
+
+    def _serve_push(self, conn: socket.socket) -> bool:
+        from .object_store import ObjectStoreFullError
+        hdr = _recv_exact(conn, ObjectID.SIZE + 8)
+        if hdr is None:
+            return False
+        oid = ObjectID(hdr[:ObjectID.SIZE])
+        (length,) = struct.unpack("<Q", hdr[ObjectID.SIZE:])
+        # Pushed objects must land in the SHM store (consumers poll it
+        # directly — a spill-file "delivery" would be invisible to them),
+        # so there is no spill fallback here: full store = status 0.
+        # _drain is only legal before any payload byte was consumed; late
+        # failures (seal) drop the connection instead.
+        try:
+            buf = self.store.create_raw(oid, length)
+        except FileExistsError:
+            _drain(conn, length)
+            conn.sendall(b"\x01")   # already present: push is idempotent
+            return True
+        except ObjectStoreFullError:
+            _drain(conn, length)
+            conn.sendall(b"\x00")
+            return True
+        ok = _recv_into_exact(conn, buf)
+        del buf
+        if not ok:
+            self.store.delete(oid)
+            return False
+        self.store.seal(oid)
+        conn.sendall(b"\x01")
+        return True
 
     def stop(self):
         self._stop = True
@@ -137,7 +187,7 @@ def fetch_object(addr: str, oid: ObjectID, local_store: SharedObjectStore,
                                             timeout=timeout_s)
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         conn.settimeout(timeout_s)
-        conn.sendall(oid.binary())
+        conn.sendall(b"G" + oid.binary())
         hdr = _recv_exact(conn, 8)
         if hdr is None:
             raise OSError("peer closed during fetch")
@@ -155,6 +205,43 @@ def fetch_object(addr: str, oid: ObjectID, local_store: SharedObjectStore,
                 _conn_pool[addr] = conn
                 conn = None
         return result
+    finally:
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+def push_object(addr: str, oid: ObjectID, value=None, frame=None,
+                is_exception: bool = False, timeout_s: float = 30.0) -> bool:
+    """Push a value (or pre-built _FramedValue) INTO the store behind
+    `addr` (reference Push, object_manager.h:209). Returns True when the
+    peer stored it (or already had it)."""
+    from .object_store import _FramedValue
+    if frame is None:
+        frame = _FramedValue(value, is_exception)
+    with _pool_lock:
+        conn = _conn_pool.pop(addr, None)
+    try:
+        if conn is None:
+            host, port = addr.rsplit(":", 1)
+            conn = socket.create_connection((host, int(port)),
+                                            timeout=timeout_s)
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        conn.settimeout(timeout_s)
+        conn.sendall(b"P" + oid.binary() + struct.pack("<Q", frame.total))
+        # stream the frame piecewise: no second full-size buffer
+        for piece in frame.iter_wire():
+            conn.sendall(piece)
+        status = _recv_exact(conn, 1)
+        if status is None:
+            raise OSError("peer closed during push")
+        with _pool_lock:
+            if addr not in _conn_pool:
+                _conn_pool[addr] = conn
+                conn = None
+        return status == b"\x01"
     finally:
         if conn is not None:
             try:

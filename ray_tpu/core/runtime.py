@@ -149,6 +149,15 @@ def build_worker_env(*, store_path: str, head_addr: str, head_family: str,
         paths.insert(0, boot)
         env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = os.pathsep.join(paths)
+    # programmatic cfg.override()s made in the driver ship as RTPU_* env
+    # to workers SPAWNED AFTER the override (the reference ships RAY_*
+    # system config the same way). Already-running workers keep their
+    # values — protocols that cross processes must compose with mixed
+    # settings (e.g. collective payloads declare inline vs store-backed
+    # per contribution)
+    from .config import cfg as _cfg
+    for name, val in _cfg.overrides_for_env().items():
+        env[name] = val
     env["RTPU_STORE_PATH"] = store_path
     if spill_dir:
         env["RTPU_SPILL_DIR"] = spill_dir
@@ -288,6 +297,53 @@ class PlacementGroupState:
         self.ready_event = threading.Event()
 
 
+def _placement_key(spec) -> tuple:
+    """Everything node selection + worker acquisition depend on. Two specs
+    with equal keys place identically against identical cluster state."""
+    from .runtime_env import env_hash
+    return (tuple(sorted(spec.resources.items())), spec.pg_id,
+            spec.pg_bundle_index, spec.node_affinity,
+            spec.node_affinity_soft, spec.scheduling_strategy,
+            env_hash(spec.runtime_env))
+
+
+class _PendingQueues:
+    """Pending tasks bucketed by placement signature (reference analog:
+    the cluster task manager's per-shape dispatch queues,
+    cluster_task_manager.h:72). A scheduling pass probes one head per
+    bucket instead of rescanning every pending task, so a burst of N
+    same-shape submissions costs O(N) total scheduling work, not O(N^2).
+    Iteration order is bucket insertion order (FIFO within a bucket)."""
+
+    __slots__ = ("buckets",)
+
+    def __init__(self):
+        self.buckets: dict[tuple, deque] = {}
+
+    def append(self, spec) -> None:
+        self.buckets.setdefault(_placement_key(spec),
+                                deque()).append(spec)
+
+    def remove(self, spec) -> None:
+        key = _placement_key(spec)
+        dq = self.buckets.get(key)
+        if dq is None:
+            raise ValueError(f"{spec!r} not pending")
+        dq.remove(spec)  # raises ValueError if absent, like deque
+        if not dq:
+            del self.buckets[key]
+
+    def __len__(self) -> int:
+        return sum(len(dq) for dq in self.buckets.values())
+
+    def __bool__(self) -> bool:
+        return any(self.buckets.values())
+
+    def __iter__(self):
+        for dq in list(self.buckets.values()):
+            yield from list(dq)
+
+
 class Runtime:
     """The head runtime. Exactly one per driver process."""
 
@@ -334,7 +390,8 @@ class Runtime:
         self.actors: dict[ActorID, ActorInfo] = {}
         self.named_actors: dict[str, ActorID] = {}
         self.pgs: dict[PlacementGroupID, PlacementGroupState] = {}
-        self.pending: deque[TaskSpec] = deque()
+        self.pending = _PendingQueues()
+        self._sweeping_failed_deps = False
         self._abandoned_rpcs: set[ObjectID] = set()
         # timeline events, bounded so a long-lived driver doesn't grow
         # without limit
@@ -1340,6 +1397,7 @@ class Runtime:
                 f"object {oid} was evicted and has no lineage "
                 "(ray_tpu.put objects are not reconstructable)"))
             e.state = FAILED
+            self._sweep_failed_deps_locked()
             return
         e.state = PENDING
         spec = e.lineage
@@ -1410,6 +1468,11 @@ class Runtime:
             self.interest.setdefault(d, set()).add(holder)
         if spec.is_actor_task:
             self._route_actor_task_locked(spec)
+        elif spec.dep_oids and self._deps_state_locked(spec) == "failed":
+            # dep already failed at submit: fail fast — a blocked bucket
+            # head would otherwise hide this task from the next pass
+            self._handle_failed_task_locked(
+                spec, self._collect_dep_error_locked(spec), retryable=False)
         else:
             self.pending.append(spec)
             self._schedule_locked()
@@ -1491,29 +1554,86 @@ class Runtime:
         return "ready"
 
     def _schedule_locked(self):
+        """Drain what's dispatchable. Per-shape bucket queues make this
+        O(buckets + dispatched + dep-waiters) per pass: once a bucket's
+        head can't place, the rest of that bucket can't either (identical
+        placement signature, and capacity only shrinks as the pass
+        dispatches), so the bucket is skipped whole. Dep-waiting tasks are
+        set aside per pass so a blocked head never hides a ready task
+        behind it."""
         if self._shutdown:
             return
-        still_pending: deque[TaskSpec] = deque()
-        while self.pending:
-            spec = self.pending.popleft()
-            deps = self._deps_state_locked(spec)
-            if deps == "failed":
-                err = self._collect_dep_error_locked(spec)
-                self._handle_failed_task_locked(spec, err, retryable=False)
+        for key in list(self.pending.buckets):
+            dq = self.pending.buckets.get(key)
+            if not dq:
                 continue
-            if deps == "wait":
-                still_pending.append(spec)
-                continue
-            node = self._pick_node_locked(spec)
-            if node is None:
-                still_pending.append(spec)
-                continue
-            w = self._acquire_worker_locked(node, spec)
-            if w is None:
-                still_pending.append(spec)
-                continue
-            self._dispatch_locked(w, spec)
-        self.pending = still_pending
+            dep_wait: list = []
+            while dq:
+                spec = dq.popleft()
+                deps = self._deps_state_locked(spec)
+                if deps == "failed":
+                    err = self._collect_dep_error_locked(spec)
+                    self._handle_failed_task_locked(spec, err,
+                                                    retryable=False)
+                    continue
+                if deps == "wait":
+                    dep_wait.append(spec)
+                    continue
+                node = self._pick_node_locked(spec)
+                w = None if node is None else \
+                    self._acquire_worker_locked(node, spec)
+                if w is None:
+                    # same signature ⇒ the rest of the bucket can't place
+                    # either this pass; stop (tasks behind the head are
+                    # NOT rescanned — failed-dependency propagation is
+                    # event-driven via _sweep_failed_deps_locked, so a
+                    # blocked head can't hide a doomed task)
+                    dq.appendleft(spec)
+                    break
+                self._dispatch_locked(w, spec)
+            # the failure sweep (run from _handle_failed_task_locked above)
+            # may have emptied-and-removed THIS bucket mid-pass: only touch
+            # the dict entry if it is still our deque, and re-route
+            # dep-waiters through append() otherwise so they land in a
+            # live bucket instead of an orphaned one
+            if dep_wait:
+                if self.pending.buckets.get(key) is dq:
+                    dq.extend(dep_wait)
+                else:
+                    for s in dep_wait:
+                        self.pending.append(s)
+            if not dq and self.pending.buckets.get(key) is dq:
+                del self.pending.buckets[key]
+
+    def _sweep_failed_deps_locked(self):
+        """Fail every pending task whose dependency just failed. Called on
+        failure EVENTS (object marked FAILED), not per scheduling pass —
+        keeping the hot path O(buckets) while failures still propagate
+        promptly past placement-blocked bucket heads. Iterates to a
+        fixpoint (a failed task's returns can doom further dependents);
+        the guard flattens the recursion through
+        _handle_failed_task_locked."""
+        if self._sweeping_failed_deps:
+            return
+        self._sweeping_failed_deps = True
+        try:
+            while True:
+                doomed = [
+                    spec for spec in self.pending
+                    if spec.dep_oids
+                    and self._deps_state_locked(spec) == "failed"]
+                if not doomed:
+                    return
+                for spec in doomed:
+                    try:
+                        self.pending.remove(spec)
+                    except ValueError:
+                        continue
+                    err = self._collect_dep_error_locked(spec)
+                    self._handle_failed_task_locked(spec, err,
+                                                    retryable=False)
+        finally:
+            self._sweeping_failed_deps = False
 
     def _acquire_worker_locked(self, node: NodeInfo, spec) -> Optional[WorkerInfo]:
         from .runtime_env import env_hash as _env_hash
@@ -1669,6 +1789,7 @@ class Runtime:
                 e.error_brief = repr(err)
             self._maybe_free_locked(oid)
         self._drop_task_dep_interest_locked(spec)
+        self._sweep_failed_deps_locked()   # cascade to pending dependents
         self.cv.notify_all()
 
     def _drop_task_dep_interest_locked(self, spec):
@@ -1747,6 +1868,7 @@ class Runtime:
                             e.error_brief = msg.get("err")
                         self._maybe_free_locked(oid)
                     self._drop_task_dep_interest_locked(spec)
+                    self._sweep_failed_deps_locked()
             self._schedule_locked()
             self.cv.notify_all()
 
@@ -1951,6 +2073,7 @@ class Runtime:
             e = self.directory.get(a.spec.ready_oid)
             if e is not None:
                 e.state = FAILED
+            self._sweep_failed_deps_locked()
         for spec in list(a.queue) + list(a.running.values()):
             self._handle_failed_task_locked(spec, err, retryable=False)
         a.queue.clear()
@@ -2243,6 +2366,7 @@ class Runtime:
                     f"object {oid} was spilled on a node that died and "
                     f"has no lineage to reconstruct from"))
                 e.state = FAILED
+                self._sweep_failed_deps_locked()
 
     def _fetch_remote(self, oid: ObjectID) -> bool:
         """Pull an object produced on an own-store node into the head's

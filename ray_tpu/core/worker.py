@@ -664,10 +664,50 @@ class WorkerLoop:
             elif t == "cancel":
                 self._cancel_current(msg["task_id"])
             elif t == "exit":
+                if _pre_exit_hook is not None:
+                    _pre_exit_hook()   # profiler dump (main() sets it)
                 os._exit(0)
 
 
+_pre_exit_hook = None
+
+
 def main():
+    prof_dir = os.environ.get("RTPU_WORKER_PROFILE_DIR")
+    if prof_dir:
+        # per-worker cProfile dumps (reference analog: worker profiling via
+        # py-spy in _private/profiling.py); enable with
+        # RTPU_WORKER_PROFILE_DIR=/some/dir before init. The exit message
+        # calls os._exit, so the dump runs via _pre_exit_hook.
+        import cProfile
+        import io
+        import pstats
+        pr = cProfile.Profile()
+
+        def dump():
+            pr.disable()
+            s = io.StringIO()
+            pstats.Stats(pr, stream=s).sort_stats(
+                "tottime").print_stats(25)
+            try:
+                with open(os.path.join(
+                        prof_dir, f"worker-{os.getpid()}.prof"), "w") as f:
+                    f.write(s.getvalue())
+            except OSError:
+                pass
+
+        global _pre_exit_hook
+        _pre_exit_hook = dump
+        pr.enable()
+        try:
+            main_inner()
+        finally:
+            dump()
+    else:
+        main_inner()
+
+
+def main_inner():
     loop = WorkerLoop()
     try:
         loop.run()

@@ -37,7 +37,10 @@ def _read_channel(store, oid: ObjectID, stop_oid: ObjectID,
     deadline = None if timeout_s is None else time.monotonic() + timeout_s
     while True:
         try:
-            val = store.get(oid, timeout_ms=100)
+            # zero_copy=False: a channel slot is deleted and RECREATED
+            # under the same id each ring pass; a zero-copy pin would make
+            # the delete lazy and the recreate collide or read stale data
+            val = store.get(oid, timeout_ms=100, zero_copy=False)
             store.delete(oid)
             return val
         except GetTimeoutError:
@@ -74,8 +77,21 @@ def _dag_actor_loop(instance, plan: list, stop_hex: str, max_inflight: int):
                     args.append(v)
             out = getattr(instance, step["method"])(*args)
             local[step["idx"]] = out
-            for base in step["out_chans"]:
-                store.put(_slot_oid(base, slot), out)
+            frame = None   # serialize once per value, reuse across targets
+            for base, addr in step["out_chans"]:
+                if addr is None:
+                    store.put(_slot_oid(base, slot), out)
+                else:
+                    # cross-store edge: push into the consumer's store
+                    from ..core.object_store import _FramedValue
+                    from ..core.object_transfer import push_object
+                    if frame is None:
+                        frame = _FramedValue(out, False)
+                    if not push_object(addr, _slot_oid(base, slot),
+                                       frame=frame):
+                        raise RuntimeError(
+                            f"DAG channel push to {addr} rejected "
+                            "(consumer store full?)")
         seq += 1
 
 
@@ -159,7 +175,9 @@ class CompiledDAG:
             for a in n.args:
                 if isinstance(a, InputNode):
                     base = chan_base(f"in->{idx}")
-                    self.input_chans.append(base)
+                    # (channel, consuming actor) — resolved to a push
+                    # target after placement is known
+                    self.input_chans.append((base, aid))
                     step["args"].append(("chan", base))
                 elif isinstance(a, ClassMethodNode):
                     src_idx = seen[id(a)]
@@ -167,28 +185,38 @@ class CompiledDAG:
                         step["args"].append(("local", src_idx))
                     else:
                         base = chan_base(f"{src_idx}->{idx}")
-                        # producer writes this channel
+                        # producer writes this channel toward consumer aid
                         for s in plans[node_actor[id(a)]]:
                             if s["idx"] == src_idx:
-                                s["out_chans"].append(base)
+                                s["out_chans"].append((base, aid))
                         step["args"].append(("chan", base))
                 else:
                     step["args"].append(("const", a))
             plans.setdefault(aid, []).append(step)
         # final node also writes the driver-facing output channel
+        # (consumer None = the driver/head store)
         out_aid = node_actor[id(self.output_node)]
         for s in plans[out_aid]:
             if s["idx"] == seen[id(self.output_node)]:
-                s["out_chans"].append(self.output_chan)
+                s["out_chans"].append((self.output_chan, None))
 
-        # channels are raw objects in the DRIVER's store: an actor on an
-        # own-store node polls a store that never sees them — refuse at
-        # compile time rather than hang at execute (cross-store channels =
-        # the transfer service + per-edge location routing, future work)
+        # ---- cross-store channel routing ------------------------------ #
+        # A consumer polls its node-LOCAL store, so the producer of every
+        # cross-store edge PUSHES the value into the consumer's store via
+        # the transfer service (reference: aDAG remote channels over RPC,
+        # local ones over shm — compiled_dag_node.py:808). Same-store
+        # edges stay plain store writes. Resolve placement by pinging each
+        # actor (forces scheduling), then mapping it to its node's data
+        # address (None = shares the driver's store).
         from ..core import runtime as rt_mod
         from ..core.ids import ActorID
+        actor_addr: dict[bytes, Optional[str]] = {a: None for a in plans}
+        head_addr: Optional[str] = None
         if isinstance(self._rt, rt_mod.Runtime):
+            ray_tpu.get([actors[aid]._exec(lambda inst: None)
+                         for aid in plans], timeout=120)
             with self._rt.lock:
+                head_addr = self._rt.head_node.data_addr
                 for aid in plans:
                     a = self._rt.actors.get(ActorID(aid))
                     w = (self._rt.workers.get(a.wid)
@@ -196,11 +224,31 @@ class CompiledDAG:
                     n = (self._rt.nodes.get(w.node_id)
                          if w is not None else None)
                     if n is not None and n.own_store:
-                        raise NotImplementedError(
-                            "compiled DAGs require all actors to share the "
-                            "driver's object store; actor "
-                            f"{a.spec.name!r} lives on own-store node "
-                            f"{n.name!r}")
+                        actor_addr[aid] = n.data_addr
+
+        def route(producer_addr: Optional[str],
+                  consumer_addr: Optional[str]) -> Optional[str]:
+            """Where the producer must place the value; None = its own
+            local store."""
+            target = consumer_addr if consumer_addr is not None else \
+                head_addr
+            own = producer_addr if producer_addr is not None else head_addr
+            return None if target == own else target
+
+        def consumer_addr(c) -> Optional[str]:
+            return actor_addr[c] if c is not None else None
+
+        for aid, plan in plans.items():
+            for step in plan:
+                step["out_chans"] = [
+                    (base, route(actor_addr[aid], consumer_addr(c)))
+                    for base, c in step["out_chans"]]
+        # driver-side channel targets (driver writes/reads the head store)
+        self.input_chans = [
+            (base, route(None, consumer_addr(c)))
+            for base, c in self.input_chans]
+        self._push_addrs = sorted({addr for addr in actor_addr.values()
+                                   if addr is not None})
 
         # ---- install loops -------------------------------------------- #
         self._loop_refs = []
@@ -218,8 +266,20 @@ class CompiledDAG:
             self._outstanding.pop(0).get()
         slot = self._seq % self.max_inflight
         self._seq += 1
-        for base in self.input_chans:
-            self.store.put(_slot_oid(base, slot), value)
+        from ..core.object_store import _FramedValue
+        from ..core.object_transfer import push_object
+        frame = None   # serialize once per execute, reuse across targets
+        for base, addr in self.input_chans:
+            if addr is None:
+                self.store.put(_slot_oid(base, slot), value)
+            else:
+                if frame is None:
+                    frame = _FramedValue(value, False)
+                if not push_object(addr, _slot_oid(base, slot),
+                                   frame=frame):
+                    raise RuntimeError(
+                        f"DAG input push to {addr} rejected "
+                        "(consumer store full?)")
         ref = CompiledDAGRef(self.store, _slot_oid(self.output_chan, slot),
                              self.stop_oid)
         self._outstanding.append(ref)
@@ -230,6 +290,13 @@ class CompiledDAG:
             return
         self._torn_down = True
         self.store.put(self.stop_oid, True)
+        # own-store actors poll their LOCAL stores for the flag
+        from ..core.object_transfer import push_object
+        for addr in self._push_addrs:
+            try:
+                push_object(addr, self.stop_oid, True)
+            except OSError:
+                pass  # node gone: its loop died with it
         import ray_tpu
         try:
             ray_tpu.get(self._loop_refs, timeout=timeout_s)

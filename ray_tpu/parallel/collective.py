@@ -30,6 +30,8 @@ from typing import Any, Optional
 
 import numpy as np
 
+from ..core.ref import ObjectRef
+
 
 class ReduceOp:
     SUM = "sum"
@@ -62,9 +64,17 @@ class _Rendezvous:
         self._pending: dict[str, dict] = {}
         self._joining: dict = {"ranks": set(), "event": asyncio.Event(),
                                "result": None}
+        # observability: bulk payload bytes that flowed THROUGH this actor.
+        # The *_refs kinds keep this near zero — bulk data moves
+        # store-to-store and only ObjectRefs pass here (the role split the
+        # reference gets from NCCL transports vs the gloo CPU store).
+        self.payload_bytes = 0
 
     def world_size(self) -> int:
         return self.world
+
+    def stats(self) -> dict:
+        return {"payload_bytes": self.payload_bytes}
 
     async def join(self, rank: int) -> int:
         """Barrier that admits a (re-)initializing group generation.
@@ -104,17 +114,26 @@ class _Rendezvous:
 
     async def gather_op(self, key: str, rank: int, payload, kind: str,
                         op: str = ReduceOp.SUM, src: int = 0):
-        """allreduce / allgather / reducescatter / broadcast / barrier."""
+        """allreduce / allgather / reducescatter / broadcast / barrier.
+
+        Each rank's `payload` is EITHER inline data (small: an ndarray, or
+        a per-destination chunk list of ndarrays for reducescatter) or its
+        store-backed form (bulk: a [ObjectRef], or a chunk list of
+        ObjectRefs) — ranks may mix freely since the threshold is a
+        per-process config. This actor only MATCHES and routes; combining
+        (reduce/concat) happens on the ranks after they deref, so bulk
+        bytes never flow through here."""
+        if isinstance(payload, np.ndarray):
+            self.payload_bytes += payload.nbytes
+        elif isinstance(payload, list):
+            self.payload_bytes += sum(p.nbytes for p in payload
+                                      if isinstance(p, np.ndarray))
         e = self._entry(key, self.world)
         e["parts"][rank] = payload
         if len(e["parts"]) == e["world"]:
             parts = [e["parts"][r] for r in range(e["world"])]
-            if kind == "allreduce":
-                e["result"] = _REDUCERS[op](parts)
-            elif kind == "allgather":
+            if kind in ("allreduce", "allgather", "reducescatter"):
                 e["result"] = parts
-            elif kind == "reducescatter":
-                e["result"] = _REDUCERS[op](parts)
             elif kind == "broadcast":
                 e["result"] = e["parts"][src]
             elif kind == "barrier":
@@ -126,13 +145,23 @@ class _Rendezvous:
             world = e["world"]
 
             def my(e):
-                full = e["result"]
-                chunk = len(full) // world
-                return full[rank * chunk:(rank + 1) * chunk]
+                # rank r takes the r-th chunk of every contribution:
+                # pre-chunked lists index directly; inline full tensors
+                # slice here (np.array_split boundaries, matching the
+                # chunking the bulk path used)
+                out = []
+                for part in e["result"]:
+                    if isinstance(part, list):
+                        out.append(part[rank])
+                    else:
+                        out.append(np.array_split(part, world)[rank])
+                return out
             return await self._finish(key, e, my)
         return await self._finish(key, e, None)
 
     async def p2p_send(self, key: str, payload):
+        if isinstance(payload, np.ndarray):
+            self.payload_bytes += payload.nbytes
         e = self._entry(key, 2)
         e["result"] = payload
         e["event"].set()
@@ -296,8 +325,52 @@ def _collective(kind: str, tensor, group_name: str, op: str = ReduceOp.SUM,
     st = _group(group_name)
     key = st.next_key(kind)
     payload = None if tensor is None else _to_host(tensor)
-    return ray.get(st.handle.gather_op.remote(
-        key, st.rank, payload, kind, op, src))
+    from ..core.config import cfg
+    bulk = payload is not None and payload.nbytes > cfg.collective_inline_bytes
+    if kind == "barrier":
+        return ray.get(st.handle.gather_op.remote(
+            key, st.rank, None, kind, op, src))
+    # This rank's contribution: inline ndarray when small, store-backed
+    # when bulk — bulk bytes live in the object store (crossing nodes via
+    # the transfer service) and only ObjectRefs visit the rendezvous
+    # actor. Refs ride NESTED in lists because top-level ObjectRef args
+    # auto-resolve at the callee (reference semantics). Ranks may disagree
+    # on the threshold (it's per-process config): the protocol composes
+    # either form.
+    if kind == "broadcast":
+        contrib = None
+        if st.rank == src:
+            contrib = [ray.put(payload)] if bulk else payload
+        res = ray.get(st.handle.gather_op.remote(
+            key, st.rank, contrib, kind, op, src))
+        return _deref(ray, res)
+    if kind == "reducescatter":
+        # per-destination chunks: rank r pulls ONLY the r-th chunk of
+        # every peer, so total bytes on the wire equal one tensor
+        chunks = np.array_split(payload, st.world, axis=0)
+        contrib = [ray.put(c) for c in chunks] if bulk else chunks
+        mine = ray.get(st.handle.gather_op.remote(
+            key, st.rank, contrib, kind, op, src))
+        return _REDUCERS[op]([_deref(ray, c) for c in mine])
+    # allreduce / allgather
+    contrib = [ray.put(payload)] if bulk else payload
+    res = ray.get(st.handle.gather_op.remote(
+        key, st.rank, contrib, kind, op, src))
+    parts = [_deref(ray, p) for p in res]
+    if kind == "allreduce":
+        return _REDUCERS[op](parts)
+    return parts  # allgather
+
+
+def _deref(ray, res):
+    """Resolve a store-backed contribution ([ObjectRef] or a bare ref);
+    pass inline ndarrays through."""
+    if isinstance(res, ObjectRef):
+        return ray.get(res)
+    if isinstance(res, list) and len(res) == 1 \
+            and isinstance(res[0], ObjectRef):
+        return ray.get(res[0])
+    return res
 
 
 def allreduce(tensor, group_name: str = "default",
@@ -355,7 +428,13 @@ def send(tensor, dst_rank: int, group_name: str = "default") -> None:
     if dst_rank == st.rank:
         raise ValueError("cannot send to self")
     key = st.next_p2p_key(st.rank, dst_rank)
-    ray.get(st.handle.p2p_send.remote(key, _to_host(tensor)))
+    from ..core.config import cfg
+    payload = _to_host(tensor)
+    if payload.nbytes > cfg.collective_inline_bytes:
+        # bulk: receiver pulls store-to-store ([ref]: nested so the actor
+        # arg does not auto-resolve)
+        payload = [ray.put(payload)]
+    ray.get(st.handle.p2p_send.remote(key, payload))
 
 
 def recv(src_rank: int, group_name: str = "default"):
@@ -367,7 +446,7 @@ def recv(src_rank: int, group_name: str = "default"):
     if src_rank == st.rank:
         raise ValueError("cannot recv from self")
     key = st.next_p2p_key(src_rank, st.rank)
-    return ray.get(st.handle.p2p_recv.remote(key))
+    return _deref(ray, ray.get(st.handle.p2p_recv.remote(key)))
 
 
 def create_collective_group(actors, world_size: int, ranks: list[int],

@@ -15,6 +15,8 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
+import contextlib  # noqa: E402
+
 import pytest  # noqa: E402
 
 
@@ -60,3 +62,39 @@ def shutdown_only():
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running convergence/regression tests")
+
+
+@contextlib.contextmanager
+def own_store_agent(ray, name, store_capacity=256 << 20, num_cpus=2,
+                    timeout=30):
+    """Spawn a REAL own-store node agent joined to `ray`'s head; yields
+    the registered NodeID hex; terminates the agent on exit. Shared by
+    every test that needs a second store (data plane, DAG channels,
+    collectives)."""
+    import os
+    import subprocess
+    import sys
+    import time
+
+    info = ray.head_address()
+    env = dict(os.environ)
+    env["RTPU_AUTHKEY"] = info["authkey"]
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.core.node_agent",
+         "--head", info["address"], "--num-cpus", str(num_cpus),
+         "--name", name, "--own-store",
+         "--store-capacity", str(store_capacity)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    try:
+        deadline = time.time() + timeout
+        node_id = None
+        while time.time() < deadline and node_id is None:
+            for row in ray.nodes():
+                if row["NodeName"] == name and row["Alive"]:
+                    node_id = row["NodeID"]
+            time.sleep(0.2)
+        assert node_id, f"own-store agent {name!r} never registered"
+        yield node_id
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)

@@ -50,6 +50,25 @@ def _make_workers(ray, world):
             from ray_tpu.util import collective as col
             return (col.get_rank(group), col.get_collective_group_size(group))
 
+        def do_bulk(self, group, n):
+            """Every op with n-element float64 payloads (bulk path)."""
+            from ray_tpu.util import collective as col
+            out = {}
+            out["allreduce"] = col.allreduce(
+                np.full((n,), self.rank + 1.0), group)
+            out["allgather"] = col.allgather(
+                np.full((n,), float(self.rank)), group)
+            out["reducescatter"] = col.reducescatter(
+                np.arange(n, dtype=np.float64), group)
+            out["broadcast"] = col.broadcast(
+                np.full((n,), self.rank * 10.0), 1, group)
+            if self.rank == 0:
+                col.send(np.full((n,), 42.0), 1, group)
+                out["p2p"] = None
+            else:
+                out["p2p"] = col.recv(0, group)
+            return out
+
     return [Rank.remote(r, world) for r in range(world)]
 
 
@@ -100,3 +119,96 @@ def test_driver_participates(ray):
     np.testing.assert_allclose(mine, np.full((4,), 3.0))
     np.testing.assert_allclose(theirs, mine)
     col.destroy_collective_group("g2")
+
+
+def test_store_backed_bulk_ops(ray):
+    """Payloads above collective_inline_bytes move store-to-store: the
+    rendezvous actor sees only ObjectRefs (near-zero payload bytes), and
+    every op still returns the right numbers."""
+    from ray_tpu.core.config import cfg
+    from ray_tpu.util import collective as col
+    cfg.override(collective_inline_bytes=1024)
+    try:
+        world = 2
+        actors = _make_workers(ray, world)
+        group = "gbulk"
+        col.create_collective_group(actors, world, list(range(world)),
+                                    backend="shm", group_name=group)
+
+        n = 64 * 1024  # 512KB float64 arrays: far above the 1KB threshold
+        refs = []
+        for a in actors:
+            refs.append(a.do_bulk.remote(group, n))
+        outs = ray.get(refs, timeout=120)
+        for rank, out in enumerate(outs):
+            np.testing.assert_allclose(
+                out["allreduce"], np.full((n,), 3.0))
+            assert [int(g[0]) for g in out["allgather"]] == [0, 1]
+            np.testing.assert_allclose(
+                out["reducescatter"],
+                2.0 * np.arange(rank * n // 2, (rank + 1) * n // 2))
+            np.testing.assert_allclose(out["broadcast"][:3],
+                                       [10.0, 10.0, 10.0])
+        if outs[1]["p2p"] is not None:
+            np.testing.assert_allclose(outs[1]["p2p"][:2], [42.0, 42.0])
+
+        handle = ray.get_actor("rtpu:collective:" + group)
+        stats = ray.get(handle.stats.remote())
+        # 5 bulk ops x ~512KB payloads; only refs may pass through
+        assert stats["payload_bytes"] < 64 * 1024, stats
+    finally:
+        cfg.reset("collective_inline_bytes")
+        col.destroy_collective_group("gbulk")
+
+
+def test_bulk_broadcast_crosses_own_store_node(ray):
+    """Broadcast between the head node and an own-store agent node: bulk
+    bytes ride the object-transfer data plane, not the rendezvous actor."""
+    from conftest import own_store_agent
+    from ray_tpu.core.config import cfg
+    from ray_tpu.util import collective as col
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy)
+
+    with own_store_agent(ray, "colnode") as node_id:
+        cfg.override(collective_inline_bytes=1024)
+        world = 2
+        group = "gxnode"
+
+        # one rank on the head node, one pinned to the own-store node
+        @ray.remote
+        class BulkRank:
+            def init_collective_group(self, world, rank, backend, group):
+                from ray_tpu.util import collective as col2
+                col2.init_collective_group(world, rank, backend, group)
+                return rank
+
+            def do_broadcast(self, group, n, rank):
+                import numpy as _np
+                from ray_tpu.util import collective as col2
+                # src contributes the bulk payload; receivers' tensor value
+                # is ignored by broadcast
+                payload = (_np.full((n,), 7.5) if rank == 0
+                           else _np.zeros(1))
+                return col2.broadcast(payload, 0, group)
+
+        a0 = BulkRank.options(num_cpus=1).remote()
+        a1 = BulkRank.options(num_cpus=1, scheduling_strategy=(
+            NodeAffinitySchedulingStrategy(node_id=node_id,
+                                           soft=False))).remote()
+        ray.get([a0.init_collective_group.remote(world, 0, "shm", group),
+                 a1.init_collective_group.remote(world, 1, "shm", group)],
+                timeout=60)
+        n = 256 * 1024  # 2MB float64
+        r0 = a0.do_broadcast.remote(group, n, 0)
+        r1 = a1.do_broadcast.remote(group, n, 1)
+        out0, out1 = ray.get([r0, r1], timeout=120)
+        np.testing.assert_allclose(out0[:3], [7.5, 7.5, 7.5])
+        np.testing.assert_allclose(out1[:3], [7.5, 7.5, 7.5])
+        assert len(out1) == n
+
+        handle = ray.get_actor("rtpu:collective:" + group)
+        stats = ray.get(handle.stats.remote())
+        assert stats["payload_bytes"] < 64 * 1024, stats
+        cfg.reset("collective_inline_bytes")
+        col.destroy_collective_group("gxnode")

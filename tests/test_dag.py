@@ -109,3 +109,40 @@ def test_compiled_faster_than_remote_calls(ray):
     finally:
         cdag.teardown()
     assert dag_dt < remote_dt * 1.5, (dag_dt, remote_dt)
+
+
+def test_cross_node_pipeline(ray):
+    """A compiled DAG spanning the head and an own-store agent node:
+    cross-store edges ride the transfer service (producer pushes into the
+    consumer's store), same-store edges stay plain shm writes.
+    Reference: multi-node is aDAG's whole point (compiled_dag_node.py:808).
+    """
+    from conftest import own_store_agent
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy)
+
+    with own_store_agent(ray, "dagnode",
+                         store_capacity=128 << 20) as node_id:
+        @ray.remote
+        class Stage:
+            def __init__(self, scale):
+                self.scale = scale
+
+            def step(self, x):
+                return x * self.scale
+
+        s1 = Stage.remote(2)  # head node
+        s2 = Stage.options(scheduling_strategy=NodeAffinitySchedulingStrategy(
+            node_id=node_id, soft=False)).remote(3)  # own-store node
+
+        with InputNode() as inp:
+            mid = s1.step.bind(inp)     # head -> push to island
+            out = s2.step.bind(mid)     # island -> push back to head
+        cdag = out.experimental_compile(max_inflight=2)
+        try:
+            assert cdag.execute(5).get(timeout_s=120) == 30
+            assert cdag.execute(7).get(timeout_s=120) == 42
+            refs = [cdag.execute(i) for i in range(3)]
+            assert [r.get(timeout_s=120) for r in refs] == [0, 6, 12]
+        finally:
+            cdag.teardown()

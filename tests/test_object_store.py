@@ -194,3 +194,78 @@ def test_reclaim_pid_aborts_unsealed_create(store):
     # the id is free again
     store.put(oid, b"fresh")
     assert store.get(oid) == b"fresh"
+
+
+class TestZeroCopyGet:
+    def test_zero_copy_views_pin_then_release(self, tmp_path):
+        """cfg.zero_copy_get: arrays come back read-only over store
+        memory; the object stays pinned (unevictable) until the last
+        array dies, then the pin releases."""
+        import gc
+
+        import numpy as np
+
+        from ray_tpu.core.config import cfg
+        from ray_tpu.core.ids import ObjectID
+        from ray_tpu.core.object_store import SharedObjectStore
+
+        st = SharedObjectStore(str(tmp_path / "zc"), capacity=64 << 20,
+                               create=True)
+        cfg.override(zero_copy_get=True)
+        try:
+            oid = ObjectID.from_random()
+            src = np.arange(1 << 20, dtype=np.float32)
+            st.put(oid, {"a": src, "b": 3})
+            out = st.get(oid)
+            np.testing.assert_array_equal(out["a"], src)
+            assert not out["a"].flags.writeable   # plasma semantics
+            # the pin blocks deletion-by-eviction: delete marks it, but
+            # memory is only reclaimed once consumers die. Drop the array:
+            arr = out["a"]
+            del out
+            np.testing.assert_array_equal(arr[:4], src[:4])
+            del arr
+            gc.collect()
+            # pin released: a delete now actually frees the entry
+            st.delete(oid)
+            assert not st.contains(oid)
+
+            # small/no-buffer objects release immediately
+            oid2 = ObjectID.from_random()
+            st.put(oid2, "just a string")
+            assert st.get(oid2) == "just a string"
+            st.delete(oid2)
+
+            # stored exceptions still raise (copy path)
+            oid3 = ObjectID.from_random()
+            st.put(oid3, ValueError("boom"), is_exception=True)
+            with pytest.raises(ValueError, match="boom"):
+                st.get(oid3)
+        finally:
+            cfg.reset("zero_copy_get")
+            st.close(unlink=True)
+
+    def test_zero_copy_roundtrip_through_api(self, tmp_path):
+        """End-to-end ray.put/get of a big array under zero_copy_get."""
+        import numpy as np
+
+        import ray_tpu
+        from ray_tpu.core.config import cfg
+
+        cfg.override(zero_copy_get=True)
+        try:
+            ray_tpu.init(num_cpus=1, object_store_memory=256 << 20)
+            src = np.arange(2 << 20, dtype=np.float64)
+            ref = ray_tpu.put(src)
+            out = ray_tpu.get(ref, timeout=60)
+            np.testing.assert_array_equal(out, src)
+
+            @ray_tpu.remote
+            def total(a):
+                return float(a.sum())
+
+            assert ray_tpu.get(total.remote(ref), timeout=60) == \
+                float(src.sum())
+        finally:
+            cfg.reset("zero_copy_get")
+            ray_tpu.shutdown()
