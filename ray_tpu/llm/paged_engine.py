@@ -175,6 +175,14 @@ class PagedInferenceEngine(_EngineBase):
             req.first_token_t = time.perf_counter()
             self._lengths[req.slot] = len(req.prompt_ids)
             self._prefilling.pop(0)
+            if getattr(req, "prefill_only", False):
+                # disaggregated prefill: export the KV pages + first token
+                # instead of decoding here (llm/pd_disagg.py)
+                req.export_payload = self._export_kv_locked(req, tok)
+                req.done = True
+                req.event.set()
+                self._release(req)
+                return
             self._active[req.slot] = req
             self._maybe_finish(req, tok)
         # NOTE: pad positions of the final chunk were written into the
@@ -228,6 +236,87 @@ class PagedInferenceEngine(_EngineBase):
             if req in self._prefilling:
                 self._prefilling.remove(req)
             self._release(req)
+
+    # -- prefill/decode disaggregation (llm/pd_disagg.py; reference:
+    # prefill_decode_disagg.py:64) ----------------------------------------
+
+    def _export_kv_locked(self, req: _Request, first_token: int) -> dict:
+        """Gather this request's KV pages to host arrays for transfer to a
+        decode replica (the role the KV-connector plays for the reference's
+        PD deployments)."""
+        idx = jnp.asarray(np.asarray(req.pages, np.int32))
+        pages = [{"k": np.asarray(layer["k"][idx]),
+                  "v": np.asarray(layer["v"][idx])}
+                 for layer in self.caches]
+        return {"prompt_ids": list(req.prompt_ids),
+                "first_token": int(first_token),
+                "page_size": self.cfg.page_size,
+                "pages": pages}
+
+    def prefill_export(self, prompt, params: SamplingParams) -> dict:
+        """Chunked-prefill `prompt` and return its exported KV payload
+        (drives the engine loop until the export is ready)."""
+        req = self.submit(prompt, params)
+        req.prefill_only = True
+        req.export_payload = None
+        while req.export_payload is None and not req.done:
+            self.step()
+        if req.export_payload is None:
+            raise RuntimeError("prefill finished without an export "
+                               "(prompt rejected?)")
+        return req.export_payload
+
+    def import_prefill(self, payload: dict, params: SamplingParams,
+                       ) -> _Request:
+        """Seed a decode-ready sequence from an exported KV payload:
+        allocate slot+pages, scatter the page data into this engine's
+        pools, and place the request directly in the decode set."""
+        import time
+        if payload["page_size"] != self.cfg.page_size:
+            raise ValueError(
+                f"page_size mismatch: payload {payload['page_size']} vs "
+                f"engine {self.cfg.page_size}")
+        ids = list(payload["prompt_ids"])
+        with self._lock:
+            req = _Request(self._next_rid, ids, params)
+            req.submit_t = time.perf_counter()
+            self._next_rid += 1
+            if not self._free_slots:
+                raise RuntimeError("no free decode slot")
+            req.slot = self._free_slots.pop(0)
+            if not self._ensure_pages(req, len(ids) + 1):
+                self._release(req)
+                raise RuntimeError("page pool exhausted importing prefill")
+            n_in = len(payload["pages"][0]["k"])
+            if n_in != len(req.pages):
+                self._release(req)
+                raise ValueError(
+                    f"payload covers {n_in} pages but this engine "
+                    f"allocated {len(req.pages)} for the same prompt")
+            idx = jnp.asarray(np.asarray(req.pages, np.int32))
+            for li, layer in enumerate(self.caches):
+                layer["k"] = self._import_fn(
+                    layer["k"], idx, jnp.asarray(payload["pages"][li]["k"]))
+                layer["v"] = self._import_fn(
+                    layer["v"], idx, jnp.asarray(payload["pages"][li]["v"]))
+            tok = int(payload["first_token"])
+            req.out_ids.append(tok)
+            req.prefill_pos = len(ids)
+            req.first_token_t = time.perf_counter()
+            self._lengths[req.slot] = len(ids)
+            self._active[req.slot] = req
+            self._maybe_finish(req, tok)
+        return req
+
+    @property
+    def _import_fn(self):
+        fn = getattr(self, "_import_fn_cached", None)
+        if fn is None:
+            # donated in-place page scatter: cache pools are not copied
+            fn = jax.jit(lambda c, idx, data: c.at[idx].set(data),
+                         donate_argnums=(0,))
+            self._import_fn_cached = fn
+        return fn
 
     # -- stats -------------------------------------------------------------
 

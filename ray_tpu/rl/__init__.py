@@ -14,6 +14,7 @@ from .sac import SAC, SACAlgorithmConfig, SACConfig, SACLearner
 from .env_runner import EnvRunner, make_gym_env
 from .learner import PPOConfig, PPOLearner, compute_gae
 from .module import MLPConfig
+from .offline import (BC, BCConfig, CQL, CQLConfig, collect_transitions)
 
 __all__ = [
     "DQN", "DQNAlgorithmConfig", "DQNConfig", "DQNLearner", "ReplayBuffer",
@@ -21,4 +22,5 @@ __all__ = [
     "vtrace", "SAC", "SACAlgorithmConfig", "SACConfig", "SACLearner",
     "PPO", "AlgorithmConfig", "EnvRunner", "make_gym_env",
     "PPOConfig", "PPOLearner", "compute_gae", "MLPConfig",
+    "BC", "BCConfig", "CQL", "CQLConfig", "collect_transitions",
 ]

@@ -1,0 +1,319 @@
+"""Offline RL: behavior cloning + discrete conservative Q-learning over
+logged ``ray_tpu.data`` datasets.
+
+Reference parity: rllib/algorithms/bc/ (BC — marwil.py with beta=0:
+plain imitation of the dataset policy) and rllib/algorithms/cql/
+(CQL — TD learning plus the conservative regularizer
+``alpha * (logsumexp_a Q(s,a) - Q(s, a_data))`` keeping learned values
+pessimistic off-dataset; Kumar et al. 2020). The reference trains from
+offline input readers (rllib/offline/); here the input is a
+``ray_tpu.data.Dataset`` of transition rows — the same Data-to-RL bridge
+its OfflineData loader provides.
+
+TPU-first: the whole per-iteration update (K minibatches) is ONE jitted
+``lax.scan`` over pre-sampled minibatch indices, so train() costs one
+device round-trip regardless of K (same shape as dqn.py's updater).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import numpy as np
+
+from . import module as module_lib
+from .base import AlgorithmBase, AlgorithmConfigBase
+from .env_runner import EnvRunner
+from .module import MLPConfig
+
+
+# --------------------------------------------------------------------------
+# logged-transition datasets
+# --------------------------------------------------------------------------
+
+def collect_transitions(env_fn: Callable, n_steps: int,
+                        policy: Optional[Callable] = None,
+                        seed: int = 0):
+    """Roll a (scripted or random) policy and return a
+    ``ray_tpu.data.Dataset`` of transition rows {obs, action, reward,
+    next_obs, done} — the offline-RL input format (reference:
+    rllib/offline/ SampleBatch json episodes)."""
+    from .. import data as rdata
+    env = env_fn()
+    rng = np.random.default_rng(seed)
+    obs, _ = env.reset(seed=seed)
+    rows = []
+    for _ in range(n_steps):
+        if policy is None:
+            action = int(env.action_space.sample())
+        else:
+            action = int(policy(np.asarray(obs, np.float32), rng))
+        nxt, rew, term, trunc, _ = env.step(action)
+        rows.append({"obs": np.asarray(obs, np.float32).tolist(),
+                     "action": action,
+                     "reward": float(rew),
+                     "next_obs": np.asarray(nxt, np.float32).tolist(),
+                     "done": bool(term or trunc)})
+        obs = nxt
+        if term or trunc:
+            obs, _ = env.reset()
+    env.close()
+    return rdata.from_items(rows)
+
+
+def _materialize(dataset) -> dict:
+    """Dataset rows -> stacked numpy arrays (offline data is bounded; the
+    learner samples minibatches from host memory like DQN's replay)."""
+    rows = dataset.take_all() if hasattr(dataset, "take_all") else \
+        list(dataset)
+    return {
+        "obs": np.asarray([r["obs"] for r in rows], np.float32),
+        "actions": np.asarray([r["action"] for r in rows], np.int32),
+        "rewards": np.asarray([r["reward"] for r in rows], np.float32),
+        "next_obs": np.asarray([r["next_obs"] for r in rows], np.float32),
+        "dones": np.asarray([float(r["done"]) for r in rows], np.float32),
+    }
+
+
+class _OfflineAlgoBase(AlgorithmBase):
+    """Shared offline scaffolding: no sampling runners drive training;
+    one env runner exists only for evaluate()."""
+
+    def _setup_offline(self, config):
+        if config.dataset is None:
+            raise ValueError("config.offline_data(dataset=...) is required")
+        self._data = _materialize(config.dataset)
+        if len(self._data["obs"]) == 0:
+            raise ValueError("offline dataset is empty")
+        config.num_env_runners = max(1, config.num_env_runners)
+        self._setup(config, EnvRunner)
+        self._np_rng = np.random.default_rng(config.seed)
+
+    def _minibatch_indices(self, k: int, batch: int) -> np.ndarray:
+        n = len(self._data["obs"])
+        return self._np_rng.integers(0, n, size=(k, batch))
+
+
+# --------------------------------------------------------------------------
+# BC
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class BCHparams:
+    """(reference: bc.py BCConfig.training(...))"""
+    lr: float = 1e-3
+    batch_size: int = 256
+    updates_per_iter: int = 64
+
+
+class BC(_OfflineAlgoBase):
+    """Behavior cloning: maximize log-likelihood of dataset actions
+    (reference: rllib/algorithms/bc/bc.py)."""
+
+    HPARAM_FIELD = "bc"
+
+    def __init__(self, config: "BCConfig"):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self._setup_offline(config)
+        hp = config.bc
+        params = module_lib.init(jax.random.PRNGKey(config.seed),
+                                 self.module_cfg)
+        opt = optax.adam(hp.lr)
+
+        data = {k: jnp.asarray(v) for k, v in self._data.items()
+                if k in ("obs", "actions")}
+
+        def loss_fn(p, idx):
+            obs = data["obs"][idx]
+            acts = data["actions"][idx]
+            logits, _ = module_lib.logits_and_value(p, obs)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            nll = -jnp.take_along_axis(
+                logp, acts[:, None].astype(jnp.int32), axis=-1)[:, 0]
+            return nll.mean()
+
+        def one_update(carry, idx):
+            p, o = carry
+            loss, grads = jax.value_and_grad(loss_fn)(p, idx)
+            upd, o = opt.update(grads, o, p)
+            return (optax.apply_updates(p, upd), o), loss
+
+        @jax.jit
+        def run_updates(p, o, all_idx):
+            (p, o), losses = jax.lax.scan(one_update, (p, o), all_idx)
+            return p, o, losses.mean()
+
+        class _Learner:
+            pass
+        self.learner = _Learner()
+        self.learner.params = params
+        self.learner.opt_state = opt.init(params)
+        self._run_updates = run_updates
+
+    def train(self) -> dict:
+        import jax.numpy as jnp
+        hp = self.config.bc
+        idx = jnp.asarray(self._minibatch_indices(hp.updates_per_iter,
+                                                  hp.batch_size))
+        p, o, loss = self._run_updates(self.learner.params,
+                                       self.learner.opt_state, idx)
+        self.learner.params = p
+        self.learner.opt_state = o
+        self.iteration += 1
+        return {"training_iteration": self.iteration,
+                "bc_loss": float(loss),
+                "num_gradient_updates": self.iteration * hp.updates_per_iter}
+
+
+class BCConfig(AlgorithmConfigBase):
+    HPARAM_FIELD = "bc"
+    HPARAM_FACTORY = BCHparams
+
+    @property
+    def ALGO_CLS(self):
+        return BC
+
+    def __init__(self):
+        super().__init__()
+        self.dataset = None
+        self.num_env_runners = 1
+
+    def offline_data(self, dataset=None):
+        self.dataset = dataset
+        return self
+
+
+# --------------------------------------------------------------------------
+# CQL (discrete)
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class CQLHparams:
+    """(reference: cql.py CQLConfig.training(...) — discrete reduction)"""
+    lr: float = 5e-4
+    gamma: float = 0.99
+    batch_size: int = 256
+    updates_per_iter: int = 64
+    target_update_freq: int = 8        # in train() iterations
+    cql_alpha: float = 1.0             # conservative penalty weight
+    huber_delta: float = 1.0
+
+
+class CQL(_OfflineAlgoBase):
+    """Discrete CQL: double-DQN TD loss on dataset transitions plus the
+    conservative term alpha * (logsumexp_a Q(s,a) - Q(s, a_data))
+    (reference: rllib/algorithms/cql/cql.py)."""
+
+    HPARAM_FIELD = "cql"
+
+    def __init__(self, config: "CQLConfig"):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self._setup_offline(config)
+        hp = config.cql
+        params = module_lib.init(jax.random.PRNGKey(config.seed),
+                                 self.module_cfg)
+        opt = optax.adam(hp.lr)
+        data = {k: jnp.asarray(v) for k, v in self._data.items()}
+
+        def q_of(p, obs):
+            # the module's "pi" head doubles as the Q head (same shape:
+            # one scalar per discrete action)
+            logits, _ = module_lib.logits_and_value(p, obs)
+            return logits
+
+        def loss_fn(p, target_p, idx):
+            obs = data["obs"][idx]
+            acts = data["actions"][idx].astype(jnp.int32)
+            rew = data["rewards"][idx]
+            nxt = data["next_obs"][idx]
+            done = data["dones"][idx]
+            q = q_of(p, obs)
+            q_a = jnp.take_along_axis(q, acts[:, None], axis=-1)[:, 0]
+            # double-Q target: online argmax, target net value
+            next_online = q_of(p, nxt)
+            next_target = q_of(target_p, nxt)
+            a_star = jnp.argmax(next_online, axis=-1)
+            q_next = jnp.take_along_axis(
+                next_target, a_star[:, None], axis=-1)[:, 0]
+            target = rew + hp.gamma * (1.0 - done) * \
+                jax.lax.stop_gradient(q_next)
+            td = q_a - target
+            huber = jnp.where(
+                jnp.abs(td) <= hp.huber_delta, 0.5 * td ** 2,
+                hp.huber_delta * (jnp.abs(td) - 0.5 * hp.huber_delta))
+            # conservative regularizer: push down unseen actions' values
+            cql = jax.scipy.special.logsumexp(q, axis=-1) - q_a
+            return huber.mean() + hp.cql_alpha * cql.mean(), (
+                huber.mean(), cql.mean())
+
+        def one_update(carry, idx):
+            p, o, tp = carry
+            (loss, (td, cql)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(p, tp, idx)
+            upd, o = opt.update(grads, o, p)
+            return (optax.apply_updates(p, upd), o, tp), (loss, td, cql)
+
+        @jax.jit
+        def run_updates(p, o, tp, all_idx):
+            (p, o, tp), (losses, tds, cqls) = jax.lax.scan(
+                one_update, (p, o, tp), all_idx)
+            return p, o, losses.mean(), tds.mean(), cqls.mean()
+
+        class _Learner:
+            pass
+        self.learner = _Learner()
+        self.learner.params = params
+        self.learner.opt_state = opt.init(params)
+        self._target_params = params
+        self._run_updates = run_updates
+
+    def _extra_state(self) -> dict:
+        return {"target_params": self._target_params}
+
+    def _load_extra_state(self, state: dict) -> None:
+        import jax
+        import jax.numpy as jnp
+        self._target_params = jax.tree.map(jnp.asarray,
+                                           state["target_params"])
+
+    def train(self) -> dict:
+        import jax.numpy as jnp
+        hp = self.config.cql
+        idx = jnp.asarray(self._minibatch_indices(hp.updates_per_iter,
+                                                  hp.batch_size))
+        p, o, loss, td, cql = self._run_updates(
+            self.learner.params, self.learner.opt_state,
+            self._target_params, idx)
+        self.learner.params = p
+        self.learner.opt_state = o
+        self.iteration += 1
+        if self.iteration % hp.target_update_freq == 0:
+            self._target_params = self.learner.params
+        return {"training_iteration": self.iteration,
+                "cql_loss": float(loss), "td_loss": float(td),
+                "cql_gap": float(cql),
+                "num_gradient_updates": self.iteration * hp.updates_per_iter}
+
+
+class CQLConfig(AlgorithmConfigBase):
+    HPARAM_FIELD = "cql"
+    HPARAM_FACTORY = CQLHparams
+
+    @property
+    def ALGO_CLS(self):
+        return CQL
+
+    def __init__(self):
+        super().__init__()
+        self.dataset = None
+        self.num_env_runners = 1
+
+    def offline_data(self, dataset=None):
+        self.dataset = dataset
+        return self

@@ -8,6 +8,7 @@ core runtime; results stream over the same report bus the Train library
 uses (`tune.report` is `train.report`, matching the unified v2 API).
 """
 from .search import (
+    BOHBSearch,
     TPESearch,
     choice,
     grid_search,
@@ -21,7 +22,9 @@ from .search import (
 from .schedulers import (
     ASHAScheduler,
     FIFOScheduler,
+    HyperBandForBOHB,
     MedianStoppingRule,
+    PB2,
     PopulationBasedTraining,
 )
 from .tuner import ResultGrid, TuneConfig, Tuner
@@ -35,6 +38,8 @@ __all__ = [
     "Tuner", "TuneConfig", "ResultGrid", "grid_search", "choice", "uniform",
     "loguniform", "randint", "qrandint", "quniform", "sample_from",
     "FIFOScheduler", "ASHAScheduler", "MedianStoppingRule",
-    "PopulationBasedTraining", "TPESearch", "report", "get_checkpoint", "get_context",
+    "PopulationBasedTraining", "HyperBandForBOHB", "PB2",
+    "TPESearch", "BOHBSearch",
+    "report", "get_checkpoint", "get_context",
     "Checkpoint",
 ]

@@ -276,3 +276,51 @@ class TPESearch:
         elif isinstance(dom, QUniform):
             v = min(max(round(v / dom.q) * dom.q, dom.low), dom.high)
         return v, score
+
+
+class BOHBSearch(TPESearch):
+    """BOHB's model half (reference: tune/search/bohb/ — TPE conditioned
+    on budget, Falkner et al. 2018): observations are tagged with the
+    budget (training_iteration) they were measured at, and suggestions
+    come from the model built at the LARGEST budget that has enough
+    observations — low-budget rung results guide early sampling, full-
+    budget results dominate once available. Pair with
+    schedulers.HyperBandForBOHB."""
+
+    def __init__(self, n_initial: int = 8, gamma: float = 0.25,
+                 n_candidates: int = 24, seed: int = 0,
+                 budget_attr: str = "training_iteration"):
+        super().__init__(n_initial=n_initial, gamma=gamma,
+                         n_candidates=n_candidates, seed=seed)
+        self.budget_attr = budget_attr
+        self._budget_obs: dict[int, list[tuple[dict, float]]] = {}
+
+    def on_trial_complete(self, config: dict, metrics: dict) -> None:
+        if not self.metric or self.metric not in metrics:
+            return
+        score = float(metrics[self.metric])
+        if self.mode == "min":
+            score = -score
+        budget = int(metrics.get(self.budget_attr, 0))
+        self._budget_obs.setdefault(budget, []).append((config, score))
+        # total count drives the random-vs-model switch in suggest()
+        self._obs.append((config, score))
+
+    def suggest(self) -> dict:
+        if len(self._obs) < self.n_initial:
+            return self._random_config()
+        # model the largest budget with enough points (>= 4); pool
+        # smaller budgets in if the largest alone is too thin
+        budgets = sorted(self._budget_obs, reverse=True)
+        pool: list[tuple[dict, float]] = []
+        for b in budgets:
+            pool = self._budget_obs[b] + pool
+            if len(self._budget_obs[b]) >= 4:
+                pool = self._budget_obs[b]
+                break
+        saved = self._obs
+        try:
+            self._obs = pool if len(pool) >= 2 else saved
+            return super().suggest()
+        finally:
+            self._obs = saved
