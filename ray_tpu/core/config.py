@@ -130,6 +130,11 @@ _FLAGS = [
          "store fill fraction above which sealed objects spill to disk"),
     Flag("min_spilling_size", 1 << 20,
          "don't spill objects smaller than this (bytes)"),
+    Flag("transfer_chunk_bytes", 8 << 20,
+         "cross-node object pulls move in pieces of this size: a transport "
+         "failure resumes from the last good byte instead of restarting "
+         "the whole frame, and frames larger than the local store stream "
+         "to the spill directory piecewise"),
     Flag("collective_inline_bytes", 64 << 10,
          "collective payloads up to this size ride inside the rendezvous "
          "actor message (one round trip); larger ones move store-to-store "

@@ -16,11 +16,19 @@ which the object is served locally and the head records the new copy.
 Wire protocol (per request, connections are reused; 1-byte verb first):
   G (get):  -> 'G' + 16B object id
             <- 8B little-endian frame length (0 = not here) + frame bytes
+  R (range):-> 'R' + 16B object id + 8B offset + 8B max bytes
+            <- 8B TOTAL frame length (0 = not here)
+               + min(max, total-offset) payload bytes from offset
   P (push): -> 'P' + 16B object id + 8B frame length + frame bytes
             <- 1B status (1 = stored/already-present, 0 = failed)
 Push is how producers place data INTO a peer store without a directory
 round-trip — compiled-DAG channels and bulk broadcast use it (reference
-Push: object_manager.h:209).
+Push: object_manager.h:209). Ranged gets are the chunked/resumable pull
+path (reference chunked Pull: object_manager.h:217, pull_manager.h:49):
+fetch_resilient pulls a large frame in cfg.transfer_chunk_bytes pieces,
+resumes from the last good byte after a transport error, fails over
+across every known holder, and streams frames bigger than the local
+store straight to the spill directory.
 """
 from __future__ import annotations
 
@@ -69,6 +77,9 @@ class ObjectDataServer:
                 if verb == b"G":
                     if not self._serve_get(conn):
                         return
+                elif verb == b"R":
+                    if not self._serve_range(conn):
+                        return
                 elif verb == b"P":
                     if not self._serve_push(conn):
                         return
@@ -98,6 +109,41 @@ class ObjectDataServer:
                     data = f.read()
                 conn.sendall(struct.pack("<Q", len(data)))
                 conn.sendall(data)
+            else:
+                conn.sendall(struct.pack("<Q", 0))
+        finally:
+            if view is not None:
+                del view
+                self.store.release(oid)
+        return True
+
+    def _serve_range(self, conn: socket.socket) -> bool:
+        hdr = _recv_exact(conn, ObjectID.SIZE + 16)
+        if hdr is None:
+            return False
+        oid = ObjectID(hdr[:ObjectID.SIZE])
+        offset, maxlen = struct.unpack("<QQ", hdr[ObjectID.SIZE:])
+        view = None
+        try:
+            view = self.store.get_raw(oid, timeout_ms=0)
+            if view is not None:
+                total = len(view)
+                lo = min(offset, total)
+                hi = min(lo + maxlen, total)
+                conn.sendall(struct.pack("<Q", total))
+                if hi > lo:
+                    conn.sendall(view[lo:hi])
+            elif self.spill is not None and self.spill.contains(oid):
+                import os as _os
+                path = self.spill._path(oid)
+                total = _os.path.getsize(path)
+                lo = min(offset, total)
+                hi = min(lo + maxlen, total)
+                conn.sendall(struct.pack("<Q", total))
+                if hi > lo:
+                    with open(path, "rb") as f:
+                        f.seek(lo)
+                        conn.sendall(f.read(hi - lo))
             else:
                 conn.sendall(struct.pack("<Q", 0))
         finally:
@@ -178,15 +224,9 @@ def fetch_object(addr: str, oid: ObjectID, local_store: SharedObjectStore,
     """Pull one object from `addr` into the local store (spill fallback
     when the local store can't hold it). Returns False if the peer does
     not have the object; raises OSError on transport failure."""
-    with _pool_lock:
-        conn = _conn_pool.pop(addr, None)
+    conn = _checkout_conn(addr, timeout_s)
+    ok = False
     try:
-        if conn is None:
-            host, port = addr.rsplit(":", 1)
-            conn = socket.create_connection((host, int(port)),
-                                            timeout=timeout_s)
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        conn.settimeout(timeout_s)
         conn.sendall(b"G" + oid.binary())
         hdr = _recv_exact(conn, 8)
         if hdr is None:
@@ -199,14 +239,12 @@ def fetch_object(addr: str, oid: ObjectID, local_store: SharedObjectStore,
             result = True
         else:
             result = _receive_frame(conn, oid, length, local_store, spill)
-        # healthy exchange: keep the connection for the next pull
-        with _pool_lock:
-            if addr not in _conn_pool:
-                _conn_pool[addr] = conn
-                conn = None
+        ok = True   # healthy exchange: pool the connection
         return result
     finally:
-        if conn is not None:
+        if ok:
+            _checkin_conn(addr, conn)
+        else:
             try:
                 conn.close()
             except OSError:
@@ -221,15 +259,9 @@ def push_object(addr: str, oid: ObjectID, value=None, frame=None,
     from .object_store import _FramedValue
     if frame is None:
         frame = _FramedValue(value, is_exception)
-    with _pool_lock:
-        conn = _conn_pool.pop(addr, None)
+    conn = _checkout_conn(addr, timeout_s)
+    ok = False
     try:
-        if conn is None:
-            host, port = addr.rsplit(":", 1)
-            conn = socket.create_connection((host, int(port)),
-                                            timeout=timeout_s)
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        conn.settimeout(timeout_s)
         conn.sendall(b"P" + oid.binary() + struct.pack("<Q", frame.total))
         # stream the frame piecewise: no second full-size buffer
         for piece in frame.iter_wire():
@@ -237,17 +269,192 @@ def push_object(addr: str, oid: ObjectID, value=None, frame=None,
         status = _recv_exact(conn, 1)
         if status is None:
             raise OSError("peer closed during push")
-        with _pool_lock:
-            if addr not in _conn_pool:
-                _conn_pool[addr] = conn
-                conn = None
+        ok = True
         return status == b"\x01"
     finally:
-        if conn is not None:
+        if ok:
+            _checkin_conn(addr, conn)
+        else:
             try:
                 conn.close()
             except OSError:
                 pass
+
+
+def _checkout_conn(addr: str, timeout_s: float) -> socket.socket:
+    with _pool_lock:
+        conn = _conn_pool.pop(addr, None)
+    if conn is None:
+        host, port = addr.rsplit(":", 1)
+        conn = socket.create_connection((host, int(port)),
+                                        timeout=timeout_s)
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    conn.settimeout(timeout_s)
+    return conn
+
+
+def _checkin_conn(addr: str, conn: socket.socket) -> None:
+    with _pool_lock:
+        if addr not in _conn_pool:
+            _conn_pool[addr] = conn
+            return
+    try:
+        conn.close()
+    except OSError:
+        pass
+
+
+def _range_once(addr: str, oid: ObjectID, offset: int, maxlen: int,
+                sink, timeout_s: float) -> Optional[int]:
+    """One ranged request; `sink(view_or_bytes)` consumes the payload.
+    Returns the TOTAL frame size, or None when the peer lacks the object.
+    Raises OSError on transport trouble."""
+    conn = _checkout_conn(addr, timeout_s)
+    ok = False
+    try:
+        conn.sendall(b"R" + oid.binary() + struct.pack("<QQ", offset,
+                                                       maxlen))
+        hdr = _recv_exact(conn, 8)
+        if hdr is None:
+            raise OSError("peer closed during ranged fetch")
+        (total,) = struct.unpack("<Q", hdr)
+        if total == 0:
+            ok = True
+            return None
+        want = min(maxlen, max(0, total - offset))
+        left = want
+        while left > 0:
+            piece = conn.recv(min(1 << 20, left))
+            if not piece:
+                raise OSError("peer closed mid-range")
+            sink(piece)
+            left -= len(piece)
+        ok = True
+        return total
+    finally:
+        if ok:
+            _checkin_conn(addr, conn)
+        else:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+def fetch_resilient(addrs: list[str], oid: ObjectID,
+                    local_store: SharedObjectStore,
+                    spill: Optional[SpillStore] = None,
+                    timeout_s: float = 30.0,
+                    max_rounds: int = 3) -> bool:
+    """Chunked, resumable, failover pull (reference: chunked Pull with
+    retry, object_manager.h:217 + pull_manager.h:49). The frame moves in
+    cfg.transfer_chunk_bytes pieces; a transport error resumes from the
+    last good byte against the NEXT holder; frames the local store cannot
+    hold stream piecewise into the spill directory (so objects up to disk
+    size cross nodes without ever fitting in shm or RAM). Returns False
+    only when no holder has the object."""
+    from .config import cfg
+    from .object_store import ObjectStoreFullError
+    if local_store.contains(oid):
+        return True
+    chunk = max(1 << 16, cfg.transfer_chunk_bytes)
+    holders = [a for a in addrs if a]
+    if not holders:
+        return False
+
+    state = {"total": None, "got": 0, "buf": None, "file": None}
+
+    def sink(piece: bytes):
+        if state["file"] is not None:
+            state["file"].write(piece)
+        else:
+            got = state["got"]
+            state["buf"][got:got + len(piece)] = piece
+        state["got"] += len(piece)
+
+    max_failures = max_rounds * len(holders)
+    failures = 0          # only ERRORS consume budget, not chunk steps
+    exhausted = 0
+    i = 0
+    done = False
+    try:
+        while failures < max_failures:
+            addr = holders[i % len(holders)]
+            try:
+                if state["total"] is None:
+                    # first request doubles as the size probe AND carries
+                    # the first chunk (small objects stay one round trip);
+                    # the prefix buffers until the destination exists
+                    prefix = bytearray()
+                    total = _range_once(addr, oid, 0, chunk,
+                                        prefix.extend, timeout_s)
+                    if total is None:
+                        exhausted += 1
+                        i += 1
+                        if exhausted >= len(holders):
+                            return False
+                        continue
+                    exhausted = 0
+                    state["total"] = total
+                    try:
+                        state["buf"] = local_store.create_raw(oid, total)
+                    except FileExistsError:
+                        done = True   # raced: another puller created it
+                        return True
+                    except ObjectStoreFullError:
+                        if spill is None:
+                            raise
+                        state["file"] = open(
+                            spill._path(oid) + ".tmp", "wb")
+                    sink(bytes(prefix))
+                    if state["got"] < state["total"]:
+                        continue
+                else:
+                    before = state["got"]
+                    total = _range_once(addr, oid, state["got"], chunk,
+                                        sink, timeout_s)
+                    if total is None or state["got"] == before:
+                        # holder lost the object mid-pull (eviction):
+                        # others may still serve it
+                        failures += 1
+                        i += 1
+                        continue
+            except OSError:
+                # transient transport trouble must not count toward the
+                # all-holders-lack-it verdict
+                exhausted = 0
+                failures += 1
+                i += 1        # failover: resume against the next holder
+                continue
+            if state["got"] >= state["total"]:
+                if state["file"] is not None:
+                    state["file"].close()
+                    state["file"] = None
+                    import os as _os
+                    _os.replace(spill._path(oid) + ".tmp",
+                                spill._path(oid))
+                else:
+                    del state["buf"]
+                    state["buf"] = None
+                    local_store.seal(oid)
+                done = True
+                return True
+        raise OSError(
+            f"fetch of {oid} failed after {max_rounds} rounds over "
+            f"{len(holders)} holder(s); got {state['got']} of "
+            f"{state['total']} bytes")
+    finally:
+        if not done:
+            if state["file"] is not None:
+                state["file"].close()
+                import os as _os
+                try:   # don't leak partial multi-GB .tmp files on abort
+                    _os.remove(spill._path(oid) + ".tmp")
+                except OSError:
+                    pass
+            if state["buf"] is not None:
+                del state["buf"]
+                local_store.delete(oid)   # abort the unsealed create
 
 
 def _receive_frame(conn, oid, length, local_store, spill) -> bool:

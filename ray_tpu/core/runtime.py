@@ -2378,14 +2378,11 @@ class Runtime:
             addrs = [n.data_addr for n in self.nodes.values()
                      if n.alive and n.own_store and n.data_addr
                      and n.node_id.hex() in locs]
-        from .object_transfer import fetch_object
-        for addr in addrs:
-            try:
-                if fetch_object(addr, oid, self.store, self.spill):
-                    return True
-            except OSError:
-                continue
-        return False
+        from .object_transfer import fetch_resilient
+        try:
+            return fetch_resilient(addrs, oid, self.store, self.spill)
+        except OSError:
+            return False
 
     def _get_one(self, oid: ObjectID, deadline: float | None):
         while True:

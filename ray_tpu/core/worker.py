@@ -227,19 +227,18 @@ class WorkerRuntime:
             addrs = self._rpc("locate", oid.binary(), timeout=10.0)
         except Exception:
             return False
-        from .object_transfer import fetch_object
-        for addr in addrs:
-            try:
-                if fetch_object(addr, oid, self.store, self.spill):
-                    self._last_fetch.pop(oid, None)
-                    if self.own_store:
-                        # the head must know this node holds a copy now
-                        # (free fanout + future locates)
-                        self.send({"t": "object_copied",
-                                   "oid": oid.binary()})
-                    return True
-            except OSError:
-                continue
+        from .object_transfer import fetch_resilient
+        try:
+            if fetch_resilient(addrs, oid, self.store, self.spill):
+                self._last_fetch.pop(oid, None)
+                if self.own_store:
+                    # the head must know this node holds a copy now
+                    # (free fanout + future locates)
+                    self.send({"t": "object_copied",
+                               "oid": oid.binary()})
+                return True
+        except OSError:
+            pass
         return False
 
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):

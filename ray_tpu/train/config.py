@@ -30,6 +30,11 @@ class ScalingConfig:
     # shrinks. Per-size reservation wait: elastic_timeout_s.
     min_workers: Optional[int] = None
     elastic_timeout_s: float = 30.0
+    # How often the fit loop checks whether a shrunken gang can GROW back
+    # toward num_workers (reference: Train v2 consults ScalingPolicy every
+    # control-loop iteration, controller.py:446). Growth checkpoints the
+    # run and restarts at the larger world size.
+    elastic_poll_s: float = 5.0
     # Multi-host gang: when True the trainer allocates a coordinator port and
     # every worker calls jax.distributed.initialize before the train fn, so
     # all workers' local chips form ONE global mesh (jax.devices() = global).

@@ -284,6 +284,21 @@ class DataParallelTrainer:
             f"no gang of {n_min}..{n_max} × {sc.bundle()} workers became "
             f"ready (cluster too small?)")
 
+    def _gang_can_grow(self, ray, current_n: int) -> bool:
+        """True when the cluster's FREE resources could host at least one
+        more worker bundle (reference: Train v2 consults ScalingPolicy
+        every control-loop tick, controller.py:446). The actual larger
+        reservation is re-validated by _reserve_gang on restart."""
+        if current_n >= self.scaling.num_workers:
+            return False
+        bundle = self.scaling.bundle()
+        frees = [dict(row["Available"]) for row in ray.nodes()
+                 if row["Alive"]]
+        for cap in frees:
+            if all(cap.get(k, 0) >= v - 1e-9 for k, v in bundle.items()):
+                return True
+        return False
+
     def _start_group(self, ray, run_name, bus, restore: Optional[Checkpoint]):
         import cloudpickle
         n, pg = self._reserve_gang(self.scaling.num_workers)
@@ -371,6 +386,8 @@ class DataParallelTrainer:
         error: Optional[BaseException] = None
 
         pg, workers, run_refs = self._start_group(ray, run_name, bus, restore)
+        elastic = self.scaling.min_workers is not None
+        next_grow_check = time.monotonic() + self.scaling.elastic_poll_s
         try:
             while True:
                 done, pending = ray.wait(run_refs, num_returns=len(run_refs),
@@ -384,6 +401,44 @@ class DataParallelTrainer:
                     if rank == 0:
                         metrics_history.append(metrics)
                         last_metrics = metrics
+                # mid-run elastic GROWTH: a shrunken gang widens as soon as
+                # capacity appears (node joined) — checkpoint, restart at
+                # the larger world size (reference Train v2: ScalingPolicy
+                # per control-loop iteration, controller.py:446). Runs
+                # AFTER the bus drain above so the restore point includes
+                # every checkpoint the old generation already reported,
+                # and stale reports can't collide with new-generation keys.
+                if elastic and len(workers) < self.scaling.num_workers \
+                        and time.monotonic() >= next_grow_check:
+                    next_grow_check = (time.monotonic()
+                                       + self.scaling.elastic_poll_s)
+                    if self._gang_can_grow(ray, len(workers)):
+                        prev_n = len(workers)
+                        generation += 1
+                        restore = manager.latest or restore
+                        self._teardown(ray, workers, pg)
+                        try:
+                            pg, workers, run_refs = self._start_group(
+                                ray, run_name, bus, restore)
+                        except TrainingFailedError as e:
+                            # the freed resources were snatched between
+                            # teardown and re-reservation: growing must
+                            # not kill a healthy run outright — spend the
+                            # failure budget like any other restart
+                            if failures_left == 0:
+                                error = e
+                                workers, pg, run_refs = [], None, []
+                                break
+                            failures_left -= 1
+                            pg, workers, run_refs = self._start_group(
+                                ray, run_name, bus, restore)
+                        if len(workers) <= prev_n:
+                            # capacity was transient or constraint-bound:
+                            # damp the next attempt so we don't thrash
+                            next_grow_check = (
+                                time.monotonic()
+                                + 10 * self.scaling.elastic_poll_s)
+                        continue
                 try:
                     ray.get(done)  # surfaces any worker failure immediately
                 except BaseException as e:  # noqa: BLE001

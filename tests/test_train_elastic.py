@@ -1,0 +1,75 @@
+"""Mid-run elastic gang growth (reference: Train v2 ScalingPolicy
+consulted every control-loop iteration, controller.py:446)."""
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+
+@pytest.fixture
+def small_head():
+    ray_tpu.init(num_cpus=1)   # holds exactly ONE 1-CPU train worker
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_joining_node_grows_gang_without_failure(small_head, tmp_path):
+    """A 2-worker-max gang starts at width 1 (cluster too small); when a
+    node joins mid-run the controller checkpoints and restarts at width 2
+    — no worker failure involved."""
+    ray = small_head
+    info = ray.head_address()
+
+    # defined in-test so cloudpickle ships it by VALUE (module-level test
+    # functions aren't importable from worker processes)
+    def _loop(config=None):
+        import time as _t
+
+        from ray_tpu import train
+        ctx = train.get_context()
+        ckpt = train.get_checkpoint()
+        start = ckpt.load_state()["step"] + 1 if ckpt else 0
+        for step in range(start, 24):
+            c = train.Checkpoint.from_state({"step": step})
+            train.report({"step": step, "world": ctx.world_size},
+                         checkpoint=c)
+            _t.sleep(0.25)
+
+    agent_proc = []
+
+    def join_later():
+        time.sleep(4.0)
+        env = dict(os.environ)
+        env["RTPU_AUTHKEY"] = info["authkey"]
+        agent_proc.append(subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.node_agent",
+             "--head", info["address"], "--num-cpus", "1",
+             "--name", "grow-node"],
+            env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT))
+
+    threading.Thread(target=join_later, daemon=True).start()
+    try:
+        result = JaxTrainer(
+            _loop,
+            scaling_config=ScalingConfig(
+                num_workers=2, min_workers=1, cpus_per_worker=1.0,
+                elastic_timeout_s=2.0, elastic_poll_s=0.5),
+            run_config=RunConfig(name="elastic-grow",
+                                 storage_path=str(tmp_path))).fit()
+        # the run finished at the FULL width and completed every step
+        assert result.metrics["world"] == 2, result.metrics
+        assert result.metrics["step"] == 23
+        worlds = [m["world"] for m in result.metrics_history]
+        assert worlds[0] == 1, "should have started shrunken"
+        assert worlds[-1] == 2, "should have grown mid-run"
+    finally:
+        for p in agent_proc:
+            p.terminate()
+            p.wait(timeout=10)
