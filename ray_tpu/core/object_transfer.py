@@ -281,13 +281,16 @@ def push_object(addr: str, oid: ObjectID, value=None, frame=None,
                 pass
 
 
-def _checkout_conn(addr: str, timeout_s: float) -> socket.socket:
+def _checkout_conn(addr: str, timeout_s: float,
+                   connect_timeout_s: Optional[float] = None,
+                   ) -> socket.socket:
     with _pool_lock:
         conn = _conn_pool.pop(addr, None)
     if conn is None:
         host, port = addr.rsplit(":", 1)
-        conn = socket.create_connection((host, int(port)),
-                                        timeout=timeout_s)
+        conn = socket.create_connection(
+            (host, int(port)),
+            timeout=connect_timeout_s or timeout_s)
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     conn.settimeout(timeout_s)
     return conn
@@ -308,8 +311,11 @@ def _range_once(addr: str, oid: ObjectID, offset: int, maxlen: int,
                 sink, timeout_s: float) -> Optional[int]:
     """One ranged request; `sink(view_or_bytes)` consumes the payload.
     Returns the TOTAL frame size, or None when the peer lacks the object.
-    Raises OSError on transport trouble."""
-    conn = _checkout_conn(addr, timeout_s)
+    Raises OSError on transport trouble. The dial is bounded separately
+    (black-holed holders must not eat the full data timeout — the caller
+    sits in a synchronous ray.get loop)."""
+    conn = _checkout_conn(addr, timeout_s,
+                          connect_timeout_s=min(5.0, timeout_s))
     ok = False
     try:
         conn.sendall(b"R" + oid.binary() + struct.pack("<QQ", offset,
@@ -425,6 +431,11 @@ def fetch_resilient(addrs: list[str], oid: ObjectID,
                 exhausted = 0
                 failures += 1
                 i += 1        # failover: resume against the next holder
+                if state["total"] is None and failures >= len(holders):
+                    # nothing fetched yet and every holder errored once:
+                    # return to the caller's 1 Hz locate/retry loop
+                    # instead of burning max_rounds x timeout here
+                    raise
                 continue
             if state["got"] >= state["total"]:
                 if state["file"] is not None:

@@ -500,12 +500,15 @@ def decode_batched(params: dict, tokens: jax.Array, cache: dict,
 
 def apply_pipelined(params: dict, tokens: jax.Array, cfg: LlamaConfig,
                     mesh, num_microbatches: int,
-                    attn_impl=None) -> jax.Array:
+                    attn_impl=None, num_chunks: int = 1) -> jax.Array:
     """Training forward with transformer blocks pipelined over the mesh's
-    `pp` axis (parallel.pipeline GPipe schedule). Embedding and lm_head are
-    pp-replicated and stay outside the pipeline; cfg.n_layers must divide
-    the pp size. Matches `apply` numerically."""
-    from ..parallel.pipeline import pipeline_apply, split_stages
+    `pp` axis (parallel.pipeline schedules: GPipe, or breadth-first
+    interleaved virtual stages with num_chunks > 1 — bubble drops from
+    (S-1)/(M+S-1) to (S-1)/(num_chunks*M+S-1)). Embedding and lm_head are
+    pp-replicated and stay outside the pipeline; pp_size * num_chunks must
+    divide cfg.n_layers. Matches `apply` numerically."""
+    from ..parallel.pipeline import (interleave_stages, pipeline_apply,
+                                     split_stages)
 
     if cfg.moe_experts:
         # the GPipe stage fn drops each layer's load-balance aux term; MoE
@@ -527,9 +530,11 @@ def apply_pipelined(params: dict, tokens: jax.Array, cfg: LlamaConfig,
         h, _ = jax.lax.scan(body, h, stage_layers)
         return h
 
-    stages = split_stages(params["layers"], n_stages)
+    stages = split_stages(params["layers"], n_stages * num_chunks)
+    if num_chunks > 1:
+        stages = interleave_stages(stages, n_stages, num_chunks)
     x = pipeline_apply(stage_fn, stages, x, mesh, num_microbatches,
-                       remat=cfg.remat)
+                       remat=cfg.remat, num_chunks=num_chunks)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     return jnp.einsum("bsd,dv->bsv", x, params["lm_head"],
                       preferred_element_type=jnp.float32)

@@ -289,15 +289,12 @@ class DataParallelTrainer:
         more worker bundle (reference: Train v2 consults ScalingPolicy
         every control-loop tick, controller.py:446). The actual larger
         reservation is re-validated by _reserve_gang on restart."""
+        from ..autoscaler.autoscaler import _fits
         if current_n >= self.scaling.num_workers:
             return False
         bundle = self.scaling.bundle()
-        frees = [dict(row["Available"]) for row in ray.nodes()
-                 if row["Alive"]]
-        for cap in frees:
-            if all(cap.get(k, 0) >= v - 1e-9 for k, v in bundle.items()):
-                return True
-        return False
+        return any(_fits(bundle, dict(row["Available"]))
+                   for row in ray.nodes() if row["Alive"])
 
     def _start_group(self, ray, run_name, bus, restore: Optional[Checkpoint]):
         import cloudpickle
@@ -388,35 +385,44 @@ class DataParallelTrainer:
         pg, workers, run_refs = self._start_group(ray, run_name, bus, restore)
         elastic = self.scaling.min_workers is not None
         next_grow_check = time.monotonic() + self.scaling.elastic_poll_s
+
+        def drain_reports():
+            nonlocal last_metrics
+            for rank, seq, metrics, ckpt_path in ray.get(
+                    bus.drain.remote()):
+                key = (generation, seq)
+                if ckpt_path and key not in seen_ckpt_seqs:
+                    seen_ckpt_seqs.add(key)
+                    manager.register(Checkpoint(ckpt_path), metrics)
+                if rank == 0:
+                    metrics_history.append(metrics)
+                    last_metrics = metrics
+
         try:
             while True:
                 done, pending = ray.wait(run_refs, num_returns=len(run_refs),
                                          timeout=0.25)
-                for rank, seq, metrics, ckpt_path in ray.get(
-                        bus.drain.remote()):
-                    key = (generation, seq)
-                    if ckpt_path and key not in seen_ckpt_seqs:
-                        seen_ckpt_seqs.add(key)
-                        manager.register(Checkpoint(ckpt_path), metrics)
-                    if rank == 0:
-                        metrics_history.append(metrics)
-                        last_metrics = metrics
+                drain_reports()
                 # mid-run elastic GROWTH: a shrunken gang widens as soon as
                 # capacity appears (node joined) — checkpoint, restart at
                 # the larger world size (reference Train v2: ScalingPolicy
-                # per control-loop iteration, controller.py:446). Runs
-                # AFTER the bus drain above so the restore point includes
-                # every checkpoint the old generation already reported,
-                # and stale reports can't collide with new-generation keys.
-                if elastic and len(workers) < self.scaling.num_workers \
+                # per control-loop iteration, controller.py:446). Only
+                # while workers are still running: a finished run's results
+                # must never be discarded for a restart.
+                if elastic and pending \
+                        and len(workers) < self.scaling.num_workers \
                         and time.monotonic() >= next_grow_check:
                     next_grow_check = (time.monotonic()
                                        + self.scaling.elastic_poll_s)
                     if self._gang_can_grow(ray, len(workers)):
                         prev_n = len(workers)
+                        # teardown FIRST, then drain: reports posted after
+                        # the loop-top drain still belong to the OLD
+                        # generation's key space
+                        self._teardown(ray, workers, pg)
+                        drain_reports()
                         generation += 1
                         restore = manager.latest or restore
-                        self._teardown(ray, workers, pg)
                         try:
                             pg, workers, run_refs = self._start_group(
                                 ray, run_name, bus, restore)
@@ -446,9 +452,10 @@ class DataParallelTrainer:
                         error = e
                         break
                     failures_left -= 1
+                    self._teardown(ray, workers, pg)
+                    drain_reports()   # residual old-generation reports
                     generation += 1
                     restore = manager.latest or restore
-                    self._teardown(ray, workers, pg)
                     pg, workers, run_refs = self._start_group(
                         ray, run_name, bus, restore)
                     continue

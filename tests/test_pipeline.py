@@ -109,3 +109,114 @@ def test_pipeline_composes_with_dp():
         h = jnp.tanh(h @ params["w"][i])
     np.testing.assert_allclose(np.asarray(got), np.asarray(h),
                                rtol=1e-5, atol=1e-5)
+
+
+class TestInterleaved:
+    """Breadth-first interleaved virtual stages (num_chunks=V): bubble
+    (S-1)/(V*M+S-1) instead of (S-1)/(M+S-1), same numerics."""
+
+    def _stage_setup(self, L=8, D=16, seed=0):
+        rng = np.random.RandomState(seed)
+        params = {"w": jnp.asarray(rng.randn(L, D, D) * 0.1, jnp.float32)}
+
+        def stage_fn(sp, h):
+            def body(h, w):
+                return jnp.tanh(h @ w), None
+            h, _ = jax.lax.scan(body, h, sp["w"])
+            return h
+
+        def sequential(x):
+            h = x
+            for i in range(L):
+                h = jnp.tanh(h @ params["w"][i])
+            return h
+
+        return params, stage_fn, sequential
+
+    def test_interleaved_matches_sequential(self):
+        from ray_tpu.parallel.pipeline import interleave_stages
+        mesh = build_mesh(MeshSpec(pp=2), devices=jax.devices()[:2])
+        S, V = 2, 2
+        params, stage_fn, sequential = self._stage_setup()
+        x = jnp.asarray(np.random.RandomState(1).randn(8, 16), jnp.float32)
+
+        stages = split_stages(params, S * V)          # 4 logical chunks
+        dev_major = interleave_stages(stages, S, V)
+        got = pipeline_apply(stage_fn, dev_major, x, mesh,
+                             num_microbatches=4, num_chunks=V)
+        np.testing.assert_allclose(np.asarray(got),
+                                   np.asarray(sequential(x)),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_interleaved_grads_match(self):
+        from ray_tpu.parallel.pipeline import interleave_stages
+        mesh = build_mesh(MeshSpec(pp=2), devices=jax.devices()[:2])
+        S, V = 2, 2
+        params, stage_fn, sequential = self._stage_setup()
+        x = jnp.asarray(np.random.RandomState(2).randn(4, 16), jnp.float32)
+        tgt = jnp.asarray(np.random.RandomState(3).randn(4, 16),
+                          jnp.float32)
+
+        def loss_pipelined(p):
+            dev_major = interleave_stages(split_stages(p, S * V), S, V)
+            y = pipeline_apply(stage_fn, dev_major, x, mesh,
+                               num_microbatches=2, num_chunks=V)
+            return jnp.mean((y - tgt) ** 2)
+
+        def loss_seq(p):
+            h = x
+            for i in range(p["w"].shape[0]):
+                h = jnp.tanh(h @ p["w"][i])
+            return jnp.mean((h - tgt) ** 2)
+
+        lp, gp = jax.value_and_grad(loss_pipelined)(params)
+        ls, gs = jax.value_and_grad(loss_seq)(params)
+        np.testing.assert_allclose(float(lp), float(ls), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(gp["w"]), np.asarray(gs["w"]),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_interleaved_v1_is_gpipe(self):
+        """num_chunks=1 must reproduce the plain GPipe result exactly."""
+        mesh = build_mesh(MeshSpec(pp=2), devices=jax.devices()[:2])
+        params, stage_fn, sequential = self._stage_setup(L=4)
+        x = jnp.asarray(np.random.RandomState(4).randn(8, 16), jnp.float32)
+        stages = split_stages(params, 2)
+        a = pipeline_apply(stage_fn, stages, x, mesh, num_microbatches=4)
+        b = pipeline_apply(stage_fn, stages, x, mesh, num_microbatches=4,
+                           num_chunks=1)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+    def test_interleaved_requires_divisible_microbatches(self):
+        mesh = build_mesh(MeshSpec(pp=2), devices=jax.devices()[:2])
+        params, stage_fn, _ = self._stage_setup()
+        from ray_tpu.parallel.pipeline import interleave_stages
+        dev_major = interleave_stages(split_stages(params, 4), 2, 2)
+        x = jnp.zeros((6, 16), jnp.float32)
+        with pytest.raises(ValueError, match="divisible"):
+            pipeline_apply(stage_fn, dev_major, x, mesh,
+                           num_microbatches=3, num_chunks=2)
+
+    def test_interleaved_single_device_mesh(self):
+        from ray_tpu.parallel.pipeline import interleave_stages
+        mesh = build_mesh(MeshSpec(pp=1), devices=jax.devices()[:1])
+        params, stage_fn, sequential = self._stage_setup(L=4)
+        x = jnp.asarray(np.random.RandomState(5).randn(4, 16), jnp.float32)
+        dev_major = interleave_stages(split_stages(params, 2), 1, 2)
+        got = pipeline_apply(stage_fn, dev_major, x, mesh,
+                             num_microbatches=2, num_chunks=2)
+        np.testing.assert_allclose(np.asarray(got),
+                                   np.asarray(sequential(x)),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_llama_interleaved_matches_apply(self):
+        mesh = build_mesh(MeshSpec(pp=2), devices=jax.devices()[:2])
+        cfg = _cfg()   # 4 layers -> S=2 x V=2 single-layer chunks
+        params = llama.init(jax.random.PRNGKey(0), cfg)
+        tokens = jnp.asarray(
+            np.random.RandomState(7).randint(0, cfg.vocab_size, (4, 16)),
+            jnp.int32)
+        want = llama.apply(params, tokens, cfg)
+        got = llama.apply_pipelined(params, tokens, cfg, mesh,
+                                    num_microbatches=2, num_chunks=2)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
