@@ -15,8 +15,8 @@ import cloudpickle
 
 from .ids import ActorID, ObjectID, TaskID
 from .ref import ObjectRef
-from .remote_function import (prepare_args, prepare_runtime_env,
-                              resolve_strategy)
+from .remote_function import (_trace_ctx, prepare_args,
+                              prepare_runtime_env, resolve_strategy)
 from .task_spec import ActorSpec, TaskSpec, validate_resources
 
 _DEFAULT_ACTOR_OPTS = dict(
@@ -133,6 +133,7 @@ class ActorMethod:
             actor_id=h._actor_id,
             method_name=self._name,
             concurrency_group=self._concurrency_group,
+            trace_ctx=_trace_ctx(),
         )
         refs = rt.submit_actor_task_spec(spec)
         if nret == 0:

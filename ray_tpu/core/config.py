@@ -130,6 +130,9 @@ _FLAGS = [
          "store fill fraction above which sealed objects spill to disk"),
     Flag("min_spilling_size", 1 << 20,
          "don't spill objects smaller than this (bytes)"),
+    Flag("tracing_enabled", False,
+         "propagate (trace_id, span_id) context through task submission "
+         "and record per-task spans in the timeline (util/tracing.py)"),
     Flag("transfer_chunk_bytes", 8 << 20,
          "cross-node object pulls move in pieces of this size: a transport "
          "failure resumes from the last good byte instead of restarting "

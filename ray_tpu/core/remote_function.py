@@ -39,6 +39,13 @@ def prepare_runtime_env(rt, renv: dict | None) -> dict | None:
     return prepared or None
 
 
+def _trace_ctx():
+    """Submitter's trace context for the outgoing spec (None when tracing
+    is off — util/tracing.py)."""
+    from ..util.tracing import context_for_submit
+    return context_for_submit()
+
+
 def _runtime():
     from . import runtime as rt
     r = rt.get_runtime_if_exists()
@@ -138,6 +145,7 @@ class RemoteFunction:
             retry_exceptions=bool(o["retry_exceptions"]),
             runtime_env=prepare_runtime_env(rt, o["runtime_env"]),
             dynamic_returns=dynamic,
+            trace_ctx=_trace_ctx(),
             **strat,
         )
         refs = rt.submit_task(spec)

@@ -711,7 +711,11 @@ class Runtime:
     def _handle_msg(self, wid: str, msg: dict):
         t = msg["t"]
         if t == "done":
+            if "span" in msg:
+                self.record_trace_span(msg["span"])
             self._on_task_done(wid, msg)
+        elif t == "trace_span":
+            self.record_trace_span(msg["span"])
         elif t == "actor_ready":
             self._on_actor_ready(wid, msg)
         elif t == "submit":
@@ -2516,6 +2520,21 @@ class Runtime:
                  "Labels": dict(n.labels), "NodeName": n.name}
                 for n in self.nodes.values()
             ]
+
+    def record_trace_span(self, rec: dict) -> None:
+        """A completed trace span (util/tracing.py) enters the timeline as
+        a chrome complete event whose args carry the trace/span/parent ids
+        — flow-stitchable across processes (reference:
+        tracing_helper.py:293 context-in-metadata)."""
+        with self.lock:
+            self.events.append({
+                "name": rec.get("name", "span"), "cat": "trace",
+                "ph": "X", "pid": rec.get("task_id", "driver"),
+                "ts": rec["start_s"] * 1e6,
+                "dur": rec.get("dur_s", 0.0) * 1e6,
+                "args": {k: rec[k] for k in
+                         ("trace_id", "span_id", "parent_id")
+                         if rec.get(k) is not None}})
 
     def timeline(self) -> list[dict]:
         with self.lock:

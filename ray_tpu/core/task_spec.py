@@ -44,6 +44,10 @@ class TaskSpec:
     # actor concurrency group this call runs in (transport
     # concurrency_group_manager.h analog)
     concurrency_group: Optional[str] = None
+    # (trace_id, parent_span_id) — the submitter's span, so the task's
+    # execution span parents correctly across processes (reference:
+    # tracing_helper.py:293 injects OTel context into task metadata)
+    trace_ctx: Optional[tuple] = None
 
     @property
     def is_actor_task(self) -> bool:

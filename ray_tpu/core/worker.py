@@ -458,12 +458,23 @@ class WorkerLoop:
         self._current_task_id = spec.task_id
         self.rt.current_task_name = spec.name
         t0 = time.time()
+        span_rec = None
         try:
             if self._renv_error is not None:
                 raise self._renv_error
             fn = self.rt.func_registry[spec.func_id]
             args, kwargs = self._resolve_args(spec.args_blob)
-            result = fn(*args, **kwargs)
+            tctx = getattr(spec, "trace_ctx", None)
+            if tctx is not None:
+                # child span of the submitter; tasks submitted inside fn
+                # inherit it (util/tracing.py; reference
+                # tracing_helper.py:326)
+                from ..util.tracing import activate
+                with activate(tctx, spec.name) as span_rec:
+                    span_rec["task_id"] = spec.task_id.hex()
+                    result = fn(*args, **kwargs)
+            else:
+                result = fn(*args, **kwargs)
             self._store_returns(spec, result)
             ok, err, retryable = True, None, False
         except BaseException as e:  # noqa: BLE001
@@ -486,6 +497,8 @@ class WorkerLoop:
         done_msg = {"t": "done", "task_id": spec.task_id, "ok": ok,
                     "err": err, "retryable": retryable, "name": spec.name,
                     "dur": time.time() - t0}
+        if span_rec is not None:
+            done_msg["span"] = span_rec
         if getattr(self, "_dynamic_items", None):
             done_msg["dynamic_items"] = self._dynamic_items
             self._dynamic_items = None
@@ -525,6 +538,7 @@ class WorkerLoop:
 
     def _run_actor_task(self, spec: TaskSpec):
         t0 = time.time()
+        span_rec = None
         try:
             group = getattr(spec, "concurrency_group", None)
             if group is not None and group not in self.group_pools:
@@ -549,10 +563,16 @@ class WorkerLoop:
                 args = args[1:]
             else:
                 method = getattr(self.actor_instance, spec.method_name)
+            tctx = getattr(spec, "trace_ctx", None)
             if asyncio.iscoroutinefunction(method):
                 fut = asyncio.run_coroutine_threadsafe(
                     method(*args, **kwargs), self.aio_loop)
                 result = fut.result()
+            elif tctx is not None:
+                from ..util.tracing import activate
+                with activate(tctx, spec.name) as span_rec:
+                    span_rec["task_id"] = spec.task_id.hex()
+                    result = method(*args, **kwargs)
             else:
                 result = method(*args, **kwargs)
             self._store_returns(spec, result)

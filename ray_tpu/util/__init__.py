@@ -18,13 +18,13 @@ from . import queue, scheduling_strategies
 __all__ = [
     "ActorPool", "PlacementGroup", "placement_group",
     "placement_group_table", "remove_placement_group", "queue",
-    "scheduling_strategies", "collective", "tpu",
+    "scheduling_strategies", "collective", "tpu", "tracing",
 ]
 
 
 def __getattr__(name):
     # Lazy (PEP 562): keep `import ray_tpu` light for worker startup —
     # collective pulls in numpy and the parallel package.
-    if name in ("collective", "tpu"):
+    if name in ("collective", "tpu", "tracing"):
         return importlib.import_module(f".{name}", __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

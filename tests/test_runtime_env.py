@@ -12,9 +12,13 @@ def ray(ray_start_regular):
     return ray_start_regular
 
 
-def test_validate_rejects_pip_and_unknown():
-    with pytest.raises(ValueError, match="hermetic"):
-        renv_mod.validate({"pip": ["requests"]})
+def test_validate_rejects_conda_and_unknown():
+    # pip/uv became a real backend (test_runtime_env_pip.py); the
+    # no-interpreter-swap keys still refuse loudly
+    with pytest.raises(ValueError, match="not supported"):
+        renv_mod.validate({"conda": {"dependencies": ["x"]}})
+    with pytest.raises(ValueError, match="not supported"):
+        renv_mod.validate({"container": {"image": "x"}})
     with pytest.raises(ValueError, match="unknown"):
         renv_mod.validate({"bogus_key": 1})
     with pytest.raises(TypeError):
