@@ -45,10 +45,15 @@ def _sub(capacity: dict, demand: dict) -> None:
         capacity[k] = capacity.get(k, 0.0) - v
 
 
-def _gang_fits(gang: list[dict], hosts: int, per_host: dict) -> bool:
+def _gang_fits(gang: list[dict], hosts: int, per_host: dict,
+               strategy: str = "PACK") -> bool:
     """Can `gang`'s bundles bin-pack onto `hosts` hosts of `per_host`
-    resources? (First-fit-decreasing — PACK-style gangs may put several
-    bundles on one host, not just one-bundle-per-host.)"""
+    resources? PACK and soft-SPREAD gangs may put several bundles on one
+    host (the runtime's placer doubles up soft SPREAD when short on
+    nodes); STRICT_SPREAD is exactly one bundle per host, so a slice
+    with fewer hosts than bundles can never satisfy it."""
+    if strategy == "STRICT_SPREAD":
+        return hosts >= len(gang) and all(_fits(b, per_host) for b in gang)
     bins = [dict(per_host) for _ in range(hosts)]
     for b in sorted(gang, key=lambda d: -sum(d.values())):
         for cap in bins:
@@ -103,12 +108,13 @@ class Autoscaler:
                     demands.extend(dict(b.resources) for b in pg.bundles)
         return [d for d in demands if d]
 
-    def pending_gangs(self) -> list[list[dict]]:
-        """Bundle lists of pending same-label (slice-constrained) PGs.
-        These can only be satisfied by launching a whole slice instance,
-        so they are planned as units, never as loose bundles."""
+    def pending_gangs(self) -> list[tuple[list[dict], str]]:
+        """(bundles, strategy) of pending same-label (slice-constrained)
+        PGs. These can only be satisfied by launching a whole slice
+        instance, so they are planned as units, never as loose bundles.
+        The strategy matters: SPREAD gangs need hosts >= bundles."""
         with self.rt.lock:
-            return [[dict(b.resources) for b in pg.bundles]
+            return [([dict(b.resources) for b in pg.bundles], pg.strategy)
                     for pg in self.rt.pgs.values()
                     if pg.state == "pending" and pg.same_label]
 
@@ -180,9 +186,9 @@ class Autoscaler:
         # slice per tick.
         gangs = self.pending_gangs()
         in_flight = list(booting_types)
-        for gang in gangs:
+        for gang, strategy in gangs:
             def covers(t: NodeTypeConfig) -> bool:
-                return _gang_fits(gang, t.hosts, t.resources)
+                return _gang_fits(gang, t.hosts, t.resources, strategy)
             hit = next((tn for tn in in_flight
                         if covers(self.node_types[tn])), None)
             if hit is not None:

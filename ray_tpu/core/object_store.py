@@ -19,6 +19,7 @@ import mmap
 import os
 import pickle
 import struct
+import sys
 from typing import Any, Optional
 
 import cloudpickle
@@ -369,7 +370,9 @@ class SharedObjectStore:
         pin-deferred delete."""
         from .config import cfg
         if zero_copy is None:
-            zero_copy = cfg.zero_copy_get
+            # _PinnedBuffer needs __buffer__ (PEP 688, CPython >= 3.12);
+            # older interpreters silently fall back to the copy path
+            zero_copy = cfg.zero_copy_get and sys.version_info >= (3, 12)
         view = self.get_raw(oid, timeout_ms)
         if view is None:
             raise GetTimeoutError(f"timed out waiting for {oid}")

@@ -564,17 +564,25 @@ class WorkerLoop:
             else:
                 method = getattr(self.actor_instance, spec.method_name)
             tctx = getattr(spec, "trace_ctx", None)
-            if asyncio.iscoroutinefunction(method):
-                fut = asyncio.run_coroutine_threadsafe(
-                    method(*args, **kwargs), self.aio_loop)
-                result = fut.result()
-            elif tctx is not None:
+
+            def _invoke():
+                # async methods run on the actor's event loop; the span
+                # wraps the synchronous wait so sync and async methods
+                # both trace (reference tracing_helper.py:407 wraps all
+                # actor methods regardless of kind)
+                if asyncio.iscoroutinefunction(method):
+                    fut = asyncio.run_coroutine_threadsafe(
+                        method(*args, **kwargs), self.aio_loop)
+                    return fut.result()
+                return method(*args, **kwargs)
+
+            if tctx is not None:
                 from ..util.tracing import activate
                 with activate(tctx, spec.name) as span_rec:
                     span_rec["task_id"] = spec.task_id.hex()
-                    result = method(*args, **kwargs)
+                    result = _invoke()
             else:
-                result = method(*args, **kwargs)
+                result = _invoke()
             self._store_returns(spec, result)
             ok, err = True, None
         except BaseException as e:  # noqa: BLE001
@@ -588,9 +596,12 @@ class WorkerLoop:
                     self.store.put(oid, werr, is_exception=True)
                 except Exception:
                     pass
-        self.rt.send({"t": "done", "task_id": spec.task_id, "ok": ok,
-                      "err": err, "retryable": False, "name": spec.name,
-                      "dur": time.time() - t0})
+        done_msg = {"t": "done", "task_id": spec.task_id, "ok": ok,
+                    "err": err, "retryable": False, "name": spec.name,
+                    "dur": time.time() - t0}
+        if span_rec is not None:
+            done_msg["span"] = span_rec
+        self.rt.send(done_msg)
 
     def _cancel_current(self, task_id):
         """Best-effort cooperative cancel: raise TaskCancelledError inside the
