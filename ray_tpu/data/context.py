@@ -13,6 +13,11 @@ class DataContext:
     # max concurrently in-flight block tasks per executing dataset
     max_tasks_in_flight: int = 16
     read_default_num_blocks: int = 8
+    # actor-pool autoscaling (reference: _internal/execution/autoscaler/
+    # default_autoscaler.py): scale UP when every active actor has at
+    # least this many calls queued; scale DOWN when more than half the
+    # pool sits idle
+    actor_pool_scale_up_queued: int = 2
 
     _instance = None
 

@@ -105,17 +105,21 @@ def _add_column_block(name, fn, batch):
                                .column(name))
 
 
-def _write_block(path_template, fmt, index, batch):
+def _write_block(fs_, path_template, fmt, index, batch):
+    # shard writes stream through the filesystem's output stream, so
+    # gs://-style destinations never stage a local copy (reference:
+    # file_datasink.py write path through pyarrow fs)
     import pyarrow.csv as pcsv
     import pyarrow.parquet as pq
-    import pyarrow.json  # noqa: F401
     path = path_template.format(i=index)
-    if fmt == "parquet":
-        pq.write_table(batch, path)
-    elif fmt == "csv":
-        pcsv.write_csv(batch, path)
-    elif fmt == "json":
-        batch.to_pandas().to_json(path, orient="records", lines=True)
+    with fs_.open_output_stream(path) as f:
+        if fmt == "parquet":
+            pq.write_table(batch, f)
+        elif fmt == "csv":
+            pcsv.write_csv(batch, f)
+        elif fmt == "json":
+            f.write(batch.to_pandas().to_json(
+                orient="records", lines=True).encode("utf-8"))
     return path
 
 
@@ -143,7 +147,10 @@ class Dataset:
         (``compute=ActorPoolStrategy(size=n)`` / ``concurrency=n`` with a
         callable CLASS): the class is constructed once per pool actor —
         model weights load once, batches stream through (reference:
-        ActorPoolMapOperator)."""
+        ActorPoolMapOperator). ``concurrency=(min, max)`` (or an
+        ActorPoolStrategy with min_size/max_size) makes the pool
+        AUTOSCALING between the two from queue depth (reference:
+        autoscaler/default_autoscaler.py)."""
         if compute is None and concurrency is None:
             return self._block_op(
                 functools.partial(_map_batches_block, fn, batch_format),
@@ -151,12 +158,24 @@ class Dataset:
         import cloudpickle
 
         from .executor import ActorPoolOp
-        size = concurrency or getattr(compute, "size", None) or 2
+        if isinstance(concurrency, (tuple, list)):
+            size, max_size = int(concurrency[0]), int(concurrency[1])
+            if size < 1 or max_size < size:
+                raise ValueError(
+                    f"concurrency=(min, max) needs 1 <= min <= max, "
+                    f"got {concurrency!r}")
+        else:
+            size = concurrency or getattr(compute, "size", None) or 2
+            max_size = size
+            if compute is not None and getattr(compute, "min_size", None):
+                size = compute.min_size
+                max_size = compute.max_size or size
         wrap = functools.partial(_call_batch_block, batch_format)
         blob = cloudpickle.dumps((fn, tuple(fn_constructor_args),
                                   fn_constructor_kwargs or {}, wrap))
         return Dataset(ActorPoolOp(self._plan, blob, int(size),
-                                   "MapBatches(actors)"), self._ctx)
+                                   "MapBatches(actors)",
+                                   max_size=int(max_size)), self._ctx)
 
     def map(self, fn: Callable[[dict], dict]) -> "Dataset":
         return self._block_op(functools.partial(_map_rows_block, fn), "Map")
@@ -315,24 +334,29 @@ class Dataset:
 
     # -- writes -----------------------------------------------------------
 
-    def _write(self, path: str, fmt: str, ext: str) -> list[str]:
-        import os
+    def _write(self, path: str, fmt: str, ext: str,
+               filesystem=None) -> list[str]:
+        import posixpath
+
         import ray_tpu
-        os.makedirs(path, exist_ok=True)
-        tmpl = os.path.join(path, f"part-{{i:05d}}.{ext}")
+        from ..util.fs import makedirs, resolve
+        fs_, root = resolve(path, filesystem)
+        makedirs(fs_, root)
+        tmpl = posixpath.join(root.replace("\\", "/"),
+                              f"part-{{i:05d}}.{ext}")
         write = ray_tpu.remote(_write_block)
-        refs = [write.remote(tmpl, fmt, i, ref)
+        refs = [write.remote(fs_, tmpl, fmt, i, ref)
                 for i, (ref, _) in enumerate(self._execute())]
         return ray_tpu.get(refs)
 
-    def write_parquet(self, path: str) -> list[str]:
-        return self._write(path, "parquet", "parquet")
+    def write_parquet(self, path: str, *, filesystem=None) -> list[str]:
+        return self._write(path, "parquet", "parquet", filesystem)
 
-    def write_csv(self, path: str) -> list[str]:
-        return self._write(path, "csv", "csv")
+    def write_csv(self, path: str, *, filesystem=None) -> list[str]:
+        return self._write(path, "csv", "csv", filesystem)
 
-    def write_json(self, path: str) -> list[str]:
-        return self._write(path, "json", "json")
+    def write_json(self, path: str, *, filesystem=None) -> list[str]:
+        return self._write(path, "json", "json", filesystem)
 
     def stats(self) -> str:
         pairs = self._execute()

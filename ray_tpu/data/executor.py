@@ -81,13 +81,16 @@ class ActorPoolOp(LogicalOp):
     ActorPoolMapOperator, _internal/execution/operators/actor_map_operator.py
     + ActorPoolStrategy). The fn is a CLASS: constructed once per actor
     (model load happens once), called per batch. Breaks block-op fusion
-    above it; downstream block fns ride along into the actor call."""
+    above it; downstream block fns ride along into the actor call.
+    The pool autoscales between min_size and max_size from queue depth
+    (reference: autoscaler/default_autoscaler.py try_trigger_scaling)."""
 
     def __init__(self, input_op: LogicalOp, fn_blob: bytes, size: int,
-                 name: str):
+                 name: str, max_size: Optional[int] = None):
         super().__init__(name, [input_op])
         self.fn_blob = fn_blob      # cloudpickle((cls, args, kwargs, wrap))
-        self.size = size
+        self.size = size            # initial/min pool size
+        self.max_size = max_size or size
 
 
 # ---------------------------------------------------------------------------
@@ -274,6 +277,8 @@ class Executor:
         self.max_in_flight_seen = 0
         # ticks where store pressure shrank the submission window
         self.backpressure_events = 0
+        # actor-pool scale up/down decisions (observable by tests/stats)
+        self.autoscale_events: list[dict] = []
 
     @staticmethod
     def _store_pressured(ray) -> bool:
@@ -339,29 +344,65 @@ class Executor:
 
     def _execute_actor_pool(self, node: ActorPoolOp, fused, window):
         """Stream upstream blocks through a pool of stateful map actors,
-        round-robin, preserving plan order; pool lives for the run."""
+        least-loaded dispatch, preserving plan order; the pool autoscales
+        between node.size and node.max_size from queue depth inside the
+        streaming loop (reference: autoscaler/default_autoscaler.py:26,
+        try_trigger_scaling :50 over autoscaling_actor_pool.py metrics)."""
         ray = _ray()
         worker_cls = ray.remote(_ActorMapWorker)
-        pool = [worker_cls.remote(node.fn_blob) for _ in range(node.size)]
+        lo, hi = node.size, max(node.max_size, node.size)
+        up_at = max(1, self.ctx.actor_pool_scale_up_queued)
+        pool = [worker_cls.remote(node.fn_blob) for _ in range(lo)]
+        RETIRED = float("inf")
+        outstanding: list[float] = [0] * lo   # per-actor queued calls
+        owner: dict[int, int] = {}            # submit seq -> actor index
+        seq = {"n": 0}
+
+        def active() -> list[int]:
+            return [j for j, o in enumerate(outstanding) if o != RETIRED]
+
+        def make_thunk(ref):
+            def thunk():
+                i = min(active(), key=outstanding.__getitem__)
+                if outstanding[i] >= up_at and len(active()) < hi:
+                    # every live actor is backed up: grow the pool
+                    pool.append(worker_cls.remote(node.fn_blob))
+                    outstanding.append(0)
+                    i = len(pool) - 1
+                    self.autoscale_events.append(
+                        {"op": node.name, "event": "up",
+                         "size": len(active())})
+                k = seq["n"]
+                seq["n"] += 1
+                owner[k] = i
+                outstanding[i] += 1
+                return pool[i].map.options(num_returns=2).remote(fused, ref)
+            return thunk
+
         try:
-            counter = {"i": 0}
-
-            def make_thunk(ref):
-                def thunk():
-                    i = counter["i"] % len(pool)
-                    counter["i"] += 1
-                    resp = pool[i].map.options(num_returns=2).remote(
-                        fused, ref)
-                    return resp
-                return thunk
-
             upstream = self.execute_streaming(node.inputs[0], window=window)
             thunks = (make_thunk(ref) for ref, _ in upstream)
-            yield from self._stream(thunks, window)
+            for k, pair in enumerate(self._stream(thunks, window)):
+                outstanding[owner.pop(k)] -= 1
+                live = active()
+                idle = [j for j in live if outstanding[j] == 0]
+                if len(live) > lo and len(idle) > len(live) // 2:
+                    # over half the pool idle: retire one actor down
+                    # toward min (never below)
+                    j = idle[-1]
+                    outstanding[j] = RETIRED
+                    self.autoscale_events.append(
+                        {"op": node.name, "event": "down",
+                         "size": len(active())})
+                    try:
+                        ray.kill(pool[j])
+                    except Exception:
+                        pass
+                yield pair
         finally:
-            for a in pool:
+            for j in active():
                 try:
-                    ray.kill(a)
+                    ray.kill(pool[j])
                 except Exception:
                     pass
 

@@ -5,8 +5,6 @@ from __future__ import annotations
 
 import builtins
 import functools
-import glob as globmod
-import os
 from typing import Optional
 
 import numpy as np
@@ -96,73 +94,63 @@ def from_arrow(table) -> Dataset:
 
 
 # -- file sources -----------------------------------------------------------
+#
+# Paths resolve through pyarrow filesystems (util/fs.py), so every reader
+# accepts local paths, globs, directories, and gs://, s3://, file:// URIs,
+# or an explicit `filesystem=` (reference:
+# data/datasource/file_based_datasource.py + path_util.py). The resolved
+# filesystem object is pickled into each read task, so workers open the
+# file on whatever store it lives on.
 
-def _expand_paths(paths) -> list[str]:
-    if isinstance(paths, str):
-        paths = [paths]
-    out: list[str] = []
-    for p in paths:
-        if os.path.isdir(p):
-            out.extend(sorted(
-                f for f in globmod.glob(os.path.join(p, "**", "*"),
-                                        recursive=True)
-                if os.path.isfile(f)))
-        elif any(c in p for c in "*?["):
-            out.extend(sorted(globmod.glob(p)))
-        else:
-            out.append(p)
-    if not out:
-        raise FileNotFoundError(f"no files matched {paths!r}")
-    return out
-
-
-def _read_parquet_task(path):
+def _read_parquet_task(fs_, path):
     import pyarrow.parquet as pq
-    return pq.read_table(path)
+    return pq.read_table(path, filesystem=fs_)
 
 
-def _read_csv_task(path):
+def _read_csv_task(fs_, path):
     import pyarrow.csv as pcsv
-    return pcsv.read_csv(path)
+    with fs_.open_input_stream(path) as f:
+        return pcsv.read_csv(f)
 
 
-def _read_json_task(path):
+def _read_json_task(fs_, path):
+    import io
+
     import pandas as pd
     import pyarrow as pa
-    df = pd.read_json(path, lines=path.endswith((".jsonl", ".ndjson"))
-                      or _is_jsonl(path))
+    from ..util.fs import read_bytes
+    raw = read_bytes(fs_, path)
+    lines = (path.endswith((".jsonl", ".ndjson"))
+             or not raw.lstrip().startswith(b"["))
+    df = pd.read_json(io.BytesIO(raw), lines=lines)
     return pa.Table.from_pandas(df, preserve_index=False)
 
 
-def _is_jsonl(path) -> bool:
-    with open(path, "rb") as f:
-        head = f.read(4096).lstrip()
-    return not head.startswith(b"[")
-
-
-def _read_text_task(path):
-    with open(path) as f:
-        lines = [ln.rstrip("\n") for ln in f]
+def _read_text_task(fs_, path):
+    from ..util.fs import read_bytes
+    lines = read_bytes(fs_, path).decode("utf-8").splitlines()
     return B.from_batch({"text": lines})
 
 
-def _file_dataset(paths, task_fn, name) -> Dataset:
-    files = _expand_paths(paths)
-    return Dataset(Read([functools.partial(task_fn, f) for f in files],
+def _file_dataset(paths, filesystem, task_fn, name) -> Dataset:
+    from ..util.fs import expand_paths
+    fs_, files = expand_paths(paths, filesystem)
+    return Dataset(Read([functools.partial(task_fn, fs_, f) for f in files],
                         name=name))
 
 
-def read_parquet(paths, **_ignored) -> Dataset:
-    return _file_dataset(paths, _read_parquet_task, "ReadParquet")
+def read_parquet(paths, *, filesystem=None, **_ignored) -> Dataset:
+    return _file_dataset(paths, filesystem, _read_parquet_task,
+                         "ReadParquet")
 
 
-def read_csv(paths, **_ignored) -> Dataset:
-    return _file_dataset(paths, _read_csv_task, "ReadCSV")
+def read_csv(paths, *, filesystem=None, **_ignored) -> Dataset:
+    return _file_dataset(paths, filesystem, _read_csv_task, "ReadCSV")
 
 
-def read_json(paths, **_ignored) -> Dataset:
-    return _file_dataset(paths, _read_json_task, "ReadJSON")
+def read_json(paths, *, filesystem=None, **_ignored) -> Dataset:
+    return _file_dataset(paths, filesystem, _read_json_task, "ReadJSON")
 
 
-def read_text(paths, **_ignored) -> Dataset:
-    return _file_dataset(paths, _read_text_task, "ReadText")
+def read_text(paths, *, filesystem=None, **_ignored) -> Dataset:
+    return _file_dataset(paths, filesystem, _read_text_task, "ReadText")

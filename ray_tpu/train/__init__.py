@@ -24,7 +24,7 @@ from .config import (
     RunConfig,
     ScalingConfig,
 )
-from .checkpoint import Checkpoint
+from .checkpoint import Checkpoint, CheckpointManager
 from .session import (
     get_checkpoint,
     get_context,
@@ -36,7 +36,7 @@ from .trainer import (DataParallelTrainer, JaxTrainer, Result,
                       TorchTrainer, TrainingFailedError)
 
 __all__ = [
-    "Checkpoint", "CheckpointConfig", "FailureConfig", "RunConfig",
+    "Checkpoint", "CheckpointManager", "CheckpointConfig", "FailureConfig", "RunConfig",
     "ScalingConfig", "get_checkpoint", "get_context", "get_dataset_shard",
     "report", "TrainContext", "DataParallelTrainer", "JaxTrainer",
     "TorchTrainer", "Result",

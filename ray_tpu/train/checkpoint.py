@@ -1,21 +1,27 @@
-"""Checkpoints: directory handles + pytree helpers + manager.
+"""Checkpoints: storage handles + pytree helpers + manager.
 
-Reference parity: python/ray/train/_checkpoint.py:56 (Checkpoint — a handle
-on a checkpoint directory), train/v2/_internal/execution/checkpoint/
+Reference parity: python/ray/train/_checkpoint.py:56 (Checkpoint — "a
+directory on local or remote (e.g. cloud) storage" accessed through
+pyarrow filesystems), train/v2/_internal/execution/checkpoint/
 checkpoint_manager.py (latest/best tracking, num_to_keep pruning).
 
-TPU-native difference: model state is a jax pytree; `from_state/load_state`
-(de)serialize with flax.serialization msgpack — zero-copy friendly and
-framework-consistent — instead of torch.save.
+TPU-native differences: model state is a jax pytree; `from_state/
+load_state` (de)serialize with flax.serialization msgpack — zero-copy
+friendly and framework-consistent — instead of torch.save. Paths may be
+local, ``file://``, or ``gs://``/``s3://`` URIs (util/fs.py resolver);
+GCS is the storage tier adjacent to TPU pods, so cloud checkpoints are
+first-class, and the orbax backend hands ``gs://`` URIs straight to
+tensorstore for shard-parallel multi-host writes.
 """
 from __future__ import annotations
 
 import json
 import os
 import pickle
-import shutil
 import tempfile
 from typing import Any, Optional
+
+from ..util import fs as fsutil
 
 _STATE_FILE = "state.msgpack"
 _TREE_FILE = "treedef.pkl"
@@ -23,65 +29,90 @@ _METADATA_FILE = "_metadata.json"
 
 
 class Checkpoint:
-    """Handle on a checkpoint directory (reference: _checkpoint.py:56)."""
+    """Handle on a checkpoint directory — local path or storage URI
+    (reference: _checkpoint.py:56)."""
 
-    def __init__(self, path: str):
-        self.path = os.path.abspath(path)
+    def __init__(self, path: str, filesystem=None):
+        self.path = path if (filesystem is not None or fsutil.is_uri(path)) \
+            else os.path.abspath(path)
+        self._filesystem = filesystem
+        self._local_cache: Optional[str] = None
+
+    def _resolved(self):
+        return fsutil.resolve(self.path, self._filesystem)
+
+    @property
+    def filesystem(self):
+        return self._resolved()[0]
 
     @classmethod
     def from_directory(cls, path: str) -> "Checkpoint":
         return cls(path)
 
+    @classmethod
+    def from_uri(cls, uri: str, filesystem=None) -> "Checkpoint":
+        return cls(uri, filesystem=filesystem)
+
     def as_directory(self) -> str:
-        return self.path
+        """A local directory view: the path itself when local, otherwise a
+        one-time download (cached for the handle's lifetime)."""
+        fs_, p = self._resolved()
+        if fsutil.is_local(fs_):
+            return p
+        if self._local_cache is None:
+            self._local_cache = fsutil.download_dir(fs_, p)
+        return self._local_cache
 
     def to_directory(self, path: Optional[str] = None) -> str:
-        dst = path or tempfile.mkdtemp(prefix="rtpu_ckpt_")
-        if os.path.abspath(dst) != self.path:
-            shutil.copytree(self.path, dst, dirs_exist_ok=True)
+        from pyarrow.fs import LocalFileSystem
+        dst = os.path.abspath(path or tempfile.mkdtemp(prefix="rtpu_ckpt_"))
+        fs_, p = self._resolved()
+        if not (fsutil.is_local(fs_) and p == dst):
+            fsutil.copy_tree(fs_, p, LocalFileSystem(), dst)
         return dst
 
     # -- pytree helpers ----------------------------------------------------
 
     @classmethod
     def from_state(cls, state: Any, path: Optional[str] = None,
-                   metadata: Optional[dict] = None) -> "Checkpoint":
+                   metadata: Optional[dict] = None,
+                   filesystem=None) -> "Checkpoint":
         """Serialize a jax pytree (params/opt state/step...) to a new
-        checkpoint directory."""
+        checkpoint directory (local or URI)."""
         import jax
         from flax import serialization
-        d = path or tempfile.mkdtemp(prefix="rtpu_ckpt_")
-        os.makedirs(d, exist_ok=True)
+        ckpt = cls(path or tempfile.mkdtemp(prefix="rtpu_ckpt_"),
+                   filesystem=filesystem)
+        fs_, d = ckpt._resolved()
+        fsutil.makedirs(fs_, d)
         state = jax.device_get(state)
-        with open(os.path.join(d, _STATE_FILE), "wb") as f:
-            f.write(serialization.to_bytes(state))
-        with open(os.path.join(d, _TREE_FILE), "wb") as f:
-            pickle.dump(jax.tree.structure(state), f)
+        fsutil.write_bytes(fs_, fsutil.join(d, _STATE_FILE),
+                           serialization.to_bytes(state))
+        fsutil.write_bytes(fs_, fsutil.join(d, _TREE_FILE),
+                           pickle.dumps(jax.tree.structure(state)))
         if metadata is not None:
-            with open(os.path.join(d, _METADATA_FILE), "w") as f:
-                json.dump(metadata, f)
-        return cls(d)
+            fsutil.write_bytes(fs_, fsutil.join(d, _METADATA_FILE),
+                               json.dumps(metadata).encode("utf-8"))
+        return ckpt
 
     def load_state(self, target: Any = None) -> Any:
         """Restore the pytree. With `target` (a template pytree), restores
         into its exact structure/dtypes; without, returns the raw tree."""
         from flax import serialization
-        with open(os.path.join(self.path, _STATE_FILE), "rb") as f:
-            blob = f.read()
+        fs_, d = self._resolved()
+        blob = fsutil.read_bytes(fs_, fsutil.join(d, _STATE_FILE))
         if target is not None:
             return serialization.from_bytes(target, blob)
         state_dict = serialization.msgpack_restore(blob)
-        tree_path = os.path.join(self.path, _TREE_FILE)
-        if os.path.exists(tree_path):
+        tree_path = fsutil.join(d, _TREE_FILE)
+        if fsutil.isfile(fs_, tree_path):
             import jax
-            with open(tree_path, "rb") as f:
-                treedef = pickle.load(f)
+            treedef = pickle.loads(fsutil.read_bytes(fs_, tree_path))
             try:
-                flat = state_dict
                 # msgpack_restore returns nested dicts keyed "0","1",... for
                 # sequences; from_state wrote a dict pytree so unflatten works
                 return jax.tree.unflatten(
-                    treedef, jax.tree.leaves(flat))
+                    treedef, jax.tree.leaves(state_dict))
             except Exception:
                 pass
         return state_dict
@@ -89,6 +120,28 @@ class Checkpoint:
     # -- orbax backend (sharded/multi-host pytrees) ------------------------
 
     _ORBAX_DIR = "orbax_state"
+
+    def _orbax_path(self) -> str:
+        """Orbax/tensorstore consumes local paths and gs:// URIs natively
+        (each host writes only ITS shards — no staging copy). Other remote
+        filesystems would need a download/upload staging pass; reject them
+        explicitly rather than silently staging a multi-host tree."""
+        if self._filesystem is not None:
+            fs_, p = self._resolved()
+            if fsutil.is_local(fs_):
+                return fsutil.join(p, self._ORBAX_DIR)
+            raise ValueError(
+                "orbax backend supports local paths and gs:// URIs, got "
+                f"an explicit {type(fs_).__name__}")
+        if not fsutil.is_uri(self.path) or self.path.startswith(
+                ("file://", "gs://")):
+            p = self.path
+            if p.startswith("file://"):
+                p = self._resolved()[1]
+            return fsutil.join(p, self._ORBAX_DIR)
+        raise ValueError(
+            f"orbax backend supports local paths and gs:// URIs, "
+            f"got {self.path!r}")
 
     @classmethod
     def from_state_orbax(cls, state: Any, path: Optional[str] = None,
@@ -105,67 +158,85 @@ class Checkpoint:
             raise ValueError(
                 "from_state_orbax needs an explicit shared-filesystem "
                 "path on multi-host deployments")
-        d = os.path.abspath(path or tempfile.mkdtemp(prefix="rtpu_ckpt_"))
-        os.makedirs(d, exist_ok=True)
+        ckpt = cls(path or tempfile.mkdtemp(prefix="rtpu_ckpt_"))
+        dst = ckpt._orbax_path()
+        if not fsutil.is_uri(dst):
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
         with ocp.StandardCheckpointer() as ckptr:
             # force=True: overwrite like the msgpack backend (callers
             # re-checkpoint into fixed 'latest' dirs)
-            ckptr.save(os.path.join(d, cls._ORBAX_DIR), state, force=True)
+            ckptr.save(dst, state, force=True)
             ckptr.wait_until_finished()
         if metadata is not None:
-            with open(os.path.join(d, _METADATA_FILE), "w") as f:
-                json.dump(metadata, f)
-        return cls(d)
+            fs_, d = ckpt._resolved()
+            fsutil.write_bytes(fs_, fsutil.join(d, _METADATA_FILE),
+                               json.dumps(metadata).encode("utf-8"))
+        return ckpt
 
     def load_state_orbax(self, target: Any = None) -> Any:
         """Restore an orbax checkpoint. ``target`` may be a pytree of
         jax.ShapeDtypeStruct (with shardings) to restore each array
         directly onto its mesh placement — the multi-host resume path."""
         import orbax.checkpoint as ocp
-        src = os.path.join(self.path, self._ORBAX_DIR)
+        src = self._orbax_path()
         with ocp.StandardCheckpointer() as ckptr:
             if target is not None:
                 return ckptr.restore(src, target)
             return ckptr.restore(src)
 
     def has_orbax_state(self) -> bool:
-        return os.path.isdir(os.path.join(self.path, self._ORBAX_DIR))
+        fs_, d = self._resolved()
+        return fsutil.isdir(fs_, fsutil.join(d, self._ORBAX_DIR))
 
     def metadata(self) -> dict:
-        p = os.path.join(self.path, _METADATA_FILE)
-        if os.path.exists(p):
-            with open(p) as f:
-                return json.load(f)
+        fs_, d = self._resolved()
+        p = fsutil.join(d, _METADATA_FILE)
+        if fsutil.isfile(fs_, p):
+            return json.loads(fsutil.read_bytes(fs_, p))
         return {}
 
     def __repr__(self):
         return f"Checkpoint({self.path})"
 
     def __reduce__(self):
-        return (Checkpoint, (self.path,))
+        return (_rebuild_checkpoint, (self.path, self._filesystem))
+
+
+def _rebuild_checkpoint(path, filesystem):
+    return Checkpoint(path, filesystem=filesystem)
 
 
 class CheckpointManager:
     """Tracks reported checkpoints; prunes to num_to_keep keeping latest and
-    best (reference: checkpoint_manager.py)."""
+    best (reference: checkpoint_manager.py). `storage_dir` may be a local
+    path or a storage URI — managed copies stream shard-by-shard through
+    the filesystem (no whole-tree staging)."""
 
     def __init__(self, storage_dir: str, num_to_keep: Optional[int] = None,
                  score_attribute: Optional[str] = None,
-                 score_order: str = "max"):
+                 score_order: str = "max", filesystem=None):
         self.dir = storage_dir
-        os.makedirs(storage_dir, exist_ok=True)
+        self._filesystem = filesystem
+        self._fs, self._fs_dir = fsutil.resolve(storage_dir, filesystem)
+        fsutil.makedirs(self._fs, self._fs_dir)
         self.num_to_keep = num_to_keep
         self.score_attribute = score_attribute
         self.score_order = score_order
         self.history: list[tuple[Checkpoint, dict]] = []
+        self._seq = 0  # monotonic: pruning must never reuse a dir name
 
     def register(self, ckpt: Checkpoint, metrics: dict) -> Checkpoint:
         """Persist a reported checkpoint into managed storage."""
-        idx = len(self.history)
-        dst = os.path.join(self.dir, f"checkpoint_{idx:06d}")
-        if os.path.abspath(ckpt.path) != dst:
-            shutil.copytree(ckpt.path, dst, dirs_exist_ok=True)
-        managed = Checkpoint(dst)
+        name = f"checkpoint_{self._seq:06d}"
+        self._seq += 1
+        dst_fs_path = fsutil.join(self._fs_dir, name)
+        src_fs, src_path = ckpt._resolved()
+        same = (type(src_fs) is type(self._fs)
+                and src_path.rstrip("/") == dst_fs_path.rstrip("/"))
+        if not same:
+            fsutil.copy_tree(src_fs, src_path, self._fs, dst_fs_path)
+        managed = Checkpoint(fsutil.join(self.dir, name),
+                             filesystem=self._filesystem)
         self.history.append((managed, dict(metrics)))
         self._prune()
         return managed
@@ -199,4 +270,11 @@ class CheckpointManager:
                 dropped.append(c)
         self.history = list(reversed(kept))
         for c in dropped:
-            shutil.rmtree(c.path, ignore_errors=True)
+            # best-effort: a transient storage error pruning an OLD
+            # checkpoint must not fail the register() that just persisted
+            # a new one
+            try:
+                fs_, p = c._resolved()
+                fsutil.delete_dir(fs_, p)
+            except Exception:
+                pass
