@@ -142,10 +142,22 @@ def glob_files(fs_, pattern: str) -> list[str]:
     base = "/".join(parts[:i])
     rest = parts[i:]
     if "**" in rest:
-        # recursive pattern: full listing from the prefix + whole-path match
+        # recursive pattern: full listing from the prefix + whole-path
+        # match. glob.glob's "**/" means ZERO or more directories, so
+        # match against every variant with "**/" elided too.
+        variants = {pat}
+        frontier = [pat]
+        while frontier:
+            p = frontier.pop()
+            if "**/" in p:
+                q = p.replace("**/", "", 1)
+                if q not in variants:
+                    variants.add(q)
+                    frontier.append(q)
         return sorted(
             q for q in list_files(fs_, base)
-            if fnmatch.fnmatch(q.replace(os.sep, "/"), pat))
+            if any(fnmatch.fnmatch(q.replace(os.sep, "/"), v)
+                   for v in variants))
     cands = [base]
     for k, seg in enumerate(rest):
         nxt: list[str] = []
