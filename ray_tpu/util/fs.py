@@ -160,13 +160,21 @@ def glob_files(fs_, pattern: str) -> list[str]:
                    for v in variants))
     cands = [base]
     for k, seg in enumerate(rest):
+        last = k == len(rest) - 1
         nxt: list[str] = []
         for b in cands:
             if not _is_glob(seg):
                 nxt.append(f"{b}/{seg}" if b else seg)
                 continue
             sel = pafs.FileSelector(b, recursive=False, allow_not_found=True)
-            for info in fs_.get_file_info(sel):
+            try:
+                infos = fs_.get_file_info(sel)
+            except (OSError, NotADirectoryError):
+                continue  # a literal segment landed on a file
+            for info in infos:
+                # only the final segment may match files
+                if not last and info.type != pafs.FileType.Directory:
+                    continue
                 name = info.path.rstrip("/").rsplit("/", 1)[-1]
                 if fnmatch.fnmatch(name, seg):
                     nxt.append(info.path)
