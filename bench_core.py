@@ -145,6 +145,24 @@ def main():
         "vs_baseline": round(worst, 3),
     }))
 
+    # TPU-down hedge: pinned CPU-mesh training-step trend (bench_trend.py)
+    # — catches sharded-step regressions even when the tunnel is dead
+    try:
+        import bench_trend
+        tps = bench_trend.measure()
+        base = (bench_trend.BASELINE_TOKENS_PER_SEC
+                or bench_trend._PIN_FILE_DEFAULT)
+        print(json.dumps({
+            "metric": "cpu_mesh_tokens_per_sec",
+            "value": round(tps, 1),
+            "unit": "tokens/s (8-dev virtual CPU mesh, pinned config)",
+            "vs_baseline": round(tps / base, 3),
+        }))
+    except Exception as e:  # noqa: BLE001 — the hedge must never fail core
+        print(json.dumps({"metric": "cpu_mesh_tokens_per_sec",
+                          "value": None, "unit": "tokens/s",
+                          "error": str(e)[:200]}))
+
 
 if __name__ == "__main__":
     main()

@@ -1,0 +1,127 @@
+"""Pinned CPU-mesh training-step trend benchmark.
+
+The MFU north star needs the TPU tunnel, which is frequently down
+(BENCH_r03/r04 rc=1). This benchmark is the hedge: a FIXED model config
++ FIXED 8-device virtual CPU mesh + FIXED batch, measured every round,
+so step-time regressions in the sharded training path are visible
+round-over-round even when the TPU is not reachable. The absolute
+number is meaningless (CPU emulation); the TREND is the signal.
+
+Prints one JSON line: {"metric": "cpu_mesh_tokens_per_sec", ...} with
+vs_baseline against the round-5 pin.
+
+Run directly (it re-execs itself with the CPU-mesh env):
+    python bench_trend.py
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+# Pinned at round 5 on the 1-core build box (measured 2026-07-30:
+# 773.7 tokens/s). Do not retune without recording a new pin; the point
+# is cross-round comparability — vs_baseline ~1.0 means no regression.
+BASELINE_TOKENS_PER_SEC = 773.7
+_PIN_FILE_DEFAULT = 773.7
+
+
+def _child():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ray_tpu.models import llama
+    from ray_tpu.parallel import MeshSpec, build_mesh, use_mesh
+    from ray_tpu.parallel.sharding import batch_spec, logical_sharding
+    from jax.sharding import NamedSharding
+
+    cfg = llama.LlamaConfig(
+        vocab_size=2048, dim=256, n_layers=4, n_heads=8, n_kv_heads=4,
+        mlp_dim=512, max_seq_len=512, dtype=jnp.float32, remat=False,
+        use_flash=False)
+    mesh = build_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+    batch, seq = 8, 257
+
+    with use_mesh(mesh):
+        params = llama.init(jax.random.PRNGKey(0), cfg)
+        param_sh = logical_sharding(llama.logical_axes(cfg), mesh)
+        params = jax.device_put(params, param_sh)
+        opt = optax.adamw(1e-3)
+        opt_state = opt.init(params)
+        batch_sh = NamedSharding(mesh, batch_spec(mesh))
+        tokens = jax.device_put(
+            jnp.asarray(np.random.RandomState(0).randint(
+                0, cfg.vocab_size, (batch, seq)), jnp.int32), batch_sh)
+
+        def train_step(params, opt_state, tokens):
+            def loss_fn(p):
+                logits = llama.apply(p, tokens[:, :-1], cfg)
+                return llama.cross_entropy_loss(logits, tokens[:, 1:])
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state2 = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state2, loss
+
+        step = jax.jit(train_step,
+                       in_shardings=(param_sh, None, batch_sh),
+                       out_shardings=(param_sh, None, None),
+                       donate_argnums=(0, 1))
+        # compile + warm
+        params, opt_state, loss = step(params, opt_state, tokens)
+        loss.block_until_ready()
+        n_steps = 5
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            params, opt_state, loss = step(params, opt_state, tokens)
+        loss.block_until_ready()
+        dt = time.perf_counter() - t0
+    tps = n_steps * batch * (seq - 1) / dt
+    print(json.dumps({"_trend_tokens_per_sec": tps}))
+
+
+def measure() -> float:
+    """Run the pinned step in a clean CPU-mesh subprocess; returns
+    tokens/s."""
+    env = dict(os.environ)
+    env["_BENCH_TREND_CHILD"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env, capture_output=True, text=True, timeout=900,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"bench_trend child failed rc={proc.returncode}:\n"
+            f"{proc.stdout}\n{proc.stderr}")
+    for line in reversed(proc.stdout.splitlines()):
+        try:
+            rec = json.loads(line)
+            if "_trend_tokens_per_sec" in rec:
+                return float(rec["_trend_tokens_per_sec"])
+        except json.JSONDecodeError:
+            continue
+    raise RuntimeError(f"no trend line in child output: {proc.stdout}")
+
+
+def main():
+    tps = measure()
+    base = BASELINE_TOKENS_PER_SEC or _PIN_FILE_DEFAULT
+    print(json.dumps({
+        "metric": "cpu_mesh_tokens_per_sec",
+        "value": round(tps, 1),
+        "unit": "tokens/s (8-dev virtual CPU mesh, pinned config)",
+        "vs_baseline": round(tps / base, 3),
+    }))
+
+
+if __name__ == "__main__":
+    if os.environ.get("_BENCH_TREND_CHILD"):
+        _child()
+    else:
+        main()

@@ -15,6 +15,7 @@ vs the reference's dedicated client gRPC proxy.
 from __future__ import annotations
 
 import json
+import multiprocessing as mp
 import os
 import threading
 from multiprocessing.connection import Client
@@ -69,30 +70,180 @@ def _head_alive(cluster_file: str) -> bool:
 
 class DriverRuntime(WorkerRuntime):
     """WorkerRuntime wired as an external driver. Adds: connection liveness
-    tracking, head-pushed exit handling, and a real shutdown."""
+    tracking, head-pushed exit handling, a real shutdown, and — the GCS
+    fault-tolerance client half (reference: the retryable GCS RPC wrappers
+    under src/ray/rpc/ + redis_store_client.h:111 restore) — reconnection
+    with backoff to a RESTARTED head: the driver re-registers, re-ships
+    its function definitions and ref interest, resubmits its unresolved
+    plain tasks, and swaps onto the new session's object store so
+    in-flight ``get``s resume against the re-executed results."""
 
-    def __init__(self, store, conn, wid, spill=None):
+    def __init__(self, store, conn, wid, spill=None, address_arg=None):
         super().__init__(store, conn, wid, spill)
         self.disconnected = threading.Event()
+        self._address_arg = address_arg
+        self._closing = False
+        self._conn_gen = 0
+        # fid -> pickled function blob, for re-shipping after reconnect
+        self._fid_blobs: dict = {}
+        # return-oid (binary) -> plain TaskSpec not yet observed resolved;
+        # resubmitted on reconnect (their results died with the old store)
+        self._unresolved: dict = {}
+        self._track_lock = threading.Lock()
         threading.Thread(target=self._conn_loop, daemon=True,
                          name="rtpu-driver-recv").start()
 
+    # -- call tracking for resubmission ---------------------------------- #
+
+    def register_function(self, fid, blob):
+        self._fid_blobs[fid] = blob
+        super().register_function(fid, blob)
+
+    def submit_task(self, spec):
+        refs = super().submit_task(spec)
+        if not spec.is_actor_task:
+            with self._track_lock:
+                for o in spec.return_ids:
+                    self._unresolved[o.binary()] = spec
+        return refs
+
+    def _get_one(self, oid, deadline, on_wait):
+        out = super()._get_one(oid, deadline, on_wait)
+        with self._track_lock:
+            self._unresolved.pop(oid.binary(), None)
+        return out
+
+    def send(self, msg):  # doc below; tracking hook first
+        if isinstance(msg, dict) and msg.get("t") == "ref_drop":
+            # the driver released its last local ref: it can never get()
+            # this result, so resubmitting its task on reconnect would be
+            # pure waste — and without this hook _unresolved grows
+            # unboundedly in fire-and-forget workloads
+            with self._track_lock:
+                self._unresolved.pop(msg["oid"], None)
+        return self._send_riding_restarts(msg)
+
+    # -- liveness / reconnection ----------------------------------------- #
+
     def _conn_loop(self):
-        # Workers drain dispatches here; a driver only ever receives "exit"
-        # (head shutting down) or EOF (head died).
-        try:
-            while True:
-                msg = self.conn.recv()
-                if isinstance(msg, dict) and msg.get("t") == "exit":
+        # Workers drain dispatches here; a driver receives "exit" (head
+        # shutting down), rpc replies (handled by WorkerRuntime paths), or
+        # EOF (head died -> try to reconnect).
+        while True:
+            try:
+                while True:
+                    msg = self.conn.recv()
+                    if isinstance(msg, dict) and msg.get("t") == "exit":
+                        self.disconnected.set()
+                        return
+            except (EOFError, OSError):
+                pass
+            try:
+                ok = not self._closing and self._reconnect()
+            except Exception:
+                ok = False  # never die silently: liveness must resolve
+            if not ok:
+                self.disconnected.set()
+                return
+
+    def _reconnect(self) -> bool:
+        from .config import cfg
+        timeout = cfg.driver_reconnect_timeout_s
+        if timeout <= 0:
+            return False
+        import time
+        deadline = time.monotonic() + timeout
+        delay = 0.25
+        while not self._closing and time.monotonic() < deadline:
+            conn = reply = None
+            # the restarted head writes a NEW session dir: try the original
+            # address first (a stable path), then fall back to auto-resolve
+            for addr in (self._address_arg, None):
+                try:
+                    cf_path = resolve_cluster_file(addr)
+                    conn, reply = _dial(cf_path)
                     break
-        except (EOFError, OSError):
-            pass
-        self.disconnected.set()
+                except (ConnectionError, OSError, EOFError, ValueError,
+                        mp.AuthenticationError):
+                    continue
+            if conn is None:
+                time.sleep(delay)
+                delay = min(delay * 2, 2.0)
+                continue
+            store = SharedObjectStore(reply["store_path"], create=False)
+            spill = (SpillStore(reply["spill_dir"])
+                     if reply.get("spill_dir") else None)
+            # snapshot ref interest BEFORE send_lock: ref_created/_drop_loop
+            # hold _ref_lock across send(), which parks on reconnect — taking
+            # _ref_lock inside send_lock here would deadlock (lock-order
+            # inversion). A ref added in the window replays itself: its own
+            # parked ref_add completes after the gen bump.
+            with self._ref_lock:
+                live = list(self._ref_counts)
+            # swap AND replay under send_lock: user threads parked in
+            # send() cannot slip a submit onto the new conn before its
+            # func_def replays land (ordering bug otherwise); _conn_gen
+            # is bumped only after the replay succeeds, so parked senders
+            # wake into a fully re-registered session
+            with self.send_lock:
+                self.conn = conn
+                self.store = store
+                self.spill = spill
+                self.wid = reply["wid"]
+                self._sent_fids.clear()
+                self._sent_renvs.clear()
+                # the new head knows nothing about us: re-ship function
+                # defs, re-register ref interest, resubmit unresolved
+                # plain tasks (their results died with the old store)
+                try:
+                    for fid, blob in list(self._fid_blobs.items()):
+                        conn.send({"t": "func_def", "fid": fid,
+                                   "blob": blob})
+                        self._sent_fids.add(fid)
+                    for oid in live:
+                        conn.send({"t": "ref_add", "oid": oid.binary()})
+                    with self._track_lock:
+                        seen, specs = set(), []
+                        for spec in self._unresolved.values():
+                            if spec.task_id not in seen:
+                                seen.add(spec.task_id)
+                                specs.append(spec)
+                    for spec in specs:
+                        spec.owner = self.wid
+                        conn.send({"t": "submit", "spec": spec})
+                except (OSError, ValueError, BrokenPipeError):
+                    continue  # head died again mid-replay; retry dial
+            self._conn_gen += 1
+            return True
+        return False
+
+    def _send_riding_restarts(self, msg):
+        """Sends ride out a head restart: block until the reconnect loop
+        swaps in a live connection (or give up with ConnectionError)."""
+        import time
+        from .config import cfg
+        deadline = time.monotonic() + max(
+            cfg.driver_reconnect_timeout_s, 1.0)
+        while True:
+            gen = self._conn_gen
+            try:
+                return super().send(msg)
+            except (OSError, ValueError, BrokenPipeError):
+                if self._closing:
+                    raise
+                while (self._conn_gen == gen
+                       and not self.disconnected.is_set()
+                       and time.monotonic() < deadline):
+                    time.sleep(0.05)
+                if self._conn_gen == gen:
+                    raise ConnectionError(
+                        "head connection lost and not re-established")
 
     def timeline(self):
         return self._rpc("timeline")
 
     def shutdown(self):
+        self._closing = True
         self.disconnected.set()
         try:
             self.conn.close()
@@ -106,9 +257,9 @@ class DriverRuntime(WorkerRuntime):
             rt_mod.set_runtime(None)
 
 
-def connect(address: str | None = None) -> dict:
-    """Connect as a driver; sets the process runtime. Returns init info."""
-    cf_path = resolve_cluster_file(address)
+def _dial(cf_path: str):
+    """Open a control connection + driver registration for a cluster file.
+    Returns (conn, registration reply)."""
     with open(cf_path) as f:
         cf = json.load(f)
     authkey = bytes.fromhex(cf["authkey"])
@@ -123,10 +274,21 @@ def connect(address: str | None = None) -> dict:
     conn.send({"t": "register_driver", "pid": os.getpid()})
     reply = conn.recv()
     if reply.get("t") != "registered_driver":
+        conn.close()
         raise ConnectionError(f"head rejected driver registration: {reply}")
+    return conn, reply
+
+
+def connect(address: str | None = None) -> dict:
+    """Connect as a driver; sets the process runtime. Returns init info."""
+    cf_path = resolve_cluster_file(address)
+    with open(cf_path) as f:
+        cf = json.load(f)
+    conn, reply = _dial(cf_path)
     store = SharedObjectStore(reply["store_path"], create=False)
     spill = SpillStore(reply["spill_dir"]) if reply.get("spill_dir") else None
-    rt = DriverRuntime(store, conn, reply["wid"], spill)
+    rt = DriverRuntime(store, conn, reply["wid"], spill,
+                       address_arg=address)
     rt_mod.set_runtime(rt)
     return {"address": cf_path, "wid": reply["wid"],
             "job_id": reply["job_id"], "session_dir": cf["session_dir"]}

@@ -159,6 +159,18 @@ _FLAGS = [
          "max workers prestarted at init so first tasks skip cold-start"),
     Flag("worker_idle_timeout_s", 60.0,
          "idle workers beyond the prestart pool are reaped after this"),
+    Flag("head_tcp_port", 0,
+         "fixed TCP port for the head's control listener (0 = ephemeral); "
+         "set it (plus RTPU_CLUSTER_AUTHKEY) so agents/drivers can re-dial "
+         "a restarted head at the same address"),
+    Flag("driver_reconnect_timeout_s", 30.0,
+         "how long an external driver retries dialing a restarted head "
+         "before its pending calls fail (0 disables reconnection)"),
+    Flag("worker_pipeline_depth", 4,
+         "extra same-shape tasks queued on a busy worker so the done->"
+         "dispatch round-trip leaves the critical path (0 disables); "
+         "idle workers steal from the longest pipeline, so skew does "
+         "not strand work behind a slow task"),
     Flag("scheduler_spread_threshold", 0.5,
          "node utilization below which the hybrid policy packs"),
     Flag("task_retry_delay_ms", 0,

@@ -34,10 +34,16 @@ class NodeAgent:
     def __init__(self, head: str, authkey: bytes, resources: dict,
                  name: str = "", own_store: bool = False,
                  store_capacity: int = 1 << 30,
-                 labels: dict | None = None):
+                 labels: dict | None = None,
+                 reconnect_timeout_s: float = 30.0):
         host, port = head.rsplit(":", 1)
         name = name or f"agent-{os.uname().nodename}"
-        self.conn = Client((host, int(port)), authkey=authkey)
+        self.head_addr = (host, int(port))
+        self._authkey_bytes = authkey
+        self._resources = dict(resources)
+        self._name = name
+        self.reconnect_timeout_s = reconnect_timeout_s
+        self.conn = Client(self.head_addr, authkey=authkey)
         self.head_host = host
         self.send_lock = threading.Lock()
 
@@ -68,15 +74,24 @@ class NodeAgent:
         # set by the TPU runtime) — never from a jax import, which would
         # touch the accelerator tunnel during agent startup.
         from ..util.tpu import discover_tpu_labels
-        all_labels = {**discover_tpu_labels(), **(labels or {})}
-        self.conn.send({"t": "register_node", "resources": resources,
-                        "name": name, "own_store": own_store,
-                        "data_addr": data_addr, "labels": all_labels})
+        self._data_addr = data_addr
+        self._labels = {**discover_tpu_labels(), **(labels or {})}
+        self.procs: dict[str, subprocess.Popen] = {}
+        self._register()
+
+    def _register(self):
+        """Register (or re-register after a head restart) over the current
+        connection (reference: raylet re-announcing itself to a failed-over
+        GCS)."""
+        self.conn.send({"t": "register_node", "resources": self._resources,
+                        "name": self._name, "own_store": self.own_store,
+                        "data_addr": self._data_addr,
+                        "labels": self._labels})
         reply = self.conn.recv()
         if reply.get("t") != "registered":
             raise RuntimeError(f"head rejected registration: {reply}")
         self.node_id = reply["node_id"]
-        if own_store:
+        if self.own_store:
             self.store_path = self._own_store_path
             self.spill_dir = self._own_spill_dir
         else:
@@ -88,9 +103,40 @@ class NodeAgent:
                     f"this host; run with --own-store so objects move via "
                     f"the transfer service")
         # the head never echoes the authkey; we authenticated with our copy
-        self.authkey = authkey.hex()
+        self.authkey = self._authkey_bytes.hex()
         self.tcp_port = reply["tcp_port"]
-        self.procs: dict[str, subprocess.Popen] = {}
+
+    def _reconnect(self) -> bool:
+        """The head went away: kill orphaned workers (their control conns
+        died with it) and re-dial the SAME address with backoff — a head
+        restarted with cfg.head_tcp_port + RTPU_CLUSTER_AUTHKEY comes back
+        dialable (the Redis-fixed-address role in reference GCS FT)."""
+        if self.reconnect_timeout_s <= 0:
+            return False
+        for p in list(self.procs.values()):
+            try:
+                p.kill()
+            except Exception:
+                pass
+        self.procs.clear()
+        deadline = time.monotonic() + self.reconnect_timeout_s
+        delay = 0.25
+        while time.monotonic() < deadline:
+            try:
+                conn = Client(self.head_addr, authkey=self._authkey_bytes)
+                # swap + register atomically vs the heartbeat thread: its
+                # send() takes send_lock, so no heartbeat can interleave
+                # into the new conn before register_node goes out
+                with self.send_lock:
+                    self.conn = conn
+                    self._register()
+                print(f"node_agent: re-joined as node {self.node_id}",
+                      flush=True)
+                return True
+            except Exception:
+                time.sleep(delay)
+                delay = min(delay * 2, 2.0)
+        return False
 
     def send(self, msg):
         with self.send_lock:
@@ -135,14 +181,21 @@ class NodeAgent:
             try:
                 self.send({"t": "heartbeat"})
             except Exception:
-                return  # conn gone; run() is tearing down
+                # conn gone: run() may be mid-reconnect (it swaps self.conn
+                # in) — keep looping; the daemon thread dies with teardown
+                continue
 
     def run(self):
         threading.Thread(target=self._heartbeat_loop, daemon=True,
                          name="agent-heartbeat").start()
         try:
             while True:
-                msg = self.conn.recv()
+                try:
+                    msg = self.conn.recv()
+                except (EOFError, OSError):
+                    if self._reconnect():
+                        continue
+                    break
                 t = msg.get("t")
                 if t == "spawn_worker":
                     try:
@@ -206,13 +259,17 @@ def main(argv=None):
                     help="node-local object store + transfer service "
                          "(required off the head host)")
     ap.add_argument("--store-capacity", type=int, default=1 << 30)
+    ap.add_argument("--reconnect-timeout", type=float, default=30.0,
+                    help="seconds to retry re-dialing a restarted head "
+                         "(0 disables)")
     args = ap.parse_args(argv)
     authkey = bytes.fromhex(args.authkey or os.environ["RTPU_AUTHKEY"])
     resources = {"CPU": args.num_cpus, **json.loads(args.resources)}
     agent = NodeAgent(args.head, authkey, resources, args.name,
                       own_store=args.own_store,
                       store_capacity=args.store_capacity,
-                      labels=json.loads(args.labels))
+                      labels=json.loads(args.labels),
+                      reconnect_timeout_s=args.reconnect_timeout)
     print(f"node_agent: joined as node {agent.node_id}", flush=True)
     agent.run()
 

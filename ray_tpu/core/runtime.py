@@ -99,6 +99,14 @@ class WorkerInfo:
         self.send_lock = threading.Lock()
         self.state = "starting"          # starting|idle|busy|actor|dead
         self.current: Optional[TaskSpec] = None
+        # pipelined (spec, nonce) already SENT to the worker behind
+        # `current` (reference analog: lease reuse / owned-task pipelining
+        # on the direct task transport). The worker's single-thread
+        # executor runs them FIFO; the head promotes on each done message.
+        # Steals name the per-dispatch nonce, not the task id, so a stale
+        # steal can never skip a later re-dispatch of the same task.
+        self.queued: deque = deque()
+        self.send_seq = 0
         self.funcs: set[str] = set()
         # runtime-env dedication: a worker that applied env E only runs
         # env-E work (reference worker_pool.h matching semantics)
@@ -434,15 +442,21 @@ class Runtime:
         # raylet/worker dials, rpc/grpc_server.h:88 — one authkeyed
         # connection-oriented channel here)
         addr = os.path.join(self.session_dir, "head.sock")
-        self._authkey = os.urandom(16)
+        # a stable cluster authkey (RTPU_CLUSTER_AUTHKEY hex) + fixed
+        # cfg.head_tcp_port let agents and drivers re-dial a RESTARTED
+        # head at the same address — the role Redis's fixed address plays
+        # for reference GCS failover (redis_store_client.h:111)
+        ak_env = os.environ.get("RTPU_CLUSTER_AUTHKEY")
+        self._authkey = bytes.fromhex(ak_env) if ak_env else os.urandom(16)
         self.listener = Listener(addr, "AF_UNIX", authkey=self._authkey)
         self.listener_addr = addr
         # loopback unless the user opts into remote nodes: the channel is
         # authkey-HMAC-gated but carries pickles, so it must not face the
         # network by default
         self._tcp_host = "0.0.0.0" if enable_remote_nodes else "127.0.0.1"
-        self.tcp_listener = Listener((self._tcp_host, 0), "AF_INET",
-                                     authkey=self._authkey)
+        self.tcp_listener = Listener(
+            (self._tcp_host, cfg.head_tcp_port), "AF_INET",
+            authkey=self._authkey)
         self.tcp_port = self.tcp_listener.address[1]
         self._accept_thread = threading.Thread(
             target=self._accept_loop, args=(self.listener,),
@@ -785,8 +799,16 @@ class Runtime:
         elif t == "blocked":
             with self.lock:
                 w = self.workers.get(wid)
-                if w and not w.blocked and (w.holding or w.holding_bundle):
+                # zero-resource tasks hold nothing but must STILL mark
+                # blocked: the flag is what excludes this worker from
+                # pipelining and what triggers the queue steal — without
+                # it a zero-cpu task waiting on work queued behind itself
+                # deadlocks (release/reacquire are no-ops on {} holdings)
+                if w and not w.blocked:
                     w.blocked = True
+                    # a blocked task may be waiting on work queued behind
+                    # it — steal the pipeline back before releasing
+                    self._steal_queued_locked(w)
                     self._release_to_node(w)
                     self._schedule_locked()
         elif t == "unblocked":
@@ -1174,6 +1196,11 @@ class Runtime:
                 node.workers.discard(wid)
             if not w.blocked:
                 self._release_to_node(w)
+            # pipelined-but-not-started tasks just go back to pending
+            if w.queued:
+                for s, _n in w.queued:
+                    self.pending.append(s)
+                w.queued.clear()
             # running normal task?
             spec = w.current
             if spec is not None and not spec.is_actor_task:
@@ -1587,6 +1614,8 @@ class Runtime:
                 w = None if node is None else \
                     self._acquire_worker_locked(node, spec)
                 if w is None:
+                    if self._pipeline_dispatch_locked(spec):
+                        continue
                     # same signature ⇒ the rest of the bucket can't place
                     # either this pass; stop (tasks behind the head are
                     # NOT rescanned — failed-dependency propagation is
@@ -1649,8 +1678,14 @@ class Runtime:
                     w.env_hash == want_env:
                 self._mark_busy(w, node, spec)
                 return w
+        # blocked workers don't count against the cap: their CPU is
+        # released and the task that blocked them may be waiting on
+        # exactly the child task this spawn would run (reference: the
+        # worker pool starts a replacement when a worker blocks in
+        # ray.get, so nested task trees can't wedge the pool)
         live = sum(1 for wid in node.workers
-                   if self.workers[wid].state != "dead")
+                   if self.workers[wid].state != "dead"
+                   and not self.workers[wid].blocked)
         if live >= node.max_workers:
             # pool full of idle workers dedicated to OTHER runtime envs?
             # reap one so this env can make progress (reference: the worker
@@ -1722,6 +1757,86 @@ class Runtime:
                             "tid": spec.task_id.hex()[:8]})
         if not w.send({"t": "task", "spec": spec}):
             self._on_worker_death(w.wid)
+
+    def _pipeline_dispatch_locked(self, spec) -> bool:
+        """Queue a same-shape plain task behind a busy worker's current
+        task (reference analog: worker-lease reuse on the direct task
+        transport — the done->dispatch round-trip leaves the worker's
+        critical path because the next task message is already in its
+        pipe). The queued task reuses the running task's resource lease,
+        so nothing extra is charged; eligibility is strict: identical
+        resource shape, same runtime env, no placement constraints."""
+        from .config import cfg as _cfg
+        depth = _cfg.worker_pipeline_depth
+        if depth <= 0 or spec.pg_id is not None \
+                or spec.node_affinity is not None \
+                or spec.scheduling_strategy == "SPREAD":
+            return False
+        env_hash = (spec.runtime_env or {}).get("hash")
+        best = None
+        for w in self.workers.values():
+            if (w.state == "busy" and not w.blocked and w.conn is not None
+                    and w.actor_id is None and w.current is not None
+                    and not w.current.is_actor_task
+                    and len(w.queued) < depth
+                    and w.current.resources == spec.resources
+                    and w.env_hash == env_hash
+                    and (best is None or len(w.queued) < len(best.queued))):
+                best = w
+        if best is None:
+            return False
+        self._ship_function_locked(best, spec.func_id)
+        nonce = f"{best.wid}:{best.send_seq}"
+        best.send_seq += 1
+        if not best.send({"t": "task", "spec": spec, "n": nonce}):
+            self._on_worker_death(best.wid)
+            return False
+        best.queued.append((spec, nonce))
+        return True
+
+    def _promote_queued_locked(self, w: WorkerInfo):
+        """The previous task's done message means the worker is already
+        executing the head of its queue: transfer the lease head-side."""
+        nxt, _nonce = w.queued.popleft()
+        w.current = nxt
+        w.state = "busy"
+        self._record_task_locked(nxt, "RUNNING", worker=w.wid,
+                                 node=w.node_id.hex(),
+                                 started_at=time.time())
+        self.events.append({"name": nxt.name, "cat": "task", "ph": "B",
+                            "pid": w.wid, "ts": time.time() * 1e6,
+                            "tid": nxt.task_id.hex()[:8]})
+
+    def _steal_queued_locked(self, w: WorkerInfo):
+        """Pull pipelined tasks back from a worker (it blocked or is
+        wanted for other work): the worker is told to skip them and the
+        specs re-enter the pending queues. Prevents the deadlock where a
+        blocked task waits on a result only its own queued successor
+        would produce."""
+        if not w.queued:
+            return
+        stolen = list(w.queued)
+        w.queued.clear()
+        w.send({"t": "steal", "nonces": [n for _, n in stolen]})
+        for s, _ in stolen:
+            self.pending.append(s)
+
+    def _rebalance_pipelines_locked(self):
+        """A worker just went idle with nothing pending: if another worker
+        has pipelined tasks stuck behind a slower one, steal that queue
+        back so the idle capacity absorbs it (work stealing keeps deep
+        pipelines safe under skewed task durations — even a single queued
+        straggler moves, else it waits out the whole task ahead of it)."""
+        if self.pending:
+            return  # the scheduler will feed the idle worker anyway
+        victim = None
+        for w in self.workers.values():
+            if len(w.queued) >= 1 and (victim is None
+                                       or len(w.queued) > len(victim.queued)):
+                victim = w
+        if victim is not None:
+            self._steal_queued_locked(victim)
+            self._schedule_locked()
 
     def _ship_renv_locked(self, w: WorkerInfo, renv_spec: dict):
         """Dedicate `w` to this runtime env: ship the env spec + its blobs
@@ -1815,15 +1930,33 @@ class Runtime:
                     spec = a.running.pop(task_id, None)
             else:
                 spec = w.current
+                if spec is not None and spec.task_id != task_id:
+                    # stale done: a pipelined dispatch was stolen AFTER the
+                    # worker had already started it (the steal lost the
+                    # race with the predecessor's in-flight done). The
+                    # worker is now executing `spec`; its real done is
+                    # still coming — record nothing, release nothing.
+                    self.events.append(
+                        {"name": msg.get("name", "task"), "cat": "task",
+                         "ph": "E", "pid": wid, "ts": time.time() * 1e6,
+                         "tid": task_id.hex()[:8]})
+                    self.cv.notify_all()
+                    return
                 w.current = None
-                if w.blocked:
-                    w.blocked = False
+                if w.queued and not w.blocked:
+                    # lease transfers to the already-sent next task; the
+                    # worker is executing it as this message is handled
+                    self._promote_queued_locked(w)
                 else:
-                    self._release_to_node(w)
-                w.holding = {}
-                w.holding_bundle = None
-                w.state = "idle"
-                w.idle_since = time.monotonic()
+                    if w.blocked:
+                        w.blocked = False
+                    else:
+                        self._release_to_node(w)
+                    w.holding = {}
+                    w.holding_bundle = None
+                    w.state = "idle"
+                    w.idle_since = time.monotonic()
+                    self._rebalance_pipelines_locked()
             self.events.append({"name": msg.get("name", "task"), "cat": "task",
                                 "ph": "E", "pid": wid, "ts": time.time() * 1e6,
                                 "tid": task_id.hex()[:8]})
@@ -2488,6 +2621,17 @@ class Runtime:
                     else:
                         w.send({"t": "cancel", "task_id": spec.task_id})
                     return
+                # pipelined behind a running task: steal it back and fail
+                for item in list(w.queued):
+                    s, nonce = item
+                    if ref.id() in s.return_ids:
+                        w.queued.remove(item)
+                        w.send({"t": "steal", "nonces": [nonce]})
+                        self._handle_failed_task_locked(
+                            s, exc.TaskCancelledError(
+                                f"task {s.name} was cancelled"),
+                            retryable=False)
+                        return
 
     # ------------------------------------------------------------------ #
     # introspection

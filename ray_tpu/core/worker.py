@@ -392,6 +392,9 @@ class WorkerLoop:
         self._cancel_lock = threading.Lock()
         self._renv_error: BaseException | None = None
         self._dynamic_items = None
+        # dispatch nonces the head reclaimed from our pipeline (set by the
+        # recv loop, checked by the exec thread before running)
+        self._stolen: set[str] = set()
 
     # -- arg resolution ----------------------------------------------------
 
@@ -454,7 +457,12 @@ class WorkerLoop:
             except FileExistsError:
                 pass  # retry re-executed an already-stored return
 
-    def _run_task(self, spec: TaskSpec):
+    def _run_task(self, spec: TaskSpec, nonce: str | None = None):
+        if nonce is not None and nonce in self._stolen:
+            # the head reclaimed this pipelined dispatch (we blocked or it
+            # was cancelled); it runs elsewhere — no done, no returns
+            self._stolen.discard(nonce)
+            return
         self._current_task_id = spec.task_id
         self.rt.current_task_name = spec.name
         t0 = time.time()
@@ -661,7 +669,7 @@ class WorkerLoop:
                                      msg)
             elif t == "task":
                 self.executor.submit(self._exec_wrapper, self._run_task,
-                                     msg["spec"])
+                                     msg["spec"], msg.get("n"))
             elif t == "actor_create":
                 self.executor.submit(self._exec_wrapper,
                                      self._run_actor_create, msg["spec"])
@@ -693,6 +701,10 @@ class WorkerLoop:
                     daemon=True).start()
             elif t == "cancel":
                 self._cancel_current(msg["task_id"])
+            elif t == "steal":
+                # handled on the recv thread so it lands BEFORE the exec
+                # thread reaches the stolen dispatch in its queue
+                self._stolen.update(msg["nonces"])
             elif t == "exit":
                 if _pre_exit_hook is not None:
                     _pre_exit_hook()   # profiler dump (main() sets it)

@@ -42,23 +42,159 @@ class PrefillReplica:
         ttft_partial_s}."""
         return self.engine.prefill_export(prompt, params or SamplingParams())
 
+    def prefill_ref(self, prompt, params: Optional[SamplingParams] = None):
+        """Like prefill(), but parks the payload in the object store and
+        returns only its ObjectRef — the KV bytes then move store-to-store
+        to whichever decode replica receives the ref (the data-plane role
+        NIXL plays for the reference's PD deployments)."""
+        import ray_tpu
+        return ray_tpu.put(self.prefill(prompt, params))
+
+    def check_health(self):
+        return True
+
 
 class DecodeReplica:
     """Owns a paged engine that only ever decodes externally-prefilled
-    sequences."""
+    sequences. A background thread steps the engine so imported requests
+    decode continuously; callers either block (`decode`) or stream
+    (`start` + `poll`, the replica-side half of the proxy's async token
+    stream — reference `_predict`'s async generator,
+    prefill_decode_disagg.py:98)."""
 
     def __init__(self, engine_cfg, params=None, rng_seed: int = 0):
+        import threading
         from .paged_engine import PagedInferenceEngine
         self.engine = PagedInferenceEngine(engine_cfg, params=params,
                                            rng_seed=rng_seed)
+        self._reqs: dict[int, Any] = {}
+        self._next_rid = 0
+        # serializes import_prefill against the stepping thread (the
+        # engine's own _lock only guards admission, not the decode step)
+        self._steplock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = False
+        self._error: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        try:
+            while not self._stop:
+                with self._steplock:
+                    worked = self.engine.has_work()
+                    if worked:
+                        self.engine.step()
+                if not worked:
+                    self._wake.wait(timeout=0.02)
+                    self._wake.clear()
+        except BaseException as e:  # noqa: BLE001 — engine died: fail fast
+            self._error = e
+            for req in list(self._reqs.values()):
+                req.event.set()
+
+    def start(self, payload, params: Optional[SamplingParams] = None) -> int:
+        """Import a prefilled KV payload into the decode pool; returns a
+        request id for poll()/wait()."""
+        if self._error is not None:
+            raise RuntimeError("decode engine died") from self._error
+        from ..core.ref import ObjectRef
+        if isinstance(payload, ObjectRef):
+            # prefill_ref hands out a ref-to-the-payload: the KV bytes
+            # cross store-to-store here, on the decode replica, never
+            # through the proxy
+            import ray_tpu
+            payload = ray_tpu.get(payload, timeout=300)
+        with self._steplock:
+            req = self.engine.import_prefill(payload,
+                                             params or SamplingParams())
+        rid = self._next_rid
+        self._next_rid += 1
+        self._reqs[rid] = req
+        self._wake.set()
+        return rid
+
+    def poll(self, rid: int) -> dict:
+        """Non-blocking progress read: {text, n_tokens, done,
+        finish_reason} for a started request. The proxy's streaming
+        generator turns successive polls into SSE deltas."""
+        if self._error is not None:
+            raise RuntimeError("decode engine died") from self._error
+        req = self._reqs[rid]
+        out = {
+            "text": self.engine.tokenizer.decode(list(req.out_ids)),
+            "n_tokens": len(req.out_ids),
+            "done": req.done,
+            "finish_reason": None,
+        }
+        if req.done:
+            res = self.engine._result(req)
+            out["text"] = res["text"]
+            out["finish_reason"] = res["finish_reason"]
+            out["prompt_tokens"] = res["prompt_tokens"]
+            self._reqs.pop(rid, None)
+        return out
+
+    def wait(self, rid: int, timeout: float = 600.0) -> dict:
+        """Block until the request finishes; returns the engine's result
+        dict (the non-streaming completion path)."""
+        import time as _time
+        req = self._reqs[rid]
+        deadline = _time.monotonic() + timeout
+        while not req.event.wait(timeout=0.5):
+            if self._error is not None:
+                raise RuntimeError("decode engine died") from self._error
+            if _time.monotonic() > deadline:
+                raise TimeoutError(f"decode of request {rid} timed out")
+        self._reqs.pop(rid, None)
+        return self.engine._result(req)
 
     def decode(self, payload, params: Optional[SamplingParams] = None):
         """Import a prefilled KV payload and decode to completion; returns
         the engine's result dict {text, token_ids, ...}."""
-        req = self.engine.import_prefill(payload,
-                                         params or SamplingParams())
-        self.engine.run_until_done([req])
-        return self.engine._result(req)
+        return self.wait(self.start(payload, params))
+
+    def decode_stream(self, payload,
+                      params: Optional[SamplingParams] = None):
+        """Generator: import the KV payload and yield progress dicts
+        ({text, n_tokens, done, finish_reason}) as tokens land. One
+        streaming call carries the whole request, so a serve streaming
+        handle stays pinned to THIS replica (stream_next goes to the
+        retaining replica) — no cross-replica request-id routing. The
+        request entry is dropped even when the consumer abandons the
+        stream mid-way (client disconnect)."""
+        import time as _time
+        rid = self.start(payload, params)
+        req = self._reqs[rid]
+        sent = 0
+        try:
+            while True:
+                if self._error is not None:
+                    raise RuntimeError(
+                        "decode engine died") from self._error
+                n = len(req.out_ids)
+                if req.done:
+                    res = self.engine._result(req)
+                    yield {"text": res["text"], "n_tokens": n,
+                           "done": True,
+                           "finish_reason": res["finish_reason"],
+                           "prompt_tokens": res["prompt_tokens"]}
+                    return
+                if n > sent:
+                    sent = n
+                    yield {"text": self.engine.tokenizer.decode(
+                               list(req.out_ids)),
+                           "n_tokens": n, "done": False,
+                           "finish_reason": None}
+                else:
+                    _time.sleep(0.01)
+        finally:
+            self._reqs.pop(rid, None)
+
+    def check_health(self):
+        if self._error is not None or not self._thread.is_alive():
+            raise RuntimeError("decode engine loop died") from self._error
+        return True
 
 
 @dataclasses.dataclass
@@ -102,6 +238,122 @@ class PDProxy:
     def proxy_stats(self) -> dict:
         with self._lock:
             return dataclasses.asdict(self.stats)
+
+
+def _params_from_request(request: dict) -> SamplingParams:
+    return SamplingParams(
+        max_tokens=int(request.get("max_tokens", 64)),
+        temperature=float(request.get("temperature", 0.0)),
+        top_k=int(request.get("top_k", 0)),
+    )
+
+
+class PDServer:
+    """Disaggregated drop-in for LLMServer behind the OpenAI ingress
+    (reference: PDProxyServer subclasses the LLM server,
+    prefill_decode_disagg.py:64, streaming `_predict` :98): speaks the
+    same completions/completions_stream surface, but each request
+    prefills on one replica group and decodes on the other. The KV
+    payload crosses as an ObjectRef — store-to-store on the data plane,
+    never through this proxy."""
+
+    def __init__(self, model_id: str, prefill_handle, decode_handle):
+        from ..core.usage import record_library_usage
+        record_library_usage("llm")
+        self.model_id = model_id
+        self.prefill = prefill_handle
+        self.decode = decode_handle
+
+    def _prefill_ref(self, request: dict):
+        """Run prefill on one replica; returns (payload ObjectRef,
+        SamplingParams). The decode side receives only the ref — KV bytes
+        move store-to-store."""
+        sp = _params_from_request(request)
+        return self.prefill.options(
+            method_name="prefill_ref").remote(
+                request.get("prompt", ""), sp).result(timeout_s=300), sp
+
+    def completions(self, request: dict) -> dict:
+        # one unary call per request: the serve handle picks a decode
+        # replica once and the whole decode happens there (no
+        # cross-replica request-id routing to get wrong)
+        payload_ref, sp = self._prefill_ref(request or {})
+        out = self.decode.options(method_name="decode").remote(
+            payload_ref, sp).result(timeout_s=600)
+        return {
+            "object": "text_completion",
+            "model": self.model_id,
+            "choices": [{
+                "text": out["text"],
+                "finish_reason": out["finish_reason"],
+                "index": 0,
+            }],
+            "usage": {
+                "prompt_tokens": out["prompt_tokens"],
+                "completion_tokens": len(out["token_ids"]),
+            },
+        }
+
+    def completions_stream(self, request: dict):
+        """Generator of token-delta chunks: ONE streaming call to a decode
+        replica (the generator stays replica-pinned) re-emitted as OpenAI
+        chunks (the role of the reference's router StreamingResponse over
+        `_predict`, router.py:259-264)."""
+        payload_ref, sp = self._prefill_ref(request or {})
+        gen = self.decode.options(method_name="decode_stream",
+                                  stream=True).remote(payload_ref, sp)
+        emitted = ""
+        for out in gen:
+            text = out["text"]
+            if out["done"]:
+                # on prefix divergence (multi-byte fallback spanning more
+                # than the withheld window) emit from the boundary anyway:
+                # a few garbled chars beat re-sending the whole completion
+                tail = text[len(emitted):]
+                yield {"object": "text_completion.chunk",
+                       "model": self.model_id,
+                       "choices": [{"text": tail, "index": 0,
+                                    "finish_reason": out["finish_reason"]}]}
+                return
+            # withhold the last few chars: a partial multi-byte token
+            # sequence decodes to replacement chars that the next token
+            # may rewrite — emit only the stable prefix
+            stable = text[:max(0, len(text) - 4)]
+            if stable.startswith(emitted) and len(stable) > len(emitted):
+                delta = stable[len(emitted):]
+                emitted = stable
+                yield {"object": "text_completion.chunk",
+                       "model": self.model_id,
+                       "choices": [{"text": delta, "index": 0,
+                                    "finish_reason": None}]}
+
+    def __call__(self, request: dict) -> dict:
+        return self.completions(request or {})
+
+    def check_health(self):
+        return True
+
+
+def build_pd_openai_app(model_id: str, n_prefill: int, n_decode: int,
+                        engine_cfg, params=None, rng_seed: int = 0):
+    """Disaggregated OpenAI app (reference build_app,
+    prefill_decode_disagg.py:160): prefill and decode replica groups as
+    Serve deployments, a PDServer deployment routing between them, and
+    the OpenAI router as ingress — /v1/completions with stream=true
+    crosses the prefill->decode handoff and streams SSE out the HTTP
+    proxy."""
+    from .. import serve
+    from .openai_api import OpenAIRouter
+    pre = serve.deployment(
+        PrefillReplica, name=f"pd-prefill:{model_id}",
+        num_replicas=n_prefill).bind(engine_cfg, params, rng_seed)
+    dec = serve.deployment(
+        DecodeReplica, name=f"pd-decode:{model_id}",
+        num_replicas=n_decode).bind(engine_cfg, params, rng_seed)
+    pd = serve.deployment(
+        PDServer, name=f"pd:{model_id}").bind(model_id, pre, dec)
+    router = serve.deployment(OpenAIRouter, name="openai-router")
+    return router.bind([model_id], pd)
 
 
 def build_pd_proxy(n_prefill: int, n_decode: int, engine_cfg,

@@ -6,10 +6,13 @@ core/rl_module/rl_module.py:258). PPO is the first algorithm (north-star
 config 3: PPO EnvRunner actors + jitted JAX learner over the mesh).
 """
 from .algorithm import PPO, AlgorithmConfig
+from .appo import APPO, AppoAlgorithmConfig, AppoConfig, AppoLearner
 from .dqn import (DQN, DQNAlgorithmConfig, DQNConfig, DQNLearner,
                   ReplayBuffer)
 from .impala import (IMPALA, ImpalaAlgorithmConfig, ImpalaConfig,
                      ImpalaLearner, vtrace)
+from .multi_agent import (MultiAgentEnv, MultiAgentEnvRunner,
+                          MultiAgentPPO, MultiAgentPPOConfig)
 from .sac import SAC, SACAlgorithmConfig, SACConfig, SACLearner
 from .env_runner import EnvRunner, make_gym_env
 from .learner import PPOConfig, PPOLearner, compute_gae
@@ -17,9 +20,12 @@ from .module import MLPConfig
 from .offline import (BC, BCConfig, CQL, CQLConfig, collect_transitions)
 
 __all__ = [
+    "APPO", "AppoAlgorithmConfig", "AppoConfig", "AppoLearner",
     "DQN", "DQNAlgorithmConfig", "DQNConfig", "DQNLearner", "ReplayBuffer",
     "IMPALA", "ImpalaAlgorithmConfig", "ImpalaConfig", "ImpalaLearner",
     "vtrace", "SAC", "SACAlgorithmConfig", "SACConfig", "SACLearner",
+    "MultiAgentEnv", "MultiAgentEnvRunner", "MultiAgentPPO",
+    "MultiAgentPPOConfig",
     "PPO", "AlgorithmConfig", "EnvRunner", "make_gym_env",
     "PPOConfig", "PPOLearner", "compute_gae", "MLPConfig",
     "BC", "BCConfig", "CQL", "CQLConfig", "collect_transitions",

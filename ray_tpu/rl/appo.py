@@ -1,0 +1,105 @@
+"""APPO: asynchronous PPO — IMPALA's pipeline with a PPO clipped
+surrogate over V-trace advantages.
+
+Reference parity: rllib/algorithms/appo/appo.py:345 (APPO — "IMPALA with
+a surrogate policy loss with clipping", plus an optional KL penalty
+toward the behaviour policy) riding the same async EnvRunner/V-trace
+machinery as rllib/algorithms/impala/.
+
+TPU-first: like IMPALA here, the whole V-trace + clipped-surrogate update
+is one jitted program; only the loss differs, so APPO subclasses the
+IMPALA learner/driver and swaps the loss function.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from . import module as module_lib
+from .base import AlgorithmConfigBase
+from .impala import IMPALA, ImpalaConfig, ImpalaLearner, vtrace
+
+
+@dataclasses.dataclass(frozen=True)
+class AppoConfig(ImpalaConfig):
+    """(reference: appo.py APPOConfig.training — clip_param :168,
+    use_kl_loss/kl_coeff :164-166)"""
+    clip_param: float = 0.2
+    use_kl_loss: bool = False
+    kl_coeff: float = 0.2
+    lr: float = 3e-4
+
+
+class AppoLearner(ImpalaLearner):
+    """IMPALA learner with the PPO clipped surrogate (reference:
+    appo_learner.py — the loss is the only override)."""
+
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+        cfg = self.cfg
+
+        def loss_fn(params, batch):
+            logits, values = module_lib.logits_and_value(
+                params, batch["obs"])                       # [T, B, A]/[T, B]
+            logp_all = jax.nn.log_softmax(logits, axis=-1)
+            target_logp = jnp.take_along_axis(
+                logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+            vs, pg_adv = vtrace(
+                batch["logp"], target_logp, batch["rewards"], values,
+                batch["dones"], batch["bootstrap_value"],
+                cfg.gamma, cfg.rho_bar, cfg.c_bar)
+            # PPO surrogate against the BEHAVIOUR policy's logp (the
+            # fragment may be a policy version behind, as in IMPALA)
+            ratio = jnp.exp(target_logp - batch["logp"])
+            clipped = jnp.clip(ratio, 1.0 - cfg.clip_param,
+                               1.0 + cfg.clip_param)
+            pg_loss = -jnp.mean(
+                jnp.minimum(ratio * pg_adv, clipped * pg_adv))
+            vf_loss = 0.5 * jnp.mean((vs - values) ** 2)
+            entropy = -jnp.mean(
+                jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+            total = (pg_loss + cfg.vf_coeff * vf_loss
+                     - cfg.entropy_coeff * entropy)
+            if cfg.use_kl_loss:
+                # KL(behaviour || target) estimated from the taken actions
+                kl = jnp.mean(batch["logp"] - target_logp)
+                total = total + cfg.kl_coeff * kl
+            return total, (pg_loss, vf_loss, entropy)
+
+        def update(params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = self.optimizer.update(
+                grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss, aux
+
+        return update
+
+
+class APPO(IMPALA):
+    """The async driver loop is IMPALA's; only the learner differs
+    (reference: APPO.training_step delegates to Impala.training_step)."""
+
+    HPARAM_FIELD = "appo"
+
+    def __init__(self, config: "AppoAlgorithmConfig"):
+        from .env_runner import EnvRunner
+        self._setup(config, EnvRunner)
+        self.learner = AppoLearner(self.module_cfg, config.appo,
+                                   seed=config.seed)
+        self._inflight = {}
+        weights_ref = self._ray.put(self.learner.params)
+        for r in self._runners:
+            self._inflight[r.sample.remote(weights_ref)] = r
+
+
+class AppoAlgorithmConfig(AlgorithmConfigBase):
+    """Fluent config for APPO (reference: appo.py APPOConfig)."""
+
+    HPARAM_FIELD = "appo"
+    HPARAM_FACTORY = AppoConfig
+
+    @property
+    def ALGO_CLS(self):
+        return APPO

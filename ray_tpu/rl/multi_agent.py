@@ -1,0 +1,318 @@
+"""Multi-agent RL: env API, runner, and multi-policy PPO.
+
+Reference parity: rllib/env/multi_agent_env.py (MultiAgentEnv — dict
+obs/action/reward keyed by agent id, "__all__" termination),
+rllib/env/multi_agent_env_runner.py:68 (MultiAgentEnvRunner — steps ONE
+multi-agent env, routes each agent through policy_mapping_fn to its
+module), and the multi-policy training loop of algorithm.py (one learner
+update per policy over its agents' transitions).
+
+TPU-first shape: simultaneous-action envs (every agent acts each step)
+let each policy's fragment keep the single-agent time-major [T, E]
+layout with E = (#agents mapped to the policy) x (#runners) — so the
+standard jitted PPOLearner (epochs x minibatches in one compiled
+program, optional dp-mesh sharding) trains each policy unchanged. Agents
+are just extra batch columns to the compiler.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import numpy as np
+
+from . import module as module_lib
+from .learner import PPOConfig, PPOLearner
+from .module import MLPConfig
+
+
+class MultiAgentEnv:
+    """Simultaneous multi-agent env API (reference: multi_agent_env.py;
+    the dict convention matches PettingZoo parallel envs).
+
+    Subclasses set ``possible_agents`` plus per-agent
+    ``observation_spaces`` / ``action_spaces`` dicts and implement:
+
+      reset(seed) -> (obs_dict, info_dict)
+      step(action_dict) -> (obs, rewards, terminations, truncations,
+                            infos) — terminations may carry "__all__"
+    """
+
+    possible_agents: list = []
+    observation_spaces: dict = {}
+    action_spaces: dict = {}
+
+    def reset(self, seed: Optional[int] = None):
+        raise NotImplementedError
+
+    def step(self, action_dict: dict):
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+
+class MultiAgentEnvRunner:
+    """Samples fragments from ONE multi-agent env, batching each policy's
+    agents into the columns of a single-agent-shaped fragment
+    (reference: multi_agent_env_runner.py:68 sample())."""
+
+    def __init__(self, env_fn: Callable, policy_mapping: dict,
+                 rollout_len: int, seed: int = 0):
+        self._env = env_fn()
+        self._mapping = dict(policy_mapping)      # agent_id -> policy_id
+        self._agents = list(self._env.possible_agents)
+        self._rollout_len = rollout_len
+        # stable per-policy agent column order
+        self._cols: dict[str, list] = {}
+        for a in self._agents:
+            self._cols.setdefault(self._mapping[a], []).append(a)
+        self._obs, _ = self._env.reset(seed=seed)
+        self._rng = np.random.default_rng(seed + 1)
+        self._fns = None
+        self._ep_return = 0.0
+        self._completed: list[float] = []
+
+    def _policy_fns(self):
+        if self._fns is None:
+            import jax
+            self._fns = (jax.jit(module_lib.sample_action),
+                         jax.jit(lambda p, o:
+                                 module_lib.logits_and_value(p, o)[1]),
+                         jax.jit(module_lib.deterministic_action))
+        return self._fns
+
+    def _stack_obs(self, pid: str) -> np.ndarray:
+        return np.stack([np.asarray(self._obs[a], np.float32).reshape(-1)
+                         for a in self._cols[pid]])
+
+    def sample(self, weights: dict) -> dict:
+        """{policy_id: fragment} — each fragment is the single-agent
+        layout (obs/actions/logp/values/rewards/dones [T, E], last_obs/
+        last_value [E]) with one column per mapped agent."""
+        import jax
+        sample_fn, value_fn, _ = self._policy_fns()
+        T = self._rollout_len
+        bufs = {
+            pid: {
+                "obs": np.empty(
+                    (T, len(cols)) + self._stack_obs(pid).shape[1:],
+                    np.float32),
+                "actions": np.empty((T, len(cols)), np.int64),
+                "logp": np.empty((T, len(cols)), np.float32),
+                "values": np.empty((T, len(cols)), np.float32),
+                "rewards": np.empty((T, len(cols)), np.float32),
+                "dones": np.empty((T, len(cols)), np.bool_),
+            }
+            for pid, cols in self._cols.items()
+        }
+        key = jax.random.PRNGKey(int(self._rng.integers(2**31)))
+        for t in range(T):
+            acts: dict = {}
+            for pid, cols in self._cols.items():
+                key, sub = jax.random.split(key)
+                ob = self._stack_obs(pid)
+                a, logp, val = sample_fn(weights[pid], ob, sub)
+                a = np.asarray(a)
+                bufs[pid]["obs"][t] = ob
+                bufs[pid]["actions"][t] = a
+                bufs[pid]["logp"][t] = np.asarray(logp)
+                bufs[pid]["values"][t] = np.asarray(val)
+                for j, agent in enumerate(cols):
+                    acts[agent] = int(a[j])
+            nxt, rews, terms, truncs, _ = self._env.step(acts)
+            done = bool(terms.get("__all__", False)
+                        or truncs.get("__all__", False)
+                        or (self._agents
+                            and all(terms.get(a, False)
+                                    or truncs.get(a, False)
+                                    for a in self._agents)))
+            step_rew = 0.0
+            for pid, cols in self._cols.items():
+                for j, agent in enumerate(cols):
+                    r = float(rews.get(agent, 0.0))
+                    bufs[pid]["rewards"][t, j] = r
+                    bufs[pid]["dones"][t, j] = done
+                    step_rew += r
+            self._ep_return += step_rew
+            if done:
+                self._completed.append(self._ep_return)
+                self._ep_return = 0.0
+                self._obs, _ = self._env.reset()
+            else:
+                self._obs = nxt
+        out = {}
+        episodes, self._completed = self._completed, []
+        for pid in self._cols:
+            last_obs = self._stack_obs(pid)
+            out[pid] = {
+                **bufs[pid],
+                "last_obs": last_obs,
+                "last_value": np.asarray(value_fn(weights[pid], last_obs)),
+                # joint return (sum over agents) is the episode metric,
+                # like the reference's default episode_return_mean
+                "episode_returns": list(episodes),
+                "episode_lens": [],
+            }
+        return out
+
+    def evaluate(self, weights: dict, num_episodes: int = 5) -> dict:
+        _, _, det = self._policy_fns()
+        returns = []
+        for ep in range(num_episodes):
+            obs, _ = self._env.reset(seed=20_000 + ep)
+            self._obs = obs
+            total, done, steps = 0.0, False, 0
+            while not done and steps < 10_000:
+                acts = {}
+                for pid, cols in self._cols.items():
+                    a = np.asarray(det(weights[pid], self._stack_obs(pid)))
+                    for j, agent in enumerate(cols):
+                        acts[agent] = int(a[j])
+                self._obs, rews, terms, truncs, _ = self._env.step(acts)
+                total += sum(float(r) for r in rews.values())
+                done = bool(terms.get("__all__", False)
+                            or truncs.get("__all__", False))
+                steps += 1
+            returns.append(total)
+        self._obs, _ = self._env.reset()
+        self._ep_return = 0.0
+        return {"episode_returns": returns,
+                "mean_return": float(np.mean(returns))}
+
+
+class MultiAgentPPOConfig:
+    """Fluent config (reference: AlgorithmConfig.multi_agent —
+    algorithm_config.py policies/policy_mapping_fn)."""
+
+    def __init__(self):
+        self.env_fn: Optional[Callable] = None
+        self.num_env_runners = 2
+        self.rollout_len = 32
+        self.hidden = (64, 64)
+        self.seed = 0
+        self.ppo = PPOConfig()
+        self.policies: list = []
+        self.policy_mapping: Union[dict, Callable, None] = None
+
+    def environment(self, env_fn: Callable):
+        self.env_fn = env_fn
+        return self
+
+    def env_runners(self, num_env_runners: Optional[int] = None,
+                    rollout_fragment_length: Optional[int] = None):
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if rollout_fragment_length is not None:
+            self.rollout_len = rollout_fragment_length
+        return self
+
+    def training(self, **kwargs):
+        import dataclasses
+        self.ppo = dataclasses.replace(self.ppo, **kwargs)
+        return self
+
+    def multi_agent(self, policies: list,
+                    policy_mapping=None):
+        """``policies``: policy ids. ``policy_mapping``: agent_id ->
+        policy_id (dict, or a picklable callable applied to each agent at
+        build time). Default: every agent shares policies[0]."""
+        self.policies = list(policies)
+        self.policy_mapping = policy_mapping
+        return self
+
+    def build(self):
+        return MultiAgentPPO(self)
+
+
+class MultiAgentPPO:
+    """Multi-policy PPO: one jitted PPOLearner per policy, one sample/
+    update/broadcast loop (reference: the multi-agent half of
+    algorithm.py training_step + learner_group keyed by module id)."""
+
+    def __init__(self, config: MultiAgentPPOConfig):
+        import ray_tpu as ray
+
+        from ..core.usage import record_library_usage
+        record_library_usage("rl")
+        if config.env_fn is None:
+            raise ValueError("config.environment(...) is required")
+        self.config = config
+        probe = config.env_fn()
+        agents = list(probe.possible_agents)
+        policies = config.policies or ["default_policy"]
+        mapping = config.policy_mapping
+        if mapping is None:
+            mapping = {a: policies[0] for a in agents}
+        elif callable(mapping):
+            mapping = {a: mapping(a) for a in agents}
+        unknown = sorted(set(mapping.values()) - set(policies))
+        if unknown:
+            raise ValueError(f"policy_mapping names unknown policies "
+                             f"{unknown}; declared: {policies}")
+        self._mapping = mapping
+        # per-policy module config from the spaces of a mapped agent
+        self.learners: dict[str, PPOLearner] = {}
+        for i, pid in enumerate(policies):
+            agent = next((a for a in agents if mapping[a] == pid), None)
+            if agent is None:
+                continue  # declared but unused policy
+            mcfg = MLPConfig(
+                obs_dim=int(np.prod(
+                    probe.observation_spaces[agent].shape)),
+                num_actions=int(probe.action_spaces[agent].n),
+                hidden=tuple(config.hidden))
+            self.learners[pid] = PPOLearner(mcfg, config.ppo,
+                                            seed=config.seed + i)
+        probe.close()
+        Runner = ray.remote(MultiAgentEnvRunner)
+        self._runners = [
+            Runner.remote(config.env_fn, mapping, config.rollout_len,
+                          seed=config.seed + 1000 * (i + 1))
+            for i in range(config.num_env_runners)]
+        self._ray = ray
+        self.iteration = 0
+        self._total_env_steps = 0
+        self._recent_returns: list[float] = []
+
+    def get_weights(self) -> dict:
+        return {pid: lrn.get_params() if hasattr(lrn, "get_params")
+                else lrn.params for pid, lrn in self.learners.items()}
+
+    def train(self) -> dict:
+        ray = self._ray
+        weights_ref = ray.put(self.get_weights())
+        samples = ray.get([r.sample.remote(weights_ref)
+                           for r in self._runners], timeout=600)
+        stats = {}
+        for pid, lrn in self.learners.items():
+            stats[pid] = lrn.update([s[pid] for s in samples])
+        self.iteration += 1
+        self._total_env_steps += (self.config.rollout_len
+                                  * len(self._mapping)
+                                  * len(self._runners))
+        for s in samples:
+            frag = next(iter(s.values()))
+            self._recent_returns.extend(frag["episode_returns"])
+        self._recent_returns = self._recent_returns[-100:]
+        mean_ret = (float(np.mean(self._recent_returns))
+                    if self._recent_returns else float("nan"))
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": mean_ret,
+            "num_env_steps_sampled_lifetime": self._total_env_steps,
+            **{f"learner/{pid}/{k}": v
+               for pid, st in stats.items() for k, v in st.items()},
+        }
+
+    def evaluate(self, num_episodes: int = 5) -> dict:
+        ray = self._ray
+        weights_ref = ray.put(self.get_weights())
+        return ray.get(self._runners[0].evaluate.remote(
+            weights_ref, num_episodes), timeout=600)
+
+    def stop(self) -> None:
+        for r in self._runners:
+            try:
+                self._ray.kill(r)
+            except Exception:
+                pass

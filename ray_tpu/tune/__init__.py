@@ -27,6 +27,7 @@ from .schedulers import (
     PB2,
     PopulationBasedTraining,
 )
+from .optuna_search import OptunaSearch
 from .tuner import ResultGrid, TuneConfig, Tuner
 from ..train.session import get_context
 from ..train import Checkpoint
@@ -39,7 +40,7 @@ __all__ = [
     "loguniform", "randint", "qrandint", "quniform", "sample_from",
     "FIFOScheduler", "ASHAScheduler", "MedianStoppingRule",
     "PopulationBasedTraining", "HyperBandForBOHB", "PB2",
-    "TPESearch", "BOHBSearch",
+    "TPESearch", "BOHBSearch", "OptunaSearch",
     "report", "get_checkpoint", "get_context",
     "Checkpoint",
 ]

@@ -1,0 +1,152 @@
+"""Multi-agent RL + APPO (reference: rllib/env/multi_agent_env_runner.py
+:68 MultiAgentEnvRunner, rllib/algorithms/appo/appo.py:345 APPO)."""
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rl import (APPO, AppoAlgorithmConfig, MultiAgentEnv,
+                        MultiAgentPPO, MultiAgentPPOConfig)
+
+
+@pytest.fixture
+def ray4():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+class _Box:
+    def __init__(self, shape):
+        self.shape = shape
+
+
+class _Discrete:
+    def __init__(self, n):
+        self.n = n
+
+
+class CoordinationGame(MultiAgentEnv):
+    """Two agents see a shared one-hot context; each earns 1 when it picks
+    the context index. Learnable to near-max return in a few iterations.
+    'follower' additionally earns a bonus when it MATCHES 'leader',
+    making per-policy learning observable."""
+
+    K = 4
+    EP_LEN = 16
+    possible_agents = ["leader", "follower"]
+    # class-body comprehensions can't read class attrs: spell out K=4
+    observation_spaces = {a: _Box((4,)) for a in ["leader", "follower"]}
+    action_spaces = {a: _Discrete(4) for a in ["leader", "follower"]}
+
+    def __init__(self, seed=0):
+        self._rng = np.random.default_rng(seed)
+        self._t = 0
+        self._ctx = 0
+
+    def _obs(self):
+        o = np.zeros(self.K, np.float32)
+        o[self._ctx] = 1.0
+        return {a: o.copy() for a in self.possible_agents}
+
+    def reset(self, seed=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._t = 0
+        self._ctx = int(self._rng.integers(self.K))
+        return self._obs(), {}
+
+    def step(self, actions):
+        rew = {a: float(actions[a] == self._ctx)
+               for a in self.possible_agents}
+        if actions["follower"] == actions["leader"]:
+            rew["follower"] += 0.5
+        self._t += 1
+        self._ctx = int(self._rng.integers(self.K))
+        done = self._t >= self.EP_LEN
+        terms = {a: done for a in self.possible_agents}
+        terms["__all__"] = done
+        truncs = {a: False for a in self.possible_agents}
+        truncs["__all__"] = False
+        return self._obs(), rew, terms, truncs, {}
+
+
+def test_multi_agent_ppo_two_policies_converge(ray4):
+    """Separate policies per agent on a 2-agent env reach near-max joint
+    return (max = 16*(1+1+0.5) = 40; random ~ 16*(0.25+0.25+0.125))."""
+    cfg = (MultiAgentPPOConfig()
+           .environment(CoordinationGame)
+           .env_runners(num_env_runners=2, rollout_fragment_length=64)
+           .training(lr=3e-3, num_epochs=4, num_minibatches=4)
+           .multi_agent(policies=["pl", "pf"],
+                        policy_mapping={"leader": "pl", "follower": "pf"}))
+    algo = cfg.build()
+    try:
+        best = -1e9
+        for _ in range(25):
+            res = algo.train()
+            if not np.isnan(res["episode_return_mean"]):
+                best = max(best, res["episode_return_mean"])
+            if best > 32:
+                break
+        assert best > 32, best
+        # both policies actually trained (per-policy learner stats exist)
+        assert "learner/pl/total_loss" in res
+        assert "learner/pf/total_loss" in res
+        ev = algo.evaluate(num_episodes=3)
+        assert ev["mean_return"] > 32
+    finally:
+        algo.stop()
+
+
+def test_multi_agent_shared_policy(ray4):
+    """All agents mapped onto one shared policy still learn."""
+    cfg = (MultiAgentPPOConfig()
+           .environment(CoordinationGame)
+           .env_runners(num_env_runners=1, rollout_fragment_length=64)
+           .training(lr=3e-3, num_epochs=4, num_minibatches=4)
+           .multi_agent(policies=["shared"]))
+    algo = cfg.build()
+    try:
+        assert set(algo.learners) == {"shared"}
+        best = -1e9
+        for _ in range(50):
+            res = algo.train()
+            if not np.isnan(res["episode_return_mean"]):
+                best = max(best, res["episode_return_mean"])
+            if best > 30:
+                break
+        assert best > 30, best
+    finally:
+        algo.stop()
+
+
+def test_multi_agent_rejects_unknown_policy(ray4):
+    cfg = (MultiAgentPPOConfig()
+           .environment(CoordinationGame)
+           .multi_agent(policies=["a"],
+                        policy_mapping={"leader": "a", "follower": "b"}))
+    with pytest.raises(ValueError, match="unknown policies"):
+        cfg.build()
+
+
+def test_appo_cartpole_converges(ray4):
+    cfg = (AppoAlgorithmConfig()
+           .environment("CartPole-v1")
+           .env_runners(num_env_runners=2, num_envs_per_env_runner=4,
+                        rollout_fragment_length=32)
+           .training(lr=2e-3, entropy_coeff=0.003, clip_param=0.3))
+    algo = cfg.build()
+    try:
+        best = -1e9
+        for _ in range(150):
+            res = algo.train()
+            if not np.isnan(res["episode_return_mean"]):
+                best = max(best, res["episode_return_mean"])
+            if best > 100:
+                break
+        # random CartPole ~ 20; learning is unambiguous past 100
+        assert best > 100, best
+    finally:
+        algo.stop()
