@@ -263,6 +263,11 @@ def main(argv=None):
                     help="seconds to retry re-dialing a restarted head "
                          "(0 disables)")
     args = ap.parse_args(argv)
+    # terminate() must run the teardown path (kill workers, unlink the
+    # own-store shm file) — without this, every terminated agent leaks
+    # its /dev/shm store for the host's lifetime
+    import signal
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
     authkey = bytes.fromhex(args.authkey or os.environ["RTPU_AUTHKEY"])
     resources = {"CPU": args.num_cpus, **json.loads(args.resources)}
     agent = NodeAgent(args.head, authkey, resources, args.name,

@@ -589,6 +589,13 @@ class Runtime:
                          and getattr(n, "last_heartbeat", None) is not None
                          and now - n.last_heartbeat > timeout]
             self._reap_idle_workers()
+            with self.lock:
+                # periodic work-stealing fallback: the done->idle trigger
+                # misses the case where the LAST other-worker done fires
+                # before a pipeline gets stuck behind a slow task — with
+                # no further events, nothing would ever steal it
+                if any(w.state == "idle" for w in self.workers.values()):
+                    self._rebalance_pipelines_locked()
             for n in stale:
                 # declare the node dead DIRECTLY: closing the conn would
                 # not wake the agent loop's blocked read (Linux read()
@@ -1746,6 +1753,7 @@ class Runtime:
             w.pending_spec = spec
             return
         w.state = "busy"
+        w.current_started = time.monotonic()
         if spec.runtime_env and w.env_hash is None:
             self._ship_renv_locked(w, spec.runtime_env)
         self._ship_function_locked(w, spec.func_id)
@@ -1800,6 +1808,7 @@ class Runtime:
         nxt, _nonce = w.queued.popleft()
         w.current = nxt
         w.state = "busy"
+        w.current_started = time.monotonic()
         self._record_task_locked(nxt, "RUNNING", worker=w.wid,
                                  node=w.node_id.hex(),
                                  started_at=time.time())
@@ -1829,10 +1838,17 @@ class Runtime:
         straggler moves, else it waits out the whole task ahead of it)."""
         if self.pending:
             return  # the scheduler will feed the idle worker anyway
+        # only steal from behind a task that is demonstrably SLOW: during
+        # a fast-draining burst workers dip idle between submissions, and
+        # stealing then just churns messages (tasks would finish sooner
+        # where they are)
+        now = time.monotonic()
         victim = None
         for w in self.workers.values():
-            if len(w.queued) >= 1 and (victim is None
-                                       or len(w.queued) > len(victim.queued)):
+            if len(w.queued) >= 1 \
+                    and now - getattr(w, "current_started", 0.0) > 0.05 \
+                    and (victim is None
+                         or len(w.queued) > len(victim.queued)):
                 victim = w
         if victim is not None:
             self._steal_queued_locked(victim)
