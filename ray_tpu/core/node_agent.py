@@ -56,6 +56,8 @@ class NodeAgent:
         self.data_server = None
         data_addr = None
         if own_store:
+            import atexit
+
             from .object_store import SharedObjectStore, SpillStore
             from .object_transfer import ObjectDataServer
             from .runtime import host_ip
@@ -64,6 +66,10 @@ class NodeAgent:
             self._own_spill_dir = f"/tmp/ray_tpu/node_{safe}_{os.getpid()}/spill"
             self.local_store = SharedObjectStore(
                 self._own_store_path, capacity=store_capacity, create=True)
+            # registered the instant the shm file exists: a SIGTERM that
+            # lands anywhere after this point (even mid-__init__, before
+            # run()'s finally is armed) still unlinks the store
+            atexit.register(self.teardown)
             self.local_spill = SpillStore(self._own_spill_dir)
             self.data_server = ObjectDataServer(
                 self.local_store, self.local_spill, host="0.0.0.0")
@@ -226,21 +232,43 @@ class NodeAgent:
         except (EOFError, OSError):
             pass  # head went away
         finally:
-            for p in list(self.procs.values()):
-                try:
-                    p.kill()
-                except Exception:
-                    pass
-            deadline = time.monotonic() + 2.0
-            for p in list(self.procs.values()):
-                try:
-                    p.wait(timeout=max(0.01, deadline - time.monotonic()))
-                except Exception:
-                    pass
-            if self.data_server is not None:
+            self.teardown()
+
+    _torn_down = False
+
+    def teardown(self):
+        """Idempotent full cleanup (kill workers, unlink the own-store shm
+        file). Runs from run()'s finally, atexit, and the SIGTERM path; a
+        second SIGTERM mid-teardown is ignored so the unlink completes."""
+        if self._torn_down:
+            return
+        self._torn_down = True
+        import signal
+        try:
+            signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        except (ValueError, OSError):
+            pass  # not the main thread / already exiting
+        for p in list(self.procs.values()):
+            try:
+                p.kill()
+            except Exception:
+                pass
+        deadline = time.monotonic() + 2.0
+        for p in list(self.procs.values()):
+            try:
+                p.wait(timeout=max(0.01, deadline - time.monotonic()))
+            except Exception:
+                pass
+        if self.data_server is not None:
+            try:
                 self.data_server.stop()
-            if self.local_store is not None:
+            except Exception:
+                pass
+        if self.local_store is not None:
+            try:
                 self.local_store.close(unlink=True)
+            except Exception:
+                pass
 
 
 def main(argv=None):

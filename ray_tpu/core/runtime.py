@@ -511,6 +511,8 @@ class Runtime:
         # already covers dead processes) — gcs_health_check_manager.h:45
         threading.Thread(target=self._health_check_loop, daemon=True,
                          name="rtpu-healthcheck").start()
+        threading.Thread(target=self._pipeline_rebalance_loop, daemon=True,
+                         name="rtpu-rebalance").start()
 
         # cross-node data plane: serve this node's store to pullers
         # (object_manager.h:119 Push/Pull analog; object_transfer.py)
@@ -589,13 +591,6 @@ class Runtime:
                          and getattr(n, "last_heartbeat", None) is not None
                          and now - n.last_heartbeat > timeout]
             self._reap_idle_workers()
-            with self.lock:
-                # periodic work-stealing fallback: the done->idle trigger
-                # misses the case where the LAST other-worker done fires
-                # before a pipeline gets stuck behind a slow task — with
-                # no further events, nothing would ever steal it
-                if any(w.state == "idle" for w in self.workers.values()):
-                    self._rebalance_pipelines_locked()
             for n in stale:
                 # declare the node dead DIRECTLY: closing the conn would
                 # not wake the agent loop's blocked read (Linux read()
@@ -614,6 +609,25 @@ class Runtime:
                     n.agent.conn.close()
                 except Exception:
                     pass
+
+    def _pipeline_rebalance_loop(self):
+        """Periodic work-stealing fallback (own timer — NOT coupled to the
+        health-check flag): the done->idle steal trigger misses the case
+        where the last other-worker done fires before a pipeline gets
+        stuck behind a slow task, or fires inside the 50ms slow gate —
+        with no further events, nothing would ever steal the straggler."""
+        from .config import cfg
+        if cfg.worker_pipeline_depth <= 0:
+            return
+        while not self._shutdown:
+            time.sleep(0.1)
+            try:
+                with self.lock:
+                    if any(w.state == "idle"
+                           for w in self.workers.values()):
+                        self._rebalance_pipelines_locked()
+            except Exception:
+                pass  # never let bookkeeping kill the timer
 
     def _reap_idle_workers(self):
         """Idle workers beyond the prestart floor exit after
