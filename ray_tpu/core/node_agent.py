@@ -242,12 +242,14 @@ class NodeAgent:
         second SIGTERM mid-teardown is ignored so the unlink completes."""
         if self._torn_down:
             return
-        self._torn_down = True
         import signal
         try:
             signal.signal(signal.SIGTERM, signal.SIG_IGN)
         except (ValueError, OSError):
             pass  # not the main thread / already exiting
+        # flag AFTER masking SIGTERM: a signal landing between the two
+        # would abort this run while the atexit retry no-ops on the flag
+        self._torn_down = True
         for p in list(self.procs.values()):
             try:
                 p.kill()

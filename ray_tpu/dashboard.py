@@ -1,19 +1,24 @@
 """ray_tpu.dashboard — cluster overview over HTTP.
 
 Reference parity: the dashboard head + its API modules
-(dashboard/head.py:48, dashboard/modules/{node,job,actor,state,metrics})
-and the React frontend, reduced TPU-first: the head runtime IS the data
-source, so the dashboard is an in-process aiohttp thread serving the
-state API as JSON plus one self-contained HTML page — no separate
-process tree, no node agents, no build step.
+(dashboard/head.py:48, dashboard/modules/{node,job,actor,state,metrics,
+log,serve}) and the React frontend, reduced TPU-first: the head runtime
+IS the data source, so the dashboard is an in-process aiohttp thread
+serving the state API as JSON plus one self-contained HTML page — no
+separate process tree, no node agents, no build step.
 
     import ray_tpu
     from ray_tpu import dashboard
     ray_tpu.init()
     port = dashboard.start_dashboard(port=8265)
-    # GET /            -> HTML overview (auto-refreshing)
+    # GET /            -> HTML overview (auto-refreshing; tasks/actors
+    #                     click through to detail, logs are browsable)
     # GET /api/summary | /api/nodes | /api/actors | /api/tasks
     #     /api/objects | /api/workers | /api/jobs | /api/config
+    #     /api/serve   | /api/logs
+    # GET /api/task/{id}   -> full task record + its timeline events
+    # GET /api/actor/{id}  -> full actor record + per-call queues
+    # GET /api/log?file=worker-X.log&tail=N -> log tail (session dir only)
     # GET /metrics     -> Prometheus text (same as state.start_metrics_server)
 """
 from __future__ import annotations
@@ -46,7 +51,15 @@ _PAGE = """<!DOCTYPE html>
 <h2>Workers</h2><table id="workers"></table>
 <h2>Actors</h2><table id="actors"></table>
 <h2>Jobs</h2><table id="jobs"></table>
+<h2>Serve</h2><table id="serve"></table>
 <h2>Recent tasks</h2><table id="tasks"></table>
+<h2>Detail</h2><pre id="detail"
+ style="background:#fff;border:1px solid #ddd;padding:8px;min-height:2em;
+        font-size:0.8em;white-space:pre-wrap">click a task or actor id</pre>
+<h2>Logs</h2><table id="logs"></table>
+<pre id="logview"
+ style="background:#111;color:#ddd;padding:8px;max-height:24em;
+        overflow:auto;font-size:0.78em;display:none"></pre>
 <script>
 function row(tr, cells, tag) {
   const r = document.createElement('tr');
@@ -56,6 +69,11 @@ function row(tr, cells, tag) {
       const s = document.createElement('span');
       s.className = 'pill ' + c.pill; s.textContent = c.pill;
       td.appendChild(s);
+    } else if (typeof c === 'object' && c && c.click) {
+      const a = document.createElement('a');
+      a.textContent = c.text; a.href = '#';
+      a.onclick = (e) => { e.preventDefault(); c.click(); };
+      td.appendChild(a);
     } else td.textContent = c;
     r.appendChild(td);
   }
@@ -66,6 +84,18 @@ function fill(id, header, rows) {
   t.innerHTML = '';
   row(t, header, 'th');
   for (const r of rows) row(t, r);
+}
+async function detail(url) {
+  const d = await (await fetch(url)).json();
+  document.getElementById('detail').textContent =
+    JSON.stringify(d, null, 2);
+}
+async function showLog(name) {
+  const v = document.getElementById('logview');
+  v.style.display = 'block';
+  const d = await (await fetch(
+    'api/log?tail=200&file=' + encodeURIComponent(name))).json();
+  v.textContent = `== ${name} ==\\n` + (d.lines || []).join('\\n');
 }
 async function refresh() {
   const s = await (await fetch('api/summary')).json();
@@ -86,15 +116,32 @@ async function refresh() {
                          w.current_task || w.actor_id]));
   const actors = await (await fetch('api/actors')).json();
   fill('actors', ['id', 'class', 'state', 'name', 'pending', 'running'],
-       actors.map(a => [a.actor_id.slice(0, 12), a.class_name,
-                        {pill: a.state}, a.name, a.pending_calls,
-                        a.running_calls]));
+       actors.map(a => [{text: a.actor_id.slice(0, 12),
+                         click: () => detail('api/actor/' + a.actor_id)},
+                        a.class_name, {pill: a.state}, a.name,
+                        a.pending_calls, a.running_calls]));
   const jobs = await (await fetch('api/jobs')).json();
   fill('jobs', ['id', 'status', 'entrypoint'],
        jobs.map(j => [j.job_id, {pill: j.status}, j.entrypoint]));
+  try {
+    const sv = await (await fetch('api/serve')).json();
+    const rows = [];
+    for (const [app, dep] of Object.entries(sv.applications || {}))
+      for (const [name, d] of Object.entries(dep.deployments || {}))
+        rows.push([app, name, {pill: d.status || 'RUNNING'},
+                   `${d.num_replicas_running ?? d.replicas ?? ''}`]);
+    fill('serve', ['app', 'deployment', 'status', 'replicas'], rows);
+  } catch (e) { fill('serve', ['(serve not running)'], []); }
   const tasks = await (await fetch('api/tasks?limit=25')).json();
-  fill('tasks', ['name', 'state', 'worker'],
-       tasks.map(t => [t.name, {pill: t.state}, t.worker || '']));
+  fill('tasks', ['task_id', 'name', 'state', 'worker', 'duration'],
+       tasks.map(t => [{text: (t.task_id || '').slice(0, 12),
+                        click: () => detail('api/task/' + t.task_id)},
+                       t.name, {pill: t.state}, t.worker || '',
+                       t.duration_s ? t.duration_s.toFixed(3) + 's' : '']));
+  const logs = await (await fetch('api/logs')).json();
+  fill('logs', ['file', 'size'],
+       logs.map(l => [{text: l.file, click: () => showLog(l.file)},
+                      `${(l.size/1024).toFixed(1)} KB`]));
 }
 refresh(); setInterval(refresh, 2000);
 </script></body></html>"""
@@ -144,6 +191,76 @@ def start_dashboard(port: int = 0, host: str = "127.0.0.1") -> int:
         return web.json_response(out, dumps=lambda o: json.dumps(
             o, default=str))
 
+    async def task_detail(request):
+        """Per-task drill-in: the full record + its timeline events
+        (reference: dashboard task detail via StateHead)."""
+        tid = request.match_info["id"]
+        with rt.lock:
+            rec = next((dict(r) for r in rt.task_records.values()
+                        if r.get("task_id") == tid), None)
+            events = [e for e in rt.events
+                      if e.get("tid") == tid[:8]]
+        if rec is None:
+            return web.json_response({"error": f"no task {tid}"},
+                                     status=404)
+        rec["events"] = events
+        return web.json_response(rec, dumps=lambda o: json.dumps(
+            o, default=str))
+
+    async def actor_detail(request):
+        aid_hex = request.match_info["id"]
+        with rt.lock:
+            hit = next(((aid, a) for aid, a in rt.actors.items()
+                        if aid.hex() == aid_hex), None)
+            if hit is None:
+                return web.json_response(
+                    {"error": f"no actor {aid_hex}"}, status=404)
+            aid, a = hit
+            out = {
+                "actor_id": aid.hex(), "class_name": a.spec.name,
+                "state": a.state.upper(), "name": a.spec.named or "",
+                "worker": a.wid or "", "restarts_left": a.restarts_left,
+                "death_cause": a.death_cause,
+                "max_concurrency": a.spec.max_concurrency,
+                "resources": dict(a.spec.resources),
+                "pending_calls": [s.name for s in a.queue],
+                "running_calls": [s.name for s in a.running.values()],
+            }
+        return web.json_response(out, dumps=lambda o: json.dumps(
+            o, default=str))
+
+    async def logs_index(request):
+        import glob as _glob
+        import os as _os
+        out = []
+        for p in sorted(_glob.glob(
+                _os.path.join(rt.session_dir, "*.log"))):
+            out.append({"file": _os.path.basename(p),
+                        "size": _os.path.getsize(p)})
+        return web.json_response(out)
+
+    async def log_tail(request):
+        """Log viewer endpoint (reference: dashboard log module). Only
+        basenames inside THIS session's dir are served."""
+        import os as _os
+        name = _os.path.basename(request.query.get("file", ""))
+        try:
+            tail = int(request.query.get("tail", 200))
+        except ValueError:
+            return web.json_response({"error": "tail must be an int"},
+                                     status=400)
+        tail = max(1, min(tail, 5000))
+        path = _os.path.join(rt.session_dir, name)
+        if not name.endswith(".log") or not _os.path.isfile(path):
+            return web.json_response({"error": f"no log {name!r}"},
+                                     status=404)
+        with open(path, "rb") as f:
+            f.seek(0, 2)
+            size = f.tell()
+            f.seek(max(0, size - 256 * 1024))
+            lines = f.read().decode("utf-8", "replace").splitlines()
+        return web.json_response({"file": name, "lines": lines[-tail:]})
+
     async def metrics(request):
         return web.Response(text=state_api._prometheus_text(),
                             content_type="text/plain")
@@ -155,6 +272,10 @@ def start_dashboard(port: int = 0, host: str = "127.0.0.1") -> int:
         asyncio.set_event_loop(loop)
         app = web.Application()
         app.router.add_get("/", page)
+        app.router.add_get("/api/task/{id}", task_detail)
+        app.router.add_get("/api/actor/{id}", actor_detail)
+        app.router.add_get("/api/logs", logs_index)
+        app.router.add_get("/api/log", log_tail)
         app.router.add_get("/api/{kind}", api)
         app.router.add_get("/metrics", metrics)
         runner = web.AppRunner(app)
