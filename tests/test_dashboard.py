@@ -72,14 +72,25 @@ def test_dashboard_drilldowns_and_logs(dash):
 
     assert ray.get(work.remote(21), timeout=60) == 42
 
-    # task drill-in: find the finished record, fetch its detail
-    status, body = _get(port, "/api/tasks?limit=50")
-    recs = json.loads(body)
-    rec = next(r for r in recs if r["name"] == "work")
-    status, body = _get(port, f"/api/task/{rec['task_id']}")
-    assert status == 200
-    d = json.loads(body)
-    assert d["name"] == "work" and d["state"] == "FINISHED"
+    # task drill-in: find the finished record, fetch its detail.
+    # get() returns at object-seal; the head's done bookkeeping settles a
+    # tick later — poll briefly.
+    import time as _time
+    d = None
+    deadline = _time.monotonic() + 10
+    while _time.monotonic() < deadline:
+        status, body = _get(port, "/api/tasks?limit=50")
+        rec = next((r for r in json.loads(body) if r["name"] == "work"),
+                   None)
+        if rec is not None:
+            status, body = _get(port, f"/api/task/{rec['task_id']}")
+            assert status == 200
+            d = json.loads(body)
+            if d["state"] == "FINISHED":
+                break
+        _time.sleep(0.2)
+    assert d is not None and d["name"] == "work"
+    assert d["state"] == "FINISHED"
     assert "events" in d
 
     # actor drill-in
