@@ -1,16 +1,22 @@
 """data.llm analog: batch inference processors over Datasets.
 
-Reference parity: python/ray/data/llm.py:248 build_llm_processor and
-llm/_internal/batch/processor/base.py:104 (Processor = chained stages:
-preprocess -> tokenize -> engine -> detokenize -> postprocess, each a Data
-transform). Here the engine stage is a map_batches over the JAX engine —
-one engine per task keeps it simple in round 1 (an actor-pool engine stage
-is the optimization path).
+Reference parity: python/ray/data/llm.py:248 build_llm_processor,
+llm/_internal/batch/processor/base.py:104 (Processor = an ordered chain
+of stages wrapped by user preprocess/postprocess), and the stage family
+under llm/_internal/batch/stages/ (chat_template_stage.py,
+tokenize_stage.py, vllm_engine_stage.py, http_request_stage.py).
+
+TPU-first shape: every stage is a Dataset transform; the engine stage is
+a stateful map_batches over an AUTOSCALING actor pool (one engine per
+actor — model init + XLA compiles paid once per actor, pool size scales
+(min,max) with queue depth via data/executor.py), and the HTTP stage
+fans rows out to any OpenAI-compatible endpoint (e.g. a ray_tpu serve
+app or a disaggregated P/D deployment).
 """
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Optional
+from typing import Any, Callable, Optional
 
 from .engine import EngineConfig, InferenceEngine, SamplingParams
 
@@ -27,46 +33,265 @@ def _get_engine(cfg: EngineConfig) -> InferenceEngine:
 
 @dataclasses.dataclass
 class ProcessorConfig:
+    """(reference: processor/base.py:21 + OfflineProcessorConfig:55)"""
     engine: Optional[EngineConfig] = None
     sampling: SamplingParams = dataclasses.field(
         default_factory=SamplingParams)
     prompt_column: str = "prompt"
     output_column: str = "generated_text"
     batch_size: int = 8
+    # engine actor pool (reference: OfflineProcessorConfig concurrency);
+    # a (min, max) tuple autoscales with queue depth
+    concurrency: Any = None
 
 
-class Processor:
-    """(reference: processor/base.py:104) `__call__(Dataset) -> Dataset`."""
+# --------------------------------------------------------------------- #
+# stages (reference: llm/_internal/batch/stages/)
+# --------------------------------------------------------------------- #
 
-    def __init__(self, cfg: ProcessorConfig,
-                 preprocess: Optional[Callable] = None,
-                 postprocess: Optional[Callable] = None):
+class Stage:
+    """One Dataset -> Dataset transform with a name (reference:
+    stages/base.py StatefulStage)."""
+
+    name = "stage"
+
+    def __call__(self, ds):
+        raise NotImplementedError
+
+
+class ChatTemplateStage(Stage):
+    """messages column -> prompt column via the chat template (reference:
+    stages/chat_template_stage.py)."""
+
+    name = "ChatTemplate"
+
+    def __init__(self, messages_column: str = "messages",
+                 prompt_column: str = "prompt"):
+        self.messages_column = messages_column
+        self.prompt_column = prompt_column
+
+    def __call__(self, ds):
+        mc, pc = self.messages_column, self.prompt_column
+
+        def apply(row: dict) -> dict:
+            from .openai_api import apply_chat_template
+            out = dict(row)
+            out[pc] = apply_chat_template(list(row[mc]))
+            return out
+
+        return ds.map(apply)
+
+
+class TokenizeStage(Stage):
+    """prompt -> token ids (reference: stages/tokenize_stage.py Tokenize
+    half). The engine consumes raw prompts too, but pre-tokenizing lets
+    the pipeline dedupe/sort by length before engine admission."""
+
+    name = "Tokenize"
+
+    def __init__(self, prompt_column: str = "prompt",
+                 ids_column: str = "input_ids", tokenizer: Any = None):
+        self.prompt_column = prompt_column
+        self.ids_column = ids_column
+        self.tokenizer = tokenizer
+
+    def __call__(self, ds):
+        pc, ic = self.prompt_column, self.ids_column
+        tok_spec = self.tokenizer
+
+        def apply_batch(batch: dict) -> dict:
+            from .tokenizer import get_tokenizer
+            tok = get_tokenizer(tok_spec)  # built once per BLOCK, not row
+            out = dict(batch)
+            out[ic] = [tok.encode(str(p)) for p in batch[pc]]
+            return out
+
+        return ds.map_batches(apply_batch)
+
+
+class DetokenizeStage(Stage):
+    """token ids -> text (reference: tokenize_stage.py Detokenize
+    half)."""
+
+    name = "Detokenize"
+
+    def __init__(self, ids_column: str = "generated_ids",
+                 text_column: str = "generated_text",
+                 tokenizer: Any = None):
+        self.ids_column = ids_column
+        self.text_column = text_column
+        self.tokenizer = tokenizer
+
+    def __call__(self, ds):
+        ic, tc = self.ids_column, self.text_column
+        tok_spec = self.tokenizer
+
+        def apply_batch(batch: dict) -> dict:
+            from .tokenizer import get_tokenizer
+            tok = get_tokenizer(tok_spec)  # built once per BLOCK, not row
+            out = dict(batch)
+            out[tc] = [tok.decode(list(ids)) for ids in batch[ic]]
+            return out
+
+        return ds.map_batches(apply_batch)
+
+
+def _default_engine_cfg(cfg: ProcessorConfig) -> EngineConfig:
+    from ..models import llama
+    return cfg.engine or EngineConfig(model=llama.llama_tiny(),
+                                      max_batch_size=cfg.batch_size)
+
+
+def _engine_batch(engine, sampling, prompt_column, output_column,
+                  batch: dict) -> dict:
+    """The one batch->result shaping both engine paths share."""
+    prompts = [str(p) for p in batch[prompt_column]]
+    outs = engine.generate(prompts, sampling)
+    result = dict(batch)
+    result[output_column] = [o["text"] for o in outs]
+    result["generated_ids"] = [list(o["token_ids"]) for o in outs]
+    result["num_generated_tokens"] = [len(o["token_ids"]) for o in outs]
+    return result
+
+
+class _EngineActor:
+    """Stateful pool member: builds its engine once, generates per batch
+    (reference: vllm_engine_stage.py — one vLLM engine per stage actor)."""
+
+    def __init__(self, engine_cfg, sampling, prompt_column, output_column):
+        self.engine = InferenceEngine(engine_cfg)
+        self.sampling = sampling
+        self.pc = prompt_column
+        self.oc = output_column
+
+    def __call__(self, batch: dict) -> dict:
+        return _engine_batch(self.engine, self.sampling, self.pc,
+                             self.oc, batch)
+
+
+class EngineStage(Stage):
+    """The LLM stage (reference: vllm_engine_stage.py). With
+    ``cfg.concurrency`` the engines run in a (min,max)-autoscaling actor
+    pool; without, a cached engine per worker process via plain
+    map_batches."""
+
+    name = "Engine"
+
+    def __init__(self, cfg: ProcessorConfig):
         self.cfg = cfg
-        self.preprocess = preprocess
-        self.postprocess = postprocess
 
     def __call__(self, ds):
         cfg = self.cfg
-        if self.preprocess is not None:
-            ds = ds.map(self.preprocess)
+        engine_cfg = _default_engine_cfg(cfg)
+        if cfg.concurrency is not None:
+            return ds.map_batches(
+                _EngineActor, concurrency=cfg.concurrency,
+                fn_constructor_args=(engine_cfg, cfg.sampling,
+                                     cfg.prompt_column,
+                                     cfg.output_column))
 
         def run_engine(batch: dict) -> dict:
-            from ..models import llama
-            engine_cfg = cfg.engine or EngineConfig(
-                model=llama.llama_tiny(),
-                max_batch_size=cfg.batch_size)
             # engines cache per worker process: model init + XLA compiles
             # are paid once, not once per block
-            engine = _get_engine(engine_cfg)
-            prompts = [str(p) for p in batch[cfg.prompt_column]]
-            outs = engine.generate(prompts, cfg.sampling)
-            result = dict(batch)
-            result[cfg.output_column] = [o["text"] for o in outs]
-            result["num_generated_tokens"] = [
-                len(o["token_ids"]) for o in outs]
-            return result
+            return _engine_batch(_get_engine(engine_cfg), cfg.sampling,
+                                 cfg.prompt_column, cfg.output_column,
+                                 batch)
 
-        ds = ds.map_batches(run_engine)
+        return ds.map_batches(run_engine)
+
+
+class HttpRequestStage(Stage):
+    """POST each row's payload to an OpenAI-compatible endpoint
+    (reference: stages/http_request_stage.py — concurrent requests with
+    retry on transient failures). Rows of a block fan out over a thread
+    pool; 429/5xx and socket errors retry with exponential backoff."""
+
+    name = "HttpRequest"
+
+    def __init__(self, url: str, payload_fn: Callable[[dict], dict],
+                 output_column: str = "response",
+                 timeout_s: float = 120.0, headers: Optional[dict] = None,
+                 max_retries: int = 3, requests_per_block: int = 8):
+        self.url = url
+        self.payload_fn = payload_fn
+        self.output_column = output_column
+        self.timeout_s = timeout_s
+        self.headers = headers or {}
+        self.max_retries = max_retries
+        self.requests_per_block = requests_per_block
+
+    def __call__(self, ds):
+        url, payload_fn = self.url, self.payload_fn
+        oc, timeout_s = self.output_column, self.timeout_s
+        headers = self.headers
+        retries, width = self.max_retries, self.requests_per_block
+
+        def one(payload: dict):
+            import json as _json
+            import time as _time
+            import urllib.error
+            import urllib.request
+            delay = 0.5
+            for attempt in range(retries + 1):
+                try:
+                    req = urllib.request.Request(
+                        url, data=_json.dumps(payload).encode(),
+                        headers={"Content-Type": "application/json",
+                                 **headers})
+                    with urllib.request.urlopen(req,
+                                                timeout=timeout_s) as r:
+                        return _json.loads(r.read())
+                except urllib.error.HTTPError as e:
+                    # 4xx (except 429) is the caller's bug: no retry
+                    if e.code not in (429, 500, 502, 503, 504) \
+                            or attempt == retries:
+                        raise
+                except (urllib.error.URLError, OSError):
+                    if attempt == retries:
+                        raise
+                _time.sleep(delay)
+                delay = min(delay * 2, 8.0)
+
+        def apply_batch(batch: dict) -> dict:
+            import concurrent.futures as cf
+            n = len(next(iter(batch.values())))
+            rows = [{k: batch[k][i] for k in batch} for i in range(n)]
+            with cf.ThreadPoolExecutor(max_workers=width) as pool:
+                resp = list(pool.map(
+                    lambda row: one(payload_fn(row)), rows))
+            out = dict(batch)
+            out[oc] = resp
+            return out
+
+        return ds.map_batches(apply_batch)
+
+
+# --------------------------------------------------------------------- #
+# processor
+# --------------------------------------------------------------------- #
+
+class Processor:
+    """(reference: processor/base.py:104) `__call__(Dataset) -> Dataset`:
+    user preprocess -> ordered stages -> user postprocess."""
+
+    def __init__(self, cfg: ProcessorConfig,
+                 preprocess: Optional[Callable] = None,
+                 postprocess: Optional[Callable] = None,
+                 stages: Optional[list] = None):
+        self.cfg = cfg
+        self.preprocess = preprocess
+        self.postprocess = postprocess
+        self.stages: list[Stage] = (list(stages) if stages is not None
+                                    else [EngineStage(cfg)])
+
+    def list_stage_names(self) -> list[str]:
+        return [s.name for s in self.stages]
+
+    def __call__(self, ds):
+        if self.preprocess is not None:
+            ds = ds.map(self.preprocess)
+        for stage in self.stages:
+            ds = stage(ds)
         if self.postprocess is not None:
             ds = ds.map(self.postprocess)
         return ds
@@ -74,6 +299,12 @@ class Processor:
 
 def build_llm_processor(config: ProcessorConfig,
                         preprocess: Optional[Callable] = None,
-                        postprocess: Optional[Callable] = None) -> Processor:
-    """(reference: data/llm.py:248)"""
-    return Processor(config, preprocess, postprocess)
+                        postprocess: Optional[Callable] = None,
+                        stages: Optional[list] = None) -> Processor:
+    """(reference: data/llm.py:248). Default = one EngineStage; pass
+    ``stages`` for custom chains, e.g.::
+
+        build_llm_processor(cfg, stages=[
+            ChatTemplateStage(), EngineStage(cfg)])
+    """
+    return Processor(config, preprocess, postprocess, stages)
