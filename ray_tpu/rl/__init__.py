@@ -7,6 +7,8 @@ config 3: PPO EnvRunner actors + jitted JAX learner over the mesh).
 """
 from .algorithm import PPO, AlgorithmConfig
 from .appo import APPO, AppoAlgorithmConfig, AppoConfig, AppoLearner
+from .connectors import (ClipObs, Connector, ConnectorPipeline,
+                         FlattenObs, MeanStdFilter)
 from .dqn import (DQN, DQNAlgorithmConfig, DQNConfig, DQNLearner,
                   ReplayBuffer)
 from .impala import (IMPALA, ImpalaAlgorithmConfig, ImpalaConfig,
@@ -21,6 +23,8 @@ from .offline import (BC, BCConfig, CQL, CQLConfig, collect_transitions)
 
 __all__ = [
     "APPO", "AppoAlgorithmConfig", "AppoConfig", "AppoLearner",
+    "Connector", "ConnectorPipeline", "FlattenObs", "ClipObs",
+    "MeanStdFilter",
     "DQN", "DQNAlgorithmConfig", "DQNConfig", "DQNLearner", "ReplayBuffer",
     "IMPALA", "ImpalaAlgorithmConfig", "ImpalaConfig", "ImpalaLearner",
     "vtrace", "SAC", "SACAlgorithmConfig", "SACConfig", "SACLearner",

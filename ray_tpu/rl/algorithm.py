@@ -65,6 +65,7 @@ class PPO(AlgorithmBase):
         t1 = time.perf_counter()
         stats = self.learner.update(samples)
         t_update = time.perf_counter() - t1
+        self._sync_connector_state()
 
         self.iteration += 1
         steps = (self.config.rollout_len * self.config.num_envs_per_runner

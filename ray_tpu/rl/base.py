@@ -42,11 +42,22 @@ class AlgorithmBase:
         self.module_cfg = self._make_module_cfg(probe)
         probe.close()
         RunnerCls = ray.remote(runner_cls)
+        extra = {}
+        if getattr(config, "env_to_module", None) is not None:
+            import inspect
+            if "connectors" not in inspect.signature(
+                    runner_cls.__init__).parameters:
+                raise ValueError(
+                    f"{type(self).__name__} does not support connector "
+                    f"pipelines ({runner_cls.__name__} takes no "
+                    f"'connectors' argument)")
+            extra["connectors"] = config.env_to_module
         self._runners = [
             RunnerCls.options(num_cpus=config.runner_resources.get(
                 "CPU", 1)).remote(
                 config.env_fn, config.num_envs_per_runner,
-                config.rollout_len, seed=config.seed + 1000 * (i + 1))
+                config.rollout_len, seed=config.seed + 1000 * (i + 1),
+                **extra)
             for i in range(config.num_env_runners)]
         self._ray = ray
         self.iteration = 0
@@ -75,6 +86,22 @@ class AlgorithmBase:
         return ray.get(self._runners[0].evaluate.remote(
             weights_ref, num_episodes))
 
+    def _sync_connector_state(self) -> None:
+        """Fold per-runner connector DELTAS (obs seen since the last
+        broadcast) into the driver pipeline's global state and broadcast
+        it back (reference: connector state syncing between EnvRunners
+        each iteration; delta-based so the shared prior is never
+        double-counted)."""
+        pipeline = getattr(self.config, "env_to_module", None)
+        if pipeline is None:
+            return
+        ray = self._ray
+        deltas = ray.get([r.get_connector_state.remote()
+                          for r in self._runners])
+        merged = pipeline.absorb_deltas(deltas)
+        ray.get([r.set_connector_state.remote(merged)
+                 for r in self._runners])
+
     def _extra_state(self) -> dict:
         """Algorithm-specific checkpoint fields (e.g. DQN target net)."""
         return {}
@@ -84,12 +111,19 @@ class AlgorithmBase:
 
     def save_checkpoint(self) -> dict:
         import jax
-        return {"params": jax.device_get(self.learner.params),
-                "opt_state": jax.device_get(self.learner.opt_state),
-                "iteration": self.iteration,
-                "total_env_steps": self._total_env_steps,
-                **{k: jax.device_get(v)
-                   for k, v in self._extra_state().items()}}
+        out = {"params": jax.device_get(self.learner.params),
+               "opt_state": jax.device_get(self.learner.opt_state),
+               "iteration": self.iteration,
+               "total_env_steps": self._total_env_steps,
+               **{k: jax.device_get(v)
+                  for k, v in self._extra_state().items()}}
+        pipeline = getattr(self.config, "env_to_module", None)
+        if pipeline is not None:
+            # normalization stats are part of the policy: restoring
+            # params without them would feed the net differently-scaled
+            # inputs than it was trained on
+            out["connector_state"] = pipeline.get_global()
+        return out
 
     def restore_checkpoint(self, state: dict) -> None:
         import jax
@@ -99,6 +133,11 @@ class AlgorithmBase:
             jnp.asarray, state["opt_state"])
         self.iteration = state["iteration"]
         self._total_env_steps = state["total_env_steps"]
+        pipeline = getattr(self.config, "env_to_module", None)
+        if pipeline is not None and state.get("connector_state"):
+            pipeline.set_state(state["connector_state"])
+            self._ray.get([r.set_connector_state.remote(
+                state["connector_state"]) for r in self._runners])
         self._load_extra_state(state)
 
     def stop(self) -> None:
@@ -169,6 +208,7 @@ class AlgorithmConfigBase:
         self.hidden = (64, 64)
         self.seed = 0
         self.runner_resources = {"CPU": 1}
+        self.env_to_module = None
         setattr(self, self.HPARAM_FIELD, self.HPARAM_FACTORY())
 
     def environment(self, env, **kwargs):
@@ -194,6 +234,19 @@ class AlgorithmConfigBase:
         import dataclasses
         hp = getattr(self, self.HPARAM_FIELD)
         setattr(self, self.HPARAM_FIELD, dataclasses.replace(hp, **kwargs))
+        return self
+
+    def connectors(self, env_to_module=None):
+        """Attach an env-to-module connector pipeline (reference:
+        AlgorithmConfig.env_runners(env_to_module_connector=...))."""
+        from .connectors import Connector, ConnectorPipeline
+        if env_to_module is not None and not isinstance(
+                env_to_module, ConnectorPipeline):
+            if isinstance(env_to_module, Connector):
+                env_to_module = ConnectorPipeline([env_to_module])
+            else:
+                env_to_module = ConnectorPipeline(list(env_to_module))
+        self.env_to_module = env_to_module
         return self
 
     def build(self):

@@ -1,0 +1,201 @@
+"""Connector pipelines: composable obs/action transforms for RL.
+
+Reference parity: rllib/connectors/connector_v2.py:31 (ConnectorV2 — a
+callable transform piece; pipelines are themselves connectors) and the
+env-to-module pipeline every new-stack algorithm composes
+(connectors/env_to_module/). Stateful pieces (MeanStdFilter) expose
+mergeable state that the driver synchronizes across runners each
+iteration, the role of RLlib's connector-state syncing between
+EnvRunners and Learners.
+
+TPU-first shape: connectors run runner-side on numpy batches (the policy
+forward stays a pure jitted function over ALREADY-transformed obs), so
+the compiled step never sees data-dependent preprocessing.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class Connector:
+    """One transform piece: ``__call__(obs_batch, update=True) ->
+    obs_batch`` (reference: connector_v2.py:31 — a connector is a
+    callable; a pipeline of connectors is also a connector).
+    ``update=False`` freezes stateful pieces (evaluation, boundary obs)
+    so reads never contaminate training statistics.
+
+    State protocol (delta-based, the reference's runner<->driver sync):
+    ``get_state()`` returns only the observations accumulated SINCE the
+    last ``set_state()`` (the delta); ``set_state(global)`` installs the
+    merged global state and resets the delta. The driver folds deltas
+    into its own global via ``ConnectorPipeline.absorb_deltas`` —
+    merging running totals instead would double-count the shared prior
+    every iteration."""
+
+    def __call__(self, obs: np.ndarray, update: bool = True) -> np.ndarray:
+        raise NotImplementedError
+
+    # stateful pieces override these (reference: ConnectorV2 state API)
+    def get_state(self) -> Optional[dict]:
+        """The DELTA accumulated since the last set_state()."""
+        return None
+
+    def get_global(self) -> Optional[dict]:
+        """Installed global state combined with the local delta."""
+        return None
+
+    def set_state(self, state: Optional[dict]) -> None:
+        pass
+
+    @staticmethod
+    def merge_states(states: list) -> Optional[dict]:
+        return None
+
+
+class ConnectorPipeline(Connector):
+    """Ordered chain; itself a Connector, so pipelines nest
+    ((A->B)->C, reference connector_v2.py docstring)."""
+
+    def __init__(self, pieces: Optional[list] = None):
+        self.pieces: list[Connector] = list(pieces or [])
+
+    def append(self, piece: Connector) -> "ConnectorPipeline":
+        self.pieces.append(piece)
+        return self
+
+    def prepend(self, piece: Connector) -> "ConnectorPipeline":
+        self.pieces.insert(0, piece)
+        return self
+
+    def __call__(self, obs: np.ndarray, update: bool = True) -> np.ndarray:
+        for p in self.pieces:
+            obs = p(obs, update=update)
+        return obs
+
+    def get_state(self):
+        return [p.get_state() for p in self.pieces]
+
+    def get_global(self):
+        return [p.get_global() for p in self.pieces]
+
+    def set_state(self, state):
+        if state is None:
+            return
+        for p, s in zip(self.pieces, state):
+            p.set_state(s)
+
+    def absorb_deltas(self, runner_deltas: list) -> list:
+        """Driver-side: fold per-runner DELTAS into this (driver-held)
+        pipeline's global state; returns the new global to broadcast."""
+        out = []
+        for i, p in enumerate(self.pieces):
+            cur = p.get_global()
+            deltas = [d[i] for d in runner_deltas if d is not None]
+            merged = type(p).merge_states(
+                ([cur] if cur is not None else []) + deltas)
+            p.set_state(merged)
+            out.append(merged)
+        return out
+
+
+class FlattenObs(Connector):
+    """[..., *dims] -> [..., prod(dims)] (reference:
+    env_to_module/flatten_observations.py)."""
+
+    def __call__(self, obs, update: bool = True):
+        obs = np.asarray(obs)
+        return obs.reshape(obs.shape[0], -1)
+
+
+class ClipObs(Connector):
+    def __init__(self, low: float = -10.0, high: float = 10.0):
+        self.low, self.high = low, high
+
+    def __call__(self, obs, update: bool = True):
+        return np.clip(obs, self.low, self.high)
+
+
+def _welford_merge(a: Optional[dict], b: Optional[dict]) -> Optional[dict]:
+    """Exact parallel-variance combine of two (count, mean, m2) states."""
+    if a is None or a.get("mean") is None:
+        return None if b is None else {k: (v.copy() if hasattr(v, "copy")
+                                           else v) for k, v in b.items()}
+    if b is None or b.get("mean") is None:
+        return {k: (v.copy() if hasattr(v, "copy") else v)
+                for k, v in a.items()}
+    n, m = a["count"], b["count"]
+    tot = n + m
+    delta = b["mean"] - a["mean"]
+    return {"count": tot,
+            "mean": a["mean"] + delta * m / tot,
+            "m2": a["m2"] + b["m2"] + delta ** 2 * n * m / tot}
+
+
+class MeanStdFilter(Connector):
+    """Running obs normalization (reference:
+    env_to_module/mean_std_filter.py, Welford accumulation).
+
+    Two accumulators: ``_base`` (the merged GLOBAL installed by the last
+    set_state) and a LOCAL delta of everything seen since.
+    Normalization always uses base+local; ``get_state()`` ships only the
+    delta, so the driver's absorb-merge never double-counts the shared
+    prior. ``update=False`` normalizes without accumulating
+    (evaluation / boundary reads)."""
+
+    def __init__(self, clip: float = 10.0, eps: float = 1e-8):
+        self.clip = clip
+        self.eps = eps
+        self._base: Optional[dict] = None
+        self._reset_local()
+
+    def _reset_local(self):
+        self.count = 0.0
+        self.mean: Optional[np.ndarray] = None
+        self.m2: Optional[np.ndarray] = None
+
+    def _update(self, batch: np.ndarray):
+        b = np.asarray(batch, np.float64)
+        n = b.shape[0]
+        bmean = b.mean(axis=0)
+        bm2 = ((b - bmean) ** 2).sum(axis=0)
+        if self.mean is None:
+            self.count, self.mean, self.m2 = float(n), bmean, bm2
+            return
+        delta = bmean - self.mean
+        tot = self.count + n
+        self.mean = self.mean + delta * n / tot
+        self.m2 = self.m2 + bm2 + delta ** 2 * self.count * n / tot
+        self.count = tot
+
+    def __call__(self, obs, update: bool = True):
+        obs = np.asarray(obs, np.float32)
+        if update:
+            self._update(obs)
+        eff = self.get_global()
+        if eff is None or eff.get("mean") is None:
+            return np.clip(obs, -self.clip, self.clip)
+        std = np.sqrt(eff["m2"] / max(eff["count"], 1.0)) + self.eps
+        out = (obs - eff["mean"]) / std
+        return np.clip(out, -self.clip, self.clip).astype(np.float32)
+
+    def get_state(self):
+        """The local DELTA since the last set_state()."""
+        return {"count": self.count,
+                "mean": None if self.mean is None else self.mean.copy(),
+                "m2": None if self.m2 is None else self.m2.copy()}
+
+    def get_global(self):
+        return _welford_merge(self._base, self.get_state())
+
+    def set_state(self, state):
+        self._base = state
+        self._reset_local()
+
+    @staticmethod
+    def merge_states(states: list) -> Optional[dict]:
+        out = None
+        for s in states:
+            out = _welford_merge(out, s)
+        return out

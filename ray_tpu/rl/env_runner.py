@@ -32,7 +32,7 @@ class EnvRunner:
     """
 
     def __init__(self, env_fn: Callable, num_envs: int, rollout_len: int,
-                 seed: int = 0):
+                 seed: int = 0, connectors=None):
         import gymnasium as gym
 
         # SAME_STEP autoreset: the env resets within the step() that ends an
@@ -46,11 +46,29 @@ class EnvRunner:
         self._rollout_len = rollout_len
         self._obs, _ = self._venv.reset(seed=seed)
         self._rng = np.random.default_rng(seed + 1)
+        # env-to-module connector pipeline (reference:
+        # connectors/env_to_module/ applied in env_runner sample); obs are
+        # stored POST-transform so the learner trains on what the policy saw
+        self._connectors = connectors
         self._sample_fn = None
         # per-env running episode returns for metrics
         self._ep_return = np.zeros(num_envs, np.float64)
         self._ep_len = np.zeros(num_envs, np.int64)
         self._completed: list[tuple[float, int]] = []
+
+    def _transform(self, obs, update: bool = True) -> np.ndarray:
+        ob = np.asarray(obs, np.float32)
+        if self._connectors is None:
+            return ob
+        return self._connectors(ob, update=update)
+
+    def get_connector_state(self):
+        return (self._connectors.get_state()
+                if self._connectors is not None else None)
+
+    def set_connector_state(self, state) -> None:
+        if self._connectors is not None:
+            self._connectors.set_state(state)
 
     def _policy(self):
         if self._sample_fn is None:
@@ -66,7 +84,7 @@ class EnvRunner:
 
         T, E = self._rollout_len, self._num_envs
         policy = self._policy()
-        obs_buf = np.empty((T, E) + self._obs.shape[1:], np.float32)
+        obs_buf = None  # allocated from the first TRANSFORMED obs shape
         act_buf = np.empty((T, E), np.int64)
         logp_buf = np.empty((T, E), np.float32)
         val_buf = np.empty((T, E), np.float32)
@@ -76,10 +94,12 @@ class EnvRunner:
         key = jax.random.PRNGKey(int(self._rng.integers(2**31)))
         for t in range(T):
             key, sub = jax.random.split(key)
-            action, logp, value = policy(params, self._obs.astype(np.float32),
-                                         sub)
+            ob = self._transform(self._obs)
+            if obs_buf is None:
+                obs_buf = np.empty((T, E) + ob.shape[1:], np.float32)
+            action, logp, value = policy(params, ob, sub)
             action = np.asarray(action)
-            obs_buf[t] = self._obs
+            obs_buf[t] = ob
             act_buf[t] = action
             logp_buf[t] = np.asarray(logp)
             val_buf[t] = np.asarray(value)
@@ -97,12 +117,14 @@ class EnvRunner:
             self._obs = nxt
 
         episodes, self._completed = self._completed, []
-        last_value = np.asarray(
-            self._value_fn(params, self._obs.astype(np.float32)))
+        # boundary obs is a READ: the next sample()'s t=0 will accumulate
+        # this same observation — updating here would double-weight it
+        last_ob = self._transform(self._obs, update=False)
+        last_value = np.asarray(self._value_fn(params, last_ob))
         return {
             "obs": obs_buf, "actions": act_buf, "logp": logp_buf,
             "values": val_buf, "rewards": rew_buf, "dones": done_buf,
-            "last_obs": self._obs.astype(np.float32),
+            "last_obs": last_ob,
             "last_value": last_value,
             "episode_returns": [r for r, _ in episodes],
             "episode_lens": [n for _, n in episodes],
@@ -120,7 +142,10 @@ class EnvRunner:
             obs, _ = env.reset(seed=10_000 + ep)
             total, done = 0.0, False
             while not done:
-                a = int(np.asarray(det(params, obs.astype(np.float32))))
+                # frozen stats: evaluation must not contaminate training
+                # normalization state
+                ob = self._transform(obs[None], update=False)[0]
+                a = int(np.asarray(det(params, ob)))
                 obs, rew, term, trunc, _ = env.step(a)
                 total += float(rew)
                 done = bool(term or trunc)

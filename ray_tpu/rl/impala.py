@@ -172,6 +172,7 @@ class IMPALA(AlgorithmBase):
             steps = int(np.prod(sample["actions"].shape))
             self._total_env_steps += steps
             self._note_returns(sample["episode_returns"])
+        self._sync_connector_state()
         mean_ret = self._note_returns(())
         self.iteration += 1
         dt = time.perf_counter() - t0

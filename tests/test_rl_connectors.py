@@ -1,0 +1,106 @@
+"""Connector pipelines (reference: rllib/connectors/connector_v2.py:31 +
+env_to_module/ mean_std_filter, flatten_observations)."""
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rl import (AlgorithmConfig, ClipObs, ConnectorPipeline,
+                        FlattenObs, MeanStdFilter)
+
+
+@pytest.fixture
+def ray4():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_pipeline_composes_in_order():
+    pipe = ConnectorPipeline([FlattenObs(), ClipObs(-1.0, 1.0)])
+    obs = np.full((2, 3, 4), 5.0, np.float32)
+    out = pipe(obs)
+    assert out.shape == (2, 12)
+    assert np.all(out == 1.0)  # flattened THEN clipped
+    # pipelines nest: a pipeline is itself a connector
+    outer = ConnectorPipeline([pipe])
+    assert outer(obs).shape == (2, 12)
+
+
+def test_mean_std_filter_normalizes_and_merges():
+    f = MeanStdFilter()
+    rng = np.random.default_rng(0)
+    batch = rng.normal(5.0, 3.0, (512, 4)).astype(np.float32)
+    out = f(batch)
+    # after seeing the batch, output is ~standardized
+    assert abs(out.mean()) < 0.2 and abs(out.std() - 1.0) < 0.2
+
+    # parallel-variance merge equals one filter that saw everything
+    a, b = MeanStdFilter(), MeanStdFilter()
+    x = rng.normal(2.0, 1.5, (300, 4))
+    a(x[:100].astype(np.float32))
+    b(x[100:].astype(np.float32))
+    merged = MeanStdFilter.merge_states([a.get_state(), b.get_state()])
+    whole = MeanStdFilter()
+    whole(x.astype(np.float32))
+    ws = whole.get_state()
+    np.testing.assert_allclose(merged["mean"], ws["mean"], rtol=1e-6)
+    np.testing.assert_allclose(merged["m2"], ws["m2"], rtol=1e-6)
+    assert merged["count"] == ws["count"]
+
+    # frozen reads don't accumulate
+    c0 = f.get_state()["count"]
+    f(batch, update=False)
+    assert f.get_state()["count"] == c0
+
+
+def test_delta_sync_counts_stay_linear():
+    """The delta protocol: repeated broadcast/absorb cycles must grow the
+    global count by exactly the new observations (merging running totals
+    would double the shared prior every round — exponential blowup)."""
+    from ray_tpu.rl import ConnectorPipeline
+    rng = np.random.default_rng(1)
+    driver = ConnectorPipeline([MeanStdFilter()])
+    runners = [ConnectorPipeline([MeanStdFilter()]) for _ in range(2)]
+    per_round = 50
+    for round_ in range(5):
+        for r in runners:
+            r(rng.normal(0, 1, (per_round, 3)).astype(np.float32))
+        merged = driver.absorb_deltas([r.get_state() for r in runners])
+        for r in runners:
+            r.set_state(merged)
+    total = driver.get_global()[0]["count"]
+    assert total == 2 * per_round * 5, total  # linear, not exponential
+
+
+def test_ppo_with_connectors_trains_and_syncs(ray4):
+    pipe = ConnectorPipeline([MeanStdFilter()])
+    cfg = (AlgorithmConfig()
+           .environment("CartPole-v1")
+           .env_runners(num_env_runners=2, num_envs_per_env_runner=2,
+                        rollout_fragment_length=32)
+           .connectors(env_to_module=pipe))
+    algo = cfg.build()
+    try:
+        r1 = algo.train()
+        assert r1["training_iteration"] == 1
+        r2 = algo.train()
+        assert np.isfinite(r2["learner/total_loss"])
+        # global filter state grew linearly with observations: 2 runners
+        # x 2 envs x 32 steps per iteration, 2 iterations
+        g = pipe.get_global()[0]
+        assert g is not None and 0 < g["count"] <= 2 * 2 * 2 * 32 + 8
+        # checkpoints carry the normalization stats
+        state = algo.save_checkpoint()
+        assert state["connector_state"][0]["count"] == g["count"]
+        algo.restore_checkpoint(state)
+        # rejected cleanly where runners don't support connectors
+        from ray_tpu.rl import DQNAlgorithmConfig
+        bad = (DQNAlgorithmConfig().environment("CartPole-v1")
+               .connectors(env_to_module=ConnectorPipeline(
+                   [MeanStdFilter()])))
+        with pytest.raises(ValueError, match="connector"):
+            bad.build()
+    finally:
+        algo.stop()
