@@ -78,8 +78,35 @@ def _run_candidate(on_tpu: bool, candidate: int):
     return cfg, tokens, params, opt_state, train_step
 
 
+def _probe_accelerator(timeout_s: float = 90.0) -> bool:
+    """The axon tunnel HANGS jax.devices() when unhealthy — probe it in
+    a killable child first so a dead tunnel yields a fast, recorded
+    failure instead of an eternal hang."""
+    import os
+    import subprocess
+    import sys
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=timeout_s,
+            env=dict(os.environ))
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main():
     from ray_tpu.parallel.mesh import tpu_topology
+
+    if not _probe_accelerator():
+        print(json.dumps({
+            "metric": "llama_train_mfu", "value": None,
+            "unit": "fraction_of_peak_bf16",
+            "vs_baseline": None,
+            "error": "accelerator unreachable (tunnel probe timed out)",
+        }))
+        raise SystemExit(3)
 
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"

@@ -16,11 +16,20 @@ import numpy as np
 
 
 def main():
+    from bench import _probe_accelerator
     from ray_tpu.llm import SamplingParams
     from ray_tpu.llm.paged_engine import (
         PagedEngineConfig, PagedInferenceEngine,
     )
     from ray_tpu.models import llama
+
+    if not _probe_accelerator():
+        print(json.dumps({
+            "metric": "serve_p50_ttft", "value": None, "unit": "seconds",
+            "vs_baseline": None,
+            "error": "accelerator unreachable (tunnel probe timed out)",
+        }))
+        raise SystemExit(3)
 
     on_tpu = jax.devices()[0].platform == "tpu"
     if on_tpu:
