@@ -651,11 +651,20 @@ class WorkerLoop:
 
     def run(self):
         self.conn.send({"t": "register", "wid": self.wid, "pid": os.getpid()})
+        backlog: list = []
         while True:
-            try:
-                msg = self.conn.recv()
-            except (EOFError, OSError):
-                return
+            if backlog:
+                msg = backlog.pop(0)
+            else:
+                try:
+                    msg = self.conn.recv()
+                except (EOFError, OSError):
+                    return
+            if msg["t"] == "batch":
+                # one pipe write from the head's scheduling pass carrying
+                # several ordered control messages
+                backlog = list(msg["msgs"]) + backlog
+                continue
             t = msg["t"]
             if t == "func":
                 self.rt.func_registry[msg["fid"]] = cloudpickle.loads(
