@@ -1842,9 +1842,10 @@ class Runtime:
         self._ship_function_locked(best, spec.func_id)
         nonce = f"{best.wid}:{best.send_seq}"
         best.send_seq += 1
-        if not self._wsend(best, {"t": "task", "spec": spec, "n": nonce}):
-            self._on_worker_death(best.wid)
-            return False
+        # only reachable from inside a scheduling pass, so _wsend always
+        # buffers here: a pipe failure surfaces at the pass flush, which
+        # requeues best.queued via _on_worker_death
+        self._wsend(best, {"t": "task", "spec": spec, "n": nonce})
         best.queued.append((spec, nonce))
         return True
 
@@ -2149,9 +2150,12 @@ class Runtime:
         if a.spec.runtime_env and w.env_hash is None:
             self._ship_renv_locked(w, a.spec.runtime_env)
         cls_blob = self.func_registry.get(a.spec.class_id)
-        w.send({"t": "func", "fid": a.spec.class_id, "blob": cls_blob})
+        # _wsend keeps ordering with any pass-buffered func/renv ships for
+        # this worker (everything lands in the same batch envelope)
+        self._wsend(w, {"t": "func", "fid": a.spec.class_id,
+                        "blob": cls_blob})
         w.funcs.add(a.spec.class_id)
-        w.send({"t": "actor_create", "spec": a.spec})
+        self._wsend(w, {"t": "actor_create", "spec": a.spec})
         w.state = "actor"
 
     def _on_actor_ready(self, wid: str, msg: dict):
@@ -2210,7 +2214,9 @@ class Runtime:
         self._record_task_locked(spec, "RUNNING", worker=w.wid,
                                  node=w.node_id.hex(),
                                  started_at=time.time())
-        if not w.send({"t": "actor_task", "spec": spec}):
+        # _wsend: must share the batch with the func ship above when a
+        # scheduling pass is open (send failure then surfaces at flush)
+        if not self._wsend(w, {"t": "actor_task", "spec": spec}):
             self._on_worker_death(w.wid)
 
     def _on_actor_worker_death_locked(self, actor_id: ActorID, wid: str):
