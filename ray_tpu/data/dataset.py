@@ -105,6 +105,10 @@ def _add_column_block(name, fn, batch):
                                .column(name))
 
 
+def _clamp(v, lo, hi):
+    return max(lo, min(hi, v))
+
+
 def _write_block(fs_, path_template, fmt, index, batch):
     # shard writes stream through the filesystem's output stream, so
     # gs://-style destinations never stage a local copy (reference:
@@ -273,6 +277,143 @@ class Dataset:
             return None
         import ray_tpu
         return Schema(ray_tpu.get(pairs[0][0]).schema)
+
+    def columns(self) -> list[str]:
+        s = self.schema()
+        return s.names if s is not None else []
+
+    # -- column aggregates (reference: Dataset.sum/min/max/mean/std over
+    # AggregateFn, data/aggregate.py — per-block partials combined
+    # driver-side) ---------------------------------------------------------
+
+    def _col_partials(self, on: str) -> list[dict]:
+        import ray_tpu
+
+        def partial(blk):
+            col = B.column_to_numpy(blk.column(on)).astype(np.float64)
+            if len(col) == 0:
+                return {"n": 0}
+            m = float(col.mean())
+            return {"n": len(col), "sum": float(col.sum()),
+                    "min": float(col.min()), "max": float(col.max()),
+                    "mean": m, "m2": float(((col - m) ** 2).sum())}
+
+        part = ray_tpu.remote(partial)
+        return ray_tpu.get([part.remote(ref)
+                            for ref, _ in self._execute()])
+
+    def sum(self, on: str) -> float:
+        ps = [p for p in self._col_partials(on) if p["n"]]
+        return sum(p["sum"] for p in ps)
+
+    def min(self, on: str) -> float:
+        ps = [p for p in self._col_partials(on) if p["n"]]
+        if not ps:
+            raise ValueError("min() on an empty dataset")
+        return min(p["min"] for p in ps)
+
+    def max(self, on: str) -> float:
+        ps = [p for p in self._col_partials(on) if p["n"]]
+        if not ps:
+            raise ValueError("max() on an empty dataset")
+        return max(p["max"] for p in ps)
+
+    def mean(self, on: str) -> float:
+        ps = [p for p in self._col_partials(on) if p["n"]]
+        n = sum(p["n"] for p in ps)
+        if n == 0:
+            raise ValueError("mean() on an empty dataset")
+        return sum(p["sum"] for p in ps) / n
+
+    def std(self, on: str, ddof: int = 1) -> float:
+        """Pairwise Welford merge of per-block (n, mean, M2) partials —
+        numerically stable for large-magnitude columns (the naive
+        sumsq - sum^2/n cancels catastrophically)."""
+        import math
+        ps = [p for p in self._col_partials(on) if p["n"]]
+        n_tot = sum(p["n"] for p in ps)
+        if n_tot <= ddof:
+            raise ValueError("std() needs more rows than ddof")
+        n, mean, m2 = 0.0, 0.0, 0.0
+        for p in ps:
+            delta = p["mean"] - mean
+            tot = n + p["n"]
+            mean += delta * p["n"] / tot
+            m2 += p["m2"] + delta ** 2 * n * p["n"] / tot
+            n = tot
+        return math.sqrt(m2 / (n - ddof))
+
+    def unique(self, column: str) -> list:
+        import ray_tpu
+
+        def uniq(blk):
+            return set(B.column_to_numpy(blk.column(column)).tolist())
+
+        u = ray_tpu.remote(uniq)
+        out: set = set()
+        for part in ray_tpu.get([u.remote(ref)
+                                 for ref, _ in self._execute()]):
+            out |= part
+        return sorted(out)
+
+    def random_sample(self, fraction: float,
+                      *, seed: Optional[int] = None) -> "Dataset":
+        """Bernoulli row sample (reference: Dataset.random_sample)."""
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError("fraction must be in [0, 1]")
+        def sample_block(blk):
+            rows = B.num_rows(blk)
+            if seed is None:
+                rng = np.random.RandomState()   # fresh OS entropy
+            else:
+                # fold block CONTENT into the seed (the block fn gets no
+                # index): equal-sized blocks must not draw identical masks
+                import zlib
+                head = B.to_rows(B.slice_block(blk, 0, min(3, rows)))
+                h = zlib.crc32(repr((rows, head)).encode())
+                rng = np.random.RandomState(
+                    (seed * 1_000_003 + h) % (2 ** 31))
+            keep = np.nonzero(rng.random_sample(rows) < fraction)[0]
+            return blk.take(keep)
+
+        return self._block_op(sample_block, "RandomSample")
+
+    def train_test_split(self, test_size: float, *, shuffle: bool = False,
+                         seed: Optional[int] = None
+                         ) -> tuple["Dataset", "Dataset"]:
+        """(train, test) row split (reference: Dataset.train_test_split)."""
+        if not 0.0 < test_size < 1.0:
+            raise ValueError("test_size must be in (0, 1)")
+        import ray_tpu
+        from .executor import _slice_task
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        pairs = ds._execute()
+        total = sum(m.rows for _, m in pairs)
+        n_test = int(round(total * test_size))
+        sl = ray_tpu.remote(_slice_task).options(num_returns=2)
+        train_pairs, test_pairs = [], []
+        seen = 0
+        for ref, meta in pairs:
+            cut = _clamp(n_test - seen, 0, meta.rows)  # rows going to test
+            seen += meta.rows
+            if cut == 0:
+                train_pairs.append((ref, meta))
+            elif cut == meta.rows:
+                test_pairs.append((ref, meta))
+            else:
+                # _slice_task returns (block, real BlockMeta) — byte
+                # sizes stay accurate for the boundary halves
+                hb, hm = sl.remote(ref, 0, cut)
+                tb, tm = sl.remote(ref, cut, meta.rows)
+                test_pairs.append((hb, ray_tpu.get(hm)))
+                train_pairs.append((tb, ray_tpu.get(tm)))
+        return (Dataset(InputData(train_pairs), self._ctx),
+                Dataset(InputData(test_pairs), self._ctx))
+
+    def to_pandas(self, limit: Optional[int] = None):
+        import pandas as pd
+        rows = self.take(limit) if limit is not None else self.take_all()
+        return pd.DataFrame(rows)
 
     def take(self, n: int = 20) -> list[dict]:
         out: list[dict] = []
