@@ -424,6 +424,9 @@ class Runtime:
         # open per-worker message batch for the current scheduling pass
         # (see _schedule_locked); None outside a pass
         self._send_buf: dict | None = None
+        # merged user-defined metrics (util/metrics.py):
+        # name -> {kind, desc, series: {tag-tuple: value}}
+        self.user_metrics: dict[str, dict] = {}
         import concurrent.futures
         # worker->head rpc handlers (blocking calls like pg_wait run here)
         # 32 threads: pg_wait parks here for up to its full timeout, and a
@@ -820,6 +823,8 @@ class Runtime:
                 for ob in msg["oids"]:
                     self._ensure_available_locked(ObjectID(ob))
                 self._schedule_locked()
+        elif t == "user_metrics":
+            self.merge_user_metrics(msg["rows"])
         elif t == "blocked":
             with self.lock:
                 w = self.workers.get(wid)
@@ -944,7 +949,7 @@ class Runtime:
                     "available_resources", "node_table", "pg_wait",
                     "create_placement_group_rpc", "remove_placement_group_rpc",
                     "timeline", "state_list", "state_summary",
-                    "pubsub_poll",
+                    "user_metrics_dump", "pubsub_poll",
                     "kv_put", "kv_get", "kv_del", "kv_keys", "locate",
                     "job_submit", "job_list", "job_status", "job_logs",
                     "job_stop")
@@ -1876,6 +1881,28 @@ class Runtime:
         w.send({"t": "steal", "nonces": [n for _, n in stolen]})
         for s, _ in stolen:
             self.pending.append(s)
+
+    def merge_user_metrics(self, rows: list) -> None:
+        """Fold user-metric deltas from any process into the head store
+        (util/metrics.py; counters/histogram buckets SUM, gauges
+        last-write-wins)."""
+        with self.lock:
+            store = self.user_metrics
+            for kind, name, desc, key, value, add in rows:
+                rec = store.setdefault(
+                    name, {"kind": kind, "desc": desc, "series": {}})
+                if add:
+                    rec["series"][key] = rec["series"].get(key, 0.0) + value
+                else:
+                    rec["series"][key] = value
+
+    def user_metrics_dump(self) -> dict:
+        """RPC: the merged user-metric store (remote drivers render their
+        own Prometheus text from it)."""
+        with self.lock:
+            return {n: {"kind": r["kind"], "desc": r["desc"],
+                        "series": dict(r["series"])}
+                    for n, r in self.user_metrics.items()}
 
     def _rebalance_pipelines_locked(self):
         """A worker just went idle with nothing pending: if another worker

@@ -207,6 +207,21 @@ def _prometheus_text() -> str:
           "objects resident in the shm store")
     counter("ray_tpu_object_store_evictions_total", st["evictions"],
             "LRU evictions")
+    # user-defined metrics (util/metrics.py Counter/Gauge/Histogram);
+    # remote drivers pull the merged store over the head RPC
+    from .util.metrics import prometheus_lines
+    remote = _remote()
+    if remote is not None:
+        try:
+            lines.extend(prometheus_lines(
+                remote._rpc("user_metrics_dump")))
+        except Exception:
+            pass  # head mid-restart: built-ins still render
+    else:
+        rt = _head()
+        if getattr(rt, "user_metrics", None):
+            with rt.lock:
+                lines.extend(prometheus_lines(rt.user_metrics))
     return "\n".join(lines) + "\n"
 
 

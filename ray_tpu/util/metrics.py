@@ -1,0 +1,248 @@
+"""User-defined metrics: Counter / Gauge / Histogram.
+
+Reference parity: python/ray/util/metrics.py (Counter:117, Gauge:192,
+Histogram:249 — tagged application metrics flowing to the cluster's
+Prometheus endpoint via each process's metrics agent).
+
+TPU-first shape: there is no per-node metrics agent; every process keeps
+a local registry and a background flusher ships DELTAS to the head over
+the existing control connection (~2s cadence, one small message), where
+they merge into the head's registry: counters and histogram buckets SUM
+across processes, gauges are last-write-wins. The head's Prometheus text
+(`state._prometheus_text`, dashboard `/metrics`) appends them after the
+built-in runtime metrics.
+
+    from ray_tpu.util.metrics import Counter, Gauge, Histogram
+    requests = Counter("app_requests", description="...",
+                       tag_keys=("route",))
+    requests.inc(1.0, tags={"route": "/v1"})
+"""
+from __future__ import annotations
+
+import re
+import threading
+import time
+from typing import Optional, Sequence
+
+_lock = threading.Lock()
+# name -> _MetricDef; (name, tags) -> value/buckets live in the defs
+_registry: dict[str, "Metric"] = {}
+_flusher_started = False
+
+
+def _tags_key(tag_keys, tags: Optional[dict]) -> tuple:
+    tags = tags or {}
+    unknown = set(tags) - set(tag_keys)
+    if unknown:
+        raise ValueError(f"undeclared tag keys {sorted(unknown)}; "
+                         f"declared: {list(tag_keys)}")
+    return tuple((k, str(tags.get(k, ""))) for k in tag_keys)
+
+
+class Metric:
+    KIND = "gauge"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = ()):
+        if not re.fullmatch(r"[a-zA-Z_:][a-zA-Z0-9_:]*", name):
+            raise ValueError(f"invalid Prometheus metric name {name!r}")
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._values: dict[tuple, float] = {}
+        self._dirty: set[tuple] = set()
+        with _lock:
+            prev = _registry.get(name)
+            if prev is not None and (
+                    prev.KIND != self.KIND
+                    or prev.tag_keys != self.tag_keys
+                    or getattr(prev, "boundaries", None)
+                    != getattr(self, "boundaries", None)):
+                raise ValueError(
+                    f"metric {name!r} already registered with a different "
+                    f"kind/tags/boundaries")
+            _registry[name] = prev or self
+            if prev is not None:
+                # share storage: re-constructing the same metric in the
+                # same process must not fork the series
+                self._values = prev._values
+                self._dirty = prev._dirty
+        _ensure_flusher()
+
+    # -- recording (subclasses call) --------------------------------------
+
+    def _record(self, key: tuple, value: float, add: bool):
+        with _lock:
+            if add:
+                self._values[key] = self._values.get(key, 0.0) + value
+            else:
+                self._values[key] = value
+            self._dirty.add(key)
+
+    # -- flush protocol ----------------------------------------------------
+
+    def _drain(self) -> list:
+        """(kind, name, desc, key, value, add) rows to ship; counters/
+        histogram buckets ship deltas, gauges ship values."""
+        out = []
+        with _lock:
+            for key in self._dirty:
+                val = self._values[key]
+                if self.KIND in ("counter", "histogram"):
+                    out.append((self.KIND, self.name, self.description,
+                                key, val, True))
+                    self._values[key] = 0.0  # delta shipped
+                else:
+                    out.append((self.KIND, self.name, self.description,
+                                key, val, False))
+            self._dirty.clear()
+        return out
+
+    def _restore(self, rows: list) -> None:
+        """Put undelivered drained rows back (flush failed: monotonic
+        counters must not silently undercount)."""
+        with _lock:
+            for kind, _n, _d, key, value, add in rows:
+                if add:
+                    self._values[key] = self._values.get(key, 0.0) + value
+                elif key not in self._dirty:
+                    self._values.setdefault(key, value)
+                self._dirty.add(key)
+
+
+class Counter(Metric):
+    """Monotonic counter (reference: util/metrics.py:117)."""
+
+    KIND = "counter"
+
+    def inc(self, value: float = 1.0, tags: Optional[dict] = None):
+        if value < 0:
+            raise ValueError("Counter.inc() takes a non-negative value")
+        self._record(_tags_key(self.tag_keys, tags), value, add=True)
+
+
+class Gauge(Metric):
+    """Last-write-wins value (reference: util/metrics.py:192)."""
+
+    KIND = "gauge"
+
+    def set(self, value: float, tags: Optional[dict] = None):
+        self._record(_tags_key(self.tag_keys, tags), float(value),
+                     add=False)
+
+
+class Histogram(Metric):
+    """Bucketed observations (reference: util/metrics.py:249). Buckets
+    are cumulative Prometheus-style: an observation lands in every bucket
+    whose boundary is >= value, plus +Inf."""
+
+    KIND = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Sequence[float] = (), tag_keys=()):
+        if not boundaries or list(boundaries) != sorted(boundaries):
+            raise ValueError("boundaries must be a sorted non-empty list")
+        self.boundaries = tuple(float(b) for b in boundaries)
+        super().__init__(name, description, tag_keys)
+
+    def observe(self, value: float, tags: Optional[dict] = None):
+        base = _tags_key(self.tag_keys, tags)
+        value = float(value)
+        for b in self.boundaries:
+            if value <= b:
+                self._record(base + (("le", repr(b)),), 1.0, add=True)
+        self._record(base + (("le", "+Inf"),), 1.0, add=True)
+        self._record(base + (("__sum__", ""),), value, add=True)
+
+
+# --------------------------------------------------------------------- #
+# flushing to the head
+# --------------------------------------------------------------------- #
+
+def _flush_once() -> bool:
+    from ..core import runtime as rt_mod
+    rt = rt_mod.get_runtime_if_exists()
+    if rt is None or not (isinstance(rt, rt_mod.Runtime)
+                          or hasattr(rt, "send")):
+        return False  # nothing drained: deltas keep accumulating locally
+    with _lock:
+        metrics = list(_registry.values())
+    per_metric = [(m, m._drain()) for m in metrics]
+    rows = [r for _, rs in per_metric for r in rs]
+    if not rows:
+        return True
+    if isinstance(rt, rt_mod.Runtime):
+        rt.merge_user_metrics(rows)
+        return True
+    try:
+        rt.send({"t": "user_metrics", "rows": rows})
+        return True
+    except Exception:
+        # delivery failed (head restarting?): restore the deltas so the
+        # next flush re-ships them
+        for m, rs in per_metric:
+            m._restore(rs)
+        return False
+
+
+def _ensure_flusher():
+    global _flusher_started
+    with _lock:
+        if _flusher_started:
+            return
+        _flusher_started = True
+
+    def loop():
+        while True:
+            time.sleep(2.0)
+            try:
+                _flush_once()
+            except Exception:
+                pass
+
+    threading.Thread(target=loop, daemon=True,
+                     name="rtpu-user-metrics").start()
+
+
+def flush() -> None:
+    """Force an immediate flush (tests / pre-shutdown)."""
+    _flush_once()
+
+
+def _esc_label(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace("\"", "\\\"") \
+        .replace("\n", "\\n")
+
+
+def _esc_help(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _series(name: str, key, val) -> str:
+    tags = ",".join(f'{k}="{_esc_label(v)}"' for k, v in key)
+    return f"{name}{{{tags}}} {val}" if tags else f"{name} {val}"
+
+
+def prometheus_lines(store: dict) -> list[str]:
+    """Render the head's merged user-metric store as Prometheus text
+    (called by state._prometheus_text). Histograms use the standard
+    _bucket/_count/_sum triplet."""
+    lines = []
+    for name, rec in sorted(store.items()):
+        kind = rec["kind"] if rec["kind"] in ("counter",
+                                              "histogram") else "gauge"
+        lines.append(f"# HELP {name} {_esc_help(rec['desc'])}")
+        lines.append(f"# TYPE {name} {kind}")
+        for key, val in sorted(rec["series"].items()):
+            if any(k == "__sum__" for k, _ in key):
+                plain = tuple((k, v) for k, v in key if k != "__sum__")
+                lines.append(_series(f"{name}_sum", plain, val))
+                continue
+            if kind == "histogram":
+                lines.append(_series(f"{name}_bucket", key, val))
+                if dict(key).get("le") == "+Inf":
+                    plain = tuple((k, v) for k, v in key if k != "le")
+                    lines.append(_series(f"{name}_count", plain, val))
+                continue
+            lines.append(_series(name, key, val))
+    return lines
