@@ -20,6 +20,8 @@ from .read_api import (
     range,  # noqa: A001 — reference API name (ray.data.range)
     read_csv,
     read_json,
+    read_binary_files,
+    read_numpy,
     read_parquet,
     read_text,
 )
@@ -29,5 +31,5 @@ __all__ = [
     "DataContext", "Dataset", "DataIterator", "Schema", "from_arrow",
     "from_huggingface",
     "from_items", "from_numpy", "from_pandas", "range", "read_csv",
-    "read_json", "read_parquet", "read_text",
+    "read_json", "read_parquet", "read_text", "read_binary_files", "read_numpy",
 ]

@@ -154,3 +154,29 @@ def read_json(paths, *, filesystem=None, **_ignored) -> Dataset:
 
 def read_text(paths, *, filesystem=None, **_ignored) -> Dataset:
     return _file_dataset(paths, filesystem, _read_text_task, "ReadText")
+
+
+def _read_binary_task(fs_, path):
+    from ..util.fs import read_bytes
+    return B.from_items([{"bytes": read_bytes(fs_, path), "path": path}])
+
+
+def read_binary_files(paths, *, filesystem=None, **_ignored) -> Dataset:
+    """One row per file: {bytes, path} (reference:
+    data/read_api.py read_binary_files)."""
+    return _file_dataset(paths, filesystem, _read_binary_task,
+                         "ReadBinary")
+
+
+def _read_numpy_task(fs_, path):
+    import io
+
+    import numpy as np
+    from ..util.fs import read_bytes
+    arr = np.load(io.BytesIO(read_bytes(fs_, path)))
+    return B.from_numpy(np.asarray(arr), B.TENSOR_COLUMN)
+
+
+def read_numpy(paths, *, filesystem=None, **_ignored) -> Dataset:
+    """.npy files -> tensor-column rows (reference: read_numpy)."""
+    return _file_dataset(paths, filesystem, _read_numpy_task, "ReadNumpy")

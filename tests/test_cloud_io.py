@@ -172,3 +172,16 @@ def test_copy_tree_streams(tmp_path):
     fsutil.copy_tree(lfs, str(src), lfs, str(dst))
     assert (dst / "a.bin").read_bytes() == b"x" * 1000
     assert (dst / "sub" / "b.bin").read_bytes() == b"y" * 2000
+
+
+def test_read_binary_and_numpy(ray2, tmp_path):
+    (tmp_path / "a.bin").write_bytes(b"\x01\x02\x03")
+    rows = rdata.read_binary_files(str(tmp_path / "a.bin")).take_all()
+    assert rows[0]["bytes"] == b"\x01\x02\x03"
+    assert rows[0]["path"].endswith("a.bin")
+
+    np.save(tmp_path / "x.npy", np.arange(6).reshape(3, 2))
+    ds = rdata.read_numpy(f"file://{tmp_path}/x.npy")
+    assert ds.count() == 3
+    got = np.stack([r["data"] for r in ds.take_all()])
+    np.testing.assert_array_equal(got, np.arange(6).reshape(3, 2))
