@@ -24,7 +24,7 @@ _DEFAULT_ACTOR_OPTS = dict(
     max_restarts=0, max_task_retries=0, max_concurrency=1,
     lifetime=None, scheduling_strategy="DEFAULT", placement_group=None,
     placement_group_bundle_index=-1, _node_id=None, _node_soft=False,
-    runtime_env=None, concurrency_groups=None,
+    runtime_env=None, concurrency_groups=None, label_selector=None,
 )
 
 
@@ -80,6 +80,8 @@ class ActorClass:
             pg_bundle_index=strat["pg_bundle_index"],
             node_affinity=strat["node_affinity"],
             node_affinity_soft=strat["node_affinity_soft"],
+            label_selector=(dict(o["label_selector"])
+                            if o["label_selector"] else None),
             named=o["name"],
             ready_oid=ready_oid,
             runtime_env=prepare_runtime_env(rt, o["runtime_env"]),

@@ -309,9 +309,11 @@ def _placement_key(spec) -> tuple:
     """Everything node selection + worker acquisition depend on. Two specs
     with equal keys place identically against identical cluster state."""
     from .runtime_env import env_hash
+    sel = getattr(spec, "label_selector", None)
     return (tuple(sorted(spec.resources.items())), spec.pg_id,
             spec.pg_bundle_index, spec.node_affinity,
             spec.node_affinity_soft, spec.scheduling_strategy,
+            tuple(sorted(sel.items())) if sel else None,
             env_hash(spec.runtime_env))
 
 
@@ -1545,6 +1547,13 @@ class Runtime:
         return node.alive and all(
             node.resources_avail.get(k, 0) >= v - 1e-9 for k, v in res.items())
 
+    @staticmethod
+    def _labels_ok(node: NodeInfo, spec) -> bool:
+        sel = getattr(spec, "label_selector", None)
+        if not sel:
+            return True
+        return all(node.labels.get(k) == v for k, v in sel.items())
+
     def _pick_node_locked(self, spec) -> Optional[NodeInfo]:
         res = spec.resources
         if spec.pg_id is not None:
@@ -1556,20 +1565,25 @@ class Runtime:
             for i in idxs:
                 b = pg.bundles[i]
                 node = self.nodes.get(b.node_id)
-                if node is None or not node.alive:
+                if node is None or not node.alive \
+                        or not self._labels_ok(node, spec):
                     continue
                 if all(b.avail.get(k, 0) >= v - 1e-9 for k, v in res.items()):
                     return node
             return None
         if spec.node_affinity is not None:
             node = self.nodes.get(NodeID(spec.node_affinity))
-            if node and self._has_avail(node, res):
+            if node and self._has_avail(node, res) \
+                    and self._labels_ok(node, spec):
                 return node
             if spec.node_affinity_soft:
                 pass  # fall through to normal policy
             else:
                 return None
-        alive = [n for n in self.nodes.values() if n.alive]
+        alive = [n for n in self.nodes.values()
+                 if n.alive and self._labels_ok(n, spec)]
+        if not alive:
+            return None
         if spec.scheduling_strategy == "SPREAD":
             order = alive[self._spread_rr % len(alive):] + \
                 alive[:self._spread_rr % len(alive)]
@@ -1581,7 +1595,7 @@ class Runtime:
         # hybrid: pack onto head/local until 50% utilized, then least-utilized
         head = self.head_node
         from .config import cfg as _cfg
-        if self._has_avail(head, res) and \
+        if self._labels_ok(head, spec) and self._has_avail(head, res) and \
                 head.utilization() < _cfg.scheduler_spread_threshold:
             return head
         best, best_u = None, 2.0
@@ -1840,6 +1854,7 @@ class Runtime:
                     and len(w.queued) < depth
                     and w.current.resources == spec.resources
                     and w.env_hash == env_hash
+                    and self._labels_ok(self.nodes[w.node_id], spec)
                     and (best is None or len(w.queued) < len(best.queued))):
                 best = w
         if best is None:
@@ -2130,7 +2145,8 @@ class Runtime:
             resources=spec.resources, pg_id=spec.pg_id,
             pg_bundle_index=spec.pg_bundle_index,
             node_affinity=spec.node_affinity,
-            node_affinity_soft=spec.node_affinity_soft)
+            node_affinity_soft=spec.node_affinity_soft,
+            label_selector=spec.label_selector)
         node = self._pick_node_locked(fake)
         if node is None:
             # retry async until resources appear
@@ -2162,7 +2178,8 @@ class Runtime:
                     resources=a.spec.resources, pg_id=a.spec.pg_id,
                     pg_bundle_index=a.spec.pg_bundle_index,
                     node_affinity=a.spec.node_affinity,
-                    node_affinity_soft=a.spec.node_affinity_soft)
+                    node_affinity_soft=a.spec.node_affinity_soft,
+                    label_selector=a.spec.label_selector)
                 if self._pick_node_locked(fake) is not None:
                     self._schedule_actor_locked(a)
                     return

@@ -35,6 +35,10 @@ class TaskSpec:
     node_affinity: Optional[bytes] = None   # NodeID binary, soft=false only
     node_affinity_soft: bool = False
     scheduling_strategy: str = "DEFAULT"    # DEFAULT | SPREAD
+    # node label requirements (reference: label_selector on tasks/actors,
+    # the node-label scheduling strategy): every (k, v) must equal the
+    # candidate node's labels
+    label_selector: Optional[dict] = None
     owner: str = "driver"              # "driver" or worker-id hex
     # prepared runtime env (hashes, not blobs — core/runtime_env.py)
     runtime_env: Optional[dict] = None
@@ -69,6 +73,7 @@ class ActorSpec:
     pg_bundle_index: int = -1
     node_affinity: Optional[bytes] = None
     node_affinity_soft: bool = False
+    label_selector: Optional[dict] = None
     named: Optional[str] = None        # ray.get_actor() name
     # named method pools: {"io": 2, ...} (concurrency groups)
     concurrency_groups: Optional[dict] = None
