@@ -585,6 +585,21 @@ int os_delete(void* hv, const uint8_t* id) {
   return 0;
 }
 
+// Fault in + write-warm the heap with a userspace memset. Call once after
+// create, BEFORE any allocation (it scribbles zeros over free heap space —
+// only the initial whole-heap FreeBlock may be live, and its header is
+// skipped). A plain memset is used instead of MADV_POPULATE_WRITE because
+// both pay the same page-zeroing cost on bare metal, but on virtualized
+// hosts populate leaves pages in a state where the first real store still
+// faults host-side (~3x slower copies measured) while a memset does not.
+void os_prefault(void* hv) {
+  Handle* h = reinterpret_cast<Handle*>(hv);
+  uint8_t* heap = h->base + h->hdr->heap_off;
+  uint64_t skip = sizeof(FreeBlock);
+  if (h->hdr->heap_size > skip)
+    memset(heap + skip, 0, h->hdr->heap_size - skip);
+}
+
 uint64_t os_capacity(void* hv) { return reinterpret_cast<Handle*>(hv)->hdr->heap_size; }
 uint64_t os_bytes_in_use(void* hv) { return reinterpret_cast<Handle*>(hv)->hdr->bytes_in_use; }
 uint64_t os_num_objects(void* hv) { return reinterpret_cast<Handle*>(hv)->hdr->num_objects; }

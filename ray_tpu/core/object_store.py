@@ -23,6 +23,7 @@ import sys
 from typing import Any, Optional
 
 import cloudpickle
+import numpy as np
 
 from .ids import ObjectID
 from .native.build import ensure_built
@@ -31,6 +32,11 @@ _FLAG_NORMAL = 0
 _FLAG_EXCEPTION = 1
 
 _HEADER = struct.Struct("<BxxxIQ")  # flags, n_bufs, pickle_len
+
+# Pieces at least this large are copied with ctypes.memmove in
+# _FramedValue.write_into (see comment there); smaller ones stay on the
+# simpler slice-assignment path.
+_MEMMOVE_MIN = 256 * 1024
 
 
 class ObjectStoreFullError(MemoryError):
@@ -68,6 +74,8 @@ def _load_lib() -> ctypes.CDLL:
     lib.os_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.os_reclaim_pid.restype = ctypes.c_int
     lib.os_reclaim_pid.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.os_prefault.restype = None
+    lib.os_prefault.argtypes = [ctypes.c_void_p]
     for fn in ("os_capacity", "os_bytes_in_use", "os_num_objects", "os_evictions"):
         getattr(lib, fn).restype = ctypes.c_uint64
         getattr(lib, fn).argtypes = [ctypes.c_void_p]
@@ -90,9 +98,24 @@ class _FramedValue:
 
     def write_into(self, buf) -> None:
         pos = 0
+        dst_addr = None
         for piece in self.iter_wire():
-            buf[pos:pos + len(piece)] = piece
-            pos += len(piece)
+            n = len(piece)
+            if n >= _MEMMOVE_MIN:
+                # ctypes.memmove is ~2x the bandwidth of memoryview slice
+                # assignment on multi-MiB pieces (the slice path goes
+                # through PyBuffer item copying; memmove is glibc's
+                # vectorized copy). Only worth the address plumbing for
+                # large pieces.
+                if dst_addr is None:
+                    dst_addr = ctypes.addressof(
+                        ctypes.c_char.from_buffer(buf))
+                src = piece if isinstance(piece, bytes) else \
+                    np.frombuffer(piece, np.uint8).ctypes.data
+                ctypes.memmove(dst_addr + pos, src, n)
+            else:
+                buf[pos:pos + n] = piece
+            pos += n
 
     def iter_wire(self):
         """The frame as a sequence of buffers in wire order — lets senders
@@ -239,17 +262,16 @@ class SharedObjectStore:
     # Linux madvise constants Python's mmap module doesn't export yet.
     _MADV_HUGEPAGE = 14
     _MADV_POPULATE_READ = 22
-    _MADV_POPULATE_WRITE = 23
 
     def _advise_mapping(self, create: bool) -> None:
         """THP always (cheap, helps TLB on multi-MiB memcpys); full
         pre-fault only when cfg.store_prefault — put/get bandwidth is
         bounded by first-touch faulting otherwise (measured ~1.8 vs ~6.4
         GiB/s for 128 MiB frames on shm), but faulting the whole capacity
-        costs ~0.4 s/GiB at create (page zeroing) and ~0.05 s/GiB per
-        attaching process (PTE setup), which short-lived test clusters
-        don't want. The creator populates for WRITE (allocates+zeroes the
-        tmpfs pages); attachers populate READ-only PTEs."""
+        costs seconds per GiB at create, which short-lived test clusters
+        don't want. The creator write-warms the heap via os_prefault's
+        memset (see objstore.cc for why not MADV_POPULATE_WRITE);
+        attachers populate READ-only PTEs."""
         from .config import cfg
         try:
             self._mm.madvise(getattr(mmap, "MADV_HUGEPAGE",
@@ -257,14 +279,18 @@ class SharedObjectStore:
         except (OSError, ValueError):
             pass
         if cfg.store_prefault:
-            try:
-                self._mm.madvise(
-                    getattr(mmap, "MADV_POPULATE_WRITE",
-                            self._MADV_POPULATE_WRITE) if create else
-                    getattr(mmap, "MADV_POPULATE_READ",
-                            self._MADV_POPULATE_READ))
-            except (OSError, ValueError):
-                pass  # pre-5.14 kernel: stay lazy
+            if create:
+                # Creator prefault: the C side memsets the heap write-warm
+                # (see os_prefault in objstore.cc for why not
+                # MADV_POPULATE_WRITE). Must run before any allocation.
+                self._lib.os_prefault(self._h)
+            else:
+                try:
+                    self._mm.madvise(
+                        getattr(mmap, "MADV_POPULATE_READ",
+                                self._MADV_POPULATE_READ))
+                except (OSError, ValueError):
+                    pass  # pre-5.14 kernel: stay lazy
 
     # -- raw byte-level API ------------------------------------------------
 
