@@ -21,6 +21,7 @@ import threading
 from multiprocessing.connection import Client
 
 from .object_store import SharedObjectStore, SpillStore
+from .protocol import PROTOCOL_VERSION, ProtocolMismatchError
 from .worker import WorkerRuntime
 from . import runtime as rt_mod
 
@@ -136,7 +137,9 @@ class DriverRuntime(WorkerRuntime):
                     if isinstance(msg, dict) and msg.get("t") == "exit":
                         self.disconnected.set()
                         return
-            except (EOFError, OSError):
+            except (EOFError, OSError, TypeError):
+                # TypeError: the conn's fd was torn down mid-recv by
+                # interpreter shutdown (read(None, ...)); same as EOF
                 pass
             try:
                 ok = not self._closing and self._reconnect()
@@ -163,6 +166,10 @@ class DriverRuntime(WorkerRuntime):
                     cf_path = resolve_cluster_file(addr)
                     conn, reply = _dial(cf_path)
                     break
+                except ProtocolMismatchError as e:
+                    # deterministic refusal — retrying cannot succeed
+                    print(f"driver reconnect refused: {e}", flush=True)
+                    return False
                 except (ConnectionError, OSError, EOFError, ValueError,
                         mp.AuthenticationError):
                     continue
@@ -271,11 +278,23 @@ def _dial(cf_path: str):
         if host == "0.0.0.0":
             host = "127.0.0.1"
         conn = Client((host, cf["tcp_port"]), "AF_INET", authkey=authkey)
-    conn.send({"t": "register_driver", "pid": os.getpid()})
+    conn.send({"t": "register_driver", "pid": os.getpid(),
+               "pv": PROTOCOL_VERSION})
     reply = conn.recv()
+    if reply.get("t") == "rejected":
+        # structured refusal (e.g. wire-protocol mismatch): deterministic,
+        # NOT retryable — reconnect loops must surface it, not back off
+        conn.close()
+        raise ProtocolMismatchError(reply.get("error", "rejected"))
     if reply.get("t") != "registered_driver":
         conn.close()
         raise ConnectionError(f"head rejected driver registration: {reply}")
+    if reply.get("pv") != PROTOCOL_VERSION:
+        # symmetric check: a pre-versioning head never sends pv
+        conn.close()
+        raise ProtocolMismatchError(
+            f"head speaks wire-protocol version {reply.get('pv')!r}, "
+            f"this driver speaks {PROTOCOL_VERSION}")
     return conn, reply
 
 

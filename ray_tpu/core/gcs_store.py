@@ -21,6 +21,8 @@ import sqlite3
 import threading
 import time
 
+from .protocol import SNAPSHOT_SCHEMA_VERSION
+
 
 class GcsStore:
     """Namespaced KV over sqlite. Thread-safe; every op commits."""
@@ -102,7 +104,8 @@ def snapshot(rt) -> None:
     kv.put("snapshot", "placement_groups", pickle.dumps(pgs))
     kv.put("snapshot", "jobs", pickle.dumps(jobs))
     kv.put("snapshot", "meta", pickle.dumps(
-        {"ts": time.time(), "session_dir": rt.session_dir}))
+        {"ts": time.time(), "session_dir": rt.session_dir,
+         "schema_version": SNAPSHOT_SCHEMA_VERSION}))
 
 
 def restore(rt, old_session_dir: str) -> dict:
@@ -113,6 +116,15 @@ def restore(rt, old_session_dir: str) -> dict:
         raise FileNotFoundError(f"no GCS snapshot at {path}")
     old = GcsStore(path)
     try:
+        meta_blob = old.get("snapshot", "meta")
+        if meta_blob is not None:
+            sv = pickle.loads(meta_blob).get("schema_version", 0)
+            if sv > SNAPSHOT_SCHEMA_VERSION:
+                raise RuntimeError(
+                    f"GCS snapshot at {path} has schema version {sv}, "
+                    f"this build reads <= {SNAPSHOT_SCHEMA_VERSION}; "
+                    f"resume with a build at least as new as the one "
+                    f"that wrote it")
         named = pickle.loads(old.get("snapshot", "named_actors") or b"\x80\x04]\x94.")
         pgs = pickle.loads(old.get("snapshot", "placement_groups") or b"\x80\x04]\x94.")
         jobs = pickle.loads(old.get("snapshot", "jobs") or b"\x80\x04]\x94.")

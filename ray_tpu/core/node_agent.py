@@ -29,6 +29,8 @@ import time
 import traceback
 from multiprocessing.connection import Client
 
+from .protocol import PROTOCOL_VERSION, ProtocolMismatchError
+
 
 class NodeAgent:
     def __init__(self, head: str, authkey: bytes, resources: dict,
@@ -92,8 +94,15 @@ class NodeAgent:
         self.conn.send({"t": "register_node", "resources": self._resources,
                         "name": self._name, "own_store": self.own_store,
                         "data_addr": self._data_addr,
-                        "labels": self._labels})
+                        "labels": self._labels, "pv": PROTOCOL_VERSION})
         reply = self.conn.recv()
+        if reply.get("t") == "rejected":
+            raise ProtocolMismatchError(reply.get("error", "rejected"))
+        if reply.get("pv") != PROTOCOL_VERSION:
+            # symmetric check: a pre-versioning head never sends pv
+            raise ProtocolMismatchError(
+                f"head speaks wire-protocol version {reply.get('pv')!r}, "
+                f"this node agent speaks {PROTOCOL_VERSION}")
         if reply.get("t") != "registered":
             raise RuntimeError(f"head rejected registration: {reply}")
         self.node_id = reply["node_id"]
@@ -139,6 +148,10 @@ class NodeAgent:
                 print(f"node_agent: re-joined as node {self.node_id}",
                       flush=True)
                 return True
+            except ProtocolMismatchError as e:
+                # deterministic refusal — retrying cannot succeed
+                print(f"node_agent: rejoin refused: {e}", flush=True)
+                return False
             except Exception:
                 time.sleep(delay)
                 delay = min(delay * 2, 2.0)

@@ -1,0 +1,48 @@
+"""Wire-protocol versioning for the control plane.
+
+The reference versions every cross-process message through generated
+protobuf schemas (reference: src/ray/protobuf/*.proto, 36 files) so peers
+from different releases fail loudly instead of mis-parsing each other. This
+runtime deliberately keeps pickled dataclasses on an authkeyed channel
+(single-language cluster, no cross-language marshalling) — but the
+cross-VERSION guarantee still matters: a worker, node agent, or driver
+built from a different checkout must be rejected at the handshake, not
+crash mid-job on a missing dataclass field.
+
+Every register message (`register`, `register_node`, `register_driver`)
+carries ``pv``; the head compares it against its own PROTOCOL_VERSION and
+refuses mismatches with a structured error the peer surfaces to the user.
+Bump PROTOCOL_VERSION whenever a control-message shape, TaskSpec/ActorSpec
+field, or the object-store wire framing changes incompatibly.
+
+GCS snapshots embed SNAPSHOT_SCHEMA_VERSION the same way so
+``init(resume_from=...)`` across an incompatible upgrade fails with a
+clear message instead of restoring garbage state (reference analog: the
+GCS table schema version in gcs_storage).
+"""
+from __future__ import annotations
+
+# Bump on any incompatible control-plane or store-framing change.
+PROTOCOL_VERSION = 1
+
+# Bump on any incompatible change to the sqlite snapshot contents.
+SNAPSHOT_SCHEMA_VERSION = 1
+
+
+class ProtocolMismatchError(ConnectionError):
+    """Peer speaks a different wire-protocol version than this process."""
+
+
+def check_peer_version(peer_pv, who: str) -> None:
+    """Raise ProtocolMismatchError unless `peer_pv` matches ours.
+
+    `who` names the peer for the error message ("worker", "node agent",
+    "driver client"). Peers that predate versioning send no ``pv`` at
+    all (None) — rejected with the same message, since they are by
+    definition an older build.
+    """
+    if peer_pv != PROTOCOL_VERSION:
+        raise ProtocolMismatchError(
+            f"{who} speaks wire-protocol version {peer_pv!r}, this process "
+            f"speaks {PROTOCOL_VERSION}; mixing builds in one cluster is "
+            f"not supported — restart the cluster from one checkout")

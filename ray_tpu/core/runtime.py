@@ -43,6 +43,8 @@ from .ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID
 from .object_store import GetTimeoutError as StoreTimeout
 from .object_store import ObjectStoreFullError, SharedObjectStore, SpillStore
 from .ref import ObjectRef
+from .protocol import (PROTOCOL_VERSION, ProtocolMismatchError,
+                       check_peer_version)
 from .task_spec import ActorSpec, TaskSpec
 
 # directory states
@@ -685,6 +687,22 @@ class Runtime:
         wid = None
         try:
             msg = conn.recv()
+            if msg.get("t") in ("register", "register_node",
+                                "register_driver"):
+                who = {"register": "worker",
+                       "register_node": "node agent",
+                       "register_driver": "driver client"}[msg["t"]]
+                try:
+                    check_peer_version(msg.get("pv"), who)
+                except ProtocolMismatchError as e:
+                    # structured refusal: agents/drivers raise it to the
+                    # user from their registration-reply check
+                    try:
+                        conn.send({"t": "rejected", "error": str(e)})
+                    except Exception:
+                        pass
+                    conn.close()
+                    return
             if msg.get("t") == "register_node":
                 self._agent_loop(conn, msg)
                 return
@@ -707,7 +725,8 @@ class Runtime:
                     conn.send({"t": "registered_driver", "wid": wid,
                                "store_path": self.store_path,
                                "spill_dir": self.spill.dir,
-                               "job_id": self.job_id.hex()})
+                               "job_id": self.job_id.hex(),
+                               "pv": PROTOCOL_VERSION})
                 while True:
                     m = conn.recv()
                     try:
@@ -896,7 +915,7 @@ class Runtime:
         agent.send({"t": "registered", "node_id": node.node_id.hex(),
                     "store_path": self.store_path,
                     "spill_dir": self.spill.dir,
-                    "tcp_port": self.tcp_port})
+                    "tcp_port": self.tcp_port, "pv": PROTOCOL_VERSION})
         with self.lock:
             self.nodes[node.node_id] = node
             self._retry_pending_pgs_locked()

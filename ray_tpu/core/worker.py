@@ -34,6 +34,7 @@ import cloudpickle
 
 from .. import exceptions as exc
 from .ids import ObjectID
+from .protocol import PROTOCOL_VERSION
 from .object_store import GetTimeoutError as StoreTimeout
 from .object_store import ObjectStoreFullError as StoreFull
 from .object_store import SharedObjectStore, SpillStore
@@ -650,7 +651,8 @@ class WorkerLoop:
             self._renv_error = e
 
     def run(self):
-        self.conn.send({"t": "register", "wid": self.wid, "pid": os.getpid()})
+        self.conn.send({"t": "register", "wid": self.wid,
+                        "pid": os.getpid(), "pv": PROTOCOL_VERSION})
         backlog: list = []
         while True:
             if backlog:
