@@ -6,6 +6,7 @@ image; the build takes <2s.
 """
 from __future__ import annotations
 
+import hashlib
 import os
 import subprocess
 import threading
@@ -13,16 +14,32 @@ import threading
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "objstore.cc")
 _LIB = os.path.join(_DIR, "libobjstore.so")
+_HASH = _LIB + ".srchash"
 _lock = threading.Lock()
 
 
+def _src_hash() -> str:
+    with open(_SRC, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
 def ensure_built() -> str:
-    """Compile objstore.cc -> libobjstore.so if missing or stale."""
+    """Compile objstore.cc -> libobjstore.so if missing or stale.
+
+    Staleness is a CONTENT hash of the source, not mtimes: a fresh git
+    checkout gives every file the same mtime, which let a committed .so
+    shadow newer committed source (missing-symbol crashes at import).
+    """
     with _lock:
-        if (
-            not os.path.exists(_LIB)
-            or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
-        ):
+        want = _src_hash()
+        have = None
+        if os.path.exists(_LIB) and os.path.exists(_HASH):
+            try:
+                with open(_HASH) as f:
+                    have = f.read().strip()
+            except OSError:
+                pass
+        if have != want:
             tmp = _LIB + ".tmp"
             subprocess.run(
                 [
@@ -33,4 +50,6 @@ def ensure_built() -> str:
                 capture_output=True,
             )
             os.replace(tmp, _LIB)
+            with open(_HASH, "w") as f:
+                f.write(want)
     return _LIB
