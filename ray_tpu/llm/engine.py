@@ -74,6 +74,29 @@ def sample_logits(logits: jax.Array, rng: jax.Array, temperature: float,
     return jax.random.categorical(rng, logits, axis=-1)
 
 
+def sample_logits_batch(logits: jax.Array, rng: jax.Array,
+                        temps: jax.Array, top_ks: jax.Array) -> jax.Array:
+    """Per-ROW sampling over [B, V] logits with per-row params, fully
+    in-jit (no shape depends on the params, so one compiled program covers
+    every request mix — the piece that lets sampling fuse into the decode
+    step instead of costing a host round-trip per token).
+
+    temps[b] <= 0 selects greedy for that row; top_ks[b] > 0 masks to that
+    row's top-k logits, honored exactly for any k (per-row threshold from
+    one full sort — the same cost the scalar sample_logits path paid).
+    """
+    v = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    svals = jnp.sort(scaled, axis=-1)                     # [B, V] asc
+    k_idx = v - jnp.clip(top_ks, 1, v)
+    kth = jnp.take_along_axis(svals, k_idx[:, None], axis=1)
+    masked = jnp.where((top_ks[:, None] > 0) & (scaled < kth),
+                       -1e30, scaled)
+    sampled = jax.random.categorical(rng, masked, axis=-1)
+    return jnp.where(temps <= 0.0, greedy, sampled).astype(jnp.int32)
+
+
 class _EngineBase:
     """Request intake, sampling dispatch and result shaping shared by the
     dense-slot and paged engines (the engine-loop surface of the reference's

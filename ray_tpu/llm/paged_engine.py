@@ -7,15 +7,21 @@ block table of page ids, so cache capacity is bounded by TOKENS IN FLIGHT,
 not max_batch x max_seq_len, and decode attention (Pallas,
 ops/paged_attention.py) reads only the pages a sequence actually uses.
 
-Two jitted programs with static shapes:
-  - chunked prefill: one page-aligned chunk of one prompt per engine step
-    (bounded work — a long prompt can no longer stall every decode slot;
-    vLLM's chunked-prefill role);
-  - batched decode: one token for every decode-ready slot.
+Two families of jitted programs with static shapes, keyed by unroll factor:
+  - chunked prefill: up to `prefill_rows` page-aligned chunk-rows per
+    dispatch (lax.scan carrying the caches, so consecutive rows may be
+    consecutive chunks of one prompt; bounded work — a long prompt can no
+    longer stall every decode slot; vLLM's chunked-prefill role);
+  - windowed decode: `decode_window` tokens for every decode-ready slot
+    per dispatch (lax.scan feeds each step's sampled tokens back in
+    on-device; window 1 while prompts are pending keeps TTFT low).
 
-The Python loop does admission, page allocation, sampling dispatch and
-retirement; all math stays compiled. Cache buffers are donated through both
-programs so XLA updates pages in place.
+Sampling is fused into both programs (sample_logits_batch), so one engine
+step is ONE device dispatch and the only device->host traffic is the
+sampled token block — dispatch latency, not math, dominates a serving step
+on remote-attached accelerators. The Python loop does admission, page
+allocation and retirement; all math stays compiled. Cache buffers are
+donated through every program so XLA updates pages in place.
 """
 from __future__ import annotations
 
@@ -28,7 +34,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models import llama
-from .engine import SamplingParams, _EngineBase, _Request  # noqa: F401 — SamplingParams re-exported
+from .engine import (  # noqa: F401 — SamplingParams re-exported
+    SamplingParams, _EngineBase, _Request, sample_logits_batch,
+)
 from .tokenizer import get_tokenizer
 
 
@@ -39,13 +47,23 @@ class PagedEngineConfig:
     page_size: int = 16
     num_pages: int = 512
     max_pages_per_seq: int = 64
-    # prefill chunk (page multiple); one chunk of one prompt per step
+    # prefill chunk (page multiple); up to prefill_rows chunks per step
     chunk_size: int = 128
+    # dispatch batching: chunk-rows prefetched per prefill dispatch and
+    # decode steps unrolled (lax.scan) per decode dispatch. Each dispatch
+    # costs a host->device round trip; on remote-attached accelerators
+    # that latency dominates a serving step, so both paths amortize it.
+    # decode_window only applies when no prefill is pending (window 1
+    # keeps TTFT low while prompts are still entering the batch).
+    prefill_rows: int = 4
+    decode_window: int = 8
     tokenizer: Any = None
 
     def __post_init__(self):
         if self.chunk_size % self.page_size:
             raise ValueError("chunk_size must be a multiple of page_size")
+        if self.prefill_rows < 1 or self.decode_window < 1:
+            raise ValueError("prefill_rows and decode_window must be >= 1")
 
     @property
     def max_seq_len(self) -> int:
@@ -78,21 +96,61 @@ class PagedInferenceEngine(_EngineBase):
         self._pending: list[_Request] = []
         self._next_rid = 0
         self._rng = jax.random.PRNGKey(rng_seed)
+        self._rng_base = jax.random.PRNGKey(rng_seed ^ 0x5EED)
+        self._rng_ctr = 0
         self._lock = threading.Lock()
+        self._interpret = interpret
+        # jitted programs, keyed by their static unroll factor (decode
+        # window / prefill row count); cache pytrees are donated through
+        # every one so XLA updates pages in place
+        self._decode_win_fns: dict[int, Any] = {}
+        self._prefill_rows_fns: dict[int, Any] = {}
 
-        page = cfg.page_size
+    def _decode_window_fn(self, w: int):
+        """One dispatch = w decode steps for every slot: lax.scan unrolls
+        decode+sample, feeding each step's sampled tokens straight back in
+        on-device. Only the [B, w] token block crosses back to the host."""
+        fn = self._decode_win_fns.get(w)
+        if fn is None:
+            mc, page = self.cfg.model, self.cfg.page_size
+            interpret = self._interpret
 
-        # cache pytrees are donated so XLA updates pages in place
-        self._decode_fn = jax.jit(
-            lambda p, c, t, bt, ln: llama.decode_paged(
-                p, t[:, None], c, bt, ln, mc, page_size=page,
-                interpret=interpret),
-            donate_argnums=(1,))
-        self._prefill_fn = jax.jit(
-            lambda p, c, chunk, bt, sp, tl: llama.prefill_paged_chunk(
-                p, chunk[None, :], c, bt, sp, mc, page_size=page,
-                true_chunk_len=tl),
-            donate_argnums=(1,))
+            def run(p, c, tok0, bt, ln0, key, ctr, temps, top_ks):
+                def body(carry, i):
+                    toks, lens, caches = carry
+                    logits, caches = llama.decode_paged(
+                        p, toks[:, None], caches, bt, lens, mc,
+                        page_size=page, interpret=interpret)
+                    sub = jax.random.fold_in(
+                        jax.random.fold_in(key, ctr), i)
+                    nxt = sample_logits_batch(logits, sub, temps, top_ks)
+                    return (nxt, lens + 1, caches), nxt
+
+                (_, _, c), out = jax.lax.scan(
+                    body, (tok0, ln0, c), jnp.arange(w))
+                return out.T, c                     # [B, w]
+
+            fn = jax.jit(run, donate_argnums=(1,))
+            self._decode_win_fns[w] = fn
+        return fn
+
+    def _prefill_rows_fn(self, r: int):
+        """One dispatch = r prefill chunk-rows + in-jit sampling of each
+        row's last-token logits (used only for prompt-completing rows)."""
+        fn = self._prefill_rows_fns.get(r)
+        if fn is None:
+            mc, page = self.cfg.model, self.cfg.page_size
+
+            def run(p, c, chunks, bts, sps, tls, key, ctr, temps, top_ks):
+                last, c = llama.prefill_paged_rows(
+                    p, chunks, c, bts, sps, tls, mc, page_size=page)
+                toks = sample_logits_batch(
+                    last, jax.random.fold_in(key, ctr), temps, top_ks)
+                return toks, c
+
+            fn = jax.jit(run, donate_argnums=(1,))
+            self._prefill_rows_fns[r] = fn
+        return fn
 
     # -- public API --------------------------------------------------------
 
@@ -154,27 +212,51 @@ class PagedInferenceEngine(_EngineBase):
         import time
         if not self._prefilling:
             return
-        req = self._prefilling[0]
-        c = self.cfg.chunk_size
-        start = req.prefill_pos
-        chunk_ids = req.prompt_ids[start:start + c]
-        true_in_chunk = len(chunk_ids)
-        chunk = np.zeros((c,), np.int32)
-        chunk[:true_in_chunk] = chunk_ids
-        logits, self.caches = self._prefill_fn(
-            self.params, self.caches, jnp.asarray(chunk),
-            jnp.asarray(self._block_tables[req.slot]),
-            np.int32(start), np.int32(true_in_chunk))
-        req.prefill_pos += true_in_chunk
-        if req.prefill_pos >= len(req.prompt_ids):
-            # prompt done: sample the first generated token
-            last = jax.lax.dynamic_index_in_dim(
-                logits, true_in_chunk - 1, 0, keepdims=False)
-            tok = int(self._sample_one(last[None, :], req.params)[0])
+        cfg = self.cfg
+        c, maxp = cfg.chunk_size, cfg.max_pages_per_seq
+        # pack up to prefill_rows chunk-rows, queue order; a request with
+        # several remaining chunks occupies consecutive rows (the scan
+        # carries caches, so later rows see earlier rows' page writes)
+        rows: list[tuple] = []              # (req, start, n_tokens)
+        for req in self._prefilling:
+            pos = req.prefill_pos
+            while pos < len(req.prompt_ids) and len(rows) < cfg.prefill_rows:
+                n = min(c, len(req.prompt_ids) - pos)
+                rows.append((req, pos, n))
+                pos += n
+            if len(rows) >= cfg.prefill_rows:
+                break
+        # a lone chunk uses the r=1 program instead of padding to
+        # prefill_rows (pad rows are correctness-safe but waste compute)
+        r = 1 if len(rows) == 1 else cfg.prefill_rows
+        chunks = np.zeros((r, c), np.int32)
+        bts = np.zeros((r, maxp), np.int32)
+        sps = np.zeros((r,), np.int32)
+        tls = np.zeros((r,), np.int32)
+        temps = np.zeros((r,), np.float32)
+        topks = np.zeros((r,), np.int32)
+        for i, (req, pos, n) in enumerate(rows):
+            chunks[i, :n] = req.prompt_ids[pos:pos + n]
+            bts[i] = self._block_tables[req.slot]
+            sps[i], tls[i] = pos, n
+            temps[i] = req.params.temperature
+            topks[i] = req.params.top_k
+        toks, self.caches = self._prefill_rows_fn(r)(
+            self.params, self.caches, chunks, bts, sps, tls,
+            self._rng_base, np.int32(self._rng_ctr), temps, topks)
+        self._rng_ctr += 1
+        toks = np.asarray(toks)
+        for i, (req, pos, n) in enumerate(rows):
+            req.prefill_pos = pos + n
+            if req.prefill_pos < len(req.prompt_ids):
+                continue
+            # prompt done: the row's in-jit sampled token is the first
+            # generated token
+            tok = int(toks[i])
             req.out_ids.append(tok)
             req.first_token_t = time.perf_counter()
             self._lengths[req.slot] = len(req.prompt_ids)
-            self._prefilling.pop(0)
+            self._prefilling.remove(req)
             if getattr(req, "prefill_only", False):
                 # disaggregated prefill: export the KV pages + first token
                 # instead of decoding here (llm/pd_disagg.py)
@@ -182,7 +264,7 @@ class PagedInferenceEngine(_EngineBase):
                 req.done = True
                 req.event.set()
                 self._release(req)
-                return
+                continue
             self._active[req.slot] = req
             self._maybe_finish(req, tok)
         # NOTE: pad positions of the final chunk were written into the
@@ -192,50 +274,80 @@ class PagedInferenceEngine(_EngineBase):
     def _decode_step(self):
         if not self._active:
             return
-        bs = self.cfg.max_batch_size
+        cfg = self.cfg
+        bs, page = cfg.max_batch_size, cfg.page_size
+        # full window only when no prompt is waiting: a pending prefill
+        # gets interleaved every step, keeping TTFT low under bursts
+        w = 1 if self._prefilling or self._pending else cfg.decode_window
         tokens = np.zeros((bs,), np.int32)
         lengths = np.zeros((bs,), np.int32)
+        temps = np.zeros((bs,), np.float32)
+        topks = np.zeros((bs,), np.int32)
         # slots not decoding this step get a zeroed block-table row: their
-        # dummy write goes to sink page 0 instead of a live (possibly
+        # dummy writes go to sink page 0 instead of a live (possibly
         # reused) page
         bt = np.zeros_like(self._block_tables)
+        allow: dict[int, int] = {}          # valid tokens per slot this window
         for slot, req in self._active.items():
+            total = len(req.prompt_ids) + len(req.out_ids)
+            # pre-allocate the window's pages (capped at the sequence
+            # ceiling); if the pool runs dry the request keeps only the
+            # tokens its allocated pages cover and finishes early
+            target = min(total + w, cfg.max_seq_len)
+            if self._ensure_pages(req, target):
+                allow[slot] = target - total
+            else:
+                allow[slot] = max(len(req.pages) * page - total, 0)
             tokens[slot] = req.out_ids[-1]
             lengths[slot] = self._lengths[slot]
+            temps[slot] = req.params.temperature
+            topks[slot] = req.params.top_k
             bt[slot] = self._block_tables[slot]
-        self._rng, sub = jax.random.split(self._rng)
-        logits, self.caches = self._decode_fn(
-            self.params, self.caches, jnp.asarray(tokens),
-            jnp.asarray(bt), jnp.asarray(lengths))
-        for slot in list(self._active):
-            self._lengths[slot] += 1
-        self._sample_and_retire(logits, sub)
-
-    def _sample_and_retire(self, logits, rng):
-        next_tokens = self._sample_next_tokens(logits, rng)
+        out, self.caches = self._decode_window_fn(w)(
+            self.params, self.caches, tokens, bt, lengths,
+            self._rng_base, np.int32(self._rng_ctr), temps, topks)
+        self._rng_ctr += 1
+        out = np.asarray(out)               # [bs, w]
         for slot in list(self._active):
             req = self._active[slot]
-            tok = next_tokens[slot]
-            req.out_ids.append(tok)
-            self._maybe_finish(req, tok)
+            for j in range(w):
+                if j >= allow[slot]:
+                    # page pool exhausted mid-window: finish early rather
+                    # than wedge (tokens past the allocation wrote to the
+                    # sink page and are not trustworthy)
+                    self._retire(req)
+                    break
+                tok = int(out[slot, j])
+                req.out_ids.append(tok)
+                self._lengths[slot] += 1
+                if self._stop_after(req, tok):
+                    self._retire(req)
+                    break
+
+    def _stop_after(self, req: _Request, tok: int) -> bool:
+        """Stop condition evaluated after appending tok to req.out_ids."""
+        total = len(req.prompt_ids) + len(req.out_ids)
+        return (len(req.out_ids) >= req.params.max_tokens
+                or tok == self._eos_id() or tok in req.params.stop_token_ids
+                or total >= self.cfg.max_seq_len - 1)
+
+    def _retire(self, req: _Request):
+        req.done = True
+        req.event.set()
+        self._active.pop(req.slot, None)
+        if req in self._prefilling:
+            self._prefilling.remove(req)
+        self._release(req)
 
     def _maybe_finish(self, req: _Request, tok: int):
-        eos = self._eos_id()
-        total = len(req.prompt_ids) + len(req.out_ids)
-        stop = (len(req.out_ids) >= req.params.max_tokens
-                or tok == eos or tok in req.params.stop_token_ids
-                or total >= self.cfg.max_seq_len - 1)
+        stop = self._stop_after(req, tok)
         if not stop:
             # growing by one token may need one more page
+            total = len(req.prompt_ids) + len(req.out_ids)
             if not self._ensure_pages(req, total + 1):
                 stop = True  # pool exhausted: finish early rather than wedge
         if stop:
-            req.done = True
-            req.event.set()
-            self._active.pop(req.slot, None)
-            if req in self._prefilling:
-                self._prefilling.remove(req)
-            self._release(req)
+            self._retire(req)
 
     # -- prefill/decode disaggregation (llm/pd_disagg.py; reference:
     # prefill_decode_disagg.py:64) ----------------------------------------

@@ -708,6 +708,40 @@ def prefill_paged_chunk(params: dict, chunk: jax.Array, caches: list[dict],
     return logits, new_caches
 
 
+def prefill_paged_rows(params: dict, chunks: jax.Array, caches: list[dict],
+                       bt_rows: jax.Array, start_pos: jax.Array,
+                       true_lens: jax.Array, cfg: LlamaConfig, *,
+                       page_size: int):
+    """Prefill up to R chunk-rows in ONE compiled program.
+
+    chunks [R, C] (each row one page-aligned chunk, right-padded);
+    bt_rows [R, max_pages]; start_pos/true_lens [R]. Rows run sequentially
+    under lax.scan carrying the caches, so consecutive rows may be
+    consecutive chunks of the SAME sequence — row i+1 sees row i's page
+    writes. Rows with true_lens == 0 are padding: all their page writes
+    route to sink page 0. Returns (last_logits [R, V] — the logit at each
+    row's last real token — and updated caches).
+
+    Exists to cut engine-step dispatch count: a burst of prompts prefills
+    in ceil(n_chunks / R) dispatches instead of one dispatch per chunk
+    (the batched-prefill scheduling role of the reference's vLLM engine,
+    llm/_internal/serve/deployments/llm/vllm/vllm_engine.py:180).
+    """
+    c = chunks.shape[1]
+
+    def body(carry, row):
+        chunk, bt, sp, tl = row
+        logits, carry = prefill_paged_chunk(
+            params, chunk[None, :], carry, bt, sp, cfg,
+            page_size=page_size, true_chunk_len=tl)
+        last = logits[jnp.clip(tl - 1, 0, c - 1)]
+        return carry, last
+
+    caches, last = jax.lax.scan(
+        body, caches, (chunks, bt_rows, start_pos, true_lens))
+    return last, caches
+
+
 def cross_entropy_loss(logits: jax.Array, targets: jax.Array,
                        mask: Optional[jax.Array] = None) -> jax.Array:
     """Mean next-token NLL. logits [B,S,V] f32, targets [B,S] int32."""
