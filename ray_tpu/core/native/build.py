@@ -53,7 +53,13 @@ def ensure_built() -> str:
                 os.replace(tmp, _LIB)
                 with open(_HASH, "w") as f:
                     f.write(want)
-            except (subprocess.CalledProcessError, OSError):
+            except subprocess.CalledProcessError as e:
+                # a real compile error must surface (silently loading the
+                # stale .so is the failure mode this hash scheme prevents)
+                raise RuntimeError(
+                    "objstore.cc failed to compile:\n"
+                    + e.stderr.decode(errors="replace")) from e
+            except OSError:
                 # no compiler / read-only checkout: a shipped .so is still
                 # usable (it may just predate the latest source)
                 if not os.path.exists(_LIB):

@@ -75,7 +75,9 @@ def sample_logits(logits: jax.Array, rng: jax.Array, temperature: float,
 
 
 def sample_logits_batch(logits: jax.Array, rng: jax.Array,
-                        temps: jax.Array, top_ks: jax.Array) -> jax.Array:
+                        temps: jax.Array, top_ks: jax.Array, *,
+                        any_sampled: bool = True,
+                        any_topk: bool = True) -> jax.Array:
     """Per-ROW sampling over [B, V] logits with per-row params, fully
     in-jit (no shape depends on the params, so one compiled program covers
     every request mix — the piece that lets sampling fuse into the decode
@@ -84,16 +86,22 @@ def sample_logits_batch(logits: jax.Array, rng: jax.Array,
     temps[b] <= 0 selects greedy for that row; top_ks[b] > 0 masks to that
     row's top-k logits, honored exactly for any k (per-row threshold from
     one full sort — the same cost the scalar sample_logits path paid).
+    any_sampled/any_topk are STATIC hints the caller derives from the
+    batch at dispatch time (it keys its jit cache on them): all-greedy
+    batches skip the categorical entirely, no-top-k batches skip the sort.
     """
-    v = logits.shape[-1]
-    greedy = jnp.argmax(logits, axis=-1)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if not any_sampled:
+        return greedy
     scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-    svals = jnp.sort(scaled, axis=-1)                     # [B, V] asc
-    k_idx = v - jnp.clip(top_ks, 1, v)
-    kth = jnp.take_along_axis(svals, k_idx[:, None], axis=1)
-    masked = jnp.where((top_ks[:, None] > 0) & (scaled < kth),
-                       -1e30, scaled)
-    sampled = jax.random.categorical(rng, masked, axis=-1)
+    if any_topk:
+        v = logits.shape[-1]
+        svals = jnp.sort(scaled, axis=-1)                 # [B, V] asc
+        k_idx = v - jnp.clip(top_ks, 1, v)
+        kth = jnp.take_along_axis(svals, k_idx[:, None], axis=1)
+        scaled = jnp.where((top_ks[:, None] > 0) & (scaled < kth),
+                           -1e30, scaled)
+    sampled = jax.random.categorical(rng, scaled, axis=-1)
     return jnp.where(temps <= 0.0, greedy, sampled).astype(jnp.int32)
 
 

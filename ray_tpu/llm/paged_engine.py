@@ -95,25 +95,35 @@ class PagedInferenceEngine(_EngineBase):
         self._prefilling: list[_Request] = []   # admitted, prompt not done
         self._pending: list[_Request] = []
         self._next_rid = 0
-        self._rng = jax.random.PRNGKey(rng_seed)
         self._rng_base = jax.random.PRNGKey(rng_seed ^ 0x5EED)
         self._rng_ctr = 0
         self._lock = threading.Lock()
         self._interpret = interpret
-        # jitted programs, keyed by their static unroll factor (decode
-        # window / prefill row count); cache pytrees are donated through
-        # every one so XLA updates pages in place
-        self._decode_win_fns: dict[int, Any] = {}
-        self._prefill_rows_fns: dict[int, Any] = {}
+        # jitted programs, keyed by (static unroll factor, sampling mode):
+        # unroll = decode window / prefill row count; mode = the
+        # (any_sampled, any_topk) pair so all-greedy batches compile
+        # without the categorical and no-top-k batches without the sort.
+        # Cache pytrees are donated through every one so XLA updates
+        # pages in place.
+        self._decode_win_fns: dict[tuple, Any] = {}
+        self._prefill_rows_fns: dict[tuple, Any] = {}
 
-    def _decode_window_fn(self, w: int):
+    @staticmethod
+    def _sampling_mode(reqs) -> tuple:
+        any_sampled = any(r.params.temperature > 0 for r in reqs)
+        any_topk = any_sampled and any(
+            r.params.top_k > 0 and r.params.temperature > 0 for r in reqs)
+        return any_sampled, any_topk
+
+    def _decode_window_fn(self, w: int, mode: tuple):
         """One dispatch = w decode steps for every slot: lax.scan unrolls
         decode+sample, feeding each step's sampled tokens straight back in
         on-device. Only the [B, w] token block crosses back to the host."""
-        fn = self._decode_win_fns.get(w)
+        fn = self._decode_win_fns.get((w, mode))
         if fn is None:
             mc, page = self.cfg.model, self.cfg.page_size
             interpret = self._interpret
+            any_sampled, any_topk = mode
 
             def run(p, c, tok0, bt, ln0, key, ctr, temps, top_ks):
                 def body(carry, i):
@@ -123,7 +133,9 @@ class PagedInferenceEngine(_EngineBase):
                         page_size=page, interpret=interpret)
                     sub = jax.random.fold_in(
                         jax.random.fold_in(key, ctr), i)
-                    nxt = sample_logits_batch(logits, sub, temps, top_ks)
+                    nxt = sample_logits_batch(
+                        logits, sub, temps, top_ks,
+                        any_sampled=any_sampled, any_topk=any_topk)
                     return (nxt, lens + 1, caches), nxt
 
                 (_, _, c), out = jax.lax.scan(
@@ -131,25 +143,27 @@ class PagedInferenceEngine(_EngineBase):
                 return out.T, c                     # [B, w]
 
             fn = jax.jit(run, donate_argnums=(1,))
-            self._decode_win_fns[w] = fn
+            self._decode_win_fns[(w, mode)] = fn
         return fn
 
-    def _prefill_rows_fn(self, r: int):
+    def _prefill_rows_fn(self, r: int, mode: tuple):
         """One dispatch = r prefill chunk-rows + in-jit sampling of each
         row's last-token logits (used only for prompt-completing rows)."""
-        fn = self._prefill_rows_fns.get(r)
+        fn = self._prefill_rows_fns.get((r, mode))
         if fn is None:
             mc, page = self.cfg.model, self.cfg.page_size
+            any_sampled, any_topk = mode
 
             def run(p, c, chunks, bts, sps, tls, key, ctr, temps, top_ks):
                 last, c = llama.prefill_paged_rows(
                     p, chunks, c, bts, sps, tls, mc, page_size=page)
                 toks = sample_logits_batch(
-                    last, jax.random.fold_in(key, ctr), temps, top_ks)
+                    last, jax.random.fold_in(key, ctr), temps, top_ks,
+                    any_sampled=any_sampled, any_topk=any_topk)
                 return toks, c
 
             fn = jax.jit(run, donate_argnums=(1,))
-            self._prefill_rows_fns[r] = fn
+            self._prefill_rows_fns[(r, mode)] = fn
         return fn
 
     # -- public API --------------------------------------------------------
@@ -226,9 +240,10 @@ class PagedInferenceEngine(_EngineBase):
                 pos += n
             if len(rows) >= cfg.prefill_rows:
                 break
-        # a lone chunk uses the r=1 program instead of padding to
-        # prefill_rows (pad rows are correctness-safe but waste compute)
-        r = 1 if len(rows) == 1 else cfg.prefill_rows
+        # size the program to the rows actually packed (the jit cache is
+        # keyed by r, at most prefill_rows variants): pad rows would be
+        # correctness-safe but cost a full chunk forward each
+        r = len(rows)
         chunks = np.zeros((r, c), np.int32)
         bts = np.zeros((r, maxp), np.int32)
         sps = np.zeros((r,), np.int32)
@@ -241,7 +256,8 @@ class PagedInferenceEngine(_EngineBase):
             sps[i], tls[i] = pos, n
             temps[i] = req.params.temperature
             topks[i] = req.params.top_k
-        toks, self.caches = self._prefill_rows_fn(r)(
+        toks, self.caches = self._prefill_rows_fn(
+            r, self._sampling_mode([q for q, _, _ in rows]))(
             self.params, self.caches, chunks, bts, sps, tls,
             self._rng_base, np.int32(self._rng_ctr), temps, topks)
         self._rng_ctr += 1
@@ -290,10 +306,15 @@ class PagedInferenceEngine(_EngineBase):
         allow: dict[int, int] = {}          # valid tokens per slot this window
         for slot, req in self._active.items():
             total = len(req.prompt_ids) + len(req.out_ids)
-            # pre-allocate the window's pages (capped at the sequence
-            # ceiling); if the pool runs dry the request keeps only the
-            # tokens its allocated pages cover and finishes early
-            target = min(total + w, cfg.max_seq_len)
+            # pre-allocate pages only for tokens this request can still
+            # emit (window, max_tokens remainder, sequence ceiling —
+            # whichever is least; over-grabbing the full window would
+            # starve later slots under pool pressure). Window writes past
+            # the allocation land on sink page 0 and those tokens are
+            # discarded. If the pool runs dry the request keeps only the
+            # tokens its allocated pages cover and finishes early.
+            remaining = max(req.params.max_tokens - len(req.out_ids), 1)
+            target = min(total + min(w, remaining), cfg.max_seq_len)
             if self._ensure_pages(req, target):
                 allow[slot] = target - total
             else:
@@ -303,7 +324,8 @@ class PagedInferenceEngine(_EngineBase):
             temps[slot] = req.params.temperature
             topks[slot] = req.params.top_k
             bt[slot] = self._block_tables[slot]
-        out, self.caches = self._decode_window_fn(w)(
+        out, self.caches = self._decode_window_fn(
+            w, self._sampling_mode(self._active.values()))(
             self.params, self.caches, tokens, bt, lengths,
             self._rng_base, np.int32(self._rng_ctr), temps, topks)
         self._rng_ctr += 1

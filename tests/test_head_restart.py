@@ -120,7 +120,7 @@ def test_named_actor_restored_after_restart(tmp_path, fresh_driver_state):
 
         c = Counter.options(name="survivor", lifetime="detached").remote()
         assert ray_tpu.get(c.bump.remote(), timeout=60) == 1
-        time.sleep(1.5)  # let a snapshot cycle capture the named actor
+        time.sleep(3.0)  # let a snapshot cycle capture the named actor
 
         head1.send_signal(signal.SIGKILL)
         head1.wait(timeout=10)
@@ -130,7 +130,7 @@ def test_named_actor_restored_after_restart(tmp_path, fresh_driver_state):
 
         # reconnect happens lazily on the next call; the restored actor is
         # a FRESH instance re-created from its spec (state restarts at 0)
-        deadline = time.monotonic() + 60
+        deadline = time.monotonic() + 120
         c2 = None
         while time.monotonic() < deadline:
             try:
