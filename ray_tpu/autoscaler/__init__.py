@@ -8,6 +8,8 @@ ResourceDemandScheduler) and the fake multi-node provider
 from .autoscaler import Autoscaler, NodeTypeConfig
 from .gce_tpu import GceTpuVmProvider
 from .node_provider import FakeNodeProvider, NodeProvider
+from .v2 import AutoscalerV2, Instance, InstanceManager
 
-__all__ = ["Autoscaler", "NodeTypeConfig", "NodeProvider",
-           "FakeNodeProvider", "GceTpuVmProvider"]
+__all__ = ["Autoscaler", "AutoscalerV2", "NodeTypeConfig", "NodeProvider",
+           "FakeNodeProvider", "GceTpuVmProvider", "Instance",
+           "InstanceManager"]

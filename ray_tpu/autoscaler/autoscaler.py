@@ -65,6 +65,108 @@ def _gang_fits(gang: list[dict], hosts: int, per_host: dict,
     return True
 
 
+def plan_scaling(node_types: dict, demands: list[dict],
+                 gangs: list[tuple[list[dict], str]], frees: list[dict],
+                 booting_types: list[str],
+                 live_by_type: dict[str, int]) -> dict[str, int]:
+    """The pure scale-up decision shared by v1 and v2 (reference:
+    scheduler.py:632 ResourceDemandScheduler): bin-pack unmet demands and
+    pending slice gangs onto new instances of the configured types.
+
+    `frees` is per-alive-host free resources; `booting_types` lists the
+    type of every instance already launching (their capacity is counted so
+    a burst of demand doesn't launch a node per tick); `live_by_type`
+    counts ALL non-terminal instances for max_workers ceilings. Mutates
+    nothing; returns {type name: count to launch}.
+    """
+    frees = [dict(f) for f in frees]
+    live_by_type = dict(live_by_type)
+    for tname in booting_types:
+        t = node_types[tname]
+        for _ in range(t.hosts):
+            frees.append(dict(t.resources))
+
+    unmet: list[dict] = []
+    for d in sorted(demands, key=lambda d: -sum(d.values())):
+        for cap in frees:
+            if _fits(d, cap):
+                _sub(cap, d)
+                break
+        else:
+            unmet.append(d)
+
+    # bin-pack unmet onto new nodes, first-fit-decreasing by type order
+    to_launch: dict[str, int] = {}
+    new_caps: list[dict] = []
+    for d in unmet:
+        placed = False
+        for cap in new_caps:
+            if _fits(d, cap):
+                _sub(cap, d)
+                placed = True
+                break
+        if placed:
+            continue
+        for t in node_types.values():
+            count = live_by_type.get(t.name, 0) + to_launch.get(t.name, 0)
+            if count >= t.max_workers:
+                continue
+            if _fits(d, dict(t.resources)):
+                cap = dict(t.resources)
+                _sub(cap, d)
+                new_caps.append(cap)
+                to_launch[t.name] = to_launch.get(t.name, 0) + 1
+                placed = True
+                break
+        # unplaceable on ANY type: leave it pending (the task's own
+        # infeasibility timeout reports the error)
+
+    # slice gangs: each pending same-label PG needs ONE instance with
+    # enough hosts, every bundle fitting the type's per-host resources
+    # (one bundle per host, the slice_placement_group shape). A booting
+    # slice-capable instance covers a gang so bursts don't launch one
+    # slice per tick.
+    in_flight = list(booting_types)
+    for gang, strategy in gangs:
+        def covers(t: NodeTypeConfig) -> bool:
+            return _gang_fits(gang, t.hosts, t.resources, strategy)
+        hit = next((tn for tn in in_flight
+                    if covers(node_types[tn])), None)
+        if hit is not None:
+            in_flight.remove(hit)
+            continue
+        for t in node_types.values():
+            count = live_by_type.get(t.name, 0) + to_launch.get(t.name, 0)
+            if count >= t.max_workers or not covers(t):
+                continue
+            to_launch[t.name] = to_launch.get(t.name, 0) + 1
+            break
+
+    # min_workers floor
+    for t in node_types.values():
+        have = live_by_type.get(t.name, 0) + to_launch.get(t.name, 0)
+        if have < t.min_workers:
+            to_launch[t.name] = to_launch.get(t.name, 0) + (
+                t.min_workers - have)
+    return to_launch
+
+
+def busy_node_hexes(rt) -> set:
+    """NodeID hexes with busy/actor/starting workers or reserved PG
+    bundles — nodes the autoscaler must not reclaim."""
+    with rt.lock:
+        busy_nodes = set()
+        for w in rt.workers.values():
+            if w.state in ("busy", "actor", "starting") or w.blocked:
+                busy_nodes.add(w.node_id)
+        for pg in rt.pgs.values():
+            if pg.state == "created":
+                for b in pg.bundles:
+                    if b.node_id is not None:
+                        busy_nodes.add(b.node_id)
+        return {n.hex() for n in busy_nodes}
+
+
 class Autoscaler:
     def __init__(self, node_types: list[NodeTypeConfig],
                  provider: Optional[NodeProvider] = None,
@@ -129,86 +231,15 @@ class Autoscaler:
         """One reconcile decision: ({type: count to launch},
         [instance ids to terminate])."""
         demands = self.pending_demands()
-        frees = self._free_capacity()
-        # in-flight launches count as future capacity so one burst of
-        # demand doesn't launch a node per tick while agents boot
-        booting_types: list[str] = []
-        for iid, tname in self.instances.items():
-            if self.provider.node_id_of(iid) is None:
-                t = self.node_types[tname]
-                booting_types.append(tname)
-                for _ in range(t.hosts):
-                    frees.append(dict(t.resources))
-
-        unmet: list[dict] = []
-        for d in sorted(demands, key=lambda d: -sum(d.values())):
-            for cap in frees:
-                if _fits(d, cap):
-                    _sub(cap, d)
-                    break
-            else:
-                unmet.append(d)
-
-        # bin-pack unmet onto new nodes, first-fit-decreasing by type order
-        to_launch: dict[str, int] = {}
+        gangs = self.pending_gangs()
+        booting_types = [tname for iid, tname in self.instances.items()
+                         if self.provider.node_id_of(iid) is None]
         live_by_type: dict[str, int] = {}
         for iid, tname in self.instances.items():
             live_by_type[tname] = live_by_type.get(tname, 0) + 1
-        new_caps: list[dict] = []
-        for d in unmet:
-            placed = False
-            for cap in new_caps:
-                if _fits(d, cap):
-                    _sub(cap, d)
-                    placed = True
-                    break
-            if placed:
-                continue
-            for t in self.node_types.values():
-                count = live_by_type.get(t.name, 0) + to_launch.get(
-                    t.name, 0)
-                if count >= t.max_workers:
-                    continue
-                if _fits(d, dict(t.resources)):
-                    cap = dict(t.resources)
-                    _sub(cap, d)
-                    new_caps.append(cap)
-                    to_launch[t.name] = to_launch.get(t.name, 0) + 1
-                    placed = True
-                    break
-            # unplaceable on ANY type: leave it pending (the task's own
-            # infeasibility timeout reports the error)
-
-        # slice gangs: each pending same-label PG needs ONE instance with
-        # enough hosts, every bundle fitting the type's per-host resources
-        # (one bundle per host, the slice_placement_group shape). A booting
-        # slice-capable instance covers a gang so bursts don't launch one
-        # slice per tick.
-        gangs = self.pending_gangs()
-        in_flight = list(booting_types)
-        for gang, strategy in gangs:
-            def covers(t: NodeTypeConfig) -> bool:
-                return _gang_fits(gang, t.hosts, t.resources, strategy)
-            hit = next((tn for tn in in_flight
-                        if covers(self.node_types[tn])), None)
-            if hit is not None:
-                in_flight.remove(hit)
-                continue
-            for t in self.node_types.values():
-                count = live_by_type.get(t.name, 0) + to_launch.get(
-                    t.name, 0)
-                if count >= t.max_workers or not covers(t):
-                    continue
-                to_launch[t.name] = to_launch.get(t.name, 0) + 1
-                break
-
-        # min_workers floor
-        for t in self.node_types.values():
-            have = live_by_type.get(t.name, 0) + to_launch.get(t.name, 0)
-            if have < t.min_workers:
-                to_launch[t.name] = to_launch.get(t.name, 0) + (
-                    t.min_workers - have)
-
+        to_launch = plan_scaling(self.node_types, demands, gangs,
+                                 self._free_capacity(), booting_types,
+                                 live_by_type)
         to_terminate = self._find_idle() if not (demands or gangs) else []
         return to_launch, to_terminate
 
@@ -216,17 +247,7 @@ class Autoscaler:
         rt = self.rt
         now = time.monotonic()
         out = []
-        with rt.lock:
-            busy_nodes = set()
-            for w in rt.workers.values():
-                if w.state in ("busy", "actor", "starting") or w.blocked:
-                    busy_nodes.add(w.node_id)
-            for pg in rt.pgs.values():
-                if pg.state == "created":
-                    for b in pg.bundles:
-                        if b.node_id is not None:
-                            busy_nodes.add(b.node_id)
-            busy_hex = {n.hex() for n in busy_nodes}
+        busy_hex = busy_node_hexes(rt)
         live_by_type: dict[str, int] = {}
         for iid, tname in self.instances.items():
             live_by_type[tname] = live_by_type.get(tname, 0) + 1

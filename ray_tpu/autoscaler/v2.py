@@ -1,0 +1,418 @@
+"""Autoscaler v2: the instance-manager rewrite.
+
+Reference parity: autoscaler/v2/autoscaler.py:42 (update_autoscaling_state
+— one reconcile over declared cluster state), instance_manager/
+instance_manager.py:29 (a VERSIONED instance table mutated only through
+update events, so concurrent reconcilers can't clobber each other) and
+scheduler.py:632 (ResourceDemandScheduler — here the shared
+`plan_scaling` bin-packer). TPU inversion: demand comes straight off the
+head runtime's queues (no GCS/autoscaler RPC hop), and the instance
+lifecycle is driven by a single reconciler thread per head, with the
+versioned table there to make every transition observable, event-sourced
+and crash-recoverable — not to coordinate multiple writers.
+
+What v2 adds over v1's flat `instances` dict:
+  - an explicit per-instance state machine
+        QUEUED -> REQUESTED -> ALLOCATED -> RAY_RUNNING
+                -> TERMINATING -> TERMINATED
+    with ALLOCATION_FAILED + bounded backoff retry on the request edge
+    (v1 called the provider inline and a raising provider lost the
+    launch: the demand re-planned from scratch next tick, with no retry
+    budget or failure record);
+  - event-sourced transitions: every instance carries its full
+    (ts, from, to, reason) history, mirrored into a global event log;
+  - crash-safe persistence: the table journals to a JSON file in the
+    session dir and a restarted head resumes instance bookkeeping
+    (provider drift is then reconciled against reality);
+  - drift detection: instances the provider no longer reports move to
+    TERMINATED with reason "provider-lost"; min_workers then relaunches
+    through the normal QUEUED path.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+from .autoscaler import (
+    Autoscaler, NodeTypeConfig, busy_node_hexes, plan_scaling,
+)
+from .node_provider import NodeProvider
+
+# instance lifecycle states (reference: instance_manager/common.py's
+# Instance proto states, collapsed to the ones a TPU head drives)
+QUEUED = "QUEUED"                      # decided, not yet asked of provider
+REQUESTED = "REQUESTED"                # provider call issued
+ALLOCATED = "ALLOCATED"                # provider reports hosts exist
+RAY_RUNNING = "RAY_RUNNING"            # every host registered with head
+TERMINATING = "TERMINATING"            # terminate issued
+TERMINATED = "TERMINATED"              # gone (terminal)
+ALLOCATION_FAILED = "ALLOCATION_FAILED"  # create failed; retry w/ backoff
+
+_TERMINAL = {TERMINATED}
+# ALLOCATION_FAILED counts for planning: it holds a retry slot, so the
+# min_workers floor must not launch a duplicate while it waits to retry
+# (nor may retries push the total past max_workers)
+_LIVE_FOR_PLANNING = (QUEUED, REQUESTED, ALLOCATED, RAY_RUNNING,
+                      ALLOCATION_FAILED)
+
+_VALID = {
+    QUEUED: {REQUESTED, ALLOCATION_FAILED, TERMINATED},
+    REQUESTED: {ALLOCATED, RAY_RUNNING, ALLOCATION_FAILED, TERMINATING,
+                TERMINATED},
+    ALLOCATED: {RAY_RUNNING, TERMINATING, TERMINATED},
+    RAY_RUNNING: {TERMINATING, TERMINATED},
+    TERMINATING: {TERMINATED},
+    ALLOCATION_FAILED: {QUEUED, TERMINATED},
+    TERMINATED: set(),
+}
+
+
+@dataclasses.dataclass
+class Instance:
+    instance_id: str                   # manager-scoped logical id
+    node_type: str
+    state: str = QUEUED
+    provider_id: Optional[str] = None  # set once the provider call returns
+    version: int = 1                   # bumped on every applied update
+    retries: int = 0                   # failed allocation attempts so far
+    retry_after: float = 0.0           # monotonic ts gate for the retry
+    queued_at: float = dataclasses.field(default_factory=time.monotonic)
+    requested_at: float = 0.0
+    events: list = dataclasses.field(default_factory=list)
+
+    def to_json(self) -> dict:
+        d = dataclasses.asdict(self)
+        # monotonic stamps don't survive a process restart; persist zeros
+        # so a resumed manager re-times from its own clock
+        d["queued_at"] = d["requested_at"] = d["retry_after"] = 0.0
+        return d
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Instance":
+        return cls(**d)
+
+
+class InstanceManager:
+    """The versioned instance table. All mutation goes through
+    `update()`, which enforces the state machine, optimistic versioning
+    and the event journal, then persists (reference:
+    instance_manager.py:29; its InstanceUpdateEvent becomes the update()
+    call, its versioned InstanceStorage the journal file)."""
+
+    def __init__(self, path: Optional[str] = None):
+        self._lock = threading.Lock()
+        self._instances: dict[str, Instance] = {}
+        self._seq = 0
+        self._path = path
+        self.events: list[dict] = []   # global mirror, for observability
+        if path and os.path.exists(path):
+            with open(path) as f:
+                data = json.load(f)
+            self._seq = data["seq"]
+            now = time.monotonic()
+            for d in data["instances"]:
+                inst = Instance.from_json(d)
+                if inst.state == REQUESTED:
+                    # monotonic stamps were zeroed on persist; re-time the
+                    # allocation-timeout clock from this process's clock
+                    inst.requested_at = now
+                self._instances[inst.instance_id] = inst
+
+    # -- reads ---------------------------------------------------------- #
+
+    def get(self, instance_id: str) -> Optional[Instance]:
+        with self._lock:
+            return self._instances.get(instance_id)
+
+    def instances(self, *states: str) -> list[Instance]:
+        with self._lock:
+            out = list(self._instances.values())
+        if states:
+            out = [i for i in out if i.state in states]
+        return out
+
+    def live_by_type(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for i in self.instances(*_LIVE_FOR_PLANNING):
+            out[i.node_type] = out.get(i.node_type, 0) + 1
+        return out
+
+    # -- writes --------------------------------------------------------- #
+
+    def create(self, node_type: str) -> Instance:
+        with self._lock:
+            self._seq += 1
+            inst = Instance(instance_id=f"im-{self._seq}",
+                            node_type=node_type)
+            ev = {"ts": time.time(), "from": None, "to": QUEUED,
+                  "reason": "scale-up", "instance": inst.instance_id}
+            inst.events.append(ev)
+            self.events.append(ev)
+            self._instances[inst.instance_id] = inst
+            self._persist_locked()
+            return inst
+
+    def update(self, instance_id: str, new_state: str, *,
+               expected_version: Optional[int] = None,
+               reason: str = "", **fields) -> bool:
+        """Apply one transition. Returns False (no mutation) when the
+        transition is invalid for the current state or the caller's
+        expected_version is stale — the optimistic-concurrency contract:
+        read the instance, decide, update with its version."""
+        with self._lock:
+            inst = self._instances.get(instance_id)
+            if inst is None:
+                return False
+            if expected_version is not None and \
+                    inst.version != expected_version:
+                self.events.append({
+                    "ts": time.time(), "instance": instance_id,
+                    "rejected": True, "to": new_state, "reason":
+                    f"stale version {expected_version} != {inst.version}"})
+                return False
+            if new_state != inst.state and \
+                    new_state not in _VALID[inst.state]:
+                self.events.append({
+                    "ts": time.time(), "instance": instance_id,
+                    "rejected": True, "to": new_state, "reason":
+                    f"invalid transition {inst.state} -> {new_state}"})
+                return False
+            ev = {"ts": time.time(), "from": inst.state, "to": new_state,
+                  "reason": reason, "instance": instance_id}
+            inst.events.append(ev)
+            self.events.append(ev)
+            inst.state = new_state
+            inst.version += 1
+            for k, v in fields.items():
+                setattr(inst, k, v)
+            self._persist_locked()
+            return True
+
+    def prune_terminated(self, keep: int = 64) -> None:
+        """Bound the table: keep only the newest `keep` TERMINATED rows
+        (their event history stays in self.events)."""
+        with self._lock:
+            dead = sorted((i for i in self._instances.values()
+                           if i.state in _TERMINAL),
+                          key=lambda i: i.queued_at)
+            for i in dead[:max(len(dead) - keep, 0)]:
+                self._instances.pop(i.instance_id, None)
+            self._persist_locked()
+
+    def _persist_locked(self) -> None:
+        if not self._path:
+            return
+        tmp = self._path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"seq": self._seq,
+                       "instances": [i.to_json()
+                                     for i in self._instances.values()]},
+                      f)
+        os.replace(tmp, self._path)
+
+
+class AutoscalerV2:
+    """Reconciler: demand -> desired instances -> lifecycle -> provider.
+
+    Reads demand exactly like v1 (the head runtime's pending queues),
+    plans with the shared bin-packer, but actuates through the
+    InstanceManager's state machine instead of calling the provider
+    inline, which is what buys retries, drift handling and a restartable
+    table (reference: autoscaler/v2/autoscaler.py:42's
+    update_autoscaling_state -> Reconciler.reconcile flow).
+    """
+
+    def __init__(self, node_types: list[NodeTypeConfig],
+                 provider: Optional[NodeProvider] = None,
+                 idle_timeout_s: float = 30.0,
+                 period_s: float = 1.0,
+                 allocation_timeout_s: float = 120.0,
+                 max_allocation_retries: int = 3,
+                 retry_backoff_s: float = 2.0,
+                 runtime=None,
+                 state_path: Optional[str] = None):
+        from ..core import runtime as rt_mod
+        self.rt = runtime or rt_mod.get_runtime_if_exists()
+        if self.rt is None:
+            raise RuntimeError("ray_tpu.init() first")
+        if provider is None:
+            from .node_provider import FakeNodeProvider
+            provider = FakeNodeProvider(self.rt)
+        self.provider = provider
+        self.node_types = {t.name: t for t in node_types}
+        self.idle_timeout_s = idle_timeout_s
+        self.period_s = period_s
+        self.allocation_timeout_s = allocation_timeout_s
+        self.max_allocation_retries = max_allocation_retries
+        self.retry_backoff_s = retry_backoff_s
+        if state_path is None and getattr(self.rt, "session_dir", None):
+            state_path = os.path.join(self.rt.session_dir,
+                                      "autoscaler_v2_instances.json")
+        self.im = InstanceManager(state_path)
+        self._idle_since: dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # demand collection is identical to v1's — reuse its methods
+    pending_demands = Autoscaler.pending_demands
+    pending_gangs = Autoscaler.pending_gangs
+    _free_capacity = Autoscaler._free_capacity
+
+    @property
+    def events(self) -> list[dict]:
+        return self.im.events
+
+    # -- one reconcile pass --------------------------------------------- #
+
+    def reconcile_once(self) -> None:
+        self._sync_provider()
+        self._plan_and_enqueue()
+        self._drive_lifecycle()
+
+    def _sync_provider(self) -> None:
+        """Converge table state with provider + head reality: advance
+        REQUESTED/ALLOCATED instances whose hosts showed up, and mark
+        provider-lost instances TERMINATED (drift — e.g. a preempted TPU
+        slice) so min_workers/demand relaunches them."""
+        alive = set(self.provider.non_terminated_nodes())
+        for inst in self.im.instances(REQUESTED, ALLOCATED, RAY_RUNNING,
+                                      TERMINATING):
+            if inst.provider_id is None:
+                continue
+            if inst.provider_id not in alive:
+                self.im.update(inst.instance_id, TERMINATED,
+                               reason="provider-lost"
+                               if inst.state != TERMINATING else "terminated")
+                self._idle_since.pop(inst.instance_id, None)
+                continue
+            if inst.state in (REQUESTED, ALLOCATED):
+                if self.provider.node_id_of(inst.provider_id) is not None:
+                    self.im.update(inst.instance_id, RAY_RUNNING,
+                                   reason="all hosts registered")
+                elif inst.state == REQUESTED and \
+                        self.provider.nodes_of(inst.provider_id):
+                    self.im.update(inst.instance_id, ALLOCATED,
+                                   reason="hosts allocating")
+                elif inst.state == REQUESTED and inst.requested_at and \
+                        time.monotonic() - inst.requested_at > \
+                        self.allocation_timeout_s:
+                    # hung allocation: reclaim whatever exists and retry
+                    # under the SAME bounded-backoff budget as a failed
+                    # create (a provider that never registers hosts must
+                    # not create/terminate-cycle forever)
+                    try:
+                        self.provider.terminate_node(inst.provider_id)
+                    except Exception:
+                        pass
+                    self.im.update(
+                        inst.instance_id, ALLOCATION_FAILED,
+                        reason="allocation timeout", provider_id=None,
+                        retries=inst.retries + 1,
+                        retry_after=time.monotonic() +
+                        self.retry_backoff_s * (2 ** inst.retries))
+
+    def _plan_and_enqueue(self) -> None:
+        demands = self.pending_demands()
+        gangs = self.pending_gangs()
+        booting = [i.node_type for i in self.im.instances(
+            QUEUED, REQUESTED, ALLOCATED)]
+        # ALLOCATION_FAILED instances about to retry also count as
+        # booting capacity (they hold a retry slot), preventing a
+        # launch-per-tick burst while one retries
+        booting += [i.node_type for i in
+                    self.im.instances(ALLOCATION_FAILED)]
+        to_launch = plan_scaling(
+            self.node_types, demands, gangs, self._free_capacity(),
+            booting, self.im.live_by_type())
+        for tname, n in to_launch.items():
+            for _ in range(n):
+                self.im.create(tname)
+        if not demands and not gangs:
+            for inst in self._find_idle():
+                self.im.update(inst.instance_id, TERMINATING,
+                               reason="idle timeout")
+
+    def _drive_lifecycle(self) -> None:
+        now = time.monotonic()
+        for inst in self.im.instances(ALLOCATION_FAILED):
+            if inst.retries >= self.max_allocation_retries:
+                self.im.update(inst.instance_id, TERMINATED,
+                               reason="allocation retries exhausted")
+            elif now >= inst.retry_after:
+                self.im.update(inst.instance_id, QUEUED,
+                               reason=f"retry {inst.retries}")
+        for inst in self.im.instances(QUEUED):
+            t = self.node_types[inst.node_type]
+            v = inst.version
+            try:
+                pid = self.provider.create_slice(
+                    t.name, dict(t.resources), t.hosts,
+                    dict(t.labels) if t.labels else None)
+            except Exception as e:
+                self.im.update(
+                    inst.instance_id, ALLOCATION_FAILED,
+                    expected_version=v, reason=f"create failed: {e}",
+                    retries=inst.retries + 1,
+                    retry_after=now + self.retry_backoff_s *
+                    (2 ** inst.retries))
+            else:
+                self.im.update(inst.instance_id, REQUESTED,
+                               expected_version=v, provider_id=pid,
+                               requested_at=time.monotonic())
+        for inst in self.im.instances(TERMINATING):
+            if inst.provider_id is not None:
+                try:
+                    self.provider.terminate_node(inst.provider_id)
+                except Exception:
+                    # leave it TERMINATING: retried next tick (moving on
+                    # would leak a live, billing provider node forever)
+                    continue
+            self.im.update(inst.instance_id, TERMINATED,
+                           reason="terminated")
+            self._idle_since.pop(inst.instance_id, None)
+
+    def _find_idle(self) -> list[Instance]:
+        busy_hex = busy_node_hexes(self.rt)
+        now = time.monotonic()
+        live = self.im.live_by_type()
+        out = []
+        for inst in self.im.instances(RAY_RUNNING):
+            if any(h in busy_hex
+                   for h in self.provider.nodes_of(inst.provider_id)):
+                self._idle_since.pop(inst.instance_id, None)
+                continue
+            first = self._idle_since.setdefault(inst.instance_id, now)
+            t = self.node_types[inst.node_type]
+            if now - first >= self.idle_timeout_s and \
+                    live.get(inst.node_type, 0) > t.min_workers:
+                out.append(inst)
+                live[inst.node_type] -= 1
+        return out
+
+    # -- loop ----------------------------------------------------------- #
+
+    def start(self) -> "AutoscalerV2":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="rtpu-autoscaler-v2")
+            self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self.period_s):
+            try:
+                self.reconcile_once()
+            except Exception:
+                import traceback
+                traceback.print_exc()
+
+    def stop(self, terminate_nodes: bool = True):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if terminate_nodes:
+            self.provider.shutdown()
