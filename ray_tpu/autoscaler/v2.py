@@ -63,7 +63,7 @@ _VALID = {
     QUEUED: {REQUESTED, ALLOCATION_FAILED, TERMINATED},
     REQUESTED: {ALLOCATED, RAY_RUNNING, ALLOCATION_FAILED, TERMINATING,
                 TERMINATED},
-    ALLOCATED: {RAY_RUNNING, TERMINATING, TERMINATED},
+    ALLOCATED: {RAY_RUNNING, ALLOCATION_FAILED, TERMINATING, TERMINATED},
     RAY_RUNNING: {TERMINATING, TERMINATED},
     TERMINATING: {TERMINATED},
     ALLOCATION_FAILED: {QUEUED, TERMINATED},
@@ -122,6 +122,12 @@ class InstanceManager:
                     inst.requested_at = now
                 self._instances[inst.instance_id] = inst
 
+    def _log_event(self, ev: dict) -> None:
+        """Append to the bounded global event mirror."""
+        self.events.append(ev)
+        if len(self.events) > 4096:
+            del self.events[:2048]
+
     # -- reads ---------------------------------------------------------- #
 
     def get(self, instance_id: str) -> Optional[Instance]:
@@ -151,7 +157,7 @@ class InstanceManager:
             ev = {"ts": time.time(), "from": None, "to": QUEUED,
                   "reason": "scale-up", "instance": inst.instance_id}
             inst.events.append(ev)
-            self.events.append(ev)
+            self._log_event(ev)
             self._instances[inst.instance_id] = inst
             self._persist_locked()
             return inst
@@ -169,14 +175,14 @@ class InstanceManager:
                 return False
             if expected_version is not None and \
                     inst.version != expected_version:
-                self.events.append({
+                self._log_event({
                     "ts": time.time(), "instance": instance_id,
                     "rejected": True, "to": new_state, "reason":
                     f"stale version {expected_version} != {inst.version}"})
                 return False
             if new_state != inst.state and \
                     new_state not in _VALID[inst.state]:
-                self.events.append({
+                self._log_event({
                     "ts": time.time(), "instance": instance_id,
                     "rejected": True, "to": new_state, "reason":
                     f"invalid transition {inst.state} -> {new_state}"})
@@ -184,7 +190,7 @@ class InstanceManager:
             ev = {"ts": time.time(), "from": inst.state, "to": new_state,
                   "reason": reason, "instance": instance_id}
             inst.events.append(ev)
-            self.events.append(ev)
+            self._log_event(ev)
             inst.state = new_state
             inst.version += 1
             for k, v in fields.items():
@@ -272,6 +278,10 @@ class AutoscalerV2:
         self._sync_provider()
         self._plan_and_enqueue()
         self._drive_lifecycle()
+        # bound table/journal growth under long-running churn
+        self._ticks = getattr(self, "_ticks", 0) + 1
+        if self._ticks % 60 == 0:
+            self.im.prune_terminated()
 
     def _sync_provider(self) -> None:
         """Converge table state with provider + head reality: advance
@@ -293,27 +303,30 @@ class AutoscalerV2:
                 if self.provider.node_id_of(inst.provider_id) is not None:
                     self.im.update(inst.instance_id, RAY_RUNNING,
                                    reason="all hosts registered")
-                elif inst.state == REQUESTED and \
-                        self.provider.nodes_of(inst.provider_id):
-                    self.im.update(inst.instance_id, ALLOCATED,
-                                   reason="hosts allocating")
-                elif inst.state == REQUESTED and inst.requested_at and \
+                elif inst.requested_at and \
                         time.monotonic() - inst.requested_at > \
                         self.allocation_timeout_s:
-                    # hung allocation: reclaim whatever exists and retry
-                    # under the SAME bounded-backoff budget as a failed
-                    # create (a provider that never registers hosts must
-                    # not create/terminate-cycle forever)
+                    # hung allocation — including a PARTIALLY registered
+                    # slice stuck in ALLOCATED (one host never joins):
+                    # reclaim and retry under the SAME bounded-backoff
+                    # budget as a failed create. If the reclaim itself
+                    # fails, stay put and retry it next tick — clearing
+                    # provider_id after a failed terminate would leak a
+                    # live, billing node with no row pointing at it.
                     try:
                         self.provider.terminate_node(inst.provider_id)
                     except Exception:
-                        pass
+                        continue
                     self.im.update(
                         inst.instance_id, ALLOCATION_FAILED,
                         reason="allocation timeout", provider_id=None,
                         retries=inst.retries + 1,
                         retry_after=time.monotonic() +
                         self.retry_backoff_s * (2 ** inst.retries))
+                elif inst.state == REQUESTED and \
+                        self.provider.nodes_of(inst.provider_id):
+                    self.im.update(inst.instance_id, ALLOCATED,
+                                   reason="hosts allocating")
 
     def _plan_and_enqueue(self) -> None:
         demands = self.pending_demands()

@@ -57,6 +57,20 @@ class PagedEngineConfig:
     # keeps TTFT low while prompts are still entering the batch).
     prefill_rows: int = 4
     decode_window: int = 8
+    # speculative decoding (prompt-lookup n-gram drafts, greedy only):
+    # propose up to spec_tokens continuation tokens by matching the last
+    # spec_ngram tokens against the sequence's own history, verify them
+    # all in ONE dispatch (models/llama.py verify_paged_rows) and accept
+    # the longest agreeing prefix — up to spec_tokens+1 tokens per
+    # dispatch on self-similar text, never a wrong token (the accept rule
+    # reproduces exact greedy). It competes with the decode window: an
+    # acceptance EMA falls back to windowed decode when drafts stop
+    # landing (with periodic re-probes), so enabling it is never worse
+    # than the window by more than the probe overhead. Worth it when
+    # spec_tokens > decode_window, or on real hardware where one wide
+    # verify is one model-step of compute vs w serial steps. 0 disables.
+    spec_tokens: int = 0
+    spec_ngram: int = 2
     tokenizer: Any = None
 
     def __post_init__(self):
@@ -107,6 +121,17 @@ class PagedInferenceEngine(_EngineBase):
         # pages in place.
         self._decode_win_fns: dict[tuple, Any] = {}
         self._prefill_rows_fns: dict[tuple, Any] = {}
+        self._verify_fns: dict[tuple, Any] = {}
+        # observability: dispatches per program family, spec accept stats
+        self.stats = {"prefill_dispatches": 0, "decode_dispatches": 0,
+                      "spec_dispatches": 0, "spec_proposed": 0,
+                      "spec_accepted": 0, "tokens_out": 0}
+        # speculation controller: EMA of tokens-per-slot-per-spec-dispatch
+        # (starts optimistic), plus a cooldown of windowed dispatches
+        # before re-probing once the EMA drops below the window
+        self._spec_gain = float(cfg.spec_tokens + 1)
+        self._spec_cooldown = 0
+        self._spec_cooldown_len = 8    # doubles per failed probe, to 256
 
     @staticmethod
     def _sampling_mode(reqs) -> tuple:
@@ -164,6 +189,22 @@ class PagedInferenceEngine(_EngineBase):
 
             fn = jax.jit(run, donate_argnums=(1,))
             self._prefill_rows_fns[(r, mode)] = fn
+        return fn
+
+    def _verify_fn(self, r: int, s1: int):
+        """One dispatch = verify r rows of s1 = 1+drafts tokens; returns
+        the model's greedy next token AT each fed position [r, s1]."""
+        fn = self._verify_fns.get((r, s1))
+        if fn is None:
+            mc, page = self.cfg.model, self.cfg.page_size
+
+            def run(p, c, toks, bts, starts):
+                logits, c = llama.verify_paged_rows(
+                    p, toks, c, bts, starts, mc, page_size=page)
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), c
+
+            fn = jax.jit(run, donate_argnums=(1,))
+            self._verify_fns[(r, s1)] = fn
         return fn
 
     # -- public API --------------------------------------------------------
@@ -261,6 +302,7 @@ class PagedInferenceEngine(_EngineBase):
             self.params, self.caches, chunks, bts, sps, tls,
             self._rng_base, np.int32(self._rng_ctr), temps, topks)
         self._rng_ctr += 1
+        self.stats["prefill_dispatches"] += 1
         toks = np.asarray(toks)
         for i, (req, pos, n) in enumerate(rows):
             req.prefill_pos = pos + n
@@ -287,14 +329,128 @@ class PagedInferenceEngine(_EngineBase):
         # sequence's own pages beyond its true length; decode masks
         # positions >= length so they are never attended.
 
+    @staticmethod
+    def _propose_draft(ctx: np.ndarray, n: int, s: int) -> list[int]:
+        """Prompt-lookup draft: find the most recent earlier occurrence of
+        the context's final n-gram and propose the s tokens that followed
+        it (reference role: vLLM's prompt-lookup speculative proposer)."""
+        m = len(ctx) - n                   # candidate match positions 0..m-1
+        if m <= 0 or s <= 0:
+            return []
+        tail = ctx[-n:]
+        hits = np.flatnonzero(np.all(
+            np.stack([ctx[i:m + i] for i in range(n)]) == tail[:, None],
+            axis=0))
+        if len(hits) == 0:
+            return []
+        # most recent occurrence that still has a FULL s-token
+        # continuation (on constant/periodic runs the newest hit sits at
+        # the end of the run with almost nothing after it); fall back to
+        # the earliest hit, whose continuation is the longest available
+        viable = hits[hits + n + s <= len(ctx)]
+        start = int(viable[-1] if len(viable) else hits[0]) + n
+        return [int(t) for t in ctx[start:start + s]]
+
+    def _spec_step(self) -> bool:
+        """One speculative verify dispatch over every active slot. Only
+        runs when every slot is greedy (the accept rule reproduces exact
+        greedy; sampled rows fall back to the windowed path) and at least
+        one slot has a draft. Returns False to fall through."""
+        cfg = self.cfg
+        s, page = cfg.spec_tokens, cfg.page_size
+        slots = sorted(self._active)
+        drafts = {}
+        for slot in slots:
+            req = self._active[slot]
+            ctx = np.asarray(req.prompt_ids + req.out_ids, np.int32)
+            drafts[slot] = self._propose_draft(ctx, cfg.spec_ngram, s)
+        # every slot must carry a draft: in a spec dispatch a draft-less
+        # slot emits exactly ONE token, strictly worse than its share of
+        # a decode window
+        if not all(drafts.values()):
+            return False
+        # bucket the row count to a power of two so the jit cache holds
+        # O(log max_batch) verify programs, not one per active-set size;
+        # pad rows write only to sink page 0 and are discarded
+        r, s1 = len(slots), s + 1
+        rb = min(1 << max(r - 1, 0).bit_length(), cfg.max_batch_size)
+        toks = np.zeros((rb, s1), np.int32)
+        bts = np.zeros((rb, cfg.max_pages_per_seq), np.int32)
+        starts = np.zeros((rb,), np.int32)
+        allow: dict[int, int] = {}
+        for i, slot in enumerate(slots):
+            req = self._active[slot]
+            total = len(req.prompt_ids) + len(req.out_ids)
+            remaining = max(req.params.max_tokens - len(req.out_ids), 1)
+            target = min(total + min(s1, remaining), cfg.max_seq_len)
+            if self._ensure_pages(req, target):
+                allow[slot] = target - total
+            else:
+                allow[slot] = max(len(req.pages) * page - total, 0)
+            toks[i, 0] = req.out_ids[-1]
+            toks[i, 1:1 + len(drafts[slot])] = drafts[slot]
+            bts[i] = self._block_tables[slot]
+            starts[i] = self._lengths[slot]
+        y, self.caches = self._verify_fn(rb, s1)(
+            self.params, self.caches, toks, bts, starts)
+        y = np.asarray(y)                                   # [r, s1]
+        self.stats["spec_dispatches"] += 1
+        emitted = 0
+        for i, slot in enumerate(slots):
+            req = self._active[slot]
+            d = drafts[slot]
+            self.stats["spec_proposed"] += len(d)
+            # accept: token j's prediction y[i, j] is the true next token
+            # only while every earlier draft matched the model's choice
+            out = [int(y[i, 0])]
+            for j in range(len(d)):
+                if d[j] != out[-1]:
+                    break
+                out.append(int(y[i, j + 1]))
+                self.stats["spec_accepted"] += 1
+            consumed = 0
+            for tok in out:
+                if consumed >= allow[slot]:
+                    self._retire(req)
+                    break
+                req.out_ids.append(tok)
+                self._lengths[slot] += 1
+                consumed += 1
+                self.stats["tokens_out"] += 1
+                if self._stop_after(req, tok):
+                    self._retire(req)
+                    break
+            emitted += consumed
+        # controller: keep speculating only while it beats the window;
+        # on fallback, re-probe optimistically after a cooldown that
+        # doubles per consecutive failed probe (text that never accepts
+        # pays a vanishing probe tax, text that turns repetitive is
+        # rediscovered within ~cooldown windows)
+        self._spec_gain = 0.5 * self._spec_gain + 0.5 * (emitted / r)
+        if self._spec_gain <= self.cfg.decode_window and \
+                self.cfg.decode_window > 1:
+            self._spec_cooldown = self._spec_cooldown_len
+            self._spec_cooldown_len = min(self._spec_cooldown_len * 2, 256)
+            self._spec_gain = float(s + 1)
+        else:
+            self._spec_cooldown_len = 8
+        return True
+
     def _decode_step(self):
         if not self._active:
             return
         cfg = self.cfg
         bs, page = cfg.max_batch_size, cfg.page_size
+        quiet = not (self._prefilling or self._pending)
+        if cfg.spec_tokens > 0 and quiet and \
+                self._sampling_mode(self._active.values()) == (False, False):
+            if self._spec_cooldown > 0:
+                self._spec_cooldown -= 1
+            elif self._spec_step():
+                return
         # full window only when no prompt is waiting: a pending prefill
         # gets interleaved every step, keeping TTFT low under bursts
-        w = 1 if self._prefilling or self._pending else cfg.decode_window
+        w = 1 if not quiet else cfg.decode_window
         tokens = np.zeros((bs,), np.int32)
         lengths = np.zeros((bs,), np.int32)
         temps = np.zeros((bs,), np.float32)
@@ -329,6 +485,7 @@ class PagedInferenceEngine(_EngineBase):
             self.params, self.caches, tokens, bt, lengths,
             self._rng_base, np.int32(self._rng_ctr), temps, topks)
         self._rng_ctr += 1
+        self.stats["decode_dispatches"] += 1
         out = np.asarray(out)               # [bs, w]
         for slot in list(self._active):
             req = self._active[slot]
@@ -342,6 +499,7 @@ class PagedInferenceEngine(_EngineBase):
                 tok = int(out[slot, j])
                 req.out_ids.append(tok)
                 self._lengths[slot] += 1
+                self.stats["tokens_out"] += 1
                 if self._stop_after(req, tok):
                     self._retire(req)
                     break
@@ -461,4 +619,5 @@ class PagedInferenceEngine(_EngineBase):
             "active": len(self._active),
             "prefilling": len(self._prefilling),
             "pending": len(self._pending),
+            **self.stats,
         }

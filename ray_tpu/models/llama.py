@@ -742,6 +742,82 @@ def prefill_paged_rows(params: dict, chunks: jax.Array, caches: list[dict],
     return last, caches
 
 
+def verify_paged_rows(params: dict, tokens: jax.Array, caches: list[dict],
+                      bt_rows: jax.Array, starts: jax.Array,
+                      cfg: LlamaConfig, *, page_size: int):
+    """Speculative-verification forward (the scorer role of vLLM-style
+    speculative decoding in the reference's serving engine): for each of
+    R rows feed S1 = 1 + n_draft tokens at positions
+    starts[r] .. starts[r]+S1-1 over that row's paged KV, writing their
+    K/V in place, and return logits [R, S1, V] for every fed position —
+    the engine accepts the longest draft prefix the model agrees with,
+    so one dispatch can emit up to S1 tokens.
+
+    Position p's K/V lands in page bt_rows[r, p // page_size] at slot
+    p % page_size; positions past the block table route to sink page 0
+    (their logits are garbage and the engine discards them). Rejected
+    drafts leave stale K/V beyond the accepted length — never attended,
+    because attention is causal and the engine re-feeds real tokens at
+    those same positions next dispatch, overwriting in place.
+
+    Rows run under one lax.scan carrying the caches (same shape
+    discipline as prefill_paged_rows; R and S1 are static).
+    """
+    maxp = bt_rows.shape[1]
+    prefix_len = maxp * page_size
+    s1 = tokens.shape[1]
+    groups = cfg.n_heads // cfg.n_kv_heads
+    scale = cfg.head_dim ** -0.5
+
+    def body(carry, row):
+        toks, bt, start = row
+        positions = start + jnp.arange(s1)                 # [S1]
+        cos, sin = rope_freqs(cfg, positions[None])
+        pidx = positions // page_size
+        page_ids = jnp.where(pidx < maxp,
+                             bt[jnp.clip(pidx, 0, maxp - 1)], 0)
+        offsets = positions % page_size
+        x = params["embed"][toks][None].astype(cfg.dtype)  # [1, S1, D]
+        new_caches = []
+        for layer in range(cfg.n_layers):
+            p = _layer_params(params, layer)
+            cache = carry[layer]
+            h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
+            q, k, v = _qkv(h, p, cfg, cos, sin)            # [1,S1,H/KVH,D]
+            k_pages = cache["k"].at[page_ids, offsets].set(
+                k[0].astype(cache["k"].dtype))
+            v_pages = cache["v"].at[page_ids, offsets].set(
+                v[0].astype(cache["v"].dtype))
+            # the gather happens AFTER the scatter, so the window's own
+            # K/V is already in place: no separate in-window concat path
+            kk = k_pages[bt].reshape(1, prefix_len, cfg.n_kv_heads,
+                                     cfg.head_dim)
+            vv = v_pages[bt].reshape(1, prefix_len, cfg.n_kv_heads,
+                                     cfg.head_dim)
+            if groups > 1:
+                kk = jnp.repeat(kk, groups, axis=2)
+                vv = jnp.repeat(vv, groups, axis=2)
+            s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                           kk.astype(jnp.float32)) * scale
+            k_pos = jnp.arange(prefix_len)
+            mask = k_pos[None, :] <= positions[:, None]    # causal+self
+            s = jnp.where(mask[None, None], s, -1e30)
+            w = jax.nn.softmax(s, axis=-1)
+            attn = jnp.einsum("bhqk,bkhd->bqhd", w,
+                              vv.astype(jnp.float32)).astype(cfg.dtype)
+            x = x + attn.reshape(1, s1, -1) @ p["wo"]
+            x, _ = _mlp_block(x, p, cfg)
+            new_caches.append({"k": k_pages, "v": v_pages})
+        x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+        logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"],
+                            preferred_element_type=jnp.float32)[0]
+        return new_caches, logits
+
+    caches, logits = jax.lax.scan(
+        body, caches, (tokens, bt_rows, starts))
+    return logits, caches                                  # [R, S1, V]
+
+
 def cross_entropy_loss(logits: jax.Array, targets: jax.Array,
                        mask: Optional[jax.Array] = None) -> jax.Array:
     """Mean next-token NLL. logits [B,S,V] f32, targets [B,S] int32."""

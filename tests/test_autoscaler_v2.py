@@ -52,6 +52,11 @@ class MockProvider(NodeProvider):
     def register(self, pid, hexid=None):
         self.alive[pid] = hexid or f"hex-{pid}"
 
+    def register_partial(self, pid):
+        """Half-joined slice: hosts exist but not all registered."""
+        self.partial = getattr(self, "partial", set())
+        self.partial.add(pid)
+
     def terminate_node(self, pid):
         self.alive.pop(pid, None)
         self.terminated.append(pid)
@@ -63,6 +68,8 @@ class MockProvider(NodeProvider):
         return self.alive.get(pid)
 
     def nodes_of(self, pid):
+        if pid in getattr(self, "partial", ()):
+            return [f"hex-{pid}-h0"]
         nid = self.alive.get(pid)
         return [nid] if nid else []
 
@@ -190,6 +197,27 @@ def test_allocation_timeout_is_bounded(head):
     # every timed-out node was reclaimed (only a still-in-flight request
     # may remain alive — persisting demand keeps planning new instances)
     assert set(prov.terminated) == set(prov.created) - set(prov.alive)
+    del refs
+
+
+def test_partially_registered_slice_times_out(head):
+    """A slice stuck in ALLOCATED (one host never joins) must hit the
+    allocation timeout and retry, not hold booting capacity forever."""
+    prov = MockProvider()
+    asc = _v2(head, prov, allocation_timeout_s=0.05)
+    refs = _demand(head)
+    asc.reconcile_once()
+    inst = asc.im.instances()[0]
+    pid0 = inst.provider_id
+    prov.register_partial(pid0)
+    asc.reconcile_once()
+    assert asc.im.get(inst.instance_id).state == ALLOCATED
+    time.sleep(0.06)
+    asc.reconcile_once()
+    got = asc.im.get(inst.instance_id)
+    assert got.state in (ALLOCATION_FAILED, QUEUED, REQUESTED), got.state
+    assert got.retries == 1
+    assert pid0 in prov.terminated     # the hung slice was reclaimed
     del refs
 
 

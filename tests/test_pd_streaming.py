@@ -67,7 +67,9 @@ def test_pd_streams_through_http_proxy(ray):
                               engine_cfg=_cfg())
     serve.run(app, name="pd", http_port=18321)
 
-    body = {"model": "pd-tiny", "prompt": _prompt(20), "max_tokens": 24,
+    # enough tokens to span several decode windows (the engine emits in
+    # decode_window bursts, so a short completion can land in one poll)
+    body = {"model": "pd-tiny", "prompt": _prompt(20), "max_tokens": 96,
             "stream": True}
     req = urllib.request.Request(
         "http://127.0.0.1:18321/pd/v1/completions",
