@@ -5,11 +5,13 @@ instance_manager/instance_manager.py:29, scheduler.py:632
 ResourceDemandScheduler) and the fake multi-node provider
 (autoscaler/_private/fake_multi_node/node_provider.py:236).
 """
-from .autoscaler import Autoscaler, NodeTypeConfig
+from .autoscaler import Autoscaler, NodeTypeConfig, active_autoscalers
+from .config import autoscaler_from_config
 from .gce_tpu import GceTpuVmProvider
 from .node_provider import FakeNodeProvider, NodeProvider
 from .v2 import AutoscalerV2, Instance, InstanceManager
 
 __all__ = ["Autoscaler", "AutoscalerV2", "NodeTypeConfig", "NodeProvider",
            "FakeNodeProvider", "GceTpuVmProvider", "Instance",
-           "InstanceManager"]
+           "InstanceManager", "active_autoscalers",
+           "autoscaler_from_config"]

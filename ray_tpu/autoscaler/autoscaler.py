@@ -22,6 +22,15 @@ from typing import Optional
 
 from .node_provider import NodeProvider
 
+# scalers started in this process, for the state API / dashboard
+# (reference: the autoscaler reports through GcsAutoscalerStateManager;
+# here the head process IS the control plane so a registry suffices)
+_ACTIVE: list = []
+
+
+def active_autoscalers() -> list:
+    return list(_ACTIVE)
+
 
 @dataclasses.dataclass
 class NodeTypeConfig:
@@ -301,7 +310,19 @@ class Autoscaler:
             self._thread = threading.Thread(target=self._loop, daemon=True,
                                             name="rtpu-autoscaler")
             self._thread.start()
+            _ACTIVE.append(self)
         return self
+
+    def report(self) -> dict:
+        """Instance table + recent events for the state API/dashboard."""
+        rows = []
+        for iid, tname in list(self.instances.items()):
+            nid = self.provider.node_id_of(iid)
+            rows.append({"instance": iid, "type": tname,
+                         "state": "RUNNING" if nid else "BOOTING",
+                         "node_id": nid})
+        return {"version": 1, "instances": rows,
+                "events": list(self.events[-100:])}
 
     def _loop(self):
         while not self._stop.wait(self.period_s):
@@ -315,5 +336,7 @@ class Autoscaler:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        if self in _ACTIVE:
+            _ACTIVE.remove(self)
         if terminate_nodes:
             self.provider.shutdown()

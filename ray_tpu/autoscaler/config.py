@@ -1,0 +1,83 @@
+"""Cluster scaling config -> a ready Autoscaler (the `ray up
+cluster.yaml` role, reference: autoscaler/_private/commands.py +
+the cluster YAML's available_node_types section, reduced to JSON and
+TPU-first provider choices).
+
+Schema (JSON, see tests/test_autoscaler_v2.py for an example):
+
+    {
+      "v2": true,                    # instance-manager reconciler (default)
+      "idle_timeout_s": 60,
+      "provider": {"type": "fake"},  # or {"type": "gce_tpu", ...ctor kw}
+      "node_types": [
+        {"name": "cpu4", "resources": {"CPU": 4},
+         "min_workers": 0, "max_workers": 4},
+        {"name": "v5e-16", "resources": {"CPU": 8, "TPU": 4},
+         "hosts": 4, "max_workers": 2,
+         "labels": {"pool": "train"}}
+      ]
+    }
+
+The gce_tpu provider's head_address/authkey_hex are filled from the
+running head when omitted, so one config file works for `ray_tpu.cli
+start --head --autoscale-config cfg.json`.
+"""
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from .autoscaler import Autoscaler, NodeTypeConfig
+
+
+def autoscaler_from_config(config, runtime=None):
+    """Build (NOT start) an Autoscaler/AutoscalerV2 from a config dict or
+    a path to a JSON file."""
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    if not isinstance(config, dict) or "node_types" not in config:
+        raise ValueError("autoscale config needs a node_types list")
+    types = [NodeTypeConfig(
+        name=t["name"], resources=dict(t["resources"]),
+        min_workers=int(t.get("min_workers", 0)),
+        max_workers=int(t.get("max_workers", 4)),
+        hosts=int(t.get("hosts", 1)),
+        labels=t.get("labels")) for t in config["node_types"]]
+    provider = _provider_from_config(config.get("provider"), runtime)
+    kwargs = {k: config[k] for k in
+              ("idle_timeout_s", "period_s") if k in config}
+    if config.get("v2", True):
+        from .v2 import AutoscalerV2
+        for k in ("allocation_timeout_s", "max_allocation_retries",
+                  "retry_backoff_s"):
+            if k in config:
+                kwargs[k] = config[k]
+        return AutoscalerV2(types, provider=provider, runtime=runtime,
+                            **kwargs)
+    return Autoscaler(types, provider=provider, runtime=runtime, **kwargs)
+
+
+def _provider_from_config(pcfg: Optional[dict], runtime):
+    if pcfg is None:
+        pcfg = {"type": "fake"}
+    pcfg = dict(pcfg)
+    kind = pcfg.pop("type", "fake")
+    if kind == "fake":
+        from .node_provider import FakeNodeProvider
+        return FakeNodeProvider(runtime)
+    if kind == "gce_tpu":
+        from ..core import runtime as rt_mod
+
+        from .gce_tpu import GceTpuVmProvider
+        rt = runtime or rt_mod.get_runtime_if_exists()
+        if rt is not None:
+            if "head_address" not in pcfg:
+                # the address TPU-VM agents dial back to: this host's
+                # primary IP (override in the config when behind NAT)
+                import socket
+                ip = socket.gethostbyname(socket.gethostname())
+                pcfg["head_address"] = f"{ip}:{rt.tcp_port}"
+            pcfg.setdefault("authkey_hex", rt._authkey.hex())
+        return GceTpuVmProvider(**pcfg)
+    raise ValueError(f"unknown provider type {kind!r}")

@@ -116,9 +116,11 @@ class InstanceManager:
             now = time.monotonic()
             for d in data["instances"]:
                 inst = Instance.from_json(d)
-                if inst.state == REQUESTED:
+                if inst.state in (REQUESTED, ALLOCATED):
                     # monotonic stamps were zeroed on persist; re-time the
                     # allocation-timeout clock from this process's clock
+                    # (ALLOCATED included: a partially registered slice
+                    # must still time out after a head restart)
                     inst.requested_at = now
                 self._instances[inst.instance_id] = inst
 
@@ -409,11 +411,22 @@ class AutoscalerV2:
     # -- loop ----------------------------------------------------------- #
 
     def start(self) -> "AutoscalerV2":
+        from .autoscaler import _ACTIVE
         if self._thread is None:
             self._thread = threading.Thread(
                 target=self._loop, daemon=True, name="rtpu-autoscaler-v2")
             self._thread.start()
+            _ACTIVE.append(self)
         return self
+
+    def report(self) -> dict:
+        """Instance table + recent events for the state API/dashboard."""
+        rows = [{"instance": i.instance_id, "type": i.node_type,
+                 "state": i.state, "provider_id": i.provider_id,
+                 "retries": i.retries, "version": i.version}
+                for i in self.im.instances()]
+        return {"version": 2, "instances": rows,
+                "events": list(self.im.events[-100:])}
 
     def _loop(self):
         while not self._stop.wait(self.period_s):
@@ -424,8 +437,11 @@ class AutoscalerV2:
                 traceback.print_exc()
 
     def stop(self, terminate_nodes: bool = True):
+        from .autoscaler import _ACTIVE
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        if self in _ACTIVE:
+            _ACTIVE.remove(self)
         if terminate_nodes:
             self.provider.shutdown()

@@ -56,6 +56,9 @@ def cmd_start(args) -> int:
             cmd += ["--num-tpus", str(args.num_tpus)]
         if args.enable_remote_nodes:
             cmd += ["--enable-remote-nodes"]
+        if args.autoscale_config:
+            cmd += ["--autoscale-config",
+                    os.path.abspath(args.autoscale_config)]
         pointer = _cluster_pointer(args.name)
         if os.path.exists(pointer):
             with open(pointer) as f:
@@ -109,6 +112,10 @@ def _run_head(args) -> int:
                  **({"enable_remote_nodes": True}
                     if args.enable_remote_nodes else {}))
     rt = rt_mod.get_runtime_if_exists()
+    asc = None
+    if args.autoscale_config:
+        from .autoscaler.config import autoscaler_from_config
+        asc = autoscaler_from_config(args.autoscale_config).start()
     pointer = _cluster_pointer(args.name)
     os.makedirs(os.path.dirname(pointer), exist_ok=True)
     with open(pointer, "w") as f:
@@ -129,6 +136,8 @@ def _run_head(args) -> int:
             os.unlink(pointer)
         except OSError:
             pass
+        if asc is not None:
+            asc.stop()
         ray_tpu.shutdown()
     return 0
 
@@ -333,6 +342,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--block", action="store_true",
                     help="run the head in the foreground")
     sp.add_argument("--enable-remote-nodes", action="store_true")
+    sp.add_argument("--autoscale-config", default=None,
+                    help="JSON scaling config (autoscaler/config.py schema)"
+                    )
     sp.set_defaults(fn=cmd_start)
 
     sp = sub.add_parser("stop", help="stop a named head")

@@ -970,6 +970,7 @@ class Runtime:
                     "available_resources", "node_table", "pg_wait",
                     "create_placement_group_rpc", "remove_placement_group_rpc",
                     "timeline", "state_list", "state_summary",
+                    "autoscaler_status",
                     "user_metrics_dump", "pubsub_poll",
                     "kv_put", "kv_get", "kv_del", "kv_keys", "locate",
                     "job_submit", "job_list", "job_status", "job_logs",
@@ -1073,6 +1074,10 @@ class Runtime:
     def state_summary(self):
         from .. import state as state_api
         return state_api.summary()
+
+    def autoscaler_status(self):
+        from .. import state as state_api
+        return state_api.autoscaler_status()
 
     def pubsub_poll(self, channel, cursor=0, timeout_s=20.0):
         # runs on the rpc pool (long-poll parks a pool thread, like pg_wait)

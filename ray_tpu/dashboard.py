@@ -52,6 +52,7 @@ _PAGE = """<!DOCTYPE html>
 <h2>Actors</h2><table id="actors"></table>
 <h2>Jobs</h2><table id="jobs"></table>
 <h2>Serve</h2><table id="serve"></table>
+<h2>Autoscaler</h2><table id="autoscaler"></table>
 <h2>Recent tasks</h2><table id="tasks"></table>
 <h2>Detail</h2><pre id="detail"
  style="background:#fff;border:1px solid #ddd;padding:8px;min-height:2em;
@@ -132,6 +133,13 @@ async function refresh() {
                    `${d.num_replicas_running ?? d.replicas ?? ''}`]);
     fill('serve', ['app', 'deployment', 'status', 'replicas'], rows);
   } catch (e) { fill('serve', ['(serve not running)'], []); }
+  try {
+    const ac = await (await fetch('api/autoscaler')).json();
+    fill('autoscaler', ['instance', 'type', 'state', 'provider_id', 'retries'],
+         (ac.instances || []).map(r => [r.instance, r.type, {pill: r.state},
+                                        r.provider_id || r.node_id || '',
+                                        r.retries ?? '']));
+  } catch (e) { fill('autoscaler', ['(no autoscaler)'], []); }
   const tasks = await (await fetch('api/tasks?limit=25')).json();
   fill('tasks', ['task_id', 'name', 'state', 'worker', 'duration'],
        tasks.map(t => [{text: (t.task_id || '').slice(0, 12),
@@ -174,6 +182,8 @@ def start_dashboard(port: int = 0, host: str = "127.0.0.1") -> int:
                 out = cfg.dump()
             elif kind == "jobs":
                 out = state_api.list_jobs()
+            elif kind == "autoscaler":
+                out = state_api.autoscaler_status()
             elif kind == "serve":
                 from . import serve as serve_api
                 # remote round-trip: keep it off the dashboard event loop

@@ -312,6 +312,7 @@ class PagedInferenceEngine(_EngineBase):
             # generated token
             tok = int(toks[i])
             req.out_ids.append(tok)
+            self.stats["tokens_out"] += 1
             req.first_token_t = time.perf_counter()
             self._lengths[req.slot] = len(req.prompt_ids)
             self._prefilling.remove(req)
@@ -366,8 +367,12 @@ class PagedInferenceEngine(_EngineBase):
             drafts[slot] = self._propose_draft(ctx, cfg.spec_ngram, s)
         # every slot must carry a draft: in a spec dispatch a draft-less
         # slot emits exactly ONE token, strictly worse than its share of
-        # a decode window
+        # a decode window. A no-draft round costs the same backed-off
+        # cooldown as a failed probe, so non-repetitive text doesn't pay
+        # the O(context) n-gram scan on every step.
         if not all(drafts.values()):
+            self._spec_cooldown = self._spec_cooldown_len
+            self._spec_cooldown_len = min(self._spec_cooldown_len * 2, 256)
             return False
         # bucket the row count to a power of two so the jit cache holds
         # O(log max_batch) verify programs, not one per active-set size;
@@ -380,13 +385,7 @@ class PagedInferenceEngine(_EngineBase):
         allow: dict[int, int] = {}
         for i, slot in enumerate(slots):
             req = self._active[slot]
-            total = len(req.prompt_ids) + len(req.out_ids)
-            remaining = max(req.params.max_tokens - len(req.out_ids), 1)
-            target = min(total + min(s1, remaining), cfg.max_seq_len)
-            if self._ensure_pages(req, target):
-                allow[slot] = target - total
-            else:
-                allow[slot] = max(len(req.pages) * page - total, 0)
+            allow[slot] = self._reserve(req, s1)
             toks[i, 0] = req.out_ids[-1]
             toks[i, 1:1 + len(drafts[slot])] = drafts[slot]
             bts[i] = self._block_tables[slot]
@@ -461,20 +460,7 @@ class PagedInferenceEngine(_EngineBase):
         bt = np.zeros_like(self._block_tables)
         allow: dict[int, int] = {}          # valid tokens per slot this window
         for slot, req in self._active.items():
-            total = len(req.prompt_ids) + len(req.out_ids)
-            # pre-allocate pages only for tokens this request can still
-            # emit (window, max_tokens remainder, sequence ceiling —
-            # whichever is least; over-grabbing the full window would
-            # starve later slots under pool pressure). Window writes past
-            # the allocation land on sink page 0 and those tokens are
-            # discarded. If the pool runs dry the request keeps only the
-            # tokens its allocated pages cover and finishes early.
-            remaining = max(req.params.max_tokens - len(req.out_ids), 1)
-            target = min(total + min(w, remaining), cfg.max_seq_len)
-            if self._ensure_pages(req, target):
-                allow[slot] = target - total
-            else:
-                allow[slot] = max(len(req.pages) * page - total, 0)
+            allow[slot] = self._reserve(req, w)
             tokens[slot] = req.out_ids[-1]
             lengths[slot] = self._lengths[slot]
             temps[slot] = req.params.temperature
@@ -503,6 +489,25 @@ class PagedInferenceEngine(_EngineBase):
                 if self._stop_after(req, tok):
                     self._retire(req)
                     break
+
+    def _reserve(self, req: _Request, width: int) -> int:
+        """Pre-allocate pages for up to `width` new tokens and return how
+        many of the dispatch's tokens are VALID for this request.
+
+        Pages are grabbed only for tokens the request can still emit
+        (width, max_tokens remainder, sequence ceiling — whichever is
+        least; over-grabbing would starve later slots under pool
+        pressure). Device writes past the allocation land on sink page 0
+        and those tokens are discarded; if the pool runs dry the request
+        keeps only the tokens its allocated pages cover and finishes
+        early. Shared by the windowed-decode and speculative paths so
+        their page budgeting can never diverge."""
+        total = len(req.prompt_ids) + len(req.out_ids)
+        remaining = max(req.params.max_tokens - len(req.out_ids), 1)
+        target = min(total + min(width, remaining), self.cfg.max_seq_len)
+        if self._ensure_pages(req, target):
+            return target - total
+        return max(len(req.pages) * self.cfg.page_size - total, 0)
 
     def _stop_after(self, req: _Request, tok: int) -> bool:
         """Stop condition evaluated after appending tok to req.out_ids."""
@@ -593,6 +598,7 @@ class PagedInferenceEngine(_EngineBase):
                     layer["v"], idx, jnp.asarray(payload["pages"][li]["v"]))
             tok = int(payload["first_token"])
             req.out_ids.append(tok)
+            self.stats["tokens_out"] += 1
             req.prefill_pos = len(ids)
             req.first_token_t = time.perf_counter()
             self._lengths[req.slot] = len(ids)

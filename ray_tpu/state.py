@@ -137,6 +137,21 @@ def list_jobs() -> list[dict]:
     return _head().jobs.list()
 
 
+def autoscaler_status() -> dict:
+    """Instance tables + recent scale events of every autoscaler running
+    in the head process (reference: `ray status` over the GCS autoscaler
+    state; here scalers self-register and remote drivers reach them over
+    the state RPC)."""
+    remote = _remote()
+    if remote is not None:
+        return remote._rpc("autoscaler_status")
+    from .autoscaler.autoscaler import active_autoscalers
+    reports = [a.report() for a in active_autoscalers()]
+    return {"autoscalers": reports,
+            "instances": [r for rep in reports for r in rep["instances"]],
+            "events": [e for rep in reports for e in rep["events"]][-100:]}
+
+
 def summary() -> dict:
     """Cluster summary (reference: `ray summary tasks` + cluster status)."""
     remote = _remote()

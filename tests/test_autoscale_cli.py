@@ -1,0 +1,79 @@
+"""CLI-driven autoscaling: `start --head --autoscale-config` boots a head
+whose v2 reconciler satisfies overflow demand with fake-provider agents,
+observable from a remote driver via the state API (reference: `ray up`
+cluster-config flow + `ray status` autoscaler reporting)."""
+import json
+import os
+import subprocess
+import sys
+import time
+import uuid
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def fresh_driver_state():
+    import ray_tpu
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    yield
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+
+
+def _cli(*args, timeout=90):
+    e = dict(os.environ)
+    e["RTPU_WORKER_PRESTART"] = "0"
+    e.pop("RTPU_ADDRESS", None)
+    return subprocess.run([sys.executable, "-m", "ray_tpu.cli", *args],
+                         capture_output=True, text=True, timeout=timeout,
+                         cwd=REPO, env=e)
+
+
+def test_config_factory_validates(tmp_path):
+    from ray_tpu.autoscaler.config import autoscaler_from_config
+    with pytest.raises(ValueError):
+        autoscaler_from_config({"no": "node_types"})
+    p = tmp_path / "bad_provider.json"
+    p.write_text(json.dumps({
+        "node_types": [{"name": "a", "resources": {"CPU": 1}}],
+        "provider": {"type": "martian"}}))
+    with pytest.raises(ValueError):
+        autoscaler_from_config(str(p))
+
+
+def test_cli_head_autoscales_and_reports(tmp_path, fresh_driver_state):
+    import ray_tpu
+    from ray_tpu import state
+
+    cfg = {"v2": True, "idle_timeout_s": 300, "period_s": 0.25,
+           "provider": {"type": "fake"},
+           "node_types": [{"name": "cpu4", "resources": {"CPU": 4},
+                           "max_workers": 1}]}
+    cfg_path = tmp_path / "scale.json"
+    cfg_path.write_text(json.dumps(cfg))
+    name = f"asc-{uuid.uuid4().hex[:8]}"
+    r = _cli("start", "--head", "--name", name, "--num-cpus", "1",
+             "--autoscale-config", str(cfg_path))
+    assert r.returncode == 0, r.stderr + r.stdout
+    try:
+        with open(f"/tmp/ray_tpu/named_{name}.json") as f:
+            info = json.load(f)
+        ray_tpu.init(address=info["cluster_file"])
+
+        @ray_tpu.remote(num_cpus=4)
+        def big():
+            return "scaled"
+
+        # the head has 1 CPU: this can only run on an autoscaled node
+        assert ray_tpu.get(big.remote(), timeout=180) == "scaled"
+
+        st = state.autoscaler_status()
+        assert st["instances"], st
+        assert any(e.get("to") == "RAY_RUNNING" for e in st["events"]), st
+    finally:
+        ray_tpu.shutdown()
+        _cli("stop", "--name", name)
