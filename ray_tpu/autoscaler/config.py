@@ -72,12 +72,10 @@ def _provider_from_config(pcfg: Optional[dict], runtime):
         from .gce_tpu import GceTpuVmProvider
         rt = runtime or rt_mod.get_runtime_if_exists()
         if rt is not None:
-            if "head_address" not in pcfg:
-                # the address TPU-VM agents dial back to: this host's
-                # primary IP (override in the config when behind NAT)
-                import socket
-                ip = socket.gethostbyname(socket.gethostname())
-                pcfg["head_address"] = f"{ip}:{rt.tcp_port}"
+            # the address TPU-VM agents dial back to (host_ip-based, NOT
+            # gethostbyname(hostname) which commonly resolves to
+            # loopback); override in the config when behind NAT
+            pcfg.setdefault("head_address", rt.head_address)
             pcfg.setdefault("authkey_hex", rt._authkey.hex())
         return GceTpuVmProvider(**pcfg)
     raise ValueError(f"unknown provider type {kind!r}")

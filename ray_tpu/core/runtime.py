@@ -1689,7 +1689,42 @@ class Runtime:
             return True  # delivery failures surface at flush
         return w.send(msg)
 
+    def _dispatch_possible_locked(self) -> bool:
+        """Cheap saturation check before walking every bucket: can ANY
+        pending plain task possibly dispatch this pass? True when a
+        zero-resource shape is pending (always placeable), a PLACEMENT
+        GROUP task is pending (bundles hold their own reserved capacity,
+        invisible in node.resources_avail — gating on node availability
+        would deadlock a PG that reserved a whole node), a node has any
+        free resource, or a busy worker has an open pipeline slot.
+        O(nodes + workers) instead of a full pass with per-task dep
+        checks — what a burst of submits pays per task once the pool is
+        saturated. Conservative by construction: a true here only means
+        the full pass runs (possibly finding nothing). Accepted
+        semantics: reconstruction of an evicted dep kicks at the next
+        capacity-freeing event rather than instantly — while saturated
+        the regenerating task could not run anyway (failed-dep
+        propagation is unaffected: submit fail-fast plus the
+        failure-event sweep run outside the pass)."""
+        from .config import cfg as _cfg
+        for key in self.pending.buckets:
+            if not key[0] or key[1] is not None:
+                return True
+        for n in self.nodes.values():
+            if n.alive and any(v > 1e-9 for v in n.resources_avail.values()):
+                return True
+        depth = _cfg.worker_pipeline_depth
+        if depth > 0:
+            for w in self.workers.values():
+                if (w.state == "busy" and not w.blocked
+                        and w.conn is not None and w.actor_id is None
+                        and len(w.queued) < depth):
+                    return True
+        return False
+
     def _schedule_pass_locked(self):
+        if self.pending.buckets and not self._dispatch_possible_locked():
+            return
         for key in list(self.pending.buckets):
             dq = self.pending.buckets.get(key)
             if not dq:

@@ -146,7 +146,17 @@ def autoscaler_status() -> dict:
     if remote is not None:
         return remote._rpc("autoscaler_status")
     from .autoscaler.autoscaler import active_autoscalers
-    reports = [a.report() for a in active_autoscalers()]
+    from .core.runtime import get_runtime_if_exists
+    rt = get_runtime_if_exists()
+    reports = []
+    for a in active_autoscalers():
+        if a.rt is not rt:
+            continue   # stale registration from a previous init()
+        try:
+            reports.append(a.report())
+        except Exception as e:  # noqa: BLE001 — isolate per scaler
+            reports.append({"version": 0, "instances": [],
+                            "events": [], "error": str(e)})
     return {"autoscalers": reports,
             "instances": [r for rep in reports for r in rep["instances"]],
             "events": [e for rep in reports for e in rep["events"]][-100:]}

@@ -101,3 +101,24 @@ def test_invalid_pg_args(ray_start_regular):
         placement_group([], strategy="PACK")
     with pytest.raises(ValueError):
         placement_group([{"CPU": 1}], strategy="DIAGONAL")
+
+
+def test_pg_task_dispatches_when_node_avail_exhausted(ray_start_regular):
+    """A PG bundle reserving the whole node zeroes node.resources_avail;
+    tasks against the bundle must still schedule (the saturation gate
+    must not mistake bundle-held capacity for a saturated cluster)."""
+    import ray_tpu
+    from ray_tpu.util import placement_group
+
+    total = ray_tpu.cluster_resources()["CPU"]
+    pg = placement_group([{"CPU": total}])
+    assert pg.wait(60)
+
+    @ray_tpu.remote(num_cpus=1)
+    def inside():
+        return "pg-ran"
+
+    out = ray_tpu.get(
+        inside.options(placement_group=pg).remote(), timeout=60)
+    assert out == "pg-ran"
+    ray_tpu.util.remove_placement_group(pg)
