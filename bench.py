@@ -88,7 +88,11 @@ def _probe_accelerator(timeout_s: float = 90.0) -> bool:
     try:
         r = subprocess.run(
             [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].platform)"],
+             # honor JAX_PLATFORMS even though the axon sitecustomize
+             # overrides it at import (CPU smoke runs need this)
+             "import os, jax; p = os.environ.get('JAX_PLATFORMS'); "
+             "p and jax.config.update('jax_platforms', p); "
+             "print(jax.devices()[0].platform)"],
             capture_output=True, text=True, timeout=timeout_s,
             env=dict(os.environ))
         return r.returncode == 0
@@ -96,8 +100,20 @@ def _probe_accelerator(timeout_s: float = 90.0) -> bool:
         return False
 
 
+def repin_jax_platforms():
+    """Honor JAX_PLATFORMS after import: the axon sitecustomize
+    overrides the jax config (not the env var) at import time, so CPU
+    smoke runs must re-apply it (same recipe as tests/conftest.py)."""
+    import os
+    p = os.environ.get("JAX_PLATFORMS")
+    if p:
+        import jax
+        jax.config.update("jax_platforms", p)
+
+
 def main():
     from ray_tpu.parallel.mesh import tpu_topology
+    repin_jax_platforms()
 
     if not _probe_accelerator():
         print(json.dumps({

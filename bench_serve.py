@@ -16,7 +16,8 @@ import numpy as np
 
 
 def main():
-    from bench import _probe_accelerator
+    from bench import _probe_accelerator, repin_jax_platforms
+    repin_jax_platforms()
     from ray_tpu.llm import SamplingParams
     from ray_tpu.llm.paged_engine import (
         PagedEngineConfig, PagedInferenceEngine,
@@ -37,9 +38,13 @@ def main():
             vocab_size=32000, dim=1024, n_layers=8, n_heads=16,
             n_kv_heads=8, mlp_dim=4096, max_seq_len=2048,
             dtype=jax.numpy.bfloat16, remat=False, use_flash=False)
+        # 32 slots: the whole burst admits at once (page pool holds
+        # 65k tokens, the burst peaks at ~10k: 7680 prompt + 2048
+        # decode); prefill_rows=8 packs the burst's ~45 chunks into ~6
+        # dispatches
         cfg = PagedEngineConfig(
-            model=model, max_batch_size=16, page_size=64, num_pages=1024,
-            max_pages_per_seq=32, chunk_size=256)
+            model=model, max_batch_size=32, page_size=64, num_pages=1024,
+            max_pages_per_seq=32, chunk_size=256, prefill_rows=8)
         n_requests, max_tokens = 32, 64
         prompt_lens = [64, 128, 256, 512]
     else:  # CPU smoke — numbers not meaningful
