@@ -193,6 +193,24 @@ def main():
                           "value": None, "unit": "tokens/s",
                           "error": str(e)[:200]}))
 
+    # serving dispatch economy: DISPATCHES per generated token on a
+    # pinned burst (a count, machine-independent; bench_trend.py);
+    # ~1.1 would mean the engine fell back to a dispatch per token.
+    try:
+        import bench_trend
+        dpt = bench_trend.measure_serve_dispatch()
+        pin = bench_trend.BASELINE_SERVE_DISPATCH_PER_TOKEN
+        print(json.dumps({
+            "metric": "serve_dispatches_per_token",
+            "value": round(dpt, 4),
+            "unit": "device dispatches per generated token (pinned burst)",
+            "vs_baseline": round(pin / max(dpt, 1e-9), 3),
+        }))
+    except Exception as e:  # noqa: BLE001
+        print(json.dumps({"metric": "serve_dispatches_per_token",
+                          "value": None, "unit": "dispatches/token",
+                          "error": str(e)[:200]}))
+
 
 if __name__ == "__main__":
     main()

@@ -24,6 +24,9 @@ import time
 # is cross-round comparability — vs_baseline ~1.0 means no regression.
 BASELINE_TOKENS_PER_SEC = 773.7
 _PIN_FILE_DEFAULT = 773.7
+# round-5 pin for the serving dispatch-economy scenario (dispatches per
+# generated token on the pinned burst; windowed decode + batched prefill)
+BASELINE_SERVE_DISPATCH_PER_TOKEN = 0.1172
 
 
 def _child():
@@ -81,32 +84,79 @@ def _child():
     print(json.dumps({"_trend_tokens_per_sec": tps}))
 
 
-def measure() -> float:
-    """Run the pinned step in a clean CPU-mesh subprocess; returns
-    tokens/s."""
+def _child_serve():
+    """Pinned serving dispatch-economy scenario: device DISPATCHES per
+    generated token over a fixed burst (count, not time — identical on
+    any machine, so the trend is noise-free). The dispatch-minimal
+    engine work (windowed decode, batched prefill, fused sampling)
+    shows up here; a regression that reintroduces per-token dispatches
+    moves this number ~10x."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from ray_tpu.llm import SamplingParams
+    from ray_tpu.llm.paged_engine import (
+        PagedEngineConfig, PagedInferenceEngine,
+    )
+    from ray_tpu.models import llama
+
+    cfg = PagedEngineConfig(
+        model=llama.llama_tiny(vocab_size=258, max_seq_len=256),
+        max_batch_size=8, page_size=8, num_pages=256,
+        max_pages_per_seq=24, chunk_size=16, prefill_rows=4,
+        decode_window=8)
+    eng = PagedInferenceEngine(cfg, rng_seed=0)
+    rng = np.random.RandomState(0)
+    prompts = [list(rng.randint(1, 250, (24 if i % 2 else 48,)))
+               for i in range(12)]
+    eng.generate(prompts, SamplingParams(max_tokens=32))
+    st = eng.stats
+    disp = (st["prefill_dispatches"] + st["decode_dispatches"]
+            + st["spec_dispatches"])
+    print(json.dumps({"_serve_dispatch_per_token":
+                      disp / max(st["tokens_out"], 1)}))
+
+
+def _run_child(kind: str, result_key: str, extra_env=None) -> float:
+    """Re-exec this file as a pinned child and parse one result key."""
     env = dict(os.environ)
-    env["_BENCH_TREND_CHILD"] = "1"
+    env["_BENCH_TREND_CHILD"] = kind
     env["JAX_PLATFORMS"] = "cpu"
-    flags = env.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        env["XLA_FLAGS"] = (
-            flags + " --xla_force_host_platform_device_count=8").strip()
+    env.update(extra_env or {})
     proc = subprocess.run(
         [sys.executable, os.path.abspath(__file__)],
         env=env, capture_output=True, text=True, timeout=900,
         cwd=os.path.dirname(os.path.abspath(__file__)))
     if proc.returncode != 0:
         raise RuntimeError(
-            f"bench_trend child failed rc={proc.returncode}:\n"
+            f"bench_trend child {kind!r} failed rc={proc.returncode}:\n"
             f"{proc.stdout}\n{proc.stderr}")
     for line in reversed(proc.stdout.splitlines()):
         try:
             rec = json.loads(line)
-            if "_trend_tokens_per_sec" in rec:
-                return float(rec["_trend_tokens_per_sec"])
+            if result_key in rec:
+                return float(rec[result_key])
         except json.JSONDecodeError:
             continue
-    raise RuntimeError(f"no trend line in child output: {proc.stdout}")
+    raise RuntimeError(f"no {result_key} line in child output: "
+                       f"{proc.stdout}")
+
+
+def measure_serve_dispatch() -> float:
+    """Dispatches per generated token on the pinned burst (child proc)."""
+    return _run_child("serve", "_serve_dispatch_per_token")
+
+
+def measure() -> float:
+    """Run the pinned step in a clean CPU-mesh subprocess; returns
+    tokens/s."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    extra = {}
+    if "xla_force_host_platform_device_count" not in flags:
+        extra["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    return _run_child("1", "_trend_tokens_per_sec", extra)
 
 
 def main():
@@ -121,7 +171,10 @@ def main():
 
 
 if __name__ == "__main__":
-    if os.environ.get("_BENCH_TREND_CHILD"):
+    kind = os.environ.get("_BENCH_TREND_CHILD")
+    if kind == "serve":
+        _child_serve()
+    elif kind:
         _child()
     else:
         main()

@@ -27,6 +27,13 @@ What v2 adds over v1's flat `instances` dict:
   - drift detection: instances the provider no longer reports move to
     TERMINATED with reason "provider-lost"; min_workers then relaunches
     through the normal QUEUED path.
+
+Known limitation: provider objects keep their fleet membership in
+process memory, so after a head restart pre-restart nodes are no longer
+under instance management — they re-join the cluster (head-restart
+survivability) and their capacity is planned against, but idle
+scale-down can't reclaim them and a min_workers floor counts only
+managed instances (it may launch fresh ones alongside orphans).
 """
 from __future__ import annotations
 
