@@ -7,27 +7,28 @@ directory instead, see core/runtime.py).
 """
 from __future__ import annotations
 
-import itertools
 import os
-import struct
+import random
 import threading
 
-# id generation: a fresh urandom prefix per (process, thread) plus a
-# 64-bit counter. Uniqueness matches urandom-per-id (the prefix is
-# unguessable and never repeats across processes/threads), but minting
-# an id costs a counter bump instead of a syscall — ids are minted twice
-# per task submit, which is hot in burst submission.
+# id generation: a urandom-seeded PRNG per (process, thread). Minting an
+# id costs one getrandbits (C-level, no syscall) — ids are minted twice
+# per task submit, which is hot in burst submission. The earlier
+# prefix+counter scheme was cheaper still but made every id on a thread
+# share its leading bytes, colliding everything derived from an id
+# PREFIX (session dirs, /dev/shm store names, truncated display ids);
+# ids must look random end to end.
 _LOCAL = threading.local()
 
 
 def _mint(size: int) -> bytes:
     gen = getattr(_LOCAL, "gen", None)
-    if gen is None or gen[2] != os.getpid():
+    if gen is None or gen[1] != os.getpid():
         # (re)seed on first use and after fork — a forked worker must
         # not continue its parent's stream
-        gen = (os.urandom(24), itertools.count(), os.getpid())
+        gen = (random.Random(os.urandom(24)), os.getpid())
         _LOCAL.gen = gen
-    return (gen[0] + struct.pack("<Q", next(gen[1])))[-size:]
+    return gen[0].getrandbits(size * 8).to_bytes(size, "little")
 
 
 class BaseID:
