@@ -314,6 +314,37 @@ def cmd_state(args) -> int:
         ray.shutdown()
 
 
+def cmd_memory(args) -> int:
+    """`ray memory` analog: per-object reference breakdown + store
+    totals (reference: scripts.py memory command)."""
+    ray, rt, _ = _client(args.address)
+    try:
+        from . import state as state_api
+        m = state_api.memory_summary(limit=args.limit)
+        st = m["object_store"]
+        print(f"object store: {st['bytes_in_use']:,} / "
+              f"{st['capacity']:,} bytes in {st['num_objects']} objects "
+              f"({st['evictions']} evictions); "
+              f"{m['num_objects_tracked']} tracked, "
+              f"{m['num_transfer_pins']} transfer pins, "
+              f"{m['num_task_arg_refs']} task-arg refs")
+        for r in m["objects"]:
+            flags = "".join((
+                "P" if r["pinned"] else "-",
+                "S" if r["in_store"] else "-",
+                "D" if r["spilled"] else "-",
+                "L" if r["reconstructable"] else "-"))
+            holders = ",".join(r["ref_holders"][:4])
+            if r["num_refs"] > 4:
+                holders += f",+{r['num_refs'] - 4}"
+            print(f"{r['object_id'][:16]}  {r['state']:<8} {flags}  "
+                  f"refs={r['num_refs']:<3} pins={r['transfer_pins']:<2} "
+                  f"contains={r['contains']:<3} {holders}")
+        return 0
+    finally:
+        ray.shutdown()
+
+
 def cmd_timeline(args) -> int:
     ray, rt, _ = _client(args.address)
     try:
@@ -392,6 +423,12 @@ def build_parser() -> argparse.ArgumentParser:
                                      "jobs"])
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_state)
+
+    sp = sub.add_parser("memory", help="object refs + store usage "
+                                       "(`ray memory` analog)")
+    sp.add_argument("--limit", type=int, default=200)
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_memory)
 
     sp = sub.add_parser("timeline", help="dump chrome trace")
     sp.add_argument("--out", default="timeline.json")

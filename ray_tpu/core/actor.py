@@ -20,12 +20,43 @@ from .remote_function import (_trace_ctx, prepare_args,
 from .task_spec import ActorSpec, TaskSpec, validate_resources
 
 _DEFAULT_ACTOR_OPTS = dict(
-    num_cpus=0.0, num_tpus=0.0, resources=None, name=None,
+    num_cpus=0.0, num_tpus=0.0, resources=None, name=None, namespace=None,
     max_restarts=0, max_task_retries=0, max_concurrency=1,
+    max_pending_calls=-1,
     lifetime=None, scheduling_strategy="DEFAULT", placement_group=None,
     placement_group_bundle_index=-1, _node_id=None, _node_soft=False,
     runtime_env=None, concurrency_groups=None, label_selector=None,
 )
+
+
+def split_actor_name(qualified):
+    """Inverse of qualify_actor_name for display surfaces: ``"ns/name"``
+    -> (ns, name); system (``rtpu:``) and unqualified names -> ("", name)
+    (reference: `ray list actors` shows name and ray_namespace as
+    separate columns)."""
+    if not qualified:
+        return "", ""
+    if qualified.startswith("rtpu:") or "/" not in qualified:
+        return "", qualified
+    ns, _, short = qualified.partition("/")
+    return ns, short
+
+
+def qualify_actor_name(name, namespace, rt):
+    """Scope a user-visible actor name to a namespace (reference:
+    ray.init(namespace=)/get_actor(namespace=) isolation of named actors).
+    Delta from the reference, by design: the cluster-wide default
+    namespace is the shared ``"default"`` (not a per-driver anonymous
+    UUID) — a single-job TPU cluster wants its drivers to see each
+    other's named actors unless told otherwise. ``rtpu:``-prefixed system
+    actors (serve controller, proxies) stay cluster-global, the analog of
+    the reference's reserved SERVE_NAMESPACE."""
+    if name is None:
+        return None
+    if name.startswith("rtpu:"):
+        return name
+    ns = namespace or getattr(rt, "namespace", None) or "default"
+    return f"{ns}/{name}"
 
 
 def _runtime():
@@ -82,7 +113,7 @@ class ActorClass:
             node_affinity_soft=strat["node_affinity_soft"],
             label_selector=(dict(o["label_selector"])
                             if o["label_selector"] else None),
-            named=o["name"],
+            named=qualify_actor_name(o["name"], o["namespace"], rt),
             ready_oid=ready_oid,
             runtime_env=prepare_runtime_env(rt, o["runtime_env"]),
             concurrency_groups=o["concurrency_groups"],
@@ -92,7 +123,8 @@ class ActorClass:
             m for m in dir(self._cls)
             if callable(getattr(self._cls, m, None)) and not m.startswith("__"))
         return ActorHandle(aid, self.__name__, methods,
-                           o["max_task_retries"], ready_oid)
+                           o["max_task_retries"], ready_oid,
+                           o["max_pending_calls"])
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
@@ -120,8 +152,9 @@ class ActorMethod:
 
     def remote(self, *args, **kwargs):
         rt = _runtime()
-        blob, deps = prepare_args(rt, args, kwargs)
         h = self._handle
+        h._admit_pending(rt)  # max_pending_calls backpressure
+        blob, deps = prepare_args(rt, args, kwargs)
         nret = self._num_returns
         spec = TaskSpec(
             task_id=TaskID.from_random(),
@@ -138,6 +171,7 @@ class ActorMethod:
             trace_ctx=_trace_ctx(),
         )
         refs = rt.submit_actor_task_spec(spec)
+        h._track_pending(refs)
         if nret == 0:
             return None
         return refs[0] if nret == 1 else refs
@@ -146,12 +180,65 @@ class ActorMethod:
 class ActorHandle:
     def __init__(self, actor_id: ActorID, class_name: str,
                  methods: list[str], max_task_retries: int,
-                 ready_oid: ObjectID | None = None):
+                 ready_oid: ObjectID | None = None,
+                 max_pending_calls: int = -1):
         self._actor_id = actor_id
         self._class_name = class_name
         self._methods = methods
         self._max_task_retries = max_task_retries
         self._ready_oid = ready_oid
+        self._max_pending_calls = max_pending_calls
+        self._pending: list[ObjectRef] = []  # see _admit_pending
+        # created eagerly: unpickling rebuilds the handle via __reduce__ →
+        # __init__, and lazy creation would race two first .remote()s
+        import threading as _t
+        self._pending_lock = _t.Lock()
+
+    def _admit_pending(self, rt):
+        """Client-side backpressure (reference: ActorTaskSubmitter's
+        max_pending_calls check raising PendingCallsLimitExceeded).
+        A call counts as pending until its first return lands in the
+        store; pruning happens on the submit path, so an idle handle
+        holds only ids, no threads."""
+        mp = self._max_pending_calls
+        if mp is None or mp <= 0:
+            return
+        store = getattr(rt, "store", None)
+        with self._pending_lock:
+            # _pending holds STRONG ObjectRefs: the held interest keeps a
+            # completed-and-consumed result from being freed before this
+            # prune can observe it (a freed oid is indistinguishable from
+            # a still-running call). Lifetime cost: at most mp results
+            # outlive their consumers until the next submit prunes them.
+            if store is not None:
+                self._pending = [r for r in self._pending
+                                 if not store.contains(r.id())]
+            else:  # local mode executes inline; nothing can be pending
+                self._pending = []
+            if len(self._pending) >= mp and hasattr(rt, "_rpc"):
+                # own-store nodes never see remote results in the local
+                # store; before refusing, ask the head which pending
+                # results exist anywhere (cost bounded to the saturated
+                # path — the backpressure boundary)
+                still = []
+                for r in self._pending:
+                    try:
+                        if not rt._rpc("locate", r.id().binary(),
+                                       timeout=10.0):
+                            still.append(r)
+                    except Exception:
+                        still.append(r)
+                self._pending = still
+            if len(self._pending) >= mp:
+                from .. import exceptions as exc
+                raise exc.PendingCallsLimitExceeded(
+                    f"{self._class_name} handle has {len(self._pending)} "
+                    f"calls in flight (max_pending_calls={mp})")
+
+    def _track_pending(self, refs):
+        if (self._max_pending_calls or 0) > 0 and refs:
+            with self._pending_lock:
+                self._pending.append(refs[0])
 
     def __getattr__(self, name: str) -> ActorMethod:
         if name.startswith("_"):
@@ -177,6 +264,8 @@ class ActorHandle:
         return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"
 
     def __reduce__(self):
+        # pending-call tracking is per-handle-copy, like the reference's
+        # per-caller submit queues
         return (ActorHandle, (self._actor_id, self._class_name,
                               self._methods, self._max_task_retries,
-                              self._ready_oid))
+                              self._ready_oid, self._max_pending_calls))

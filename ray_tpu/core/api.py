@@ -54,7 +54,7 @@ def init(address: Optional[str] = None,
         address = "auto"
     if address is not None and address != "local":
         from .client import connect
-        return connect(address)
+        return connect(address, namespace=namespace)
     if local_mode:
         rt = LocalModeRuntime()
         rt_mod.set_runtime(rt)
@@ -66,10 +66,15 @@ def init(address: Optional[str] = None,
     res = {"CPU": float(num_cpus), **(resources or {})}
     if num_tpus:
         res["TPU"] = float(num_tpus)
+    # named-actor scoping (core/actor.py qualify_actor_name); set BEFORE
+    # Runtime() so prestarted workers inherit it and in-task get_actor
+    # resolves in the job's namespace
+    os.environ["RTPU_NAMESPACE"] = namespace or "default"
     rt = Runtime(res,
                  object_store_memory=object_store_memory or None,
                  head_labels=labels,
                  log_to_driver=log_to_driver)
+    rt.namespace = namespace or "default"
     rt_mod.set_runtime(rt)
     out = {"node_id": rt.head_node.node_id.hex(),
            "session_dir": rt.session_dir}
@@ -78,6 +83,7 @@ def init(address: Optional[str] = None,
         # actors, placement groups, job table) from a previous session's
         # snapshot (core/gcs_store.py restore)
         from .gcs_store import restore
+        rt.resumed_from = os.path.abspath(resume_from)
         out["restored"] = restore(rt, resume_from)
     return out
 
@@ -157,8 +163,12 @@ def cancel(ref: ObjectRef, *, force: bool = False,
     _runtime().cancel(ref, force=force, recursive=recursive)
 
 
-def get_actor(name: str) -> ActorHandle:
-    spec = _runtime().get_actor_by_name(name)
+def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
+    """Look up a named actor, scoped to `namespace` (default: the
+    calling driver/job's namespace — reference: ray.get_actor)."""
+    from .actor import qualify_actor_name
+    rt = _runtime()
+    spec = rt.get_actor_by_name(qualify_actor_name(name, namespace, rt))
     return ActorHandle(spec.actor_id, spec.name, [], spec.max_task_retries,
                        spec.ready_oid)
 

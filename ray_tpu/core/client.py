@@ -165,7 +165,6 @@ class DriverRuntime(WorkerRuntime):
                 try:
                     cf_path = resolve_cluster_file(addr)
                     conn, reply = _dial(cf_path)
-                    break
                 except ProtocolMismatchError as e:
                     # deterministic refusal — retrying cannot succeed
                     print(f"driver reconnect refused: {e}", flush=True)
@@ -173,6 +172,23 @@ class DriverRuntime(WorkerRuntime):
                 except (ConnectionError, OSError, EOFError, ValueError,
                         mp.AuthenticationError):
                     continue
+                # identity check: only attach to OUR cluster — the same
+                # session (transient drop) or a head that RESUMED from it
+                # (restart). Auto-resolve picks the newest local cluster
+                # file, which on a busy box can belong to an unrelated
+                # cluster; silently hijacking onto it would cross-wire
+                # two jobs (reference analog: GCS FT clients reconnect to
+                # a fixed redis-backed address, never to "any GCS").
+                mine = getattr(self, "_session_dir", None)
+                if mine and reply.get("session_dir") != mine and \
+                        reply.get("resumed_from") != mine:
+                    try:
+                        conn.close()
+                    except Exception:
+                        pass
+                    conn = reply = None
+                    continue
+                break
             if conn is None:
                 time.sleep(delay)
                 delay = min(delay * 2, 2.0)
@@ -197,6 +213,10 @@ class DriverRuntime(WorkerRuntime):
                 self.store = store
                 self.spill = spill
                 self.wid = reply["wid"]
+                # restart chains: the NEW session becomes our identity,
+                # so a later restart resuming from IT still matches
+                self._session_dir = reply.get("session_dir") or \
+                    getattr(self, "_session_dir", None)
                 self._sent_fids.clear()
                 self._sent_renvs.clear()
                 # the new head knows nothing about us: re-ship function
@@ -298,7 +318,8 @@ def _dial(cf_path: str):
     return conn, reply
 
 
-def connect(address: str | None = None) -> dict:
+def connect(address: str | None = None,
+            namespace: str | None = None) -> dict:
     """Connect as a driver; sets the process runtime. Returns init info."""
     cf_path = resolve_cluster_file(address)
     with open(cf_path) as f:
@@ -308,6 +329,13 @@ def connect(address: str | None = None) -> dict:
     spill = SpillStore(reply["spill_dir"]) if reply.get("spill_dir") else None
     rt = DriverRuntime(store, conn, reply["wid"], spill,
                        address_arg=address)
+    # named-actor scoping: this driver's default namespace (a job driver
+    # inherits the submitting cluster's via RTPU_NAMESPACE)
+    rt.namespace = namespace or os.environ.get("RTPU_NAMESPACE", "default")
+    # cluster identity for reconnect verification (_reconnect): the
+    # session we attached to; updated on each successful reconnect so
+    # restart CHAINS keep matching
+    rt._session_dir = reply.get("session_dir") or cf.get("session_dir")
     rt_mod.set_runtime(rt)
     return {"address": cf_path, "wid": reply["wid"],
             "job_id": reply["job_id"], "session_dir": cf["session_dir"]}

@@ -23,7 +23,10 @@ GCS table schema version in gcs_storage).
 from __future__ import annotations
 
 # Bump on any incompatible control-plane or store-framing change.
-PROTOCOL_VERSION = 1
+# v2: submit/actor_call imply the submitter's interest in return_ids
+#     (no per-task ref_add), batched ref_drops, positional-tuple
+#     TaskSpec/ActorSpec pickling (+ max_calls field).
+PROTOCOL_VERSION = 2
 
 # Bump on any incompatible change to the sqlite snapshot contents.
 SNAPSHOT_SCHEMA_VERSION = 1

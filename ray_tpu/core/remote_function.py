@@ -25,7 +25,7 @@ _DEFAULT_TASK_OPTS = dict(
     max_retries=3, retry_exceptions=False, name=None,
     scheduling_strategy="DEFAULT", placement_group=None,
     placement_group_bundle_index=-1, _node_id=None, _node_soft=False,
-    runtime_env=None, label_selector=None,
+    runtime_env=None, label_selector=None, max_calls=0,
 )
 
 
@@ -148,6 +148,7 @@ class RemoteFunction:
             trace_ctx=_trace_ctx(),
             label_selector=(dict(o["label_selector"])
                             if o["label_selector"] else None),
+            max_calls=max(0, o["max_calls"]),
             **strat,
         )
         refs = rt.submit_task(spec)

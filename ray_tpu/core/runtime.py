@@ -722,10 +722,18 @@ class Runtime:
                     w.conn = conn
                     self.workers[wid] = w
                 with w.send_lock:
+                    # session_dir + resumed_from let a reconnecting driver
+                    # verify this head is ITS cluster (same session, or a
+                    # restart resumed from its session) before attaching —
+                    # auto-resolve must never hijack onto an unrelated
+                    # local cluster (client.py _reconnect)
                     conn.send({"t": "registered_driver", "wid": wid,
                                "store_path": self.store_path,
                                "spill_dir": self.spill.dir,
                                "job_id": self.job_id.hex(),
+                               "session_dir": self.session_dir,
+                               "resumed_from": getattr(
+                                   self, "resumed_from", None),
                                "pv": PROTOCOL_VERSION})
                 while True:
                     m = conn.recv()
@@ -782,6 +790,12 @@ class Runtime:
             self._on_actor_ready(wid, msg)
         elif t == "submit":
             with self.lock:
+                # v2 protocol: the submit itself registers the submitter's
+                # interest in every return (the client sends no per-task
+                # ref_add — half the client writes on a burst). Interest
+                # lands BEFORE the task can run, same guarantee as before.
+                for oid in msg["spec"].return_ids:
+                    self._ref_add_locked(oid, wid, False)
                 self._submit_locked(msg["spec"])
         elif t == "func_def":
             with self.lock:
@@ -828,6 +842,12 @@ class Runtime:
         elif t == "ref_drop":
             with self.lock:
                 self._ref_drop_locked(ObjectID(msg["oid"]), wid)
+        elif t == "ref_drops":
+            # batched 1->0 drops from a client's drop thread: one lock
+            # acquire + one message for a burst of dying refs
+            with self.lock:
+                for ob in msg["oids"]:
+                    self._ref_drop_locked(ObjectID(ob), wid)
         elif t == "ref_xfer":
             with self.lock:
                 oid = ObjectID(msg["oid"])
@@ -836,7 +856,13 @@ class Runtime:
             with self.lock:
                 self._create_actor_locked(msg["spec"])
         elif t == "actor_call":
-            self.submit_actor_task_spec(msg["spec"])
+            with self.lock:
+                # v2: actor_call implies submitter interest (see "submit");
+                # route directly rather than via submit_actor_task_spec so
+                # no head-side ObjectRefs are minted just to be GC'd
+                for oid in msg["spec"].return_ids:
+                    self._ref_add_locked(oid, wid, False)
+                self._submit_actor_task_locked(msg["spec"])
         elif t == "kill_actor":
             self.kill_actor(ActorID(msg["actor_id"]), msg.get("no_restart", True))
         elif t == "ensure":
@@ -970,7 +996,7 @@ class Runtime:
                     "available_resources", "node_table", "pg_wait",
                     "create_placement_group_rpc", "remove_placement_group_rpc",
                     "timeline", "state_list", "state_summary",
-                    "autoscaler_status",
+                    "memory_summary", "autoscaler_status",
                     "user_metrics_dump", "pubsub_poll",
                     "kv_put", "kv_get", "kv_del", "kv_keys", "locate",
                     "job_submit", "job_list", "job_status", "job_logs",
@@ -1074,6 +1100,10 @@ class Runtime:
     def state_summary(self):
         from .. import state as state_api
         return state_api.summary()
+
+    def memory_summary(self, limit: int = 1000):
+        from .. import state as state_api
+        return state_api.memory_summary(limit)
 
     def autoscaler_status(self):
         from .. import state as state_api
@@ -2286,15 +2316,18 @@ class Runtime:
     def submit_actor_task_spec(self, spec: TaskSpec) -> list[ObjectRef]:
         with self.lock:
             refs = [ObjectRef(o) for o in spec.return_ids]  # interest first
-            self.counters["tasks_submitted"] += 1
-            self._record_task_locked(spec, "PENDING")
-            for oid in spec.return_ids:
-                self.directory[oid] = DirEntry(PENDING, lineage=None)
-            holder = f"task:{spec.task_id.hex()}"
-            for d in spec.dep_oids:
-                self.interest.setdefault(d, set()).add(holder)
-            self._route_actor_task_locked(spec)
+            self._submit_actor_task_locked(spec)
         return refs
+
+    def _submit_actor_task_locked(self, spec: TaskSpec) -> None:
+        self.counters["tasks_submitted"] += 1
+        self._record_task_locked(spec, "PENDING")
+        for oid in spec.return_ids:
+            self.directory[oid] = DirEntry(PENDING, lineage=None)
+        holder = f"task:{spec.task_id.hex()}"
+        for d in spec.dep_oids:
+            self.interest.setdefault(d, set()).add(holder)
+        self._route_actor_task_locked(spec)
 
     def _route_actor_task_locked(self, spec: TaskSpec):
         a = self.actors.get(spec.actor_id)

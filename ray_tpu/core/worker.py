@@ -60,6 +60,9 @@ class WorkerRuntime:
         # own-store node: misses pull via object_transfer; RPC replies come
         # over the conn into this dict instead of the (invisible) head store
         self.own_store = os.environ.get("RTPU_OWN_STORE") == "1"
+        # in-task get_actor/named-actor creation resolve in the job's
+        # namespace (core/actor.py qualify_actor_name)
+        self.namespace = os.environ.get("RTPU_NAMESPACE", "default")
         self._rpc_replies: dict[bytes, object] = {}
         self._rpc_reply_evt = threading.Event()
         self._rpc_abandoned: set[bytes] = set()
@@ -69,6 +72,10 @@ class WorkerRuntime:
         # (reference_count.h:73 borrower protocol, simplified)
         self._ref_counts: dict = {}
         self._ref_lock = threading.Lock()
+        # return-ids of a task being submitted: their first ObjectRef needs
+        # no ref_add send — the v2 submit/actor_call message itself carries
+        # the submitter's interest (runtime._handle_msg "submit")
+        self._presumed: set = set()
         # __del__ may fire from a GC pass triggered INSIDE send() or
         # ref_created() on the same thread; doing IPC or taking these locks
         # there would self-deadlock. Drops only enqueue (SimpleQueue.put is
@@ -89,6 +96,9 @@ class WorkerRuntime:
         with self._ref_lock:
             c = self._ref_counts.get(oid, 0)
             self._ref_counts[oid] = c + 1
+            if c == 0 and not from_transfer and oid in self._presumed:
+                self._presumed.discard(oid)
+                return  # the submit message registers this interest
             if c == 0 or from_transfer:
                 self.send({"t": "ref_add", "oid": oid.binary(),
                            "transfer": from_transfer})
@@ -97,16 +107,34 @@ class WorkerRuntime:
         self._drop_q.put(oid)
 
     def _drop_loop(self):
+        import queue as _q
         while True:
-            oid = self._drop_q.get()
+            oids = [self._drop_q.get()]
+            # greedy drain: a GC pass killing a burst of refs becomes ONE
+            # batched ref_drops message instead of one write per ref
             try:
+                while len(oids) < 4096:
+                    oids.append(self._drop_q.get_nowait())
+            except _q.Empty:
+                pass
+            try:
+                # compute + send under _ref_lock: a concurrent 0->1
+                # ref_add must not land between our 1->0 decision and the
+                # drop reaching the wire (same ordering rule as
+                # ref_created's send-under-lock)
                 with self._ref_lock:
-                    c = self._ref_counts.get(oid, 0) - 1
-                    if c <= 0:
-                        self._ref_counts.pop(oid, None)
-                        self.send({"t": "ref_drop", "oid": oid.binary()})
-                    else:
-                        self._ref_counts[oid] = c
+                    dead = []
+                    for oid in oids:
+                        c = self._ref_counts.get(oid, 0) - 1
+                        if c <= 0:
+                            self._ref_counts.pop(oid, None)
+                            dead.append(oid.binary())
+                        else:
+                            self._ref_counts[oid] = c
+                    if len(dead) == 1:
+                        self.send({"t": "ref_drop", "oid": dead[0]})
+                    elif dead:
+                        self.send({"t": "ref_drops", "oids": dead})
             except Exception:
                 return  # connection gone: worker is exiting
 
@@ -272,8 +300,11 @@ class WorkerRuntime:
 
     def submit_task(self, spec: TaskSpec):
         spec.owner = self.wid
-        # refs first: their ref_add precedes the submit on this conn, so the
-        # head registers interest before the task can complete
+        # v2: the submit message itself carries our interest in the
+        # returns (head adds it before the task can run), so the local
+        # refs are constructed without a ref_add send each
+        with self._ref_lock:
+            self._presumed.update(spec.return_ids)
         refs = [ObjectRef(o) for o in spec.return_ids]
         self.send({"t": "submit", "spec": spec})
         return refs
@@ -283,7 +314,9 @@ class WorkerRuntime:
 
     def submit_actor_task_spec(self, spec: TaskSpec):
         spec.owner = self.wid
-        refs = [ObjectRef(o) for o in spec.return_ids]  # interest first
+        with self._ref_lock:
+            self._presumed.update(spec.return_ids)  # see submit_task
+        refs = [ObjectRef(o) for o in spec.return_ids]
         self.send({"t": "actor_call", "spec": spec})
         return refs
 
@@ -396,6 +429,8 @@ class WorkerLoop:
         # dispatch nonces the head reclaimed from our pipeline (set by the
         # recv loop, checked by the exec thread before running)
         self._stolen: set[str] = set()
+        # per-function execution counts for @remote(max_calls=N) retirement
+        self._fn_calls: dict[str, int] = {}
 
     # -- arg resolution ----------------------------------------------------
 
@@ -512,6 +547,18 @@ class WorkerLoop:
             done_msg["dynamic_items"] = self._dynamic_items
             self._dynamic_items = None
         self.rt.send(done_msg)
+        mc = getattr(spec, "max_calls", 0)
+        if mc:
+            # @remote(max_calls=N): retire this worker after N executions
+            # of the function — the release valve for user code that
+            # leaks process state (reference: worker_pool's
+            # max-calls-triggered worker exit). Exit AFTER the done send:
+            # the head sees done, then EOF; anything pipelined behind us
+            # requeues via _on_worker_death.
+            n = self._fn_calls[spec.func_id] = \
+                self._fn_calls.get(spec.func_id, 0) + 1
+            if n >= mc:
+                os._exit(0)
 
     def _run_actor_create(self, spec: ActorSpec):
         try:

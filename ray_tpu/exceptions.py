@@ -85,3 +85,9 @@ class RuntimeEnvSetupError(RayError):
 
 class PlacementGroupUnavailableError(RayError):
     """Placement group cannot be scheduled with current cluster resources."""
+
+
+class PendingCallsLimitExceeded(RayError):
+    """An actor handle with ``max_pending_calls`` set has that many calls
+    in flight (reference: ray.exceptions.PendingCallsLimitExceeded, raised
+    by the actor task submitter's client-side backpressure)."""

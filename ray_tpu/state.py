@@ -72,13 +72,15 @@ def list_actors(limit: int = 1000) -> list[dict]:
     remote = _remote()
     if remote is not None:
         return remote._rpc("state_list", "actors", limit)
+    from .core.actor import split_actor_name
     rt = _head()
     with rt.lock:
         out = []
         for aid, a in rt.actors.items():
+            ns, short = split_actor_name(a.spec.named or "")
             out.append({
                 "actor_id": aid.hex(), "class_name": a.spec.name,
-                "state": a.state.upper(), "name": a.spec.named or "",
+                "state": a.state.upper(), "name": short, "namespace": ns,
                 "worker": a.wid or "", "restarts_left": a.restarts_left,
                 "pending_calls": len(a.queue), "running_calls": len(a.running),
                 "death_cause": a.death_cause,
@@ -183,6 +185,51 @@ def summary() -> dict:
             "nodes_alive": sum(1 for n in rt.nodes.values() if n.alive),
             "pending_tasks": len(rt.pending),
             "objects_tracked": len(rt.directory),
+            "object_store": {
+                "capacity": rt.store.capacity(),
+                "bytes_in_use": rt.store.bytes_in_use(),
+                "num_objects": rt.store.num_objects(),
+                "evictions": rt.store.evictions(),
+            },
+        }
+
+
+def memory_summary(limit: int = 1000) -> dict:
+    """Per-object reference breakdown + store totals — the `ray memory`
+    debugging view (reference: scripts.py `ray memory` over
+    _private/internal_api.memory_summary; here read straight from the
+    head's ownership tables: interest holders, transfer pins,
+    containment edges, lineage). Rows are capped at `limit`, pinned/
+    most-referenced first, so a leak investigation sees the heavy
+    objects without shipping the whole directory."""
+    remote = _remote()
+    if remote is not None:
+        return remote._rpc("memory_summary", limit)
+    rt = _head()
+    with rt.lock:
+        rows = []
+        for oid, e in rt.directory.items():
+            holders = sorted(rt.interest.get(oid, ()))
+            rows.append({
+                "object_id": oid.hex(),
+                "state": _STATE_NAMES.get(e.state, str(e.state)),
+                "in_store": rt.store.contains(oid),
+                "spilled": rt.spill.contains(oid),
+                "ref_holders": holders,
+                "num_refs": len(holders),
+                "transfer_pins": rt.xfer_pins.get(oid, 0),
+                "contains": len(rt.contained.get(oid, ())),
+                "pinned": oid in rt._pinned,
+                "reconstructable": e.lineage is not None,
+            })
+        rows.sort(key=lambda r: (not r["pinned"], -r["num_refs"]))
+        task_holders = sum(1 for r in rows for h in r["ref_holders"]
+                           if h.startswith("task:"))
+        return {
+            "objects": rows[:limit],
+            "num_objects_tracked": len(rt.directory),
+            "num_task_arg_refs": task_holders,
+            "num_transfer_pins": sum(rt.xfer_pins.values()),
             "object_store": {
                 "capacity": rt.store.capacity(),
                 "bytes_in_use": rt.store.bytes_in_use(),

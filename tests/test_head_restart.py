@@ -145,3 +145,62 @@ def test_named_actor_restored_after_restart(tmp_path, fresh_driver_state):
             if p is not None and p.poll() is None:
                 p.kill()
                 p.wait(timeout=10)
+
+
+def test_reconnect_refuses_unrelated_cluster(tmp_path, fresh_driver_state):
+    """A driver whose head died must NOT silently attach to some other
+    local cluster that auto-resolve happens to find (cross-cluster
+    hijack): its session lineage check rejects the foreign head, and
+    sends fail with ConnectionError instead of landing on the wrong
+    cluster (reference analog: GCS FT clients reconnect to a fixed
+    address, never to 'any GCS')."""
+    import ray_tpu
+    from ray_tpu.core.config import cfg
+    head1, info1 = _start_head(tmp_path)
+    foreign = None
+    try:
+        cf = os.path.join(info1["session_dir"], "cluster.json")
+        ray_tpu.init(address=cf)
+
+        @ray_tpu.remote
+        def nop():
+            return 1
+
+        assert ray_tpu.get(nop.remote(), timeout=60) == 1
+
+        head1.send_signal(signal.SIGKILL)
+        head1.wait(timeout=10)
+        # an unrelated cluster appears (different port, NEWEST session):
+        # auto-resolve would pick it — the identity check must refuse
+        env = dict(os.environ)
+        env["RTPU_CLUSTER_AUTHKEY"] = AUTHKEY
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        foreign = subprocess.Popen(
+            [sys.executable, "-c",
+             HEAD_SCRIPT.format(port=PORT + 1, resume="")],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        json.loads(foreign.stdout.readline())
+
+        cfg.override(driver_reconnect_timeout_s=6.0)
+        try:
+            # the first send may still land in the dead socket's buffer;
+            # keep submitting until the refused-reconnect surfaces
+            got = None
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and got is None:
+                try:
+                    ray_tpu.get(nop.remote(), timeout=5)
+                except ConnectionError as e:
+                    got = e
+                except Exception:
+                    time.sleep(0.2)
+            assert isinstance(got, ConnectionError), \
+                "driver attached to an unrelated cluster"
+        finally:
+            cfg.override(driver_reconnect_timeout_s=60.0)
+    finally:
+        for p in (head1, foreign):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
