@@ -209,6 +209,70 @@ class PagedInferenceEngine(_EngineBase):
 
     # -- public API --------------------------------------------------------
 
+    def warmup(self, sample_modes=((False, False),),
+               families=("prefill", "decode", "verify")) -> float:
+        """Compile every program family this engine dispatches, BEFORE
+        serving traffic; returns seconds spent.
+
+        The reference's serving engine does the same at deployment time
+        (vLLM profiles and captures its execution graphs during engine
+        init, before the server admits requests — vllm_engine.py:180's
+        engine start path). Here the stakes are higher: one mid-burst XLA
+        compile on a remote-attached TPU is tens of requests' worth of
+        latency, landing exactly when the first burst does.
+
+        Families: prefill rows over the power-of-two buckets, decode
+        windows {1, decode_window}, and — when speculation is on — the
+        verify-row buckets. ``families`` narrows the set for replicas
+        that only ever run one side (a P/D prefill replica never
+        decodes; a decode replica never prefills — compiling the other
+        side would double deploy-time for nothing). Dummy dispatches
+        carry zero block tables and zero true_lens, so every write
+        routes to sink page 0 and no visible engine state is touched;
+        the donated caches round-trip through each program.
+        """
+        import time as _time
+        t0 = _time.perf_counter()
+        cfg = self.cfg
+        bs, maxp, c = (cfg.max_batch_size, cfg.max_pages_per_seq,
+                       cfg.chunk_size)
+        key, ctr = self._rng_base, np.int32(0)
+        for mode in sample_modes:
+            rb = 1
+            while "prefill" in families:
+                rb = min(rb, cfg.prefill_rows)
+                toks, self.caches = self._prefill_rows_fn(rb, mode)(
+                    self.params, self.caches,
+                    np.zeros((rb, c), np.int32),
+                    np.zeros((rb, maxp), np.int32),
+                    np.zeros((rb,), np.int32), np.zeros((rb,), np.int32),
+                    key, ctr, np.zeros((rb,), np.float32),
+                    np.zeros((rb,), np.int32))
+                np.asarray(toks)
+                if rb >= cfg.prefill_rows:
+                    break
+                rb <<= 1
+            for w in (sorted({1, cfg.decode_window})
+                      if "decode" in families else ()):
+                out, self.caches = self._decode_window_fn(w, mode)(
+                    self.params, self.caches, np.zeros((bs,), np.int32),
+                    np.zeros((bs, maxp), np.int32),
+                    np.zeros((bs,), np.int32), key, ctr,
+                    np.zeros((bs,), np.float32), np.zeros((bs,), np.int32))
+                np.asarray(out)
+        if cfg.spec_tokens > 0 and "verify" in families:
+            s1, rb = cfg.spec_tokens + 1, 1
+            while True:
+                rb = min(rb, bs)
+                y, self.caches = self._verify_fn(rb, s1)(
+                    self.params, self.caches, np.zeros((rb, s1), np.int32),
+                    np.zeros((rb, maxp), np.int32), np.zeros((rb,), np.int32))
+                np.asarray(y)
+                if rb >= bs:
+                    break
+                rb <<= 1
+        return _time.perf_counter() - t0
+
     def has_work(self) -> bool:
         return bool(self._pending or self._prefilling or self._active)
 
@@ -281,16 +345,21 @@ class PagedInferenceEngine(_EngineBase):
                 pos += n
             if len(rows) >= cfg.prefill_rows:
                 break
-        # size the program to the rows actually packed (the jit cache is
-        # keyed by r, at most prefill_rows variants): pad rows would be
-        # correctness-safe but cost a full chunk forward each
+        # bucket the row count to a power of two (same trick as
+        # _spec_step): the jit cache holds O(log prefill_rows) prefill
+        # programs instead of one per packed-row count. Pad rows carry
+        # true_len 0, so the kernel routes all their writes to sink page
+        # 0 (prefill_paged_rows docstring) — they cost compute but no
+        # fresh XLA compile, and a mid-burst compile costs tens of
+        # requests' worth of latency on a remote-attached accelerator.
         r = len(rows)
-        chunks = np.zeros((r, c), np.int32)
-        bts = np.zeros((r, maxp), np.int32)
-        sps = np.zeros((r,), np.int32)
-        tls = np.zeros((r,), np.int32)
-        temps = np.zeros((r,), np.float32)
-        topks = np.zeros((r,), np.int32)
+        rb = min(1 << max(r - 1, 0).bit_length(), cfg.prefill_rows)
+        chunks = np.zeros((rb, c), np.int32)
+        bts = np.zeros((rb, maxp), np.int32)
+        sps = np.zeros((rb,), np.int32)
+        tls = np.zeros((rb,), np.int32)
+        temps = np.zeros((rb,), np.float32)
+        topks = np.zeros((rb,), np.int32)
         for i, (req, pos, n) in enumerate(rows):
             chunks[i, :n] = req.prompt_ids[pos:pos + n]
             bts[i] = self._block_tables[req.slot]
@@ -298,7 +367,7 @@ class PagedInferenceEngine(_EngineBase):
             temps[i] = req.params.temperature
             topks[i] = req.params.top_k
         toks, self.caches = self._prefill_rows_fn(
-            r, self._sampling_mode([q for q, _, _ in rows]))(
+            rb, self._sampling_mode([q for q, _, _ in rows]))(
             self.params, self.caches, chunks, bts, sps, tls,
             self._rng_base, np.int32(self._rng_ctr), temps, topks)
         self._rng_ctr += 1

@@ -31,10 +31,14 @@ class PrefillReplica:
     """Owns a paged engine used exclusively for prefill; returns the KV
     payload (pages + first sampled token) instead of decoding."""
 
-    def __init__(self, engine_cfg, params=None, rng_seed: int = 0):
+    def __init__(self, engine_cfg, params=None, rng_seed: int = 0,
+                 warmup: bool = True):
         from .paged_engine import PagedInferenceEngine
         self.engine = PagedInferenceEngine(engine_cfg, params=params,
                                            rng_seed=rng_seed)
+        if warmup:
+            # prefill-only replica: never dispatches decode/verify
+            self.engine.warmup(families=("prefill",))
 
     def prefill(self, prompt, params: Optional[SamplingParams] = None):
         """Run chunked prefill; returns the exported KV payload dict
@@ -62,11 +66,15 @@ class DecodeReplica:
     stream — reference `_predict`'s async generator,
     prefill_decode_disagg.py:98)."""
 
-    def __init__(self, engine_cfg, params=None, rng_seed: int = 0):
+    def __init__(self, engine_cfg, params=None, rng_seed: int = 0,
+                 warmup: bool = True):
         import threading
         from .paged_engine import PagedInferenceEngine
         self.engine = PagedInferenceEngine(engine_cfg, params=params,
                                            rng_seed=rng_seed)
+        if warmup:
+            # decode-only replica: imported KV pages, no prefill programs
+            self.engine.warmup(families=("decode", "verify"))
         self._reqs: dict[int, Any] = {}
         self._next_rid = 0
         # serializes import_prefill against the stepping thread (the

@@ -40,6 +40,12 @@ class LLMConfig:
     tpus_per_replica: float = 0.0
     lora_dir: Optional[str] = None
     max_loras: int = 2
+    # compile every engine program family at replica init, before the
+    # replica reports ready (vLLM-style deploy-time graph capture) —
+    # keeps the first request burst from paying mid-burst XLA compiles.
+    # Sampled + top-k modes are warmed too when True.
+    warmup: bool = True
+    warmup_sampled: bool = False
 
 
 class LLMServer:
@@ -77,7 +83,13 @@ class LLMServer:
 
     def _build_engine(self, params):
         if isinstance(self.engine_cfg, PagedEngineConfig):
-            return PagedInferenceEngine(self.engine_cfg, params)
+            eng = PagedInferenceEngine(self.engine_cfg, params)
+            if self.cfg.warmup:
+                modes = [(False, False)]
+                if self.cfg.warmup_sampled:
+                    modes += [(True, False), (True, True)]
+                eng.warmup(sample_modes=tuple(modes))
+            return eng
         return InferenceEngine(self.engine_cfg, params)
 
     def _engines(self):

@@ -208,3 +208,37 @@ def test_spec_decode_beats_window_on_repetitive_text():
     spent = spec.stats["decode_dispatches"] + spec.stats["spec_dispatches"]
     assert spent < base.stats["decode_dispatches"], (
         spec.stats, base.stats)
+
+
+def test_warmup_covers_every_burst_program():
+    """After warmup(), a mixed burst (several prompt lengths, partial
+    final prefill pack, window-1 and full-window decodes, spec verify)
+    must trigger ZERO new jit entries: on a remote-attached accelerator
+    one mid-burst compile costs tens of requests' worth of TTFT, so the
+    row-bucketing + warmup contract is exactly 'no compiles after
+    deploy' (reference analog: vLLM's deploy-time graph capture,
+    vllm_engine.py:180)."""
+    rng = np.random.RandomState(3)
+    cfg = PagedEngineConfig(
+        model=llama.llama_tiny(vocab_size=258, max_seq_len=256),
+        max_batch_size=4, page_size=8, num_pages=128,
+        max_pages_per_seq=16, chunk_size=16, prefill_rows=3,
+        decode_window=4, spec_tokens=6)
+    eng = PagedInferenceEngine(cfg, rng_seed=0)
+    eng.warmup()
+    families = (eng._prefill_rows_fns, eng._decode_win_fns,
+                eng._verify_fns)
+    warmed = tuple(set(d) for d in families)
+    # odd prompt lengths force a partial final prefill pack; the
+    # self-similar prompt triggers the spec verify path solo
+    prompts = [list(rng.randint(1, 250, (n,))) for n in (5, 17, 33)]
+    prompts.append([7, 8, 9] * 6)
+    out = eng.generate(prompts, SamplingParams(max_tokens=24))
+    assert all(r["token_ids"] for r in out)
+    # spec verify only fires when EVERY active slot carries a draft — run
+    # the self-similar prompt solo so the verify family gets exercised
+    out2 = eng.generate([[7, 8, 9] * 6], SamplingParams(max_tokens=24))
+    assert out2[0]["token_ids"]
+    assert eng.stats["spec_dispatches"] > 0, eng.stats
+    for d, before in zip(families, warmed):
+        assert set(d) == before, (set(d) - before, "compiled mid-burst")
