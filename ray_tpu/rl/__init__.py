@@ -19,7 +19,8 @@ from .sac import SAC, SACAlgorithmConfig, SACConfig, SACLearner
 from .env_runner import EnvRunner, make_gym_env
 from .learner import PPOConfig, PPOLearner, compute_gae
 from .module import MLPConfig
-from .offline import (BC, BCConfig, CQL, CQLConfig, collect_transitions)
+from .offline import (BC, BCConfig, CQL, CQLConfig, MARWIL,
+                      MARWILConfig, collect_transitions)
 
 __all__ = [
     "APPO", "AppoAlgorithmConfig", "AppoConfig", "AppoLearner",
@@ -32,5 +33,6 @@ __all__ = [
     "MultiAgentPPOConfig",
     "PPO", "AlgorithmConfig", "EnvRunner", "make_gym_env",
     "PPOConfig", "PPOLearner", "compute_gae", "MLPConfig",
-    "BC", "BCConfig", "CQL", "CQLConfig", "collect_transitions",
+    "BC", "BCConfig", "CQL", "CQLConfig", "MARWIL", "MARWILConfig",
+    "collect_transitions",
 ]

@@ -187,6 +187,152 @@ class BCConfig(AlgorithmConfigBase):
 
 
 # --------------------------------------------------------------------------
+# MARWIL
+# --------------------------------------------------------------------------
+
+def _discounted_returns(rewards: np.ndarray, dones: np.ndarray,
+                        gamma: float) -> np.ndarray:
+    """Per-step discounted return-to-go, resetting at episode ends (the
+    dataset rows are in logging order; collect_transitions guarantees
+    that). The final partial episode is bootstrapped with 0 — the same
+    truncation the reference accepts for offline return targets."""
+    g, out = 0.0, np.zeros_like(rewards)
+    for i in range(len(rewards) - 1, -1, -1):
+        g = rewards[i] + gamma * (1.0 - dones[i]) * g
+        out[i] = g
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class MARWILHparams:
+    """(reference: marwil.py MARWILConfig.training(...))"""
+    lr: float = 1e-3
+    beta: float = 1.0                  # 0 => plain BC
+    gamma: float = 0.99
+    vf_coeff: float = 1.0
+    batch_size: int = 256
+    updates_per_iter: int = 64
+    # decay of the moving average of E[adv^2] normalizing the exponent
+    # (reference: MARWIL's ma_adv_norm update in its loss)
+    adv_norm_decay: float = 0.99
+
+
+class MARWIL(_OfflineAlgoBase):
+    """Monotonic advantage re-weighted imitation learning (Wang et al.
+    2018): imitation weighted by ``exp(beta * advantage)`` so the clone
+    prefers the dataset's better-than-average actions, plus a value head
+    regression that supplies the advantages. BC is exactly beta=0
+    (reference: rllib/algorithms/marwil/marwil.py — its BC subclasses
+    MARWIL the same way)."""
+
+    HPARAM_FIELD = "marwil"
+
+    def __init__(self, config: "MARWILConfig"):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self._setup_offline(config)
+        hp = config.marwil
+        params = module_lib.init(jax.random.PRNGKey(config.seed),
+                                 self.module_cfg)
+        opt = optax.adam(hp.lr)
+
+        returns = _discounted_returns(self._data["rewards"],
+                                      self._data["dones"], hp.gamma)
+        # scale-stabilize value targets (CartPole returns are O(100);
+        # raw-scale MSE would drown the policy term)
+        self._ret_scale = float(np.abs(returns).mean() + 1e-6)
+        data = {"obs": jnp.asarray(self._data["obs"]),
+                "actions": jnp.asarray(self._data["actions"]),
+                "returns": jnp.asarray(returns / self._ret_scale,
+                                       jnp.float32)}
+
+        def loss_fn(p, ma_norm, idx):
+            obs = data["obs"][idx]
+            acts = data["actions"][idx].astype(jnp.int32)
+            ret = data["returns"][idx]
+            logits, value = module_lib.logits_and_value(p, obs)
+            logp = jnp.take_along_axis(
+                jax.nn.log_softmax(logits, axis=-1), acts[:, None],
+                axis=-1)[:, 0]
+            adv = jax.lax.stop_gradient(ret - value)
+            ma_norm = hp.adv_norm_decay * ma_norm + \
+                (1.0 - hp.adv_norm_decay) * jnp.mean(adv ** 2)
+            # normalized exponent, clipped: one outlier advantage must
+            # not blow the exp into inf (reference normalizes by the
+            # moving RMS the same way)
+            expn = jnp.clip(hp.beta * adv * jax.lax.rsqrt(ma_norm + 1e-8),
+                            -20.0, 10.0)
+            pol = -(jnp.exp(expn) * logp).mean()
+            vf = 0.5 * ((value - ret) ** 2).mean()
+            return pol + hp.vf_coeff * vf, (ma_norm, pol, vf)
+
+        def one_update(carry, idx):
+            p, o, ma = carry
+            (loss, (ma, pol, vf)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(p, ma, idx)
+            upd, o = opt.update(grads, o, p)
+            return (optax.apply_updates(p, upd), o, ma), (loss, pol, vf)
+
+        @jax.jit
+        def run_updates(p, o, ma, all_idx):
+            (p, o, ma), (losses, pols, vfs) = jax.lax.scan(
+                one_update, (p, o, ma), all_idx)
+            return p, o, ma, losses.mean(), pols.mean(), vfs.mean()
+
+        class _Learner:
+            pass
+        self.learner = _Learner()
+        self.learner.params = params
+        self.learner.opt_state = opt.init(params)
+        self._ma_norm = jnp.asarray(1.0, jnp.float32)
+        self._run_updates = run_updates
+
+    def _extra_state(self) -> dict:
+        return {"ma_norm": np.asarray(self._ma_norm)}
+
+    def _load_extra_state(self, state: dict) -> None:
+        import jax.numpy as jnp
+        self._ma_norm = jnp.asarray(state["ma_norm"])
+
+    def train(self) -> dict:
+        import jax.numpy as jnp
+        hp = self.config.marwil
+        idx = jnp.asarray(self._minibatch_indices(hp.updates_per_iter,
+                                                  hp.batch_size))
+        p, o, ma, loss, pol, vf = self._run_updates(
+            self.learner.params, self.learner.opt_state, self._ma_norm,
+            idx)
+        self.learner.params = p
+        self.learner.opt_state = o
+        self._ma_norm = ma
+        self.iteration += 1
+        return {"training_iteration": self.iteration,
+                "marwil_loss": float(loss), "policy_loss": float(pol),
+                "vf_loss": float(vf),
+                "num_gradient_updates": self.iteration * hp.updates_per_iter}
+
+
+class MARWILConfig(AlgorithmConfigBase):
+    HPARAM_FIELD = "marwil"
+    HPARAM_FACTORY = MARWILHparams
+
+    @property
+    def ALGO_CLS(self):
+        return MARWIL
+
+    def __init__(self):
+        super().__init__()
+        self.dataset = None
+        self.num_env_runners = 1
+
+    def offline_data(self, dataset=None):
+        self.dataset = dataset
+        return self
+
+
+# --------------------------------------------------------------------------
 # CQL (discrete)
 # --------------------------------------------------------------------------
 
