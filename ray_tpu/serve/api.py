@@ -158,12 +158,21 @@ def _controller(create: bool = True):
 
 def run(app: Application, *, name: str = "default",
         route_prefix: Optional[str] = "/", blocking: bool = False,
-        http_port: Optional[int] = None) -> DeploymentHandle:
+        http_port: Optional[int] = None,
+        local_testing_mode: bool = False,
+        _local_testing_mode: bool = False) -> DeploymentHandle:
     """Deploy an application; returns the ingress handle
-    (reference: serve/api.py:691)."""
+    (reference: serve/api.py:691). With ``local_testing_mode=True`` the
+    whole application runs in-process with no cluster — unit-test speed
+    for composition/async/streaming logic (reference:
+    serve/_private/local_testing_mode.py; also accepted under the
+    reference's ``_local_testing_mode`` spelling)."""
     import cloudpickle
     from ..core.usage import record_library_usage
     record_library_usage("serve")
+    if local_testing_mode or _local_testing_mode:
+        from .local_mode import build_local_app
+        return build_local_app(app, name)
     ray = _ray()
     ctrl = _controller()
     specs_blob = cloudpickle.dumps(
@@ -178,6 +187,10 @@ def run(app: Application, *, name: str = "default",
 
 
 def get_app_handle(name: str = "default") -> DeploymentHandle:
+    from .local_mode import get_local_app
+    local = get_local_app(name)
+    if local is not None:
+        return local
     ray = _ray()
     ctrl = _controller(create=False)
     ingress = ray.get(ctrl.get_ingress.remote(name))
@@ -205,6 +218,10 @@ def status() -> dict:
 
 
 def delete(name: str = "default") -> None:
+    from .local_mode import delete_local_app, get_local_app
+    if get_local_app(name) is not None:
+        delete_local_app(name)
+        return
     ray = _ray()
     try:
         ctrl = _controller(create=False)

@@ -1,0 +1,113 @@
+"""Serve local testing mode: whole apps in-process, no cluster
+(reference: serve/_private/local_testing_mode.py via
+serve.run(app, _local_testing_mode=True))."""
+import asyncio
+
+import pytest
+
+from ray_tpu import serve
+
+
+@pytest.fixture(autouse=True)
+def _cleanup():
+    yield
+    serve.delete("default")
+    serve.delete("other")
+
+
+def test_local_mode_composition_and_methods():
+    @serve.deployment
+    class Scorer:
+        def __init__(self, offset):
+            self.offset = offset
+
+        def score(self, x):
+            return x * 2 + self.offset
+
+    @serve.deployment
+    class Ingress:
+        def __init__(self, scorer):
+            self.scorer = scorer
+
+        def __call__(self, x):
+            # nested response resolves before dispatch, like real handles
+            return self.scorer.score.remote(x).result() + 1
+
+    h = serve.run(Ingress.bind(Scorer.bind(10)), local_testing_mode=True)
+    assert h.remote(5).result() == 21
+    # no cluster side effects: status() reports no running controller apps
+    assert serve.get_app_handle("default") is h
+
+
+def test_local_mode_async_and_function_deployments():
+    @serve.deployment
+    async def double(x):
+        await asyncio.sleep(0.01)
+        return x * 2
+
+    h = serve.run(double.bind(), local_testing_mode=True)
+    assert h.remote(21).result(timeout_s=5) == 42
+
+
+def test_local_mode_streaming_and_user_config():
+    @serve.deployment(user_config={"step": 3})
+    class Gen:
+        def __init__(self):
+            self.step = 1
+
+        def reconfigure(self, cfg):
+            self.step = cfg["step"]
+
+        def stream(self, n):
+            for i in range(n):
+                yield i * self.step
+
+    h = serve.run(Gen.bind(), name="other", local_testing_mode=True)
+    got = list(h.options(method_name="stream", stream=True).remote(4))
+    assert got == [0, 3, 6, 9]
+
+
+def test_local_mode_reference_spelling():
+    @serve.deployment
+    def f():
+        return "ok"
+
+    h = serve.run(f.bind(), _local_testing_mode=True)
+    assert h.remote().result() == "ok"
+
+
+def test_local_mode_async_composition_no_deadlock():
+    """An async ingress passing a pending child response into another
+    child's .remote() must not deadlock the shared loop (dispatch runs on
+    the pool, never blocking the loop thread)."""
+    @serve.deployment
+    class Adder:
+        async def add(self, x, y):
+            await asyncio.sleep(0.01)
+            return x + y
+
+    @serve.deployment
+    class Ingress:
+        def __init__(self, a):
+            self.a = a
+
+        async def __call__(self, x):
+            r1 = self.a.add.remote(x, 1)       # pending child response
+            r2 = self.a.add.remote(r1, 10)     # nested composition
+            return await r2
+
+    h = serve.run(Ingress.bind(Adder.bind()), local_testing_mode=True)
+    assert h.remote(5).result(timeout_s=10) == 16
+
+
+def test_local_mode_async_generator_streaming():
+    @serve.deployment
+    class AGen:
+        async def stream(self, n):
+            for i in range(n):
+                await asyncio.sleep(0.001)
+                yield i * 2
+
+    h = serve.run(AGen.bind(), name="other", local_testing_mode=True)
+    got = list(h.options(method_name="stream", stream=True).remote(3))
+    assert got == [0, 2, 4]
