@@ -114,6 +114,8 @@ class ActorClass:
             label_selector=(dict(o["label_selector"])
                             if o["label_selector"] else None),
             named=qualify_actor_name(o["name"], o["namespace"], rt),
+            namespace=(o["namespace"]
+                       or getattr(rt, "namespace", None)),
             ready_oid=ready_oid,
             runtime_env=prepare_runtime_env(rt, o["runtime_env"]),
             concurrency_groups=o["concurrency_groups"],
@@ -169,6 +171,7 @@ class ActorMethod:
             method_name=self._name,
             concurrency_group=self._concurrency_group,
             trace_ctx=_trace_ctx(),
+            namespace=getattr(rt, "namespace", None),
         )
         refs = rt.submit_actor_task_spec(spec)
         h._track_pending(refs)
@@ -218,17 +221,17 @@ class ActorHandle:
             if len(self._pending) >= mp and hasattr(rt, "_rpc"):
                 # own-store nodes never see remote results in the local
                 # store; before refusing, ask the head which pending
-                # results exist anywhere (cost bounded to the saturated
-                # path — the backpressure boundary)
-                still = []
-                for r in self._pending:
-                    try:
-                        if not rt._rpc("locate", r.id().binary(),
-                                       timeout=10.0):
-                            still.append(r)
-                    except Exception:
-                        still.append(r)
-                self._pending = still
+                # results exist anywhere — ONE batched round-trip on the
+                # saturated path only (the backpressure boundary)
+                try:
+                    done = rt._rpc(
+                        "locate_many",
+                        [r.id().binary() for r in self._pending],
+                        timeout=10.0)
+                    self._pending = [r for r, d in
+                                     zip(self._pending, done) if not d]
+                except Exception:
+                    pass  # head unreachable: keep the conservative view
             if len(self._pending) >= mp:
                 from .. import exceptions as exc
                 raise exc.PendingCallsLimitExceeded(

@@ -146,6 +146,13 @@ def restore(rt, old_session_dir: str) -> dict:
     import dataclasses
     from .ids import ActorID, ObjectID
     for name, spec, blob in named:
+        # v1->v2 migration: pre-namespace snapshots stored unqualified
+        # names; qualify into the shared default namespace so
+        # get_actor("x") (which qualifies to "default/x") still finds
+        # every restored actor (actor.py qualify_actor_name)
+        if name and "/" not in name and not name.startswith("rtpu:"):
+            name = f"default/{name}"
+            spec = dataclasses.replace(spec, named=name)
         rt.register_function(spec.class_id, blob)
         # fresh ids: the old actor process is gone; what survives is the
         # named identity + class + init args (reference: detached actors

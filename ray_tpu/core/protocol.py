@@ -29,7 +29,9 @@ from __future__ import annotations
 PROTOCOL_VERSION = 2
 
 # Bump on any incompatible change to the sqlite snapshot contents.
-SNAPSHOT_SCHEMA_VERSION = 1
+# v2: named-actor keys are namespace-qualified ("ns/name"); v1 snapshots
+#     are migrated on restore (unqualified names -> "default/name").
+SNAPSHOT_SCHEMA_VERSION = 2
 
 
 class ProtocolMismatchError(ConnectionError):

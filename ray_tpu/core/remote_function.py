@@ -149,6 +149,7 @@ class RemoteFunction:
             label_selector=(dict(o["label_selector"])
                             if o["label_selector"] else None),
             max_calls=max(0, o["max_calls"]),
+            namespace=getattr(rt, "namespace", None),
             **strat,
         )
         refs = rt.submit_task(spec)

@@ -999,6 +999,7 @@ class Runtime:
                     "memory_summary", "autoscaler_status",
                     "user_metrics_dump", "pubsub_poll",
                     "kv_put", "kv_get", "kv_del", "kv_keys", "locate",
+                    "locate_many",
                     "job_submit", "job_list", "job_status", "job_logs",
                     "job_stop")
 
@@ -1017,6 +1018,24 @@ class Runtime:
             for n in self.nodes.values():
                 if n.alive and n.node_id.hex() in locs and n.data_addr:
                     out.append(n.data_addr)
+        return out
+
+    def locate_many(self, oids: list[bytes]) -> list[bool]:
+        """Existence (anywhere: any store, spill, or live holder node)
+        for a batch of objects in ONE round-trip — the saturated
+        max_pending_calls prune asks about every pending result at once
+        (actor.py _admit_pending) instead of one locate RPC per ref."""
+        out = []
+        with self.lock:
+            alive = {n.node_id.hex() for n in self.nodes.values()
+                     if n.alive}
+            for ob in oids:
+                oid = ObjectID(ob)
+                e = self.directory.get(oid)
+                locs = set(e.locations or ()) if e is not None else set()
+                out.append(bool(
+                    self.store.contains(oid) or self.spill.contains(oid)
+                    or (locs & alive)))
         return out
 
     # internal KV (gcs_kv_manager.h / ray.experimental.internal_kv analog);

@@ -56,6 +56,10 @@ class TaskSpec:
     # this many times; 0 = unlimited (reference: @ray.remote(max_calls=N),
     # the leaked-state/GPU-memory release valve)
     max_calls: int = 0
+    # submitting driver's namespace: in-task get_actor / named-actor
+    # creation resolve in it, not in the worker host's default (reference:
+    # tasks inherit the job's namespace)
+    namespace: Optional[str] = None
 
     @property
     def is_actor_task(self) -> bool:
@@ -93,6 +97,9 @@ class ActorSpec:
     # creation-readiness object: resolves when the actor __init__ finished
     ready_oid: Optional[ObjectID] = None
     runtime_env: Optional[dict] = None
+    # creating driver's namespace — the actor's methods resolve named
+    # actors in it (reference: an actor belongs to its job's namespace)
+    namespace: Optional[str] = None
 
     def __reduce__(self):
         # see TaskSpec.__reduce__ — same wire-format/versioning contract

@@ -43,9 +43,30 @@ from .task_spec import ActorSpec, TaskSpec
 from . import runtime as rt_mod
 
 
+import contextvars
+
+# active task's namespace (a ContextVar flows into coroutines too, so
+# async actor methods resolve names correctly — see _run_actor_task)
+_ACTIVE_NS: "contextvars.ContextVar" = contextvars.ContextVar(
+    "rtpu_active_namespace", default=None)
+
+
 class WorkerRuntime:
     """Worker-side implementation of the runtime interface used by the public
     API (`ray_tpu.get/put/wait/...` called *inside* a task or actor)."""
+
+    @property
+    def namespace(self) -> str:
+        """Namespace named-actor calls resolve in: the namespace of the
+        job that submitted the RUNNING task (or created the running
+        actor), falling back to the cluster default between tasks
+        (reference: tasks/actors inherit their job's namespace)."""
+        return _ACTIVE_NS.get() or self._default_ns
+
+    @namespace.setter
+    def namespace(self, value: str) -> None:
+        # drivers (DriverRuntime) set their own default at connect
+        self._default_ns = value
 
     def __init__(self, store: SharedObjectStore, conn, wid: str,
                  spill=None):
@@ -60,9 +81,10 @@ class WorkerRuntime:
         # own-store node: misses pull via object_transfer; RPC replies come
         # over the conn into this dict instead of the (invisible) head store
         self.own_store = os.environ.get("RTPU_OWN_STORE") == "1"
-        # in-task get_actor/named-actor creation resolve in the job's
-        # namespace (core/actor.py qualify_actor_name)
-        self.namespace = os.environ.get("RTPU_NAMESPACE", "default")
+        # fallback namespace when no task is executing (the head's);
+        # during execution the SUBMITTING driver's namespace is active
+        # (core/actor.py qualify_actor_name reads self.namespace)
+        self._default_ns = os.environ.get("RTPU_NAMESPACE", "default")
         self._rpc_replies: dict[bytes, object] = {}
         self._rpc_reply_evt = threading.Event()
         self._rpc_abandoned: set[bytes] = set()
@@ -503,6 +525,7 @@ class WorkerLoop:
         self.rt.current_task_name = spec.name
         t0 = time.time()
         span_rec = None
+        ns_tok = _ACTIVE_NS.set(getattr(spec, "namespace", None))
         try:
             if self._renv_error is not None:
                 raise self._renv_error
@@ -537,6 +560,7 @@ class WorkerLoop:
                         pass
         finally:
             self._current_task_id = None
+            _ACTIVE_NS.reset(ns_tok)
         self.rt._did_block = False
         done_msg = {"t": "done", "task_id": spec.task_id, "ok": ok,
                     "err": err, "retryable": retryable, "name": spec.name,
@@ -561,6 +585,10 @@ class WorkerLoop:
                 os._exit(0)
 
     def _run_actor_create(self, spec: ActorSpec):
+        # the actor lives in its creating job's namespace: __init__ AND
+        # every later method call resolve names there
+        self._actor_ns = getattr(spec, "namespace", None)
+        ns_tok = _ACTIVE_NS.set(self._actor_ns)
         try:
             if self._renv_error is not None:
                 raise self._renv_error
@@ -591,6 +619,8 @@ class WorkerLoop:
             tb = traceback.format_exc()
             self.rt.send({"t": "actor_ready", "actor_id": spec.actor_id,
                           "ok": False, "err": tb})
+        finally:
+            _ACTIVE_NS.reset(ns_tok)
 
     def _run_actor_task(self, spec: TaskSpec):
         t0 = time.time()
@@ -621,6 +651,20 @@ class WorkerLoop:
                 method = getattr(self.actor_instance, spec.method_name)
             tctx = getattr(spec, "trace_ctx", None)
 
+            # methods resolve names in the actor's CREATION namespace
+            # (reference: an actor belongs to its job's namespace), not
+            # the caller's; async methods get it via the coroutine
+            # wrapper since a thread-local set here wouldn't cross into
+            # the event loop
+            actor_ns = getattr(self, "_actor_ns", None)
+
+            async def _with_ns(coro):
+                tok = _ACTIVE_NS.set(actor_ns)
+                try:
+                    return await coro
+                finally:
+                    _ACTIVE_NS.reset(tok)
+
             def _invoke():
                 # async methods run on the actor's event loop; the span
                 # wraps the synchronous wait so sync and async methods
@@ -628,9 +672,13 @@ class WorkerLoop:
                 # actor methods regardless of kind)
                 if asyncio.iscoroutinefunction(method):
                     fut = asyncio.run_coroutine_threadsafe(
-                        method(*args, **kwargs), self.aio_loop)
+                        _with_ns(method(*args, **kwargs)), self.aio_loop)
                     return fut.result()
-                return method(*args, **kwargs)
+                tok = _ACTIVE_NS.set(actor_ns)
+                try:
+                    return method(*args, **kwargs)
+                finally:
+                    _ACTIVE_NS.reset(tok)
 
             if tctx is not None:
                 from ..util.tracing import activate

@@ -173,6 +173,11 @@ def run(app: Application, *, name: str = "default",
     if local_testing_mode or _local_testing_mode:
         from .local_mode import build_local_app
         return build_local_app(app, name)
+    # a cluster deploy supersedes any local-mode app of the same name —
+    # otherwise get_app_handle/delete keep shadowing the cluster app with
+    # the stale in-process one
+    from .local_mode import delete_local_app
+    delete_local_app(name)
     ray = _ray()
     ctrl = _controller()
     specs_blob = cloudpickle.dumps(
@@ -218,10 +223,10 @@ def status() -> dict:
 
 
 def delete(name: str = "default") -> None:
-    from .local_mode import delete_local_app, get_local_app
-    if get_local_app(name) is not None:
-        delete_local_app(name)
-        return
+    from .local_mode import delete_local_app
+    # drop any local-mode app of this name AND fall through to the
+    # cluster: both can exist if local and cluster runs interleaved
+    delete_local_app(name)
     ray = _ray()
     try:
         ctrl = _controller(create=False)

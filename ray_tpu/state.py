@@ -213,8 +213,6 @@ def memory_summary(limit: int = 1000) -> dict:
             rows.append({
                 "object_id": oid.hex(),
                 "state": _STATE_NAMES.get(e.state, str(e.state)),
-                "in_store": rt.store.contains(oid),
-                "spilled": rt.spill.contains(oid),
                 "ref_holders": holders,
                 "num_refs": len(holders),
                 "transfer_pins": rt.xfer_pins.get(oid, 0),
@@ -225,7 +223,7 @@ def memory_summary(limit: int = 1000) -> dict:
         rows.sort(key=lambda r: (not r["pinned"], -r["num_refs"]))
         task_holders = sum(1 for r in rows for h in r["ref_holders"]
                            if h.startswith("task:"))
-        return {
+        out = {
             "objects": rows[:limit],
             "num_objects_tracked": len(rt.directory),
             "num_task_arg_refs": task_holders,
@@ -237,6 +235,15 @@ def memory_summary(limit: int = 1000) -> dict:
                 "evictions": rt.store.evictions(),
             },
         }
+    # store/spill residency probes (shm lookup + file stat per object)
+    # run OUTSIDE the head lock and only for the rows actually returned —
+    # a huge directory must not stall scheduling for a capped listing
+    from .core.ids import ObjectID as _OID
+    for r in out["objects"]:
+        oid = _OID(bytes.fromhex(r["object_id"]))
+        r["in_store"] = rt.store.contains(oid)
+        r["spilled"] = rt.spill.contains(oid)
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -77,3 +77,41 @@ def test_max_pending_calls_backpressure(ray):
     assert ray_tpu.get([r1, r2], timeout=60) == ["done", "done"]
     r3 = a.work.remote()
     assert ray_tpu.get(r3, timeout=60) == "done"
+
+
+def test_in_task_namespace_resolution(ray):
+    """Tasks resolve named actors in the SUBMITTING driver's namespace,
+    and an actor's methods resolve in its CREATING job's namespace —
+    not in the worker host's default (reference: runtime-context
+    namespace inheritance)."""
+    import ray_tpu.core.runtime as rt_mod
+
+    @ray_tpu.remote
+    class Named:
+        def who(self):
+            return "me"
+
+    Named.options(name="tgt", namespace="nsX").remote()
+
+    # pretend this driver runs in nsX: tasks it submits must inherit it
+    rt = rt_mod.get_runtime_if_exists()
+    old = getattr(rt, "namespace", "default")
+    rt.namespace = "nsX"
+    try:
+        @ray_tpu.remote
+        def find():
+            h = ray_tpu.get_actor("tgt")       # no explicit namespace
+            return ray_tpu.get(h.who.remote(), timeout=60)
+
+        assert ray_tpu.get(find.remote(), timeout=120) == "me"
+
+        @ray_tpu.remote
+        class Finder:
+            async def afind(self):
+                h = ray_tpu.get_actor("tgt")   # async path: contextvar
+                return ray_tpu.get(h.who.remote(), timeout=60)
+
+        f = Finder.remote()
+        assert ray_tpu.get(f.afind.remote(), timeout=120) == "me"
+    finally:
+        rt.namespace = old

@@ -151,3 +151,42 @@ def test_memory_monitor_kills_and_task_retries(ray_start_regular):
     # the retriable task must still complete via the crash-retry path
     assert ray.get(ref, timeout=120) == "done"
     assert mon.kills == 1
+
+
+def test_v1_snapshot_named_actor_migrates_to_default_namespace(tmp_path):
+    """Snapshots written before namespace qualification stored bare
+    actor names; restore must qualify them into 'default/' so
+    get_actor('x') (which qualifies its lookup) still finds every
+    restored actor (protocol.SNAPSHOT_SCHEMA_VERSION v2 note)."""
+    import cloudpickle
+    import pickle
+
+    import ray_tpu
+    from ray_tpu.core.gcs_store import restore
+    from ray_tpu.core.ids import ActorID, ObjectID
+    from ray_tpu.core.task_spec import ActorSpec
+
+    class Legacy:
+        def ping(self):
+            return "pong"
+
+    blob = cloudpickle.dumps(Legacy)
+    spec = ActorSpec(
+        actor_id=ActorID.from_random(), class_id="cls_legacy",
+        name="Legacy", args_blob=cloudpickle.dumps(((), {})),
+        dep_oids=[], resources={}, named="survivor",   # v1: unqualified
+        ready_oid=ObjectID.from_random())
+    sdir = tmp_path / "old_session"
+    sdir.mkdir()
+    s = GcsStore(str(sdir / "gcs.sqlite"))
+    s.put("snapshot", "named_actors",
+          pickle.dumps([("survivor", spec, blob)]))
+    s.put("snapshot", "meta", pickle.dumps({"schema_version": 1}))
+    s.close()
+
+    ray_tpu.init(num_cpus=1, resume_from=str(sdir))
+    try:
+        h = ray_tpu.get_actor("survivor")       # default-namespace lookup
+        assert ray_tpu.get(h.ping.remote(), timeout=60) == "pong"
+    finally:
+        ray_tpu.shutdown()

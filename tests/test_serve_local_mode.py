@@ -111,3 +111,31 @@ def test_local_mode_async_generator_streaming():
     h = serve.run(AGen.bind(), name="other", local_testing_mode=True)
     got = list(h.options(method_name="stream", stream=True).remote(3))
     assert got == [0, 2, 4]
+
+
+def test_cluster_run_supersedes_local_app(ray_start_regular):
+    """A cluster deploy of the same app name clears the local-mode
+    registry entry, so get_app_handle returns the CLUSTER handle, not
+    the stale in-process one."""
+    @serve.deployment
+    def v1():
+        return "local"
+
+    @serve.deployment
+    def v2():
+        return "cluster"
+
+    serve.run(v1.bind(), local_testing_mode=True)
+    try:
+        h = serve.run(v2.bind())
+        try:
+            assert h.remote().result(timeout_s=60) == "cluster"
+            from ray_tpu.serve.local_mode import get_local_app
+            assert get_local_app("default") is None
+            # the app-handle lookup now routes to the cluster app
+            assert serve.get_app_handle("default").remote().result(
+                timeout_s=60) == "cluster"
+        finally:
+            serve.delete("default")
+    finally:
+        serve.shutdown()
