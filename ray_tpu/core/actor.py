@@ -218,16 +218,20 @@ class ActorHandle:
                                  if not store.contains(r.id())]
             else:  # local mode executes inline; nothing can be pending
                 self._pending = []
-            if len(self._pending) >= mp and hasattr(rt, "_rpc"):
-                # own-store nodes never see remote results in the local
-                # store; before refusing, ask the head which pending
-                # results exist anywhere — ONE batched round-trip on the
-                # saturated path only (the backpressure boundary)
+            if len(self._pending) >= mp:
+                # the local store can miss settled results (remote-node
+                # stores, FAILED-without-result crashes); before
+                # refusing, ask the head which pending results settled —
+                # ONE batched round-trip (or direct call on the head
+                # driver), on the saturated path only
                 try:
-                    done = rt._rpc(
-                        "locate_many",
-                        [r.id().binary() for r in self._pending],
-                        timeout=10.0)
+                    obs = [r.id().binary() for r in self._pending]
+                    if hasattr(rt, "locate_many"):   # in-process head
+                        done = rt.locate_many(obs)
+                    elif hasattr(rt, "_rpc"):
+                        done = rt._rpc("locate_many", obs, timeout=10.0)
+                    else:
+                        done = [False] * len(obs)
                     self._pending = [r for r, d in
                                      zip(self._pending, done) if not d]
                 except Exception:

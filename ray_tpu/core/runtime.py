@@ -1021,21 +1021,33 @@ class Runtime:
         return out
 
     def locate_many(self, oids: list[bytes]) -> list[bool]:
-        """Existence (anywhere: any store, spill, or live holder node)
-        for a batch of objects in ONE round-trip — the saturated
-        max_pending_calls prune asks about every pending result at once
-        (actor.py _admit_pending) instead of one locate RPC per ref."""
-        out = []
+        """Settled-ness (a result exists anywhere — any store, spill, or
+        live holder node — or the task terminally FAILED) for a batch of
+        objects in ONE round-trip: the saturated max_pending_calls prune
+        asks about every pending result at once (actor.py
+        _admit_pending) instead of one locate RPC per ref. FAILED counts
+        as settled — an errored call is not in flight (runtime.wait's
+        'errors count as ready' rule). Store/spill probes (shm lookup +
+        file stat each) run OUTSIDE the head lock, same reasoning as
+        state.memory_summary."""
+        undecided: list[tuple[int, ObjectID]] = []
+        out = [False] * len(oids)
         with self.lock:
             alive = {n.node_id.hex() for n in self.nodes.values()
                      if n.alive}
-            for ob in oids:
+            for i, ob in enumerate(oids):
                 oid = ObjectID(ob)
                 e = self.directory.get(oid)
+                if e is not None and e.state == FAILED:
+                    out[i] = True
+                    continue
                 locs = set(e.locations or ()) if e is not None else set()
-                out.append(bool(
-                    self.store.contains(oid) or self.spill.contains(oid)
-                    or (locs & alive)))
+                if locs & alive:
+                    out[i] = True
+                else:
+                    undecided.append((i, oid))
+        for i, oid in undecided:
+            out[i] = self.store.contains(oid) or self.spill.contains(oid)
         return out
 
     # internal KV (gcs_kv_manager.h / ray.experimental.internal_kv analog);
@@ -2818,7 +2830,9 @@ class Runtime:
             if deadline is not None and time.monotonic() >= deadline:
                 break
             time.sleep(0.005)
-        return ready, pending
+        # reference contract: at most num_returns refs in ready; extra
+        # already-ready refs stay in the remaining list
+        return ready[:num_returns], ready[num_returns:] + pending
 
     def cancel(self, ref: ObjectRef, force: bool = False,
                recursive: bool = True):

@@ -316,7 +316,9 @@ class WorkerRuntime:
                 for r in pending:
                     self._try_fetch(r.id())
             time.sleep(0.002)
-        return ready, pending
+        # reference contract: at most num_returns refs in ready; extra
+        # already-ready refs stay in the remaining list
+        return ready[:num_returns], ready[num_returns:] + pending
 
     # -- task/actor API ----------------------------------------------------
 

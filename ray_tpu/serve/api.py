@@ -223,10 +223,16 @@ def status() -> dict:
 
 
 def delete(name: str = "default") -> None:
-    from .local_mode import delete_local_app
-    # drop any local-mode app of this name AND fall through to the
-    # cluster: both can exist if local and cluster runs interleaved
+    import ray_tpu
+
+    from .local_mode import delete_local_app, get_local_app
+    # drop any local-mode app of this name; fall through to the cluster
+    # only if one is ALREADY running (a purely-local session must not
+    # boot a whole cluster just to tear down an in-process app)
+    had_local = get_local_app(name) is not None
     delete_local_app(name)
+    if had_local and not ray_tpu.is_initialized():
+        return
     ray = _ray()
     try:
         ctrl = _controller(create=False)

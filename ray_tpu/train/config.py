@@ -5,7 +5,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
-from typing import Optional
+from typing import Any, Optional
 
 
 @dataclasses.dataclass
@@ -77,6 +77,10 @@ class RunConfig:
         default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = dataclasses.field(
         default_factory=CheckpointConfig)
+    # tune.reporter.CLIReporter (or any object with its hook surface);
+    # the Tuner's result loop feeds it (reference:
+    # RunConfig.progress_reporter / tune/progress_reporter.py)
+    progress_reporter: Optional[Any] = None
 
     def resolved_storage_path(self) -> str:
         return self.storage_path or os.path.join(

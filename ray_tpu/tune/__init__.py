@@ -28,6 +28,7 @@ from .schedulers import (
     PopulationBasedTraining,
 )
 from .optuna_search import OptunaSearch
+from .reporter import CLIReporter
 from .tuner import ResultGrid, TuneConfig, Tuner
 from ..train.session import get_context
 from ..train import Checkpoint
@@ -36,6 +37,7 @@ from ..train import Checkpoint
 from ..train.session import report, get_checkpoint  # noqa: F401
 
 __all__ = [
+    "CLIReporter",
     "Tuner", "TuneConfig", "ResultGrid", "grid_search", "choice", "uniform",
     "loguniform", "randint", "qrandint", "quniform", "sample_from",
     "FIFOScheduler", "ASHAScheduler", "MedianStoppingRule",

@@ -239,6 +239,9 @@ class Tuner:
         tc = self.tune_config
         sched = tc.scheduler
         sched.setup(tc.metric, tc.mode)
+        reporter = getattr(self.run_config, "progress_reporter", None)
+        if reporter is not None:
+            reporter.setup(tc.metric)
         run_name = self.run_config.name or f"tune_{uuid.uuid4().hex[:8]}"
         storage = os.path.join(self.run_config.resolved_storage_path(),
                                run_name)
@@ -346,6 +349,9 @@ class Tuner:
                     metrics = dict(metrics)
                     metrics.setdefault("training_iteration", t.iteration)
                     t.results.append(metrics)
+                    if reporter is not None:
+                        reporter.on_result(t.index, t.config, metrics,
+                                           t.status)
                     if ckpt_path:
                         t.last_checkpoint = Checkpoint(ckpt_path)
                     decision = CONTINUE
@@ -379,6 +385,15 @@ class Tuner:
                 self._save_experiment(storage, trials, fn_blob)
             except Exception:
                 pass
+            if reporter is not None:
+                try:
+                    # a misbehaving user reporter must never mask the
+                    # real in-flight exception or eat the ResultGrid
+                    for t in trials:
+                        reporter.on_trial_complete(t.index, t.status)
+                    reporter.final()
+                except Exception:
+                    pass
 
         return ResultGrid([TrialResult(t) for t in trials],
                           tc.metric, tc.mode)

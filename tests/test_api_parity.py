@@ -115,3 +115,23 @@ def test_in_task_namespace_resolution(ray):
         assert ray_tpu.get(f.afind.remote(), timeout=120) == "me"
     finally:
         rt.namespace = old
+
+
+def test_max_pending_calls_prunes_failed_results(ray):
+    """Errored calls are not in flight: a handle whose every call raised
+    must admit new calls (FAILED counts as settled in the prune —
+    locate_many's 'errors count as ready' rule)."""
+    @ray_tpu.remote
+    class Boom:
+        def go(self, ok=False):
+            if not ok:
+                raise ValueError("nope")
+            return "fine"
+
+    a = Boom.options(max_pending_calls=2).remote()
+    refs = [a.go.remote(), a.go.remote()]
+    for r in refs:
+        with pytest.raises(ValueError):
+            ray_tpu.get(r, timeout=60)
+    # both settled (as errors): the handle must admit again
+    assert ray_tpu.get(a.go.remote(True), timeout=60) == "fine"

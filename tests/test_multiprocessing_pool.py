@@ -1,0 +1,59 @@
+"""multiprocessing.Pool-compatible pool over cluster tasks (reference:
+python/ray/util/multiprocessing/pool.py)."""
+import pytest
+
+from ray_tpu.util.multiprocessing import Pool
+
+
+@pytest.fixture
+def ray(ray_start_regular):
+    return ray_start_regular
+
+
+def _sq(x):
+    return x * x
+
+
+def _add(a, b):
+    return a + b
+
+
+def test_map_and_chunking(ray):
+    with Pool(processes=2) as p:
+        assert p.map(_sq, range(50)) == [i * i for i in range(50)]
+        assert p.map(_sq, range(7), chunksize=3) == [i * i
+                                                     for i in range(7)]
+
+
+def test_starmap_apply_async(ray):
+    with Pool(processes=2) as p:
+        assert p.starmap(_add, [(1, 2), (3, 4)]) == [3, 7]
+        r = p.apply_async(_add, (10, 5))
+        assert r.get(timeout=60) == 15
+        assert p.apply(_add, (2, 2)) == 4
+
+
+def test_imap_orders_and_unordered_completes(ray):
+    with Pool(processes=2) as p:
+        assert list(p.imap(_sq, range(10), chunksize=2)) == \
+            [i * i for i in range(10)]
+        assert sorted(p.imap_unordered(_sq, range(10), chunksize=2)) == \
+            sorted(i * i for i in range(10))
+
+
+def _set_env(k, v):
+    import os
+    os.environ[k] = v
+
+
+def _read_env(_):
+    import os
+    return os.environ.get("_POOL_INIT")
+
+
+def test_initializer_and_closed_pool(ray):
+    with Pool(processes=1, initializer=_set_env,
+              initargs=("_POOL_INIT", "1")) as p:
+        assert p.map(_read_env, [0]) == ["1"]
+    with pytest.raises(ValueError):
+        p.map(_sq, [1])
