@@ -54,6 +54,9 @@ _PAGE = """<!DOCTYPE html>
 <h2>Serve</h2><table id="serve"></table>
 <h2>Autoscaler</h2><table id="autoscaler"></table>
 <h2>Recent tasks</h2><table id="tasks"></table>
+<h2>Memory <small>(<a href="api/timeline" download="timeline.json">
+download chrome trace</a>)</small></h2>
+<div id="memsum"></div><table id="memory"></table>
 <h2>Detail</h2><pre id="detail"
  style="background:#fff;border:1px solid #ddd;padding:8px;min-height:2em;
         font-size:0.8em;white-space:pre-wrap">click a task or actor id</pre>
@@ -146,6 +149,19 @@ async function refresh() {
                         click: () => detail('api/task/' + t.task_id)},
                        t.name, {pill: t.state}, t.worker || '',
                        t.duration_s ? t.duration_s.toFixed(3) + 's' : '']));
+  const mem = await (await fetch('api/memory?limit=25')).json();
+  document.getElementById('memsum').textContent =
+    `${mem.num_objects_tracked} objects tracked | ` +
+    `${mem.num_transfer_pins} transfer pins | ` +
+    `${mem.num_task_arg_refs} task-arg refs | store ` +
+    `${(mem.object_store.bytes_in_use/1048576).toFixed(1)}MB in ` +
+    `${mem.object_store.num_objects} objects`;
+  fill('memory', ['object', 'state', 'refs', 'holders', 'pins',
+                  'in store', 'spilled', 'pinned'],
+       mem.objects.map(o => [o.object_id.slice(0, 16), {pill: o.state},
+                             o.num_refs, o.ref_holders.join(','),
+                             o.transfer_pins, o.in_store ? 'y' : '',
+                             o.spilled ? 'y' : '', o.pinned ? 'y' : '']));
   const logs = await (await fetch('api/logs')).json();
   fill('logs', ['file', 'size'],
        logs.map(l => [{text: l.file, click: () => showLog(l.file)},
@@ -189,6 +205,10 @@ def start_dashboard(port: int = 0, host: str = "127.0.0.1") -> int:
                 # remote round-trip: keep it off the dashboard event loop
                 loop = asyncio.get_event_loop()
                 out = await loop.run_in_executor(None, serve_api.status)
+            elif kind == "memory":
+                out = state_api.memory_summary(limit)
+            elif kind == "timeline":
+                out = rt.timeline()
             elif kind in ("tasks", "actors", "objects", "nodes", "workers"):
                 fn = getattr(state_api, f"list_{kind}")
                 out = fn(limit) if kind in ("tasks", "actors",

@@ -38,24 +38,85 @@ class DQNConfig:
     eps_decay_steps: int = 10_000
     learning_starts: int = 1_000       # env steps before updates begin
     huber_delta: float = 1.0
+    # Rainbow components (reference: dqn.py's Rainbow configuration —
+    # n_step, dueling, prioritized replay; each independently toggleable)
+    n_step: int = 1                    # multi-step TD targets
+    dueling: bool = False              # Q = V + A - mean(A) (two heads)
+    prioritized_replay: bool = False   # PER (Schaul et al. 2016)
+    per_alpha: float = 0.6             # priority exponent
+    per_beta: float = 0.4              # IS-correction start (anneals to 1)
+    per_beta_anneal_steps: int = 50_000   # in gradient updates
+    per_eps: float = 1e-6              # priority floor
+
+
+def nstep_transitions(obs, actions, rewards, next_obs, dones,
+                      T: int, E: int, n: int, gamma: float,
+                      ends=None):
+    """Collapse a [T*E] rollout fragment into n-step transitions.
+
+    Per env column, each step t gets return sum_k gamma^k r_{t+k} over
+    its window, the window's LAST next_obs/done, and the EFFECTIVE
+    discount gamma^len(window) — so a shortened window is still an exact
+    (shorter) multi-step target, not a biased one (reference: Rainbow's
+    n-step component; rllib stores n_step per batch the same way).
+
+    Windows cut at ``ends`` (term OR trunc — any episode boundary: a
+    time-limit truncation still separates episodes, so rewards must
+    never sum across it) while ``dones`` (term only, when the true final
+    obs is known) stays the bootstrap mask. Without ``ends``, ``dones``
+    cuts — correct only when the collector treats truncation as
+    terminal.
+    """
+    N = T * E
+    R = np.zeros(N, np.float32)
+    nxt = np.empty_like(next_obs)
+    dn = np.zeros(N, np.float32)
+    gm = np.empty(N, np.float32)
+    r2 = rewards.reshape(T, E)
+    e2 = (dones if ends is None else ends).reshape(T, E)
+    for e in range(E):
+        for t in range(T):
+            acc, g = 0.0, 1.0
+            k = 0
+            while True:
+                acc += g * float(r2[t + k, e])
+                g *= gamma
+                if e2[t + k, e] or k == n - 1 or t + k == T - 1:
+                    break
+                k += 1
+            i = t * E + e
+            j = (t + k) * E + e
+            R[i] = acc
+            nxt[i] = next_obs[j]
+            dn[i] = dones[j]
+            gm[i] = g
+    return {"obs": obs, "actions": actions, "rewards": R,
+            "next_obs": nxt, "dones": dn, "gammas": gm}
 
 
 class ReplayBuffer:
-    """Uniform ring buffer over transitions (reference:
-    utils/replay_buffers/episode_replay_buffer.py, reduced to the uniform
-    case)."""
+    """Ring buffer over transitions, uniform or prioritized (reference:
+    utils/replay_buffers/ episode_replay_buffer.py +
+    prioritized_episode_replay_buffer.py, reduced to the flat case)."""
 
-    def __init__(self, capacity: int, obs_dim: int):
+    def __init__(self, capacity: int, obs_dim: int, gamma: float = 0.99):
         self.capacity = capacity
         self.obs = np.empty((capacity, obs_dim), np.float32)
         self.next_obs = np.empty((capacity, obs_dim), np.float32)
         self.actions = np.empty((capacity,), np.int32)
         self.rewards = np.empty((capacity,), np.float32)
         self.dones = np.empty((capacity,), np.float32)
+        # per-transition effective discount (gamma^n_step_len)
+        self.gammas = np.full((capacity,), gamma, np.float32)
+        # PER priorities; new entries get the max seen so every
+        # transition is trained on at least once (Schaul et al. §3.3)
+        self.prios = np.ones((capacity,), np.float64)
+        self.max_prio = 1.0
         self.size = 0
         self.pos = 0
 
-    def add_batch(self, obs, actions, rewards, next_obs, dones):
+    def add_batch(self, obs, actions, rewards, next_obs, dones,
+                  gammas=None):
         n = len(actions)
         idx = (self.pos + np.arange(n)) % self.capacity
         self.obs[idx] = obs
@@ -63,12 +124,33 @@ class ReplayBuffer:
         self.actions[idx] = actions
         self.rewards[idx] = rewards
         self.dones[idx] = dones
+        if gammas is not None:
+            self.gammas[idx] = gammas
+        self.prios[idx] = self.max_prio
         self.pos = int((self.pos + n) % self.capacity)
         self.size = int(min(self.size + n, self.capacity))
 
     def sample_indices(self, rng: np.random.Generator, batch: int,
                       k: int) -> np.ndarray:
         return rng.integers(0, self.size, size=(k, batch))
+
+    def sample_prioritized(self, rng: np.random.Generator, batch: int,
+                           k: int, alpha: float, beta: float):
+        """(indices [k,batch], IS weights [k,batch] normalized by their
+        max) — probability p_i^alpha / sum, weights (N P_i)^-beta."""
+        p = self.prios[:self.size] ** alpha
+        P = p / p.sum()
+        idx = rng.choice(self.size, size=(k, batch), p=P)
+        w = (self.size * P[idx]) ** (-beta)
+        w = w / w.max()
+        return idx, w.astype(np.float32)
+
+    def update_priorities(self, idx: np.ndarray, td_abs: np.ndarray,
+                          eps: float) -> None:
+        pr = np.abs(td_abs).astype(np.float64).ravel() + eps
+        self.prios[idx.ravel()] = pr
+        m = float(pr.max()) if len(pr) else 1.0
+        self.max_prio = max(self.max_prio, m)
 
 
 class DQNRunner:
@@ -99,6 +181,7 @@ class DQNRunner:
         act_b = np.empty((T * E,), np.int32)
         rew_b = np.empty((T * E,), np.float32)
         done_b = np.empty((T * E,), np.float32)
+        end_b = np.empty((T * E,), np.float32)  # term|trunc: episode cut
         n_actions = self._venv.single_action_space.n
         for t in range(T):
             greedy = np.asarray(self._q_fn(
@@ -130,6 +213,7 @@ class DQNRunner:
             act_b[sl] = action
             rew_b[sl] = rew
             done_b[sl] = done_for_td
+            end_b[sl] = ended.astype(np.float32)
             self._ep_return += rew
             for i in np.nonzero(np.logical_or(term, trunc))[0]:
                 self._completed.append(float(self._ep_return[i]))
@@ -137,8 +221,9 @@ class DQNRunner:
             self._obs = nxt
         episodes, self._completed = self._completed, []
         return {"obs": obs_b, "actions": act_b, "rewards": rew_b,
-                "next_obs": nxt_b, "dones": done_b,
-                "episode_returns": episodes}
+                "next_obs": nxt_b, "dones": done_b, "ends": end_b,
+                "episode_returns": episodes,
+                "rollout_len": T, "num_envs": E}
 
     def evaluate(self, params, num_episodes: int = 5) -> dict:
         import jax
@@ -182,7 +267,13 @@ class DQNLearner:
         cfg = self.cfg
 
         def q_values(params, obs):
-            logits, _ = module_lib.logits_and_value(params, obs)
+            logits, value = module_lib.logits_and_value(params, obs)
+            if cfg.dueling:
+                # Q = V + A - mean(A): the module's value head is the
+                # state-value stream, the pi head the advantage stream
+                # (reference: Rainbow's dueling architecture)
+                return value[..., None] + logits - \
+                    logits.mean(axis=-1, keepdims=True)
             return logits  # the pi head doubles as the Q head
 
         def loss_fn(params, target_params, batch):
@@ -198,60 +289,78 @@ class DQNLearner:
                     q_next_t, a_star[:, None], 1)[:, 0]
             else:
                 q_next = q_next_t.max(axis=-1)
-            target = batch["rewards"] + cfg.gamma * (
+            # per-sample effective discount: gamma^window for n-step
+            target = batch["rewards"] + batch["gammas"] * (
                 1.0 - batch["dones"]) * jax.lax.stop_gradient(q_next)
             td = q_a - target
-            # huber
+            # huber, importance-weighted (weights are 1 without PER)
             adelta = jnp.abs(td)
             loss = jnp.where(
                 adelta <= cfg.huber_delta,
                 0.5 * td ** 2,
                 cfg.huber_delta * (adelta - 0.5 * cfg.huber_delta))
-            return loss.mean(), (jnp.abs(td).mean(), q_a.mean())
+            return (batch["weights"] * loss).mean(), (
+                adelta, adelta.mean(), q_a.mean())
 
         def k_updates(params, target_params, opt_state, data, idx):
             def one(carry, i):
                 params, opt_state = carry
                 batch = {k: v[i] for k, v in data.items()}
-                (loss, (td, qm)), grads = jax.value_and_grad(
+                (loss, (td_abs, td, qm)), grads = jax.value_and_grad(
                     loss_fn, has_aux=True)(params, target_params, batch)
                 updates, opt_state = self.optimizer.update(
                     grads, opt_state, params)
                 import optax
                 params = optax.apply_updates(params, updates)
-                return (params, opt_state), (loss, td, qm)
+                return (params, opt_state), (loss, td_abs, td, qm)
 
-            (params, opt_state), (losses, tds, qms) = jax.lax.scan(
+            (params, opt_state), (losses, td_abs, tds, qms) = jax.lax.scan(
                 one, (params, opt_state), jnp.arange(idx.shape[0]))
-            return params, opt_state, losses.mean(), tds.mean(), qms.mean()
+            return (params, opt_state, losses.mean(), tds.mean(),
+                    qms.mean(), td_abs)
 
         def update(params, target_params, opt_state, obs, actions, rewards,
-                   next_obs, dones, idx):
+                   next_obs, dones, gammas, weights, idx):
             data = {
                 "obs": obs[idx], "actions": actions[idx],
                 "rewards": rewards[idx], "next_obs": next_obs[idx],
-                "dones": dones[idx],
+                "dones": dones[idx], "gammas": gammas[idx],
+                "weights": weights,
             }
             return k_updates(params, target_params, opt_state, data, idx)
 
         return update
 
+    def _per_beta(self) -> float:
+        cfg = self.cfg
+        frac = min(1.0, self.updates_done /
+                   max(1, cfg.per_beta_anneal_steps))
+        return cfg.per_beta + frac * (1.0 - cfg.per_beta)
+
     def update_from_buffer(self, buf: ReplayBuffer,
                            rng: np.random.Generator) -> dict:
         import jax.numpy as jnp
         cfg = self.cfg
-        idx = buf.sample_indices(rng, cfg.batch_size,
-                                 cfg.num_updates_per_iter)
+        k = cfg.num_updates_per_iter
+        if cfg.prioritized_replay:
+            idx, weights = buf.sample_prioritized(
+                rng, cfg.batch_size, k, cfg.per_alpha, self._per_beta())
+        else:
+            idx = buf.sample_indices(rng, cfg.batch_size, k)
+            weights = np.ones((k, cfg.batch_size), np.float32)
         # full-capacity arrays: fixed shapes -> ONE compile for the whole
         # run (indices never reach past buf.size)
-        self.params, self.opt_state, loss, td, qm = self._update(
+        (self.params, self.opt_state, loss, td, qm,
+         td_abs) = self._update(
             self.params, self.target_params, self.opt_state,
             jnp.asarray(buf.obs), jnp.asarray(buf.actions),
             jnp.asarray(buf.rewards), jnp.asarray(buf.next_obs),
-            jnp.asarray(buf.dones), jnp.asarray(idx))
-        self.updates_done += cfg.num_updates_per_iter
-        if self.updates_done % cfg.target_update_freq < \
-                cfg.num_updates_per_iter:
+            jnp.asarray(buf.dones), jnp.asarray(buf.gammas),
+            jnp.asarray(weights), jnp.asarray(idx))
+        if cfg.prioritized_replay:
+            buf.update_priorities(idx, np.asarray(td_abs), cfg.per_eps)
+        self.updates_done += k
+        if self.updates_done % cfg.target_update_freq < k:
             import jax
             self.target_params = jax.tree.map(lambda x: x, self.params)
         return {"loss": float(loss), "td_error": float(td),
@@ -268,7 +377,8 @@ class DQN(AlgorithmBase):
         self.learner = DQNLearner(self.module_cfg, config.dqn,
                                   seed=config.seed)
         self.buffer = ReplayBuffer(config.dqn.buffer_size,
-                                   self.module_cfg.obs_dim)
+                                   self.module_cfg.obs_dim,
+                                   gamma=config.dqn.gamma)
         self._np_rng = np.random.default_rng(config.seed)
 
     def _epsilon(self) -> float:
@@ -283,9 +393,20 @@ class DQN(AlgorithmBase):
         weights_ref = ray.put(self.learner.params)
         samples = ray.get([r.sample.remote(weights_ref, eps)
                            for r in self._runners])
+        n = self.config.dqn.n_step
         for s in samples:
-            self.buffer.add_batch(s["obs"], s["actions"], s["rewards"],
-                                  s["next_obs"], s["dones"])
+            if n > 1:
+                t = nstep_transitions(
+                    s["obs"], s["actions"], s["rewards"], s["next_obs"],
+                    s["dones"], s["rollout_len"], s["num_envs"], n,
+                    self.config.dqn.gamma, ends=s.get("ends"))
+                self.buffer.add_batch(t["obs"], t["actions"],
+                                      t["rewards"], t["next_obs"],
+                                      t["dones"], gammas=t["gammas"])
+            else:
+                self.buffer.add_batch(s["obs"], s["actions"],
+                                      s["rewards"], s["next_obs"],
+                                      s["dones"])
         mean_ret = self._note_returns(
             [r for s in samples for r in s["episode_returns"]])
         steps = sum(len(s["actions"]) for s in samples)

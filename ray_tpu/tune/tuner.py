@@ -350,8 +350,11 @@ class Tuner:
                     metrics.setdefault("training_iteration", t.iteration)
                     t.results.append(metrics)
                     if reporter is not None:
-                        reporter.on_result(t.index, t.config, metrics,
-                                           t.status)
+                        try:
+                            reporter.on_result(t.index, t.config, metrics,
+                                               t.status)
+                        except Exception:
+                            pass  # a broken reporter must not kill trials
                     if ckpt_path:
                         t.last_checkpoint = Checkpoint(ckpt_path)
                     decision = CONTINUE

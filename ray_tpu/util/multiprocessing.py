@@ -82,6 +82,7 @@ class Pool:
         self._initializer = initializer
         self._initargs = initargs
         self._closed = False
+        self._outstanding: list = []  # every ref handed out; join() drains
 
     # -- helpers ----------------------------------------------------------
 
@@ -118,7 +119,9 @@ class Pool:
         # is in the supported envelope). `processes` sizes the default
         # chunksize, not a submission throttle, which would block the
         # *_async and imap contracts.
-        return [run.remote(block, star) for block in _chunks(items, cs)]
+        refs = [run.remote(block, star) for block in _chunks(items, cs)]
+        self._outstanding.extend(refs)
+        return refs
 
     # -- multiprocessing.Pool API -----------------------------------------
 
@@ -160,7 +163,9 @@ class Pool:
                 init(*initargs)
             return fn(*a, **kw)
 
-        return AsyncResult([run_one.remote(args, kwds)], single=True)
+        ref = run_one.remote(args, kwds)
+        self._outstanding.append(ref)
+        return AsyncResult([ref], single=True)
 
     def imap(self, fn: Callable, iterable: Iterable,
              chunksize: Optional[int] = None):
@@ -190,8 +195,15 @@ class Pool:
         self._closed = True
 
     def join(self) -> None:
+        """Block until every submitted task finished — the canonical
+        ``close(); join()`` completion idiom drains outstanding work
+        exactly like the reference Pool."""
         if not self._closed:
             raise ValueError("Pool is still running")
+        import ray_tpu
+        refs, self._outstanding = self._outstanding, []
+        if refs:
+            ray_tpu.wait(refs, num_returns=len(refs))
 
     def __enter__(self) -> "Pool":
         return self

@@ -56,6 +56,16 @@ def test_dashboard_pages(dash):
     status, text = _get(port, "/metrics")
     assert "ray_tpu_nodes_alive" in text
 
+    ref = ray.put(b"dash-mem-probe")
+    status, body = _get(port, "/api/memory?limit=10")
+    m = json.loads(body)
+    assert status == 200 and "objects" in m and "object_store" in m
+    assert m["num_objects_tracked"] >= 1
+    del ref
+
+    status, body = _get(port, "/api/timeline")
+    assert status == 200 and isinstance(json.loads(body), list)
+
     status, body = _get(port, "/api/bogus")
     assert status == 404 or "error" in body
 

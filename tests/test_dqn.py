@@ -88,3 +88,93 @@ def test_double_q_flag_changes_targets():
         lrn.update_from_buffer(buf, np.random.default_rng(2))
         outs.append(np.asarray(lrn.params["pi"]["head"]["w"]))
     assert not np.allclose(outs[0], outs[1])
+
+
+def test_nstep_transitions_exact():
+    """Hand-checked 3-step aggregation with an episode boundary and a
+    fragment-end truncation (both must use the EFFECTIVE discount)."""
+    from ray_tpu.rl.dqn import nstep_transitions
+    T, E, g = 4, 1, 0.5
+    obs = np.arange(T, dtype=np.float32)[:, None]
+    nxt = obs + 10
+    act = np.zeros(T, np.int32)
+    rew = np.array([1, 2, 4, 8], np.float32)
+    done = np.array([0, 1, 0, 0], np.float32)   # episode ends at t=1
+    out = nstep_transitions(obs, act, rew, nxt, done, T, E, 3, g)
+    # t=0: window [0,1] (cut by done): R = 1 + .5*2, gamma_eff=.25,
+    #      next = nxt[1], done=1
+    assert out["rewards"][0] == pytest.approx(2.0)
+    assert out["gammas"][0] == pytest.approx(0.25)
+    assert out["dones"][0] == 1.0 and out["next_obs"][0, 0] == 11
+    # t=1: window [1] alone (done immediately)
+    assert out["rewards"][1] == pytest.approx(2.0)
+    assert out["gammas"][1] == pytest.approx(0.5)
+    # t=2: window [2,3] cut by fragment end: R = 4 + .5*8 = 8, g=.25
+    assert out["rewards"][2] == pytest.approx(8.0)
+    assert out["gammas"][2] == pytest.approx(0.25)
+    assert out["dones"][2] == 0.0 and out["next_obs"][2, 0] == 13
+
+
+def test_prioritized_replay_prefers_high_td():
+    """High-priority transitions dominate sampling; IS weights are <= 1
+    and priorities refresh from td errors."""
+    rng = np.random.default_rng(0)
+    buf = ReplayBuffer(128, obs_dim=2)
+    obs = np.zeros((128, 2), np.float32)
+    buf.add_batch(obs, np.zeros(128, np.int32), np.zeros(128, np.float32),
+                  obs, np.zeros(128, np.float32))
+    td = np.full(128, 0.01)
+    td[7] = 50.0                                 # one huge-error sample
+    buf.update_priorities(np.arange(128), td, eps=1e-6)
+    idx, w = buf.sample_prioritized(rng, batch=64, k=8, alpha=1.0,
+                                    beta=0.4)
+    assert (idx == 7).mean() > 0.5               # dominates sampling
+    assert w.max() == pytest.approx(1.0) and (w > 0).all()
+    # the over-sampled transition gets the SMALLEST IS weight
+    assert w[idx == 7].max() < w[idx != 7].min()
+
+
+def test_rainbow_components_cartpole(ray_start_regular):
+    """n-step + dueling + PER together still clear the learning bar
+    (reference: Rainbow's component stack on the DQN base)."""
+    cfg = (DQNAlgorithmConfig()
+           .environment("CartPole-v1")
+           .env_runners(num_env_runners=2, num_envs_per_env_runner=4,
+                        rollout_fragment_length=32)
+           .training(lr=1e-3, eps_decay_steps=4000, learning_starts=500,
+                     num_updates_per_iter=48, target_update_freq=400,
+                     n_step=3, dueling=True, prioritized_replay=True))
+    algo = cfg.build()
+    try:
+        best = 0.0
+        for i in range(110):
+            r = algo.train()
+            best = max(best, r["episode_return_mean"])
+            if best >= 100:
+                break
+        assert best >= 100, best
+    finally:
+        algo.stop()
+
+
+def test_nstep_cuts_at_truncation_boundary():
+    """Windows must never sum rewards across a time-limit truncation:
+    `ends` (term|trunc) cuts the window while `dones` (term only) stays
+    the bootstrap mask — a truncated-but-not-terminated step yields a
+    SHORT window that still bootstraps."""
+    from ray_tpu.rl.dqn import nstep_transitions
+    T, E, g = 3, 1, 0.5
+    obs = np.zeros((T, 1), np.float32)
+    nxt = np.arange(10, 10 + T, dtype=np.float32)[:, None]
+    act = np.zeros(T, np.int32)
+    rew = np.array([1, 2, 4], np.float32)
+    done = np.array([0, 0, 0], np.float32)    # no termination anywhere
+    ends = np.array([0, 1, 0], np.float32)    # truncation after t=1
+    out = nstep_transitions(obs, act, rew, nxt, done, T, E, 3, g,
+                            ends=ends)
+    # t=0 window [0,1] (cut by truncation): R = 1 + .5*2 = 2; still
+    # bootstraps (done=0) from the TRUE final obs of step 1
+    assert out["rewards"][0] == pytest.approx(2.0)
+    assert out["dones"][0] == 0.0
+    assert out["gammas"][0] == pytest.approx(0.25)
+    assert out["next_obs"][0, 0] == 11

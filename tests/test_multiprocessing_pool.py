@@ -57,3 +57,18 @@ def test_initializer_and_closed_pool(ray):
         assert p.map(_read_env, [0]) == ["1"]
     with pytest.raises(ValueError):
         p.map(_sq, [1])
+
+
+def test_close_join_drains_outstanding(ray):
+    import time
+
+    def slowmul(x):
+        time.sleep(0.2)
+        return x * 3
+
+    p = Pool(processes=2)
+    r = p.map_async(slowmul, range(6), chunksize=3)
+    p.close()
+    p.join()                       # must block until the chunks finish
+    assert r.ready()
+    assert r.get(timeout=5) == [i * 3 for i in range(6)]
