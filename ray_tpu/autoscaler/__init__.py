@@ -11,7 +11,10 @@ from .gce_tpu import GceTpuVmProvider
 from .node_provider import FakeNodeProvider, NodeProvider
 from .v2 import AutoscalerV2, Instance, InstanceManager
 
+from .sdk import request_resources
+
 __all__ = ["Autoscaler", "AutoscalerV2", "NodeTypeConfig", "NodeProvider",
+           "request_resources",
            "FakeNodeProvider", "GceTpuVmProvider", "Instance",
            "InstanceManager", "active_autoscalers",
            "autoscaler_from_config"]

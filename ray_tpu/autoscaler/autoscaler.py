@@ -217,6 +217,12 @@ class Autoscaler:
             for pg in rt.pgs.values():
                 if pg.state == "pending" and not pg.same_label:
                     demands.extend(dict(b.resources) for b in pg.bundles)
+            # programmatic floor (reference: autoscaler/sdk
+            # request_resources): bundles the operator asked to keep
+            # launchable regardless of queued work — planned like
+            # pending tasks every tick until replaced/cleared
+            demands.extend(dict(b)
+                           for b in getattr(rt, "resource_requests", ()))
         return [d for d in demands if d]
 
     def pending_gangs(self) -> list[tuple[list[dict], str]]:

@@ -1,0 +1,36 @@
+"""Programmatic autoscaler requests.
+
+Reference parity: ray.autoscaler.sdk.request_resources
+(python/ray/autoscaler/sdk/sdk.py) — ask the cluster to scale to
+accommodate a resource shape immediately, without queueing workloads
+first (pre-warming before a burst, holding capacity between jobs). The
+last call REPLACES the standing request; calling with no arguments
+clears it. Bundles already covered by free capacity launch nothing (the
+planner subtracts live free capacity), and a standing request also
+holds off idle scale-down — it is a floor, not a one-shot.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+
+def request_resources(num_cpus: Optional[int] = None,
+                      bundles: Optional[list[dict]] = None) -> None:
+    from ..core import runtime as rt_mod
+    rt = rt_mod.get_runtime_if_exists()
+    if rt is None:
+        raise RuntimeError("ray_tpu.init() first")
+    req: list[dict] = []
+    if num_cpus:
+        # reference semantics: 'scale until N CPUs exist' — N unit
+        # bundles, so any mix of node sizes can satisfy it (one {CPU: N}
+        # bundle would demand a single N-CPU host)
+        req.extend({"CPU": 1.0} for _ in range(int(num_cpus)))
+    for b in bundles or ():
+        if b:
+            req.append({k: float(v) for k, v in b.items()})
+    if isinstance(rt, rt_mod.Runtime):
+        with rt.lock:
+            rt.resource_requests = req
+        return
+    rt._rpc("request_resources_rpc", req)  # worker/driver: one head RPC

@@ -388,6 +388,9 @@ class Runtime:
         # and driver-side store pins from ray.put
         self.interest: dict[ObjectID, set[str]] = {}
         self.xfer_pins: dict[ObjectID, int] = {}
+        # standing programmatic demand floor (autoscaler/sdk.py
+        # request_resources); the autoscaler plans these every tick
+        self.resource_requests: list[dict] = []
         self._local_refs: dict[ObjectID, int] = {}
         self._pinned: set[ObjectID] = set()
         # containment edges: outer stored object -> refs pickled inside it
@@ -999,7 +1002,7 @@ class Runtime:
                     "memory_summary", "autoscaler_status",
                     "user_metrics_dump", "pubsub_poll",
                     "kv_put", "kv_get", "kv_del", "kv_keys", "locate",
-                    "locate_many",
+                    "locate_many", "request_resources_rpc",
                     "job_submit", "job_list", "job_status", "job_logs",
                     "job_stop")
 
@@ -1019,6 +1022,12 @@ class Runtime:
                 if n.alive and n.node_id.hex() in locs and n.data_addr:
                     out.append(n.data_addr)
         return out
+
+    def request_resources_rpc(self, bundles: list[dict]) -> None:
+        """Replace the standing programmatic demand floor
+        (autoscaler/sdk.py request_resources from a remote driver)."""
+        with self.lock:
+            self.resource_requests = [dict(b) for b in bundles]
 
     def locate_many(self, oids: list[bytes]) -> list[bool]:
         """Settled-ness (a result exists anywhere — any store, spill, or

@@ -206,9 +206,14 @@ def start_dashboard(port: int = 0, host: str = "127.0.0.1") -> int:
                 loop = asyncio.get_event_loop()
                 out = await loop.run_in_executor(None, serve_api.status)
             elif kind == "memory":
-                out = state_api.memory_summary(limit)
+                # head lock + per-object residency probes: keep it off
+                # the dashboard event loop (same rule as the serve branch)
+                loop = asyncio.get_event_loop()
+                out = await loop.run_in_executor(
+                    None, state_api.memory_summary, limit)
             elif kind == "timeline":
-                out = rt.timeline()
+                loop = asyncio.get_event_loop()
+                out = await loop.run_in_executor(None, rt.timeline)
             elif kind in ("tasks", "actors", "objects", "nodes", "workers"):
                 fn = getattr(state_api, f"list_{kind}")
                 out = fn(limit) if kind in ("tasks", "actors",

@@ -34,6 +34,10 @@ class SamplingParams:
     top_k: int = 0                    # 0 = no top-k
     stop_token_ids: tuple = ()
     seed: int = 0
+    # > 0: return the chosen token's log-probability per generated token
+    # (model-natural log_softmax, not temperature-scaled; top-N
+    # alternatives are not reported). Paged engine only.
+    logprobs: int = 0
 
 
 @dataclasses.dataclass
@@ -52,6 +56,7 @@ class _Request:
     prompt_ids: list[int]
     params: SamplingParams
     out_ids: list[int] = dataclasses.field(default_factory=list)
+    out_logps: list[float] = dataclasses.field(default_factory=list)
     slot: int = -1
     pages: list[int] = dataclasses.field(default_factory=list)
     prefill_pos: int = 0          # prompt tokens already prefilled (paged)
@@ -77,7 +82,8 @@ def sample_logits(logits: jax.Array, rng: jax.Array, temperature: float,
 def sample_logits_batch(logits: jax.Array, rng: jax.Array,
                         temps: jax.Array, top_ks: jax.Array, *,
                         any_sampled: bool = True,
-                        any_topk: bool = True) -> jax.Array:
+                        any_topk: bool = True,
+                        want_logp: bool = True):
     """Per-ROW sampling over [B, V] logits with per-row params, fully
     in-jit (no shape depends on the params, so one compiled program covers
     every request mix — the piece that lets sampling fuse into the decode
@@ -90,9 +96,21 @@ def sample_logits_batch(logits: jax.Array, rng: jax.Array,
     batch at dispatch time (it keys its jit cache on them): all-greedy
     batches skip the categorical entirely, no-top-k batches skip the sort.
     """
+    def chosen_logp(tok):
+        # model-natural log-probability of the chosen token (OpenAI
+        # logprobs semantics): from the RAW logits, not the
+        # temperature/top-k-processed ones. want_logp is STATIC like
+        # any_sampled: batches with no logprobs request skip the
+        # full-vocab log_softmax entirely (same design rule that lets
+        # all-greedy batches skip the categorical).
+        if not want_logp:
+            return None
+        lsm = jax.nn.log_softmax(logits, axis=-1)
+        return jnp.take_along_axis(lsm, tok[:, None], axis=-1)[:, 0]
+
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     if not any_sampled:
-        return greedy
+        return greedy, chosen_logp(greedy)
     scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
     if any_topk:
         v = logits.shape[-1]
@@ -102,7 +120,8 @@ def sample_logits_batch(logits: jax.Array, rng: jax.Array,
         scaled = jnp.where((top_ks[:, None] > 0) & (scaled < kth),
                            -1e30, scaled)
     sampled = jax.random.categorical(rng, scaled, axis=-1)
-    return jnp.where(temps <= 0.0, greedy, sampled).astype(jnp.int32)
+    tok = jnp.where(temps <= 0.0, greedy, sampled).astype(jnp.int32)
+    return tok, chosen_logp(tok)
 
 
 class _EngineBase:
@@ -185,6 +204,8 @@ class _EngineBase:
                        if req.first_token_t else None),
             "finish_reason": ("stop" if eos is not None and eos in req.out_ids
                               else "length"),
+            "logprobs": (list(req.out_logps) if req.params.logprobs
+                         and req.out_logps else None),
         }
 
 

@@ -135,10 +135,15 @@ class PagedInferenceEngine(_EngineBase):
 
     @staticmethod
     def _sampling_mode(reqs) -> tuple:
+        reqs = list(reqs)
         any_sampled = any(r.params.temperature > 0 for r in reqs)
         any_topk = any_sampled and any(
             r.params.top_k > 0 and r.params.temperature > 0 for r in reqs)
-        return any_sampled, any_topk
+        # third static key: only batches containing a logprobs request
+        # compile + pay the full-vocab log_softmax (engine.py
+        # chosen_logp); everyone else runs the lean program
+        want_logp = any(r.params.logprobs for r in reqs)
+        return any_sampled, any_topk, want_logp
 
     def _decode_window_fn(self, w: int, mode: tuple):
         """One dispatch = w decode steps for every slot: lax.scan unrolls
@@ -148,7 +153,7 @@ class PagedInferenceEngine(_EngineBase):
         if fn is None:
             mc, page = self.cfg.model, self.cfg.page_size
             interpret = self._interpret
-            any_sampled, any_topk = mode
+            any_sampled, any_topk, want_logp = mode
 
             def run(p, c, tok0, bt, ln0, key, ctr, temps, top_ks):
                 def body(carry, i):
@@ -158,14 +163,19 @@ class PagedInferenceEngine(_EngineBase):
                         page_size=page, interpret=interpret)
                     sub = jax.random.fold_in(
                         jax.random.fold_in(key, ctr), i)
-                    nxt = sample_logits_batch(
+                    nxt, lp = sample_logits_batch(
                         logits, sub, temps, top_ks,
-                        any_sampled=any_sampled, any_topk=any_topk)
-                    return (nxt, lens + 1, caches), nxt
+                        any_sampled=any_sampled, any_topk=any_topk,
+                        want_logp=want_logp)
+                    return (nxt, lens + 1, caches), (
+                        (nxt, lp) if want_logp else nxt)
 
-                (_, _, c), out = jax.lax.scan(
+                (_, _, c), ys = jax.lax.scan(
                     body, (tok0, ln0, c), jnp.arange(w))
-                return out.T, c                     # [B, w]
+                if want_logp:
+                    out, lps = ys
+                    return out.T, lps.T, c          # [B, w] each
+                return ys.T, None, c
 
             fn = jax.jit(run, donate_argnums=(1,))
             self._decode_win_fns[(w, mode)] = fn
@@ -177,34 +187,43 @@ class PagedInferenceEngine(_EngineBase):
         fn = self._prefill_rows_fns.get((r, mode))
         if fn is None:
             mc, page = self.cfg.model, self.cfg.page_size
-            any_sampled, any_topk = mode
+            any_sampled, any_topk, want_logp = mode
 
             def run(p, c, chunks, bts, sps, tls, key, ctr, temps, top_ks):
                 last, c = llama.prefill_paged_rows(
                     p, chunks, c, bts, sps, tls, mc, page_size=page)
-                toks = sample_logits_batch(
+                toks, lps = sample_logits_batch(
                     last, jax.random.fold_in(key, ctr), temps, top_ks,
-                    any_sampled=any_sampled, any_topk=any_topk)
-                return toks, c
+                    any_sampled=any_sampled, any_topk=any_topk,
+                    want_logp=want_logp)
+                return toks, lps, c
 
             fn = jax.jit(run, donate_argnums=(1,))
             self._prefill_rows_fns[(r, mode)] = fn
         return fn
 
-    def _verify_fn(self, r: int, s1: int):
+    def _verify_fn(self, r: int, s1: int, want_logp: bool = False):
         """One dispatch = verify r rows of s1 = 1+drafts tokens; returns
-        the model's greedy next token AT each fed position [r, s1]."""
-        fn = self._verify_fns.get((r, s1))
+        the model's greedy next token AT each fed position [r, s1] (and
+        its log-probability when the batch asked for logprobs — a
+        static key, like the sampling modes)."""
+        fn = self._verify_fns.get((r, s1, want_logp))
         if fn is None:
             mc, page = self.cfg.model, self.cfg.page_size
 
             def run(p, c, toks, bts, starts):
                 logits, c = llama.verify_paged_rows(
                     p, toks, c, bts, starts, mc, page_size=page)
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32), c
+                y = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                if not want_logp:
+                    return y, None, c
+                lp = jnp.take_along_axis(
+                    jax.nn.log_softmax(logits, axis=-1), y[..., None],
+                    axis=-1)[..., 0]
+                return y, lp, c
 
             fn = jax.jit(run, donate_argnums=(1,))
-            self._verify_fns[(r, s1)] = fn
+            self._verify_fns[(r, s1, want_logp)] = fn
         return fn
 
     # -- public API --------------------------------------------------------
@@ -237,11 +256,12 @@ class PagedInferenceEngine(_EngineBase):
         bs, maxp, c = (cfg.max_batch_size, cfg.max_pages_per_seq,
                        cfg.chunk_size)
         key, ctr = self._rng_base, np.int32(0)
-        for mode in sample_modes:
+        modes = [tuple(m) + (False,) * (3 - len(m)) for m in sample_modes]
+        for mode in modes:
             rb = 1
             while "prefill" in families:
                 rb = min(rb, cfg.prefill_rows)
-                toks, self.caches = self._prefill_rows_fn(rb, mode)(
+                toks, _lps, self.caches = self._prefill_rows_fn(rb, mode)(
                     self.params, self.caches,
                     np.zeros((rb, c), np.int32),
                     np.zeros((rb, maxp), np.int32),
@@ -254,7 +274,7 @@ class PagedInferenceEngine(_EngineBase):
                 rb <<= 1
             for w in (sorted({1, cfg.decode_window})
                       if "decode" in families else ()):
-                out, self.caches = self._decode_window_fn(w, mode)(
+                out, _lps, self.caches = self._decode_window_fn(w, mode)(
                     self.params, self.caches, np.zeros((bs,), np.int32),
                     np.zeros((bs, maxp), np.int32),
                     np.zeros((bs,), np.int32), key, ctr,
@@ -264,7 +284,7 @@ class PagedInferenceEngine(_EngineBase):
             s1, rb = cfg.spec_tokens + 1, 1
             while True:
                 rb = min(rb, bs)
-                y, self.caches = self._verify_fn(rb, s1)(
+                y, _ylp, self.caches = self._verify_fn(rb, s1)(
                     self.params, self.caches, np.zeros((rb, s1), np.int32),
                     np.zeros((rb, maxp), np.int32), np.zeros((rb,), np.int32))
                 np.asarray(y)
@@ -366,13 +386,14 @@ class PagedInferenceEngine(_EngineBase):
             sps[i], tls[i] = pos, n
             temps[i] = req.params.temperature
             topks[i] = req.params.top_k
-        toks, self.caches = self._prefill_rows_fn(
+        toks, lps, self.caches = self._prefill_rows_fn(
             rb, self._sampling_mode([q for q, _, _ in rows]))(
             self.params, self.caches, chunks, bts, sps, tls,
             self._rng_base, np.int32(self._rng_ctr), temps, topks)
         self._rng_ctr += 1
         self.stats["prefill_dispatches"] += 1
         toks = np.asarray(toks)
+        lps = None if lps is None else np.asarray(lps)
         for i, (req, pos, n) in enumerate(rows):
             req.prefill_pos = pos + n
             if req.prefill_pos < len(req.prompt_ids):
@@ -381,6 +402,8 @@ class PagedInferenceEngine(_EngineBase):
             # generated token
             tok = int(toks[i])
             req.out_ids.append(tok)
+            if lps is not None:
+                req.out_logps.append(float(lps[i]))
             self.stats["tokens_out"] += 1
             req.first_token_t = time.perf_counter()
             self._lengths[req.slot] = len(req.prompt_ids)
@@ -459,9 +482,11 @@ class PagedInferenceEngine(_EngineBase):
             toks[i, 1:1 + len(drafts[slot])] = drafts[slot]
             bts[i] = self._block_tables[slot]
             starts[i] = self._lengths[slot]
-        y, self.caches = self._verify_fn(rb, s1)(
+        want_lp = any(self._active[sl].params.logprobs for sl in slots)
+        y, ylp, self.caches = self._verify_fn(rb, s1, want_lp)(
             self.params, self.caches, toks, bts, starts)
         y = np.asarray(y)                                   # [r, s1]
+        ylp = None if ylp is None else np.asarray(ylp)
         self.stats["spec_dispatches"] += 1
         emitted = 0
         for i, slot in enumerate(slots):
@@ -470,18 +495,22 @@ class PagedInferenceEngine(_EngineBase):
             self.stats["spec_proposed"] += len(d)
             # accept: token j's prediction y[i, j] is the true next token
             # only while every earlier draft matched the model's choice
-            out = [int(y[i, 0])]
+            def _lp(row, col):
+                return None if ylp is None else float(ylp[row, col])
+            out = [(int(y[i, 0]), _lp(i, 0))]
             for j in range(len(d)):
-                if d[j] != out[-1]:
+                if d[j] != out[-1][0]:
                     break
-                out.append(int(y[i, j + 1]))
+                out.append((int(y[i, j + 1]), _lp(i, j + 1)))
                 self.stats["spec_accepted"] += 1
             consumed = 0
-            for tok in out:
+            for tok, lp in out:
                 if consumed >= allow[slot]:
                     self._retire(req)
                     break
                 req.out_ids.append(tok)
+                if lp is not None:
+                    req.out_logps.append(lp)
                 self._lengths[slot] += 1
                 consumed += 1
                 self.stats["tokens_out"] += 1
@@ -511,7 +540,8 @@ class PagedInferenceEngine(_EngineBase):
         bs, page = cfg.max_batch_size, cfg.page_size
         quiet = not (self._prefilling or self._pending)
         if cfg.spec_tokens > 0 and quiet and \
-                self._sampling_mode(self._active.values()) == (False, False):
+                self._sampling_mode(
+                    self._active.values())[:2] == (False, False):
             if self._spec_cooldown > 0:
                 self._spec_cooldown -= 1
             elif self._spec_step():
@@ -535,13 +565,14 @@ class PagedInferenceEngine(_EngineBase):
             temps[slot] = req.params.temperature
             topks[slot] = req.params.top_k
             bt[slot] = self._block_tables[slot]
-        out, self.caches = self._decode_window_fn(
+        out, lps, self.caches = self._decode_window_fn(
             w, self._sampling_mode(self._active.values()))(
             self.params, self.caches, tokens, bt, lengths,
             self._rng_base, np.int32(self._rng_ctr), temps, topks)
         self._rng_ctr += 1
         self.stats["decode_dispatches"] += 1
         out = np.asarray(out)               # [bs, w]
+        lps = None if lps is None else np.asarray(lps)
         for slot in list(self._active):
             req = self._active[slot]
             for j in range(w):
@@ -553,6 +584,8 @@ class PagedInferenceEngine(_EngineBase):
                     break
                 tok = int(out[slot, j])
                 req.out_ids.append(tok)
+                if lps is not None:
+                    req.out_logps.append(float(lps[slot, j]))
                 self._lengths[slot] += 1
                 self.stats["tokens_out"] += 1
                 if self._stop_after(req, tok):

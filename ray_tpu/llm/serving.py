@@ -168,8 +168,16 @@ class LLMServer:
             max_tokens=int(request.get("max_tokens", 64)),
             temperature=float(request.get("temperature", 0.0)),
             top_k=int(request.get("top_k", 0)),
+            logprobs=int(request.get("logprobs") or 0),
         )
         eng = self._engine_for(request)
+        if sp.logprobs and not hasattr(eng, "_prefill_rows_fns"):
+            # dense InferenceEngine never fills out_logps: refuse loudly
+            # instead of returning a well-formed response missing the
+            # requested field (paged engine is the production path)
+            raise ValueError(
+                "logprobs requires the paged engine "
+                "(LLMConfig(engine=PagedEngineConfig(...)))")
         # submit UNDER the lora lock: eviction (also lock-guarded) only
         # removes idle engines, so once submit lands the engine has work
         # and cannot be evicted out from under this request; re-insert if
@@ -196,14 +204,30 @@ class LLMServer:
         if self._error is not None and not req.done:
             raise RuntimeError("llm engine loop died") from self._error
         out = eng._result(req)
+        text = out["text"]
+        if request.get("echo"):
+            # OpenAI echo: the completion text is prompt + generation
+            prompt = request.get("prompt", "")
+            text = (prompt if isinstance(prompt, str)
+                    else eng.tokenizer.decode(list(prompt))) + text
+        choice = {
+            "text": text,
+            "finish_reason": out["finish_reason"],
+            "index": 0,
+        }
+        if out.get("logprobs") is not None:
+            # chosen-token logprobs (top-N alternatives not reported —
+            # SamplingParams.logprobs docstring)
+            choice["logprobs"] = {
+                "tokens": [eng.tokenizer.decode([t])
+                           for t in out["token_ids"]],
+                "token_logprobs": out["logprobs"],
+                "top_logprobs": None,
+            }
         return {
             "object": "text_completion",
             "model": self.model_id,
-            "choices": [{
-                "text": out["text"],
-                "finish_reason": out["finish_reason"],
-                "index": 0,
-            }],
+            "choices": [choice],
             "usage": {
                 "prompt_tokens": out["prompt_tokens"],
                 "completion_tokens": len(out["token_ids"]),

@@ -242,6 +242,12 @@ def delete(name: str = "default") -> None:
 
 
 def shutdown() -> None:
+    import ray_tpu
+
+    from .local_mode import _REGISTRY
+    _REGISTRY.clear()
+    if not ray_tpu.is_initialized():
+        return  # nothing cluster-side to stop; never BOOT one to shut down
     ray = _ray()
     try:
         gp = ray.get_actor("rtpu:serve:grpc-proxy")

@@ -117,5 +117,5 @@ class NodeKiller(_KillerBase):
         try:
             agent.send({"t": "shutdown"})
         except Exception:
-            pass
+            return None  # agent already gone: no fault was injected
         return hexid

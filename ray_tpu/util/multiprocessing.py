@@ -103,6 +103,17 @@ class Pool:
 
         return run_chunk
 
+    def _track(self, refs: list) -> None:
+        """Remember refs for join() — but DROP settled ones first so a
+        long-lived pool doesn't pin every past result in the object
+        store for its lifetime."""
+        import ray_tpu
+        if self._outstanding:
+            _, self._outstanding = ray_tpu.wait(
+                self._outstanding, num_returns=len(self._outstanding),
+                timeout=0)
+        self._outstanding.extend(refs)
+
     def _default_chunksize(self, n: int) -> int:
         # multiprocessing's heuristic: ~4 chunks per worker slot
         return max(1, n // (self._processes * 4) or 1)
@@ -120,7 +131,7 @@ class Pool:
         # chunksize, not a submission throttle, which would block the
         # *_async and imap contracts.
         refs = [run.remote(block, star) for block in _chunks(items, cs)]
-        self._outstanding.extend(refs)
+        self._track(refs)
         return refs
 
     # -- multiprocessing.Pool API -----------------------------------------
@@ -164,7 +175,7 @@ class Pool:
             return fn(*a, **kw)
 
         ref = run_one.remote(args, kwds)
-        self._outstanding.append(ref)
+        self._track([ref])
         return AsyncResult([ref], single=True)
 
     def imap(self, fn: Callable, iterable: Iterable,
@@ -192,7 +203,10 @@ class Pool:
         self._closed = True
 
     def terminate(self) -> None:
+        # abort semantics: join() after terminate() must NOT wait for
+        # pending work (reference Pool.terminate discards it)
         self._closed = True
+        self._outstanding = []
 
     def join(self) -> None:
         """Block until every submitted task finished — the canonical

@@ -131,3 +131,27 @@ def test_autoscaler_satisfies_training_gang(small_head):
         assert len(asc.instances) >= 1   # agents were launched for it
     finally:
         asc.stop()
+
+
+def test_request_resources_scales_without_workload(small_head):
+    """Programmatic demand floor (reference: ray.autoscaler.sdk
+    request_resources): the plan launches for a standing request with
+    NOTHING queued, requests covered by free capacity launch nothing,
+    and clearing the request re-enables idle scale-down planning."""
+    from ray_tpu.autoscaler import request_resources
+
+    asc = Autoscaler([NodeTypeConfig("cpu4", {"CPU": 4}, max_workers=3)],
+                     provider=FakeNodeProvider())
+    # floor bigger than the head's capacity: launches
+    request_resources(bundles=[{"CPU": 4}, {"CPU": 4}])
+    to_launch, to_term = asc.plan()
+    assert to_launch == {"cpu4": 2}, to_launch
+    assert to_term == []
+    # a request that fits existing free capacity launches nothing
+    request_resources(num_cpus=1)
+    to_launch, _ = asc.plan()
+    assert to_launch == {}, to_launch
+    # cleared: back to no demand
+    request_resources()
+    to_launch, _ = asc.plan()
+    assert to_launch == {}, to_launch

@@ -104,3 +104,32 @@ def test_batch_processor(ray_start_regular):
     out = proc(ds).take_all()
     assert len(out) == 2
     assert all(o["num_generated_tokens"] == 4 for o in out)
+
+
+def test_completions_logprobs_and_echo(ray_start_regular):
+    """OpenAI-surface logprobs + echo on /v1/completions (reference:
+    the OpenAI completions params the llm router accepts)."""
+    from ray_tpu import serve
+    from ray_tpu.llm.serving import LLMConfig, LLMServer
+
+    app = serve.deployment(LLMServer).options(
+        name="llm-lp").bind(LLMConfig(model_id="tiny", warmup=False))
+    h = serve.run(app, name="lp")
+    try:
+        out = h.options(method_name="completions").remote(
+            {"prompt": [5, 6, 7], "max_tokens": 6,
+             "logprobs": 1, "echo": True}).result(timeout_s=180)
+        ch = out["choices"][0]
+        lp = ch["logprobs"]
+        assert len(lp["token_logprobs"]) == out["usage"][
+            "completion_tokens"]
+        assert all(v <= 0 for v in lp["token_logprobs"])
+        assert len(lp["tokens"]) == len(lp["token_logprobs"])
+        # echo prepends the prompt text to the completion
+        plain = h.options(method_name="completions").remote(
+            {"prompt": [5, 6, 7], "max_tokens": 6}).result(timeout_s=120)
+        assert ch["text"].endswith(plain["choices"][0]["text"])
+        assert len(ch["text"]) > len(plain["choices"][0]["text"])
+        assert "logprobs" not in plain["choices"][0]
+    finally:
+        serve.delete("lp")

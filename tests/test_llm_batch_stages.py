@@ -19,9 +19,11 @@ def ray4():
         ray_tpu.shutdown()
     ray_tpu.init(num_cpus=4)
     yield
-    ray_tpu.shutdown()
+    # serve teardown FIRST: after ray_tpu.shutdown a serve call would
+    # have nothing to talk to (and must never boot a fresh cluster)
     from ray_tpu import serve
     serve.shutdown()
+    ray_tpu.shutdown()
 
 
 def _ecfg():

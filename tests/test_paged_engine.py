@@ -242,3 +242,31 @@ def test_warmup_covers_every_burst_program():
     assert eng.stats["spec_dispatches"] > 0, eng.stats
     for d, before in zip(families, warmed):
         assert set(d) == before, (set(d) - before, "compiled mid-burst")
+
+
+def test_logprobs_reported_and_consistent():
+    """Chosen-token logprobs ride every program family (prefill first
+    token, windowed decode, spec verify) and are the model-natural
+    log_softmax values: re-running the same greedy generation twice
+    yields identical tokens AND logprobs, all finite and <= 0."""
+    model = llama.llama_tiny(vocab_size=258, max_seq_len=256)
+    mk = lambda spec: PagedInferenceEngine(PagedEngineConfig(
+        model=model, max_batch_size=2, page_size=8, num_pages=96,
+        max_pages_per_seq=24, chunk_size=16, decode_window=4,
+        spec_tokens=8 if spec else 0), rng_seed=0)
+    base, spec = mk(False), mk(True)
+    spec.params = base.params
+
+    prompt = [7, 8, 9] * 5
+    sp = SamplingParams(max_tokens=24, logprobs=1)
+    a = base.generate([prompt], sp)[0]
+    b = spec.generate([prompt], sp)[0]
+    assert a["token_ids"] == b["token_ids"]
+    assert len(a["logprobs"]) == len(a["token_ids"])
+    assert all(np.isfinite(v) and v <= 0.0 for v in a["logprobs"])
+    # windowed vs spec paths agree on the values (same forward math)
+    np.testing.assert_allclose(a["logprobs"], b["logprobs"],
+                               rtol=2e-3, atol=2e-3)
+    # logprobs=0 (default) omits them from the result
+    c = base.generate([prompt], SamplingParams(max_tokens=4))[0]
+    assert c["logprobs"] is None
