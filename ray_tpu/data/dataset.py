@@ -440,10 +440,14 @@ class Dataset:
 
     def iter_batches(self, *, batch_size: Optional[int] = 256,
                      batch_format: str = "numpy",
-                     drop_last: bool = False) -> Iterator:
+                     drop_last: bool = False,
+                     local_shuffle_buffer_size: Optional[int] = None,
+                     local_shuffle_seed: Optional[int] = None) -> Iterator:
         return DataIterator(self._stream_pairs()).iter_batches(
             batch_size=batch_size, batch_format=batch_format,
-            drop_last=drop_last)
+            drop_last=drop_last,
+            local_shuffle_buffer_size=local_shuffle_buffer_size,
+            local_shuffle_seed=local_shuffle_seed)
 
     def iter_jax_batches(self, *, batch_size: int = 256,
                          drop_last: bool = True, sharding=None) -> Iterator:
@@ -593,7 +597,14 @@ class DataIterator:
 
     def iter_batches(self, *, batch_size: Optional[int] = 256,
                      batch_format: str = "numpy",
-                     drop_last: bool = False) -> Iterator:
+                     drop_last: bool = False,
+                     local_shuffle_buffer_size: Optional[int] = None,
+                     local_shuffle_seed: Optional[int] = None) -> Iterator:
+        if local_shuffle_buffer_size:
+            yield from self._iter_shuffled(
+                batch_size or 256, batch_format, drop_last,
+                local_shuffle_buffer_size, local_shuffle_seed)
+            return
         carry: Optional[B.Block] = None
         for blk in self.iter_blocks():
             if carry is not None and carry.num_rows:
@@ -612,6 +623,36 @@ class DataIterator:
                 carry = B.slice_block(blk, start, blk.num_rows)
         if carry is not None and carry.num_rows and not drop_last:
             yield B.format_batch(carry, batch_format)
+
+    def _iter_shuffled(self, batch_size: int, batch_format: str,
+                      drop_last: bool, buf_size: int,
+                      seed: Optional[int]) -> Iterator:
+        """Streaming local shuffle (reference: iter_batches'
+        local_shuffle_buffer_size): hold ~buf_size rows, emit each batch
+        as a random draw from the buffer while the stream refills it —
+        per-epoch randomization at buffer-memory cost, without a full
+        distributed random_shuffle()."""
+        rng = np.random.default_rng(seed)
+        buf: Optional[B.Block] = None
+        for blk in self.iter_blocks():
+            buf = blk if buf is None else B.concat([buf, blk])
+            while buf.num_rows >= buf_size + batch_size:
+                pick = rng.choice(buf.num_rows, size=batch_size,
+                                  replace=False)
+                mask = np.ones(buf.num_rows, bool)
+                mask[pick] = False
+                yield B.format_batch(buf.take(pick), batch_format)
+                buf = buf.take(np.nonzero(mask)[0])
+        if buf is None or not buf.num_rows:
+            return
+        order = rng.permutation(buf.num_rows)
+        start = 0
+        while buf.num_rows - start >= batch_size:
+            yield B.format_batch(
+                buf.take(order[start:start + batch_size]), batch_format)
+            start += batch_size
+        if start < buf.num_rows and not drop_last:
+            yield B.format_batch(buf.take(order[start:]), batch_format)
 
     def iter_torch_batches(self, *, batch_size: Optional[int] = 256,
                            drop_last: bool = False) -> Iterator:

@@ -91,3 +91,11 @@ class PendingCallsLimitExceeded(RayError):
     """An actor handle with ``max_pending_calls`` set has that many calls
     in flight (reference: ray.exceptions.PendingCallsLimitExceeded, raised
     by the actor task submitter's client-side backpressure)."""
+
+
+class ExitActorSignal(BaseException):
+    """Control-flow signal raised by ray_tpu.exit_actor() inside an actor
+    method; the worker catches it and exits the actor intentionally
+    (no restart). BaseException so user ``except Exception`` blocks
+    cannot swallow it — the same reason the reference's sync path raises
+    SystemExit (ray.actor.exit_actor)."""
