@@ -7,8 +7,14 @@ batching — vs_baseline = 0.2 / p50_s (>= 1.0 passes).
 Workload: a burst of requests with mixed prompt lengths arrives at once
 (worst case for TTFT: every prompt queues behind running decodes); chunked
 prefill bounds how long any decode step stalls.
+
+``--metrics``: after the run, print a second JSON line with
+``serve.metrics_summary()`` (histogram-derived p50/p95/p99 TTFT,
+inter-token, queue wait, KV utilization, token/request counters) — the
+telemetry the engines recorded via ray_tpu.util.metrics during the burst.
 """
 import json
+import sys
 import time
 
 import jax
@@ -90,6 +96,11 @@ def main():
                  f"{jax.devices()[0].platform})"),
         "vs_baseline": round(0.2 / max(p50, 1e-9), 4),
     }))
+
+    if "--metrics" in sys.argv:
+        from ray_tpu.serve.metrics import metrics_summary
+        print(json.dumps({"metric": "serve_metrics_summary",
+                          "value": metrics_summary()}, default=str))
 
     _pd_interference(model, cfg, rng, max_tokens, prompt_lens, on_tpu)
 

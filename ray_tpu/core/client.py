@@ -241,6 +241,15 @@ class DriverRuntime(WorkerRuntime):
                 except (OSError, ValueError, BrokenPipeError):
                     continue  # head died again mid-replay; retry dial
             self._conn_gen += 1
+            # the restarted head's metric store is empty: re-mark gauge
+            # series dirty (last-write-wins values only live on the head)
+            # and re-ship everything on the spot
+            try:
+                from ..util import metrics as _um
+                _um.mark_gauges_dirty()
+                _um.flush()
+            except Exception:
+                pass
             return True
         return False
 
@@ -270,7 +279,16 @@ class DriverRuntime(WorkerRuntime):
         return self._rpc("timeline")
 
     def shutdown(self):
+        # _closing FIRST: it makes _send_riding_restarts fail fast, so the
+        # final flush ships over a live head but never stalls teardown for
+        # the reconnect deadline when the head is already gone (the deltas
+        # were lost with the head's store anyway)
         self._closing = True
+        try:
+            from ..util.metrics import shutdown_flush
+            shutdown_flush()  # last counter deltas before the conn dies
+        except Exception:
+            pass
         self.disconnected.set()
         try:
             self.conn.close()

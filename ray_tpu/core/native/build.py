@@ -23,6 +23,24 @@ def _src_hash() -> str:
         return hashlib.sha256(f.read()).hexdigest()
 
 
+def _compile_and_swap() -> None:
+    """Compile to a tmp path and atomically replace the .so + hash.
+    Caller holds _lock. Raises CalledProcessError on compile errors and
+    OSError when the compiler is missing / checkout is read-only."""
+    tmp = _LIB + ".tmp"
+    subprocess.run(
+        [
+            "g++", "-O2", "-g", "-shared", "-fPIC", "-std=c++17",
+            "-o", tmp, _SRC, "-lpthread",
+        ],
+        check=True,
+        capture_output=True,
+    )
+    os.replace(tmp, _LIB)
+    with open(_HASH, "w") as f:
+        f.write(_src_hash())
+
+
 def ensure_built() -> str:
     """Compile objstore.cc -> libobjstore.so if missing or stale.
 
@@ -40,19 +58,8 @@ def ensure_built() -> str:
             except OSError:
                 pass
         if have != want:
-            tmp = _LIB + ".tmp"
             try:
-                subprocess.run(
-                    [
-                        "g++", "-O2", "-g", "-shared", "-fPIC", "-std=c++17",
-                        "-o", tmp, _SRC, "-lpthread",
-                    ],
-                    check=True,
-                    capture_output=True,
-                )
-                os.replace(tmp, _LIB)
-                with open(_HASH, "w") as f:
-                    f.write(want)
+                _compile_and_swap()
             except subprocess.CalledProcessError as e:
                 # a real compile error must surface (silently loading the
                 # stale .so is the failure mode this hash scheme prevents)
@@ -64,4 +71,23 @@ def ensure_built() -> str:
                 # usable (it may just predate the latest source)
                 if not os.path.exists(_LIB):
                     raise
+    return _LIB
+
+
+def rebuild() -> str:
+    """Recompile for THIS host and swap in the result. Used when a
+    shipped binary fails to LOAD (e.g. built against a newer glibc than
+    this host) — the content hash can't catch that, only dlopen can.
+    The existing .so is replaced only AFTER a successful compile: a
+    compiler-less host, or a checkout shared over NFS with hosts where
+    the shipped binary loads fine, must never lose it to a failed
+    attempt."""
+    with _lock:
+        try:
+            _compile_and_swap()
+        except (subprocess.CalledProcessError, OSError) as e:
+            stderr = getattr(e, "stderr", None) or b""
+            raise RuntimeError(
+                "libobjstore.so failed to load and recompiling for this "
+                "host failed:\n" + stderr.decode(errors="replace")) from e
     return _LIB

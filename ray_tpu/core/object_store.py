@@ -52,7 +52,13 @@ class GetTimeoutError(TimeoutError):
 
 
 def _load_lib() -> ctypes.CDLL:
-    lib = ctypes.CDLL(ensure_built())
+    try:
+        lib = ctypes.CDLL(ensure_built())
+    except OSError:
+        # a shipped .so can be source-current yet unloadable here (built
+        # against a newer glibc); recompile for this host
+        from .native.build import rebuild
+        lib = ctypes.CDLL(rebuild())
     lib.os_store_create.restype = ctypes.c_void_p
     lib.os_store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32]
     lib.os_store_attach.restype = ctypes.c_void_p

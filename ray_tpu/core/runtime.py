@@ -1313,6 +1313,24 @@ class Runtime:
                 self.store.reclaim_pid(w.proc.pid)
             except Exception:
                 pass
+            # zero the dead process's per-proc gauge series (host:pid
+            # label, llm/telemetry.py): gauges are last-write-wins with
+            # no owner left to update them, so a killed replica's last
+            # kv_utilization/occupancy would pin /metrics forever. A
+            # same-pid collision from another host self-heals on that
+            # process's next ~2s flush tick.
+            try:
+                suffix = f":{w.proc.pid}"
+                for rec in self.user_metrics.values():
+                    if rec.get("kind") != "gauge":
+                        continue
+                    for key, val in rec["series"].items():
+                        if val and any(k == "proc"
+                                       and str(v).endswith(suffix)
+                                       for k, v in key):
+                            rec["series"][key] = 0.0
+            except Exception:
+                pass
             # and its refcount interest (it will never send ref_drop)
             for oid in [o for o, s in self.interest.items() if wid in s]:
                 self._ref_drop_locked(oid, wid)
@@ -2924,7 +2942,8 @@ class Runtime:
                 "ts": rec["start_s"] * 1e6,
                 "dur": rec.get("dur_s", 0.0) * 1e6,
                 "args": {k: rec[k] for k in
-                         ("trace_id", "span_id", "parent_id")
+                         ("trace_id", "span_id", "parent_id",
+                          "request_id")
                          if rec.get(k) is not None}})
 
     def timeline(self) -> list[dict]:
@@ -2948,6 +2967,10 @@ class Runtime:
                 self._log_tail_scan()
             except Exception:
                 pass
+        # final metric flush BEFORE the snapshot: counter deltas recorded
+        # since the last 2s tick merge into user_metrics and persist
+        from ..util.metrics import shutdown_flush
+        shutdown_flush()
         # durable snapshot FIRST: killing workers below tears actors out
         # of the tables (watch-proc death path), and a successor must see
         # them as they were while alive

@@ -814,6 +814,18 @@ class WorkerLoop:
                 # thread reaches the stolen dispatch in its queue
                 self._stolen.update(msg["nonces"])
             elif t == "exit":
+                try:
+                    import sys
+                    # zero this process's per-proc engine gauges first:
+                    # the head store is last-write-wins and no one else
+                    # will ever update a dead replica's series
+                    tmod = sys.modules.get("ray_tpu.llm.telemetry")
+                    if tmod is not None:
+                        tmod.zero_proc_gauges()
+                    from ..util.metrics import shutdown_flush
+                    shutdown_flush()   # final counter deltas to the head
+                except Exception:
+                    pass
                 if _pre_exit_hook is not None:
                     _pre_exit_hook()   # profiler dump (main() sets it)
                 os._exit(0)

@@ -15,7 +15,7 @@ separate process tree, no node agents, no build step.
     #                     click through to detail, logs are browsable)
     # GET /api/summary | /api/nodes | /api/actors | /api/tasks
     #     /api/objects | /api/workers | /api/jobs | /api/config
-    #     /api/serve   | /api/logs
+    #     /api/serve   | /api/serve_metrics | /api/logs
     # GET /api/task/{id}   -> full task record + its timeline events
     # GET /api/actor/{id}  -> full actor record + per-call queues
     # GET /api/log?file=worker-X.log&tail=N -> log tail (session dir only)
@@ -205,6 +205,12 @@ def start_dashboard(port: int = 0, host: str = "127.0.0.1") -> int:
                 # remote round-trip: keep it off the dashboard event loop
                 loop = asyncio.get_event_loop()
                 out = await loop.run_in_executor(None, serve_api.status)
+            elif kind == "serve_metrics":
+                # p50/p95/p99 TTFT / e2e / replica latency + headline
+                # counters, condensed from the head's merged metric store
+                from .serve.metrics import metrics_summary
+                loop = asyncio.get_event_loop()
+                out = await loop.run_in_executor(None, metrics_summary)
             elif kind == "memory":
                 # head lock + per-object residency probes: keep it off
                 # the dashboard event loop (same rule as the serve branch)

@@ -63,6 +63,13 @@ class _Request:
     done: bool = False
     submit_t: float = 0.0
     first_token_t: float = 0.0    # TTFT = first_token_t - submit_t
+    # telemetry (llm/telemetry.py): admission time, wall-clock submit
+    # (spans use wall time), serve request id, and the submitter's trace
+    # context so the engine thread can emit an llm.request span
+    admit_t: float = 0.0
+    submit_wall: float = 0.0
+    request_id: str = ""
+    trace_ctx: Optional[tuple] = None
     event: threading.Event = dataclasses.field(
         default_factory=threading.Event)
 
@@ -131,6 +138,8 @@ class _EngineBase:
     programs; they must maintain self.cfg (with .max_seq_len), self._lock,
     self._pending, self._active, self._rng, self.tokenizer."""
 
+    telemetry_kind = "dense"
+
     def generate(self, prompts, params=None) -> list[dict]:
         """Blocking batch generation; returns [{text, token_ids,
         prompt_tokens, ttft_s, finish_reason}] in prompt order."""
@@ -156,12 +165,27 @@ class _EngineBase:
         if params.max_tokens > capacity:
             params = dataclasses.replace(params,
                                          max_tokens=max(1, capacity))
+        from . import telemetry
         with self._lock:
             req = _Request(self._next_rid, ids, params)
             req.submit_t = time.perf_counter()
             self._next_rid += 1
+            # stamp trace/request identity BEFORE publishing: once req is
+            # in _pending a concurrently stepping engine thread can retire
+            # a short request and emit its span/metrics immediately
+            telemetry.on_submit(self, req)
             self._pending.append(req)
         return req
+
+    def _finish_request(self, req: _Request, finish=None):
+        """Retire a request: mark done, wake waiters, emit telemetry
+        (TTFT/ITL/e2e observations + the request's trace span)."""
+        if req.done:
+            return
+        req.done = True
+        req.event.set()
+        from . import telemetry
+        telemetry.on_finish(self, req, finish)
 
     def has_work(self) -> bool:
         return bool(self._pending or self._active)
@@ -229,6 +253,10 @@ class InferenceEngine(_EngineBase):
         self._next_rid = 0
         self._rng = jax.random.PRNGKey(rng_seed)
         self._lock = threading.Lock()
+        # observability: dispatch/token counts (paged engine parity;
+        # telemetry ships deltas from here to the Prometheus counters)
+        self.stats = {"prefill_dispatches": 0, "decode_dispatches": 0,
+                      "tokens_out": 0}
 
         mc = cfg.model
         max_len = cfg.max_seq_len
@@ -285,15 +313,20 @@ class InferenceEngine(_EngineBase):
         logits, self.cache = self._decode_fn(
             self.params, self.cache, jnp.asarray(tokens),
             jnp.asarray(active))
+        self.stats["decode_dispatches"] += 1
         self._sample_and_retire(logits, sub)
+        from . import telemetry
+        telemetry.on_step(self)
 
     def _admit(self):
         with self._lock:
+            from . import telemetry
             while self._pending and self._free_slots:
                 req = self._pending.pop(0)
                 slot = self._free_slots.pop(0)
                 req.slot = slot
                 self._active[slot] = req
+                telemetry.on_admit(self, req)
                 self._do_prefill(req)
 
     def _bucket(self, n: int) -> int:
@@ -313,6 +346,10 @@ class InferenceEngine(_EngineBase):
         first = self._sample_one(last_logits[None, :], req.params)
         req.out_ids.append(int(first[0]))
         req.first_token_t = time.perf_counter()
+        self.stats["prefill_dispatches"] += 1
+        self.stats["tokens_out"] += 1
+        from . import telemetry
+        telemetry.on_first_token(self, req)
 
     def _sample_and_retire(self, logits, rng):
         next_tokens = self._sample_next_tokens(logits, rng)
@@ -321,12 +358,12 @@ class InferenceEngine(_EngineBase):
             req = self._active[slot]
             tok = next_tokens[slot]
             req.out_ids.append(tok)
+            self.stats["tokens_out"] += 1
             stop = (len(req.out_ids) >= req.params.max_tokens
                     or tok == eos or tok in req.params.stop_token_ids
                     or int(self.cache["lengths"][slot])
                     >= self.cfg.max_seq_len - 1)
             if stop:
-                req.done = True
-                req.event.set()
+                self._finish_request(req)
                 del self._active[slot]
                 self._free_slots.append(slot)

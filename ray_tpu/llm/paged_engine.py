@@ -87,6 +87,8 @@ class PagedEngineConfig:
 class PagedInferenceEngine(_EngineBase):
     """Synchronous paged engine; serving runs it on a background thread."""
 
+    telemetry_kind = "paged"
+
     def __init__(self, cfg: PagedEngineConfig, params: Optional[dict] = None,
                  rng_seed: int = 0, interpret: bool = False):
         self.cfg = cfg
@@ -332,6 +334,8 @@ class PagedInferenceEngine(_EngineBase):
         self._admit()
         self._prefill_step()
         self._decode_step()
+        from . import telemetry
+        telemetry.on_step(self)
 
     def _admit(self):
         with self._lock:
@@ -346,6 +350,8 @@ class PagedInferenceEngine(_EngineBase):
                 req.slot = self._free_slots.pop(0)
                 self._ensure_pages(req, len(req.prompt_ids) + 1)
                 self._prefilling.append(req)
+                from . import telemetry
+                telemetry.on_admit(self, req)
 
     def _prefill_step(self):
         import time
@@ -406,14 +412,15 @@ class PagedInferenceEngine(_EngineBase):
                 req.out_logps.append(float(lps[i]))
             self.stats["tokens_out"] += 1
             req.first_token_t = time.perf_counter()
+            from . import telemetry
+            telemetry.on_first_token(self, req)
             self._lengths[req.slot] = len(req.prompt_ids)
             self._prefilling.remove(req)
             if getattr(req, "prefill_only", False):
                 # disaggregated prefill: export the KV pages + first token
                 # instead of decoding here (llm/pd_disagg.py)
                 req.export_payload = self._export_kv_locked(req, tok)
-                req.done = True
-                req.event.set()
+                self._finish_request(req, "export")
                 self._release(req)
                 continue
             self._active[req.slot] = req
@@ -506,6 +513,8 @@ class PagedInferenceEngine(_EngineBase):
             consumed = 0
             for tok, lp in out:
                 if consumed >= allow[slot]:
+                    from . import telemetry
+                    telemetry.on_preempted(self)
                     self._retire(req)
                     break
                 req.out_ids.append(tok)
@@ -580,6 +589,8 @@ class PagedInferenceEngine(_EngineBase):
                     # page pool exhausted mid-window: finish early rather
                     # than wedge (tokens past the allocation wrote to the
                     # sink page and are not trustworthy)
+                    from . import telemetry
+                    telemetry.on_preempted(self)
                     self._retire(req)
                     break
                 tok = int(out[slot, j])
@@ -619,8 +630,7 @@ class PagedInferenceEngine(_EngineBase):
                 or total >= self.cfg.max_seq_len - 1)
 
     def _retire(self, req: _Request):
-        req.done = True
-        req.event.set()
+        self._finish_request(req)
         self._active.pop(req.slot, None)
         if req in self._prefilling:
             self._prefilling.remove(req)
@@ -633,6 +643,8 @@ class PagedInferenceEngine(_EngineBase):
             total = len(req.prompt_ids) + len(req.out_ids)
             if not self._ensure_pages(req, total + 1):
                 stop = True  # pool exhausted: finish early rather than wedge
+                from . import telemetry
+                telemetry.on_preempted(self)
         if stop:
             self._retire(req)
 
@@ -679,6 +691,9 @@ class PagedInferenceEngine(_EngineBase):
         with self._lock:
             req = _Request(self._next_rid, ids, params)
             req.submit_t = time.perf_counter()
+            req.admit_t = req.submit_t
+            from . import telemetry
+            telemetry.on_submit(self, req)
             self._next_rid += 1
             if not self._free_slots:
                 raise RuntimeError("no free decode slot")

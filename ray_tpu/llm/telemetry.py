@@ -1,0 +1,264 @@
+"""Engine telemetry: the serving hot path rendered measurable.
+
+Reference role: vLLM's Stats/StatLogger pipeline (engine-loop iteration
+stats feeding Prometheus) and the reference serve deployments' per-request
+metrics. Orca/vLLM-class continuous-batching systems are tuned almost
+entirely off TTFT / inter-token-latency / KV-utilization telemetry; these
+hooks put those series on the head's `/metrics` via the existing
+util/metrics.py delta-flush — zero new transport, and a no-op overhead of
+a few dict updates per engine step.
+
+Every metric carries an ``engine`` label ("paged" / "dense") so mixed
+deployments stay separable; gauges additionally carry a ``proc``
+(host:pid) label because they are last-write-wins on the head — without
+it, replicas of the same engine kind would overwrite each other. When tracing is enabled each request also
+emits one ``llm.request`` span parented to whatever span submitted it
+(the serve replica's task span when the request came through Serve), so
+a proxy -> replica -> engine request renders as one stitched tree in
+``ray_tpu.timeline()``.
+
+Metric names (all prefixed ``rtpu_llm_``):
+  ttft_seconds           histogram  submit -> first generated token
+  inter_token_seconds    histogram  mean gap between generated tokens
+  queue_wait_seconds     histogram  submit -> admission into the batch
+  e2e_seconds            histogram  submit -> request retired
+  batch_occupancy        gauge      active slots / max_batch_size
+  kv_utilization         gauge      KV pages in use / pool size (paged)
+  pending_requests       gauge      submitted, not yet admitted
+  prefilling_requests    gauge      admitted, prompt not fully prefilled
+  decoding_requests      gauge      in the decode set
+  tokens_generated_total counter    generated tokens
+  requests_total         counter    retired requests, by finish label
+  preemptions_total      counter    requests finished early (KV pool dry)
+  spec_proposed_total    counter    speculative tokens proposed
+  spec_accepted_total    counter    speculative tokens accepted
+  dispatches_total       counter    device dispatches, by program family
+"""
+from __future__ import annotations
+
+import functools
+import os
+import time
+from typing import Optional
+
+from ..util.metrics import (LATENCY_BUCKETS, Counter, Gauge, Histogram,
+                            cached_metric)
+
+
+def _hist(name, desc, boundaries=LATENCY_BUCKETS):
+    return cached_metric(Histogram, name, desc, boundaries=boundaries,
+                         tag_keys=("engine",))
+
+
+def _gauge(name, desc):
+    # gauges carry a per-process label: they are last-write-wins on the
+    # head, so two replicas of the same engine kind flushing under one
+    # key would mask each other (a saturated replica's kv_utilization
+    # hidden by an idle one). Counters/histograms sum deltas and stay
+    # engine-keyed.
+    return cached_metric(Gauge, name, desc, tag_keys=("engine", "proc"))
+
+
+_proc_pid = None
+_proc_label = ""
+
+
+def _proc() -> str:
+    """host:pid, re-derived after fork so a worker never inherits the
+    parent's identity."""
+    global _proc_pid, _proc_label
+    pid = os.getpid()
+    if pid != _proc_pid:
+        import socket
+        _proc_pid = pid
+        _proc_label = f"{socket.gethostname()}:{pid}"
+    return _proc_label
+
+
+def _counter(name, desc, tag_keys=("engine",)):
+    return cached_metric(Counter, name, desc, tag_keys=tag_keys)
+
+
+def zero_proc_gauges() -> None:
+    """Exit-path hook (core/worker.py): zero this process's per-proc
+    gauge series before the final flush, so a downscaled replica's last
+    values don't pin /metrics and metrics_summary()'s max aggregation
+    forever. Best-effort — a SIGKILLed replica skips it."""
+    try:
+        from ..util import metrics as um
+        um.zero_gauges(("proc", _proc()))
+    except Exception:
+        pass
+
+
+def _never_raise(fn):
+    """These hooks sit inside the engine step loop and submit path; an
+    exception here (e.g. a user metric registered under a colliding
+    name) must degrade to lost telemetry, never kill the engine thread
+    and strand every in-flight request."""
+    @functools.wraps(fn)
+    def wrapped(*args, **kw):
+        try:
+            return fn(*args, **kw)
+        except Exception:
+            pass
+    return wrapped
+
+
+# --------------------------------------------------------------------- #
+# hooks (called by engine.py / paged_engine.py)
+# --------------------------------------------------------------------- #
+
+@_never_raise
+def on_submit(engine, req) -> None:
+    """Stamp trace/request identity on the request at intake. Runs on the
+    submitter's thread (inside the replica's activated task span when the
+    request came through Serve), so the engine loop thread can emit the
+    request's span later without any contextvar of its own."""
+    req.submit_wall = time.time()
+    try:
+        from ..util import tracing
+        if tracing.tracing_enabled():
+            req.trace_ctx = tracing.current_context() or \
+                (tracing.new_trace_id(), None)
+        from ..serve.context import get_request_context
+        req.request_id = get_request_context().request_id
+    except Exception:
+        pass
+
+
+@_never_raise
+def on_admit(engine, req) -> None:
+    req.admit_t = time.perf_counter()
+
+
+@_never_raise
+def on_first_token(engine, req) -> None:
+    tags = {"engine": engine.telemetry_kind}
+    if req.submit_t:
+        _hist("rtpu_llm_ttft_seconds",
+              "time to first generated token").observe(
+            req.first_token_t - req.submit_t, tags=tags)
+        if req.admit_t:
+            _hist("rtpu_llm_queue_wait_seconds",
+                  "submit to batch admission").observe(
+                max(req.admit_t - req.submit_t, 0.0), tags=tags)
+
+
+@_never_raise
+def on_finish(engine, req, finish: Optional[str] = None) -> None:
+    now = time.perf_counter()
+    if finish is None:
+        eos = engine._eos_id()
+        if eos is not None and eos in req.out_ids:
+            finish = "stop"
+        elif len(req.out_ids) >= req.params.max_tokens:
+            finish = "length"
+        else:
+            finish = "other"
+    tags = {"engine": engine.telemetry_kind}
+    _counter("rtpu_llm_requests_total", "retired requests",
+             tag_keys=("engine", "finish")).inc(
+        1.0, tags={**tags, "finish": finish})
+    if req.submit_t:
+        _hist("rtpu_llm_e2e_seconds", "submit to retirement").observe(
+            now - req.submit_t, tags=tags)
+    n = len(req.out_ids)
+    if n > 1 and req.first_token_t:
+        _hist("rtpu_llm_inter_token_seconds",
+              "mean inter-token gap over the request").observe(
+            max(now - req.first_token_t, 0.0) / (n - 1), tags=tags)
+    _emit_request_span(req)
+
+
+@_never_raise
+def on_preempted(engine) -> None:
+    _counter("rtpu_llm_preemptions_total",
+             "requests finished early because the KV page pool ran "
+             "dry").inc(1.0, tags={"engine": engine.telemetry_kind})
+
+
+@_never_raise
+def on_step(engine) -> None:
+    """Per-step gauges + counter deltas from the engine's stats dict.
+    Cheap on purpose: a handful of dict updates under one lock, all
+    host-side state (never forces a device transfer)."""
+    kind = engine.telemetry_kind
+    tags = {"engine": kind}
+    gtags = {"engine": kind, "proc": _proc()}
+    cfg = engine.cfg
+    _gauge("rtpu_llm_batch_occupancy",
+           "active decode slots / max_batch_size").set(
+        len(engine._active) / max(cfg.max_batch_size, 1), tags=gtags)
+    _gauge("rtpu_llm_pending_requests",
+           "submitted, not yet admitted").set(
+        len(engine._pending), tags=gtags)
+    _gauge("rtpu_llm_decoding_requests", "requests in the decode set").set(
+        len(engine._active), tags=gtags)
+    prefilling = getattr(engine, "_prefilling", None)
+    if prefilling is not None:
+        _gauge("rtpu_llm_prefilling_requests",
+               "admitted, prompt not fully prefilled").set(
+            len(prefilling), tags=gtags)
+    free = getattr(engine, "_free_pages", None)
+    if free is not None:
+        pool = cfg.num_pages - 1  # page 0 is the write sink
+        _gauge("rtpu_llm_kv_utilization",
+               "KV pages in use / pool size").set(
+            (pool - len(free)) / max(pool, 1), tags=gtags)
+    stats = getattr(engine, "stats", None)
+    if stats:
+        _ship_stat_deltas(engine, stats, tags)
+
+
+_STAT_COUNTERS = (
+    ("tokens_out", "rtpu_llm_tokens_generated_total",
+     "generated tokens", None),
+    ("spec_proposed", "rtpu_llm_spec_proposed_total",
+     "speculative draft tokens proposed", None),
+    ("spec_accepted", "rtpu_llm_spec_accepted_total",
+     "speculative draft tokens accepted", None),
+    ("prefill_dispatches", "rtpu_llm_dispatches_total",
+     "device dispatches by program family", "prefill"),
+    ("decode_dispatches", "rtpu_llm_dispatches_total",
+     "device dispatches by program family", "decode"),
+    ("spec_dispatches", "rtpu_llm_dispatches_total",
+     "device dispatches by program family", "verify"),
+)
+
+
+def _ship_stat_deltas(engine, stats: dict, tags: dict) -> None:
+    last = getattr(engine, "_telem_shipped", None)
+    if last is None:
+        last = engine._telem_shipped = {}
+    for key, name, desc, family in _STAT_COUNTERS:
+        cur = stats.get(key)
+        if cur is None:
+            continue
+        delta = cur - last.get(key, 0)
+        if delta <= 0:
+            continue
+        last[key] = cur
+        if family is None:
+            _counter(name, desc).inc(float(delta), tags=tags)
+        else:
+            _counter(name, desc, tag_keys=("engine", "family")).inc(
+                float(delta), tags={**tags, "family": family})
+
+
+def _emit_request_span(req) -> None:
+    ctx: Optional[tuple] = getattr(req, "trace_ctx", None)
+    if ctx is None:
+        return
+    try:
+        from ..util import tracing
+        trace_id, parent_id = ctx
+        rec = {"trace_id": trace_id, "span_id": tracing.new_span_id(),
+               "parent_id": parent_id, "name": "llm.request",
+               "start_s": req.submit_wall,
+               "dur_s": max(time.time() - req.submit_wall, 0.0)}
+        if getattr(req, "request_id", ""):
+            rec["request_id"] = req.request_id
+        tracing.record_span(rec)
+    except Exception:
+        pass

@@ -34,12 +34,13 @@ from .context import get_multiplexed_model_id, get_request_context
 from .handle import (DeploymentHandle, DeploymentResponse,
                      DeploymentResponseGenerator)
 from .grpc_proxy import start_grpc_proxy
+from .metrics import metrics_summary
 from .multiplex import multiplexed
 
 __all__ = [
     "Application", "Deployment", "deployment", "run", "shutdown", "delete",
     "status", "get_app_handle", "DeploymentHandle", "DeploymentResponse",
     "DeploymentResponseGenerator", "batch", "multiplexed",
-    "get_multiplexed_model_id", "get_request_context", "start_grpc_proxy",
-    "update_user_config",
+    "get_multiplexed_model_id", "get_request_context", "metrics_summary",
+    "start_grpc_proxy", "update_user_config",
 ]

@@ -20,8 +20,9 @@ from typing import Any, Callable, Optional
 
 class _BatchQueue:
     def __init__(self, fn: Callable, max_batch_size: int,
-                 batch_wait_timeout_s: float):
+                 batch_wait_timeout_s: float, fn_name: str = ""):
         self.fn = fn
+        self.fn_name = fn_name
         self.max_batch_size = max_batch_size
         self.timeout_s = batch_wait_timeout_s
         self.queue: asyncio.Queue = asyncio.Queue()
@@ -53,8 +54,18 @@ class _BatchQueue:
                         batch.append(self.queue.get_nowait())
                     except asyncio.QueueEmpty:
                         break
-            args = [a for a, _ in batch]
-            futs = [f for _, f in batch]
+            args = [a for a, _, _ in batch]
+            futs = [f for _, f, _ in batch]
+            try:
+                from . import metrics as sm
+                sm.batch_size().observe(len(batch),
+                                        tags={"fn": self.fn_name})
+                # FIFO queue: batch[0] is the oldest item
+                sm.batch_wait().observe(
+                    max(asyncio.get_event_loop().time() - batch[0][2], 0.0),
+                    tags={"fn": self.fn_name})
+            except Exception:
+                pass  # telemetry must never fail the batch
             try:
                 results = await self.fn(args)
                 if results is None or len(results) != len(args):
@@ -100,11 +111,13 @@ def batch(_fn=None, *, max_batch_size: int = 10,
                     "@serve.batch functions take exactly one request arg")
             q = queues.get(key)
             if q is None:
-                q = queues[key] = _BatchQueue(call, max_batch_size,
-                                              batch_wait_timeout_s)
+                q = queues[key] = _BatchQueue(
+                    call, max_batch_size, batch_wait_timeout_s,
+                    fn_name=getattr(fn, "__qualname__", fn.__name__))
             q.ensure_worker()
-            fut: asyncio.Future = asyncio.get_event_loop().create_future()
-            q.queue.put_nowait((request, fut))
+            loop = asyncio.get_event_loop()
+            fut: asyncio.Future = loop.create_future()
+            q.queue.put_nowait((request, fut, loop.time()))
             return await fut
 
         wrapper._rtpu_batch_queues = queues  # introspection/tests

@@ -67,20 +67,48 @@ class ReplicaActor:
                 loop = asyncio.get_event_loop()
                 out = await loop.run_in_executor(
                     None, lambda: ctx.run(target, *args, **kwargs))
-            if asyncio.iscoroutine(out):
+            # inspect.iscoroutine, NOT asyncio.iscoroutine: on py<3.12 the
+            # asyncio one also accepts PLAIN GENERATORS (legacy @coroutine
+            # support), and awaiting a sync-generator deployment's return
+            # value raises TypeError instead of streaming it
+            import inspect
+            if inspect.iscoroutine(out):
                 out = await out
             return out
         finally:
             reset_request_context(token)
 
+    @staticmethod
+    def _observe(context: Optional[dict], t0: float, outcome: str):
+        """Replica-side telemetry (reference: serve/_private replica
+        processing-latency + request counters). Never raises."""
+        import time
+        try:
+            from . import metrics as sm
+            tags = {"app": (context or {}).get("app_name", ""),
+                    "deployment": (context or {}).get("deployment", "")}
+            sm.replica_latency().observe(time.perf_counter() - t0,
+                                         tags=tags)
+            sm.replica_requests().inc(
+                1.0, tags={**tags, "outcome": outcome})
+        except Exception:
+            pass
+
     async def handle_request(self, method: str, args: tuple, kwargs: dict,
                              context: Optional[dict] = None):
+        import time
         self._ongoing += 1
         self._total += 1
+        t0 = time.perf_counter()
+        outcome = "ok"
         try:
             return await self._invoke(method, args, kwargs, context)
+        except BaseException:
+            outcome = "error"
+            raise
         finally:
             self._ongoing -= 1
+            self._observe(context, t0, outcome)
 
     # -- streaming responses (reference: replica.py handles generator
     # results via ray streaming generators; here the replica retains the
@@ -89,8 +117,10 @@ class ReplicaActor:
     async def handle_request_streaming(self, method: str, args: tuple,
                                        kwargs: dict,
                                        context: Optional[dict] = None) -> int:
+        import time
         self._ongoing += 1
         self._total += 1
+        t0 = time.perf_counter()
         try:
             out = await self._invoke(method, args, kwargs, context)
             if not hasattr(out, "__anext__") and \
@@ -100,7 +130,11 @@ class ReplicaActor:
                     f"{type(out).__name__}, not a generator")
         except BaseException:
             self._ongoing -= 1
+            self._observe(context, t0, "error")
             raise
+        # latency here covers the call that produced the generator; the
+        # drain is accounted at the proxy's e2e histogram
+        self._observe(context, t0, "ok")
         self._stream_seq += 1
         sid = self._stream_seq
         self._streams[sid] = out
@@ -421,6 +455,16 @@ class ServeController:
                     ray_tpu.kill(r)
                 except Exception:
                     pass
+            # gauges are last-write-wins: without an explicit zero the
+            # deleted deployment's queue_depth/replicas series hold their
+            # final value on /metrics forever
+            try:
+                from . import metrics as sm
+                tags = {"app": st.app, "deployment": st.spec.name}
+                sm.queue_depth().set(0.0, tags=tags)
+                sm.replica_count().set(0.0, tags=tags)
+            except Exception:
+                pass
 
     async def shutdown(self) -> None:
         self._shutdown = True
@@ -461,6 +505,22 @@ class ServeController:
                             except Exception:
                                 pass
                     st.replicas = alive
+                    # membership check right before the write (no await in
+                    # between, and the controller is single-event-loop):
+                    # delete_application may have zeroed these gauges while
+                    # this tick awaited replica stats, and a write from the
+                    # pre-delete snapshot would resurrect the series at a
+                    # stale value forever
+                    if self._apps.get(st.app, {}).get(st.spec.name) is st:
+                        try:
+                            from . import metrics as sm
+                            tags = {"app": st.app,
+                                    "deployment": st.spec.name}
+                            sm.queue_depth().set(ongoing, tags=tags)
+                            sm.replica_count().set(len(st.replicas),
+                                                   tags=tags)
+                        except Exception:
+                            pass
                     cfg = st.spec.autoscaling_config
                     if cfg is not None:
                         self._autoscale(st, cfg, ongoing)
@@ -474,14 +534,25 @@ class ServeController:
         desired = math.ceil(total_ongoing / max(cfg.target_ongoing_requests,
                                                 1e-9))
         desired = max(cfg.min_replicas, min(cfg.max_replicas, desired))
+        direction = None
         if desired > st.target and \
                 now - self._last(st, "up") >= cfg.upscale_delay_s:
             st.target = desired
             st._last_scale_up = now
+            direction = "up"
         elif desired < st.target and \
                 now - self._last(st, "down") >= cfg.downscale_delay_s:
             st.target = desired
             st._last_scale_down = now
+            direction = "down"
+        if direction is not None:
+            try:
+                from . import metrics as sm
+                sm.autoscale_decisions().inc(1.0, tags={
+                    "app": st.app, "deployment": st.spec.name,
+                    "direction": direction})
+            except Exception:
+                pass
 
     @staticmethod
     def _last(st: _DeploymentState, which: str) -> float:

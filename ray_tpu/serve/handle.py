@@ -249,6 +249,7 @@ class DeploymentHandle:
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         import ray_tpu
+        t0 = time.perf_counter()
         self._refresh()
         self._ensure_listener()
         deadline = time.monotonic() + 30.0
@@ -271,9 +272,22 @@ class DeploymentHandle:
         def done(i=idx):
             self._inflight[i] = max(0, self._inflight.get(i, 1) - 1)
 
+        request_id = ""
+        try:
+            from . import metrics as sm
+            from .context import get_request_context
+            request_id = get_request_context().request_id
+            tags = {"app": self.app_name,
+                    "deployment": self.deployment_name}
+            sm.handle_requests().inc(1.0, tags=tags)
+            sm.router_wait().observe(time.perf_counter() - t0, tags=tags)
+        except Exception:
+            pass  # telemetry must never fail a request
+
         context = {"app_name": self.app_name,
                    "deployment": self.deployment_name,
-                   "multiplexed_model_id": self._model_id}
+                   "multiplexed_model_id": self._model_id,
+                   "request_id": request_id}
 
         if self._stream:
             import ray_tpu

@@ -1,0 +1,215 @@
+"""Serve telemetry: request-path metrics + the metrics_summary() helper.
+
+Reference parity: serve/_private's per-deployment counters and latency
+histograms feeding the metrics agent (_private/metrics_agent.py) and the
+autoscaler. Everything here records through util/metrics.py, so series
+from the proxy, handles, replicas and controller — each its own process —
+merge on the head and render on `/metrics` with zero new transport.
+
+Metric names and label sets:
+  rtpu_serve_proxy_requests_total{route,method,status}   counter
+  rtpu_serve_request_latency_seconds{app,route}          histogram (e2e,
+      observed at the proxy: parse -> route -> replica -> respond)
+  rtpu_serve_request_errors_total{app,route,code}        counter
+  rtpu_serve_handle_requests_total{app,deployment}       counter
+  rtpu_serve_router_wait_seconds{app,deployment}         histogram (handle
+      call -> request handed to a replica: replica-set refresh + cold start)
+  rtpu_serve_replica_latency_seconds{app,deployment}     histogram
+  rtpu_serve_replica_requests_total{app,deployment,outcome} counter
+  rtpu_serve_queue_depth{app,deployment}                 gauge (ongoing
+      requests summed over replicas; the autoscaler's input signal)
+  rtpu_serve_replicas{app,deployment}                    gauge
+  rtpu_serve_autoscale_decisions_total{app,deployment,direction} counter
+  rtpu_serve_batch_size{fn}                              histogram
+  rtpu_serve_batch_wait_seconds{fn}                      histogram
+
+``metrics_summary()`` condenses the merged store into finite p50/p95/p99
+latencies (TTFT, e2e, replica) plus the headline gauges/counters — the
+number a perf PR cites, and what ``bench_serve.py --metrics`` prints.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..util.metrics import (LATENCY_BUCKETS as _LAT, Counter, Gauge,
+                            Histogram, cached_metric as _metric,
+                            histogram_quantiles)
+
+_SIZES = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
+
+
+def proxy_requests() -> Counter:
+    return _metric(Counter, "rtpu_serve_proxy_requests_total",
+                   "HTTP requests through the Serve proxy",
+                   tag_keys=("route", "method", "status"))
+
+
+def request_latency() -> Histogram:
+    return _metric(Histogram, "rtpu_serve_request_latency_seconds",
+                   "end-to-end request latency observed at the proxy",
+                   boundaries=_LAT, tag_keys=("app", "route"))
+
+
+def request_errors() -> Counter:
+    return _metric(Counter, "rtpu_serve_request_errors_total",
+                   "requests that returned an error",
+                   tag_keys=("app", "route", "code"))
+
+
+def handle_requests() -> Counter:
+    return _metric(Counter, "rtpu_serve_handle_requests_total",
+                   "requests routed through DeploymentHandles",
+                   tag_keys=("app", "deployment"))
+
+
+def router_wait() -> Histogram:
+    return _metric(Histogram, "rtpu_serve_router_wait_seconds",
+                   "handle call to replica hand-off (replica-set refresh "
+                   "and cold-start wait)", boundaries=_LAT,
+                   tag_keys=("app", "deployment"))
+
+
+def replica_latency() -> Histogram:
+    return _metric(Histogram, "rtpu_serve_replica_latency_seconds",
+                   "request execution time inside a replica",
+                   boundaries=_LAT, tag_keys=("app", "deployment"))
+
+
+def replica_requests() -> Counter:
+    return _metric(Counter, "rtpu_serve_replica_requests_total",
+                   "requests executed by replicas",
+                   tag_keys=("app", "deployment", "outcome"))
+
+
+def queue_depth() -> Gauge:
+    return _metric(Gauge, "rtpu_serve_queue_depth",
+                   "ongoing requests summed over a deployment's replicas",
+                   tag_keys=("app", "deployment"))
+
+
+def replica_count() -> Gauge:
+    return _metric(Gauge, "rtpu_serve_replicas",
+                   "running replicas per deployment",
+                   tag_keys=("app", "deployment"))
+
+
+def autoscale_decisions() -> Counter:
+    return _metric(Counter, "rtpu_serve_autoscale_decisions_total",
+                   "autoscaler retarget decisions",
+                   tag_keys=("app", "deployment", "direction"))
+
+
+def batch_size() -> Histogram:
+    return _metric(Histogram, "rtpu_serve_batch_size",
+                   "items per @serve.batch invocation",
+                   boundaries=_SIZES, tag_keys=("fn",))
+
+
+def batch_wait() -> Histogram:
+    return _metric(Histogram, "rtpu_serve_batch_wait_seconds",
+                   "oldest item's queue wait per @serve.batch invocation",
+                   boundaries=_LAT, tag_keys=("fn",))
+
+
+# --------------------------------------------------------------------- #
+# summary
+# --------------------------------------------------------------------- #
+
+def _collect_store() -> dict:
+    """The merged user-metric store: head tables on the head driver, the
+    user_metrics_dump RPC from a remote driver/worker, this process's
+    registry when no runtime exists (bench / unit tests)."""
+    from ..core import runtime as rt_mod
+    from ..util import metrics as um
+    um.flush()   # ship this process's deltas first
+    rt = rt_mod.get_runtime_if_exists()
+    if rt is None:
+        return um.local_store()
+    if isinstance(rt, rt_mod.Runtime):
+        with rt.lock:
+            return {n: {"kind": r["kind"], "desc": r["desc"],
+                        "series": dict(r["series"])}
+                    for n, r in rt.user_metrics.items()}
+    try:
+        return rt._rpc("user_metrics_dump")
+    except Exception:
+        return um.local_store()
+
+
+def _hist_stats(rec: Optional[dict]) -> Optional[dict]:
+    """Aggregate one histogram record across its label sets into
+    {count, mean, p50, p95, p99}."""
+    if not rec:
+        return None
+    buckets: dict[str, float] = {}
+    total_sum = 0.0
+    for key, val in rec["series"].items():
+        le = next((v for k, v in key if k == "le"), None)
+        if le is not None:
+            buckets[le] = buckets.get(le, 0.0) + val
+        elif any(k == "__sum__" for k, _ in key):
+            total_sum += val
+    count = buckets.get("+Inf", 0.0)
+    if count <= 0:
+        return None
+    p50, p95, p99 = histogram_quantiles(buckets, count, (0.5, 0.95, 0.99))
+    return {"count": count, "mean": total_sum / count,
+            "p50": p50, "p95": p95, "p99": p99}
+
+
+def _counter_total(rec: Optional[dict]) -> float:
+    return sum(rec["series"].values()) if rec else 0.0
+
+
+def metrics_summary() -> dict:
+    """Percentiles and headline series from the merged metric store.
+
+    Returns a dict with (present only when data exists):
+      ttft / inter_token / queue_wait / e2e_latency / replica_latency —
+          {count, mean, p50, p95, p99} in seconds
+      kv_utilization / batch_occupancy — {<engine>: value of the
+          most-loaded process}
+      requests — {proxy, handle, replica, errors} cumulative counts
+    Worker-side series ship on a ~2s cadence; a summary taken immediately
+    after traffic may trail by one flush tick.
+    """
+    store = _collect_store()
+    out: dict = {}
+    for key, name in (
+            ("ttft", "rtpu_llm_ttft_seconds"),
+            ("inter_token", "rtpu_llm_inter_token_seconds"),
+            ("queue_wait", "rtpu_llm_queue_wait_seconds"),
+            ("e2e_latency", "rtpu_serve_request_latency_seconds"),
+            ("replica_latency", "rtpu_serve_replica_latency_seconds"),
+            ("router_wait", "rtpu_serve_router_wait_seconds")):
+        stats = _hist_stats(store.get(name))
+        if stats is not None:
+            out[key] = stats
+    for key, name in (("kv_utilization", "rtpu_llm_kv_utilization"),
+                      ("batch_occupancy", "rtpu_llm_batch_occupancy")):
+        rec = store.get(name)
+        if rec:
+            # gauge series are per-process (proc label); the headline
+            # number per engine kind is the MOST LOADED process — mean
+            # would let one idle replica mask a saturated one
+            agg: dict = {}
+            for kk, vv in rec["series"].items():
+                eng = next((v for k, v in kk if k == "engine"), "")
+                agg[eng] = max(agg.get(eng, 0.0), vv)
+            out[key] = agg
+    out["requests"] = {
+        "proxy": _counter_total(
+            store.get("rtpu_serve_proxy_requests_total")),
+        "handle": _counter_total(
+            store.get("rtpu_serve_handle_requests_total")),
+        "replica": _counter_total(
+            store.get("rtpu_serve_replica_requests_total")),
+        "errors": _counter_total(
+            store.get("rtpu_serve_request_errors_total")),
+        "llm": _counter_total(store.get("rtpu_llm_requests_total")),
+        "llm_tokens": _counter_total(
+            store.get("rtpu_llm_tokens_generated_total")),
+        "llm_preemptions": _counter_total(
+            store.get("rtpu_llm_preemptions_total")),
+    }
+    return out

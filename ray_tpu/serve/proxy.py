@@ -15,6 +15,11 @@ from typing import Optional
 
 _STREAM_END = object()
 
+# the proxy route registers METH_ANY; metric labels must come from this
+# fixed set, never the raw (client-controlled) method token
+_KNOWN_VERBS = frozenset(
+    {"GET", "POST", "PUT", "DELETE", "PATCH", "HEAD", "OPTIONS"})
+
 
 class ProxyActor:
     def __init__(self, port: int):
@@ -64,6 +69,59 @@ class ProxyActor:
         return self._port
 
     async def _dispatch(self, request):
+        """Telemetry shell around _dispatch_inner: mints the request id,
+        opens the request's root trace span, and lands the per-route
+        counters + e2e latency histogram whatever the outcome."""
+        import secrets
+        import time as _time
+
+        from aiohttp import web
+
+        from . import metrics as sm
+        from ..util import tracing
+
+        rid = secrets.token_hex(8)
+        meta = {"app": "", "route": ""}
+        t0 = _time.perf_counter()
+        status = 500
+        try:
+            with tracing.span("serve.proxy", root=True) as span_rec:
+                if span_rec is not None:
+                    span_rec["request_id"] = rid
+                resp = await self._dispatch_inner(request, rid, meta)
+            status = resp.status
+            return resp
+        except web.HTTPException as e:
+            status = e.status
+            raise
+        except (ConnectionResetError, asyncio.CancelledError):
+            # the client dropped mid-stream: not a server error (499,
+            # nginx's client-closed-request), and kept out of the error
+            # counter an operator alerts on
+            status = 499
+            raise
+        finally:
+            try:
+                route = meta["route"] or "/"
+                # the route registers METH_ANY, so request.method is an
+                # arbitrary client token: allowlist it (same unbounded-
+                # cardinality guard as the app label below)
+                method = request.method if request.method in _KNOWN_VERBS \
+                    else "OTHER"
+                sm.proxy_requests().inc(1.0, tags={
+                    "route": route, "method": method,
+                    "status": str(status)})
+                sm.request_latency().observe(
+                    _time.perf_counter() - t0,
+                    tags={"app": meta["app"], "route": route})
+                if status >= 400 and status != 499:
+                    sm.request_errors().inc(1.0, tags={
+                        "app": meta["app"], "route": route,
+                        "code": str(status)})
+            except Exception:
+                pass  # telemetry must never turn a response into a 500
+
+    async def _dispatch_inner(self, request, rid: str, meta: dict):
         from aiohttp import web
         import ray_tpu
         from .api import CONTROLLER_NAME
@@ -93,6 +151,7 @@ class ProxyActor:
             if full == p or full.startswith(p + "/"):
                 app_name = app
                 subpath = full[len(p):].strip("/")
+                meta["route"] = p
                 break
         if app_name is None:
             app_name = path.split("/", 1)[0] if path else "default"
@@ -119,6 +178,13 @@ class ProxyActor:
             else:
                 return web.json_response(
                     {"error": "no default app"}, status=404)
+        # label AFTER ingress resolution: app_name is client-controlled
+        # until it resolves against deployed apps, and unresolved names
+        # must not mint metric series (unbounded label cardinality —
+        # every scanner probe would become a permanent head-store series)
+        meta["app"] = app_name
+        if not meta["route"]:
+            meta["route"] = "/" + app_name
 
         payload: Optional[dict] = None
         if request.can_read_body:
@@ -150,8 +216,20 @@ class ProxyActor:
                 return resp  # a DeploymentResponseGenerator
             return resp.result(30.0)
 
+        # run_in_executor does NOT carry contextvars: capture the handler
+        # context (active proxy span + request context) explicitly so the
+        # replica call parents to the proxy span and rides the request id
+        import contextvars
+
+        from .context import reset_request_context, set_request_context
+        token = set_request_context(request_id=rid, app_name=app_name)
+        try:
+            call_ctx = contextvars.copy_context()
+        finally:
+            reset_request_context(token)
+
         loop = asyncio.get_event_loop()
-        out = await loop.run_in_executor(None, call)
+        out = await loop.run_in_executor(None, lambda: call_ctx.run(call))
         if want_stream:
             stream = web.StreamResponse()
             stream.headers["Content-Type"] = "text/event-stream"

@@ -28,6 +28,15 @@ _lock = threading.Lock()
 # name -> _MetricDef; (name, tags) -> value/buckets live in the defs
 _registry: dict[str, "Metric"] = {}
 _flusher_started = False
+# name -> Metric singletons handed out by cached_metric()
+_metric_cache: dict = {}
+
+# shared latency boundaries (seconds) for serving histograms: sub-ms
+# through 60s covers in-process CPU smoke engines and remote-attached-TPU
+# serving alike. llm/telemetry.py and serve/metrics.py both bucket with
+# these so rtpu_llm_* / rtpu_serve_* quantiles stay comparable.
+LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
 
 
 def _tags_key(tag_keys, tags: Optional[dict]) -> tuple:
@@ -148,11 +157,26 @@ class Histogram(Metric):
     def observe(self, value: float, tags: Optional[dict] = None):
         base = _tags_key(self.tag_keys, tags)
         value = float(value)
-        for b in self.boundaries:
-            if value <= b:
-                self._record(base + (("le", repr(b)),), 1.0, add=True)
-        self._record(base + (("le", "+Inf"),), 1.0, add=True)
-        self._record(base + (("__sum__", ""),), value, add=True)
+        with _lock:
+            for b in self.boundaries:
+                key = base + (("le", repr(b)),)
+                if value <= b:
+                    self._values[key] = self._values.get(key, 0.0) + 1.0
+                    self._dirty.add(key)
+                elif key not in self._values:
+                    # materialize empty lower buckets (standard client-lib
+                    # behavior): quantile estimation interpolates between
+                    # ADJACENT boundaries, so a missing empty bucket makes
+                    # it anchor at 0 and systematically underestimate —
+                    # and an all-above-max series would render +Inf only
+                    self._values[key] = 0.0
+                    self._dirty.add(key)
+            ikey = base + (("le", "+Inf"),)
+            self._values[ikey] = self._values.get(ikey, 0.0) + 1.0
+            self._dirty.add(ikey)
+            skey = base + (("__sum__", ""),)
+            self._values[skey] = self._values.get(skey, 0.0) + value
+            self._dirty.add(skey)
 
 
 # --------------------------------------------------------------------- #
@@ -209,6 +233,108 @@ def flush() -> None:
     _flush_once()
 
 
+def shutdown_flush() -> None:
+    """Best-effort final flush, wired into runtime teardown: counter
+    deltas recorded since the last 2s flush tick would otherwise be lost
+    when the process exits. Never raises — teardown must proceed."""
+    try:
+        _flush_once()
+    except Exception:
+        pass
+
+
+def zero_gauges(label: tuple) -> None:
+    """Set every gauge series carrying the given (key, value) label pair
+    to 0 and mark it for shipping. Exit-path cleanup for per-process
+    gauges: the head store is last-write-wins with no owner left to
+    update a dead process's series, so without this a killed replica's
+    last kv_utilization/occupancy values pin /metrics forever."""
+    with _lock:
+        for m in _registry.values():
+            if m.KIND != "gauge":
+                continue
+            for key in list(m._values):
+                if label in key:
+                    m._values[key] = 0.0
+                    m._dirty.add(key)
+
+
+def mark_gauges_dirty() -> None:
+    """Re-mark every gauge series dirty. Called after a worker/driver
+    reconnects to a restarted head: gauges are last-write-wins and live
+    only in the head's merged store, which the restart lost — without
+    this they vanish from /metrics until the next set(). Counters and
+    histogram buckets need no help (their deltas keep accumulating
+    locally until a flush succeeds)."""
+    with _lock:
+        for m in _registry.values():
+            if m.KIND == "gauge":
+                m._dirty.update(m._values.keys())
+
+
+def local_store() -> dict:
+    """This process's registry rendered in head-store format
+    ({name: {kind, desc, series}}). Used when no runtime exists (bench
+    runs, unit tests) so metrics_summary()/prometheus_lines() work off
+    the local registry; counters that already flushed to a head are not
+    included (they drained)."""
+    with _lock:
+        return {name: {"kind": m.KIND, "desc": m.description,
+                       "series": dict(m._values)}
+                for name, m in _registry.items() if m._values}
+
+
+def cached_metric(cls, name: str, description: str = "", **kw):
+    """Process-wide metric singleton: construct once, hand the same
+    object back on every call (instrumentation sites call this per
+    event; re-constructing would re-validate against the registry each
+    time). Cleared by _reset_registry() so tests can't leak series."""
+    m = _metric_cache.get(name)
+    if m is None:
+        m = _metric_cache[name] = cls(name, description=description, **kw)
+    return m
+
+
+def _reset_registry() -> None:
+    """Test hook: drop every registered metric (and the cached_metric
+    singletons) so series can't leak across tests. Metric objects held
+    by callers keep working locally but re-register on next
+    construction."""
+    with _lock:
+        _registry.clear()
+        _metric_cache.clear()
+
+
+def histogram_quantiles(buckets: dict, total: float,
+                        qs: Sequence[float]) -> list:
+    """Quantiles from cumulative Prometheus buckets ({le_label: count},
+    le labels as emitted by Histogram.observe — repr(boundary) or
+    "+Inf"). Linear interpolation within a bucket, the standard
+    histogram_quantile() estimate; a quantile landing in the +Inf bucket
+    returns the highest finite boundary (the value is only known to
+    exceed it). Returns None per quantile when the histogram is empty."""
+    if total <= 0:
+        return [None] * len(qs)
+    pts = sorted(((float(le), c) for le, c in buckets.items()),
+                 key=lambda p: p[0])
+    out = []
+    for q in qs:
+        target = min(max(q, 0.0), 1.0) * total
+        prev_b, prev_c, val = 0.0, 0.0, None
+        for b, c in pts:
+            if c >= target:
+                if b == float("inf"):
+                    val = prev_b
+                else:
+                    width = c - prev_c
+                    frac = 0.0 if width <= 0 else (target - prev_c) / width
+                    val = prev_b + frac * (b - prev_b)
+                break
+            prev_b, prev_c = b, c
+        out.append(val)
+    return out
+
+
 def _esc_label(v: str) -> str:
     return str(v).replace("\\", "\\\\").replace("\"", "\\\"") \
         .replace("\n", "\\n")
@@ -226,23 +352,58 @@ def _series(name: str, key, val) -> str:
 def prometheus_lines(store: dict) -> list[str]:
     """Render the head's merged user-metric store as Prometheus text
     (called by state._prometheus_text). Histograms use the standard
-    _bucket/_count/_sum triplet."""
+    _bucket/_count/_sum triplet: buckets in ascending numeric `le` order
+    (lexical sort would put "10.0" before "2.5", which OpenMetrics
+    forbids), then _sum, then _count per label set."""
     lines = []
     for name, rec in sorted(store.items()):
         kind = rec["kind"] if rec["kind"] in ("counter",
                                               "histogram") else "gauge"
         lines.append(f"# HELP {name} {_esc_help(rec['desc'])}")
         lines.append(f"# TYPE {name} {kind}")
+        if kind == "histogram":
+            lines.extend(_histogram_lines(name, rec["series"]))
+            continue
         for key, val in sorted(rec["series"].items()):
             if any(k == "__sum__" for k, _ in key):
+                # defensive: a kind-mismatched merge left histogram rows
+                # under a non-histogram name; render the sum series
                 plain = tuple((k, v) for k, v in key if k != "__sum__")
                 lines.append(_series(f"{name}_sum", plain, val))
                 continue
-            if kind == "histogram":
-                lines.append(_series(f"{name}_bucket", key, val))
-                if dict(key).get("le") == "+Inf":
-                    plain = tuple((k, v) for k, v in key if k != "le")
-                    lines.append(_series(f"{name}_count", plain, val))
-                continue
             lines.append(_series(name, key, val))
+    return lines
+
+
+def _histogram_lines(name: str, series: dict) -> list[str]:
+    # group by base label set (everything but le/__sum__), so each label
+    # combination emits a complete ordered triplet
+    groups: dict = {}
+    lines = []
+    for key, val in series.items():
+        base = tuple((k, v) for k, v in key
+                     if k not in ("le", "__sum__"))
+        g = groups.setdefault(base, {"buckets": {}, "sum": None})
+        if any(k == "__sum__" for k, _ in key):
+            g["sum"] = val
+            continue
+        le = dict(key).get("le")
+        if le is None:
+            # kind-mismatched cross-process merge folded plain (gauge/
+            # counter) rows under a histogram name; render them rather
+            # than crash the whole /metrics page
+            lines.append(_series(name, key, val))
+            continue
+        g["buckets"][le] = val
+    for base in sorted(groups):
+        g = groups[base]
+        for le, val in sorted(g["buckets"].items(),
+                              key=lambda kv: float(kv[0])):
+            lines.append(_series(f"{name}_bucket",
+                                 base + (("le", le),), val))
+        if g["sum"] is not None:
+            lines.append(_series(f"{name}_sum", base, g["sum"]))
+        inf = g["buckets"].get("+Inf")
+        if inf is not None:
+            lines.append(_series(f"{name}_count", base, inf))
     return lines

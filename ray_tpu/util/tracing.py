@@ -79,14 +79,17 @@ def activate(trace_ctx: tuple, name: str):
 
 
 @contextlib.contextmanager
-def span(name: str):
+def span(name: str, root: bool = False):
     """User-facing in-process span (driver or inside a task): children
     submitted within parent to it; the span lands in the local runtime's
-    timeline when one exists."""
+    timeline when one exists. ``root=True`` ignores any ambient context
+    and starts a fresh trace — per-request servers use it so every
+    request becomes its own span tree instead of all parenting to the
+    long-lived span that happened to be active when the server booted."""
     if not tracing_enabled():
         yield None
         return
-    ctx = _current.get()
+    ctx = None if root else _current.get()
     if ctx is None:
         ctx = (new_trace_id(), new_span_id())
         trace_id, parent_id = ctx[0], None

@@ -1,0 +1,30 @@
+"""Import-weight guard: `import ray_tpu` must stay light.
+
+Worker fork/startup cost is dominated by module imports; jax alone is
+hundreds of ms. aiohttp (dashboard/proxy) and opentelemetry (tracing's
+optional exporter) are runtime-optional and must load lazily — tracing's
+otel export is soft-gated precisely so the package imports without it.
+"""
+import subprocess
+import sys
+
+
+_PROBE = """
+import sys
+before = set(sys.modules)
+import ray_tpu
+leaked = [m for m in ("jax", "aiohttp", "opentelemetry")
+          if m in sys.modules and m not in before]
+print("LEAKED=" + ",".join(leaked))
+"""
+
+
+def test_import_ray_tpu_skips_heavy_modules():
+    out = subprocess.run([sys.executable, "-c", _PROBE],
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    line = next(ln for ln in out.stdout.splitlines()
+                if ln.startswith("LEAKED="))
+    assert line == "LEAKED=", (
+        f"import ray_tpu pulled heavy modules at top level: "
+        f"{line.removeprefix('LEAKED=')}")
