@@ -28,6 +28,7 @@ import sys
 import threading
 import time
 import traceback
+from collections import deque
 from multiprocessing.connection import Client
 
 import cloudpickle
@@ -750,10 +751,10 @@ class WorkerLoop:
     def run(self):
         self.conn.send({"t": "register", "wid": self.wid,
                         "pid": os.getpid(), "pv": PROTOCOL_VERSION})
-        backlog: list = []
+        backlog: deque = deque()
         while True:
             if backlog:
-                msg = backlog.pop(0)
+                msg = backlog.popleft()
             else:
                 try:
                     msg = self.conn.recv()
@@ -761,8 +762,10 @@ class WorkerLoop:
                     return
             if msg["t"] == "batch":
                 # one pipe write from the head's scheduling pass carrying
-                # several ordered control messages
-                backlog = list(msg["msgs"]) + backlog
+                # several ordered control messages; they run BEFORE any
+                # already-queued batch's remainder (extendleft preserves
+                # the batch's own order)
+                backlog.extendleft(reversed(msg["msgs"]))
                 continue
             t = msg["t"]
             if t == "func":

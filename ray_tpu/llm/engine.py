@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+from collections import deque
 from typing import Any, Optional
 
 import jax
@@ -60,6 +61,9 @@ class _Request:
     slot: int = -1
     pages: list[int] = dataclasses.field(default_factory=list)
     prefill_pos: int = 0          # prompt tokens already prefilled (paged)
+    # content-hash chain of the prompt's FULL pages (paged engine prefix
+    # caching); computed lazily at admission, None until then
+    page_hashes: Optional[list] = None
     done: bool = False
     submit_t: float = 0.0
     first_token_t: float = 0.0    # TTFT = first_token_t - submit_t
@@ -247,9 +251,9 @@ class InferenceEngine(_EngineBase):
         self.params = params
         self.cache = llama.init_slot_cache(cfg.model, cfg.max_batch_size,
                                            cfg.max_seq_len)
-        self._free_slots = list(range(cfg.max_batch_size))
+        self._free_slots = deque(range(cfg.max_batch_size))
         self._active: dict[int, _Request] = {}      # slot -> request
-        self._pending: list[_Request] = []
+        self._pending: deque[_Request] = deque()
         self._next_rid = 0
         self._rng = jax.random.PRNGKey(rng_seed)
         self._lock = threading.Lock()
@@ -322,8 +326,8 @@ class InferenceEngine(_EngineBase):
         with self._lock:
             from . import telemetry
             while self._pending and self._free_slots:
-                req = self._pending.pop(0)
-                slot = self._free_slots.pop(0)
+                req = self._pending.popleft()
+                slot = self._free_slots.popleft()
                 req.slot = slot
                 self._active[slot] = req
                 telemetry.on_admit(self, req)

@@ -26,7 +26,9 @@ donated through every program so XLA updates pages in place.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import threading
+from collections import OrderedDict, deque
 from typing import Any, Optional
 
 import jax
@@ -71,6 +73,15 @@ class PagedEngineConfig:
     # verify is one model-step of compute vs w serial steps. 0 disables.
     spec_tokens: int = 0
     spec_ngram: int = 2
+    # automatic prefix caching (vLLM-style block-hash reuse): retired
+    # requests park their full KV pages in a content-addressed LRU pool
+    # instead of freeing them; a later request whose prompt shares a
+    # page-aligned prefix maps those pages into its block table and starts
+    # chunked prefill at the first uncached, chunk-aligned token. Shared
+    # pages are refcounted and read-only (every write lands past the
+    # cached region, so divergence copies instead of corrupting); the LRU
+    # pool is reclaimed page-by-page under allocation pressure.
+    enable_prefix_caching: bool = True
     tokenizer: Any = None
 
     def __post_init__(self):
@@ -103,13 +114,27 @@ class PagedInferenceEngine(_EngineBase):
         # step (their dummy token writes land there, never attended); it is
         # never allocated to a sequence
         self._free_pages = list(range(1, cfg.num_pages))
-        self._free_slots = list(range(cfg.max_batch_size))
+        self._free_slots = deque(range(cfg.max_batch_size))
         self._block_tables = np.zeros(
             (cfg.max_batch_size, cfg.max_pages_per_seq), np.int32)
         self._lengths = np.zeros((cfg.max_batch_size,), np.int32)
         self._active: dict[int, _Request] = {}
         self._prefilling: list[_Request] = []   # admitted, prompt not done
-        self._pending: list[_Request] = []
+        self._pending: deque[_Request] = deque()
+        # -- prefix cache state (enable_prefix_caching) -------------------
+        # Full pages are content-addressed by a chained hash
+        # h_i = H(h_{i-1} || page_token_ids) — the chain makes the flat
+        # dict an implicit trie: a page's key encodes its whole prefix.
+        # _page_refs counts live request references per page; pages whose
+        # refcount drops to zero but that hold published (hashed) content
+        # park in _cached_lru (insertion order = eviction order) instead
+        # of returning to _free_pages, and are reclaimed LRU-first when
+        # allocation outruns the free list.
+        self._prefix_on = bool(cfg.enable_prefix_caching)
+        self._page_refs = np.zeros((cfg.num_pages,), np.int32)
+        self._hash_to_page: dict[bytes, int] = {}
+        self._page_to_hash: dict[int, bytes] = {}
+        self._cached_lru: "OrderedDict[int, None]" = OrderedDict()
         self._next_rid = 0
         self._rng_base = jax.random.PRNGKey(rng_seed ^ 0x5EED)
         self._rng_ctr = 0
@@ -127,7 +152,13 @@ class PagedInferenceEngine(_EngineBase):
         # observability: dispatches per program family, spec accept stats
         self.stats = {"prefill_dispatches": 0, "decode_dispatches": 0,
                       "spec_dispatches": 0, "spec_proposed": 0,
-                      "spec_accepted": 0, "tokens_out": 0}
+                      "spec_accepted": 0, "tokens_out": 0,
+                      # prefix cache: full prompt pages served from cache
+                      # vs computed by prefill, LRU pages reclaimed under
+                      # pressure, and prompt tokens whose prefill was
+                      # skipped entirely
+                      "prefix_hits": 0, "prefix_misses": 0,
+                      "prefix_evictions": 0, "prefix_tokens_saved": 0}
         # speculation controller: EMA of tokens-per-slot-per-spec-dispatch
         # (starts optimistic), plus a cooldown of windowed dispatches
         # before re-probing once the EMA drops below the window
@@ -303,21 +334,88 @@ class PagedInferenceEngine(_EngineBase):
     def _pages_needed(self, tokens: int) -> int:
         return (tokens + self.cfg.page_size - 1) // self.cfg.page_size
 
+    def _pages_avail(self) -> int:
+        """Pages allocatable right now: truly free + LRU-reclaimable."""
+        return len(self._free_pages) + len(self._cached_lru)
+
+    def _pop_free_page(self) -> int:
+        """One allocatable page; evicts the least-recently-used
+        unreferenced cached page when the free list is dry. Never touches
+        a page with live references — only refcount-0 pages sit in the
+        LRU. Callers must check _pages_avail() first."""
+        if self._free_pages:
+            return self._free_pages.pop()
+        pid, _ = self._cached_lru.popitem(last=False)
+        self._unregister(pid)
+        self.stats["prefix_evictions"] += 1
+        return pid
+
+    def _unregister(self, pid: int):
+        h = self._page_to_hash.pop(pid, None)
+        if h is not None and self._hash_to_page.get(h) == pid:
+            del self._hash_to_page[h]
+
+    def _incref(self, pid: int):
+        """Pin a page for a request; a cached (refcount-0) page leaves
+        the eviction pool."""
+        if self._page_refs[pid] == 0:
+            self._cached_lru.pop(pid, None)
+        self._page_refs[pid] += 1
+
+    def _decref(self, pid: int):
+        """Drop one reference; at zero the page parks in the cached LRU
+        (published content, reusable) or returns to the free list."""
+        self._page_refs[pid] -= 1
+        if self._page_refs[pid] > 0:
+            return
+        if pid in self._page_to_hash:
+            self._cached_lru[pid] = None    # most-recently-released last
+        else:
+            self._free_pages.append(pid)
+
+    def _claim_pages(self, matched: list[int],
+                     n_pages: int) -> Optional[list[int]]:
+        """Assemble a page list: pin `matched` (a cached prefix run), then
+        allocate fresh pages up to n_pages. Returns None — with NO side
+        effects — when the pool cannot cover the remainder. Matches are
+        pinned BEFORE any fresh allocation (an allocation could otherwise
+        evict a still-unpinned match), and claiming an unreferenced LRU
+        page removes an eviction candidate, so those count against
+        availability. Shared by admission and PD import so their pool
+        accounting can never diverge."""
+        need = n_pages - len(matched)
+        if need > self._pages_avail() - sum(
+                1 for p in matched if self._page_refs[p] == 0):
+            return None
+        for pid in matched:
+            self._incref(pid)
+        pages = list(matched)
+        for _ in range(need):
+            pid = self._pop_free_page()
+            self._page_refs[pid] = 1
+            pages.append(pid)
+        return pages
+
     def _ensure_pages(self, req: _Request, upto_tokens: int) -> bool:
         """Grow req's page list to cover upto_tokens; False if pool dry."""
         need = self._pages_needed(upto_tokens) - len(req.pages)
         if need <= 0:
             return True
-        if len(self._free_pages) < need:
+        if self._pages_avail() < need:
             return False
         for _ in range(need):
-            req.pages.append(self._free_pages.pop())
+            pid = self._pop_free_page()
+            self._page_refs[pid] = 1
+            req.pages.append(pid)
         bt = self._block_tables[req.slot]
         bt[:len(req.pages)] = req.pages
         return True
 
     def _release(self, req: _Request):
-        self._free_pages.extend(req.pages)
+        if self._prefix_on:
+            self._register_request_pages(req)
+        for pid in req.pages:
+            self._decref(pid)
         req.pages = []
         if req.slot >= 0:
             # zero the row so nothing stale survives into the next tenant
@@ -326,6 +424,121 @@ class PagedInferenceEngine(_EngineBase):
             self._free_slots.append(req.slot)
             self._lengths[req.slot] = 0
             req.slot = -1
+
+    # -- prefix cache (enable_prefix_caching) ------------------------------
+
+    def _hash_chain(self, tokens, prev: bytes = b"") -> list[bytes]:
+        """Chained content hashes of `tokens`' FULL pages: each full page
+        is keyed by H(parent_digest || page_token_ids), so equal keys
+        imply equal whole prefixes (the flat index is an implicit trie).
+        blake2b over the raw int32 bytes: stable across processes, so
+        PD-disagg payloads can carry the hashes verbatim."""
+        page = self.cfg.page_size
+        arr = np.asarray(tokens, np.int32)
+        out = []
+        for i in range(len(arr) // page):
+            prev = hashlib.blake2b(
+                prev + arr[i * page:(i + 1) * page].tobytes(),
+                digest_size=16).digest()
+            out.append(prev)
+        return out
+
+    def _prompt_hashes(self, req: _Request) -> list[bytes]:
+        if req.page_hashes is None:
+            req.page_hashes = self._hash_chain(req.prompt_ids)
+        return req.page_hashes
+
+    def _reuse_limit(self, req: _Request) -> int:
+        """Most prompt tokens admissible from cache: chunk-aligned (so
+        prefill resumes on a chunk boundary) and strictly short of the
+        prompt, so at least one token always prefills — the request's
+        first generated token is sampled from real last-position logits."""
+        c = self.cfg.chunk_size
+        return ((len(req.prompt_ids) - 1) // c) * c
+
+    def _match_prefix(self, req: _Request) -> list[int]:
+        """Longest cached page run covering the prompt's head, truncated
+        to whole chunks and to _reuse_limit. Pure lookup — no pinning."""
+        if not self._prefix_on:
+            return []
+        limit = self._reuse_limit(req)
+        if limit <= 0:
+            return []
+        page = self.cfg.page_size
+        hashes = self._prompt_hashes(req)
+        pages: list[int] = []
+        for i in range(limit // page):
+            pid = self._hash_to_page.get(hashes[i])
+            if pid is None:
+                break
+            pages.append(pid)
+        per_chunk = self.cfg.chunk_size // page
+        return pages[:(len(pages) // per_chunk) * per_chunk]
+
+    def _try_reuse(self, req: _Request):
+        """Mid-prefill reuse: jump req.prefill_pos over chunks whose pages
+        another request has published since this one was admitted (an
+        identical-prompt burst: the first request prefills, the rest map
+        its pages in as they land). Swapped-out private pages go straight
+        back to the free list."""
+        if not self._prefix_on:
+            return
+        c, page = self.cfg.chunk_size, self.cfg.page_size
+        pos = req.prefill_pos
+        if pos % c:
+            return
+        limit = self._reuse_limit(req)
+        hashes = self._prompt_hashes(req)
+        while pos < limit:
+            idxs = range(pos // page, (pos + c) // page)
+            pids = [self._hash_to_page.get(hashes[i]) for i in idxs]
+            if any(p is None for p in pids):
+                break
+            for i, pid in zip(idxs, pids):
+                old = req.pages[i]
+                if old == pid:
+                    continue
+                self._incref(pid)
+                req.pages[i] = pid
+                self._decref(old)
+            pos += c
+            self.stats["prefix_hits"] += len(pids)
+            self.stats["prefix_tokens_saved"] += c
+        if pos != req.prefill_pos:
+            req.prefill_pos = pos
+            self._block_tables[req.slot, :len(req.pages)] = req.pages
+
+    def _register_page(self, pid: int, h: bytes):
+        if pid in self._page_to_hash or h in self._hash_to_page:
+            return      # already published, or duplicate content elsewhere
+        self._page_to_hash[pid] = h
+        self._hash_to_page[h] = pid
+
+    def _register_request_pages(self, req: _Request):
+        """Publish req's full, KV-materialized pages into the content
+        index (retirement path). KV is materialized for the prompt plus
+        every generated token except the last — a sampled token's K/V is
+        only written when it is fed back on the next dispatch — so pages
+        holding generated text become reusable for multi-turn follow-ups
+        whose prompt embeds this request's output."""
+        page = self.cfg.page_size
+        n_tok = len(req.prompt_ids) + max(len(req.out_ids) - 1, 0)
+        if req.prefill_pos < len(req.prompt_ids):
+            # released mid-prefill (e.g. a future cancel path): only
+            # positions < prefill_pos hold computed KV — publishing
+            # further pages would serve garbage to matching prompts
+            n_tok = req.prefill_pos
+        n_full = min(n_tok // page, len(req.pages))
+        if n_full <= 0:
+            return
+        hashes = self._prompt_hashes(req)
+        if n_full > len(hashes):
+            tokens = (req.prompt_ids + req.out_ids)[
+                len(hashes) * page:n_full * page]
+            hashes = hashes + self._hash_chain(
+                tokens, prev=hashes[-1] if hashes else b"")
+        for i in range(n_full):
+            self._register_page(req.pages[i], hashes[i])
 
     # -- engine loop -------------------------------------------------------
 
@@ -343,12 +556,21 @@ class PagedInferenceEngine(_EngineBase):
                 # admission control: hold requests until the pool can cover
                 # the whole prompt (avoids deadlocking a half-prefilled seq)
                 req = self._pending[0]
-                if (self._pages_needed(len(req.prompt_ids) + 1)
-                        > len(self._free_pages)):
+                matched = self._match_prefix(req)
+                pages = self._claim_pages(
+                    matched, self._pages_needed(len(req.prompt_ids) + 1))
+                if pages is None:
                     break
-                self._pending.pop(0)
-                req.slot = self._free_slots.pop(0)
-                self._ensure_pages(req, len(req.prompt_ids) + 1)
+                self._pending.popleft()
+                req.slot = self._free_slots.popleft()
+                req.pages = pages
+                self._block_tables[req.slot, :len(pages)] = pages
+                if matched:
+                    # chunked prefill starts at the first uncached chunk
+                    # boundary
+                    req.prefill_pos = len(matched) * self.cfg.page_size
+                    self.stats["prefix_hits"] += len(matched)
+                    self.stats["prefix_tokens_saved"] += req.prefill_pos
                 self._prefilling.append(req)
                 from . import telemetry
                 telemetry.on_admit(self, req)
@@ -364,6 +586,9 @@ class PagedInferenceEngine(_EngineBase):
         # carries caches, so later rows see earlier rows' page writes)
         rows: list[tuple] = []              # (req, start, n_tokens)
         for req in self._prefilling:
+            # skip ahead over chunks published since the last step (an
+            # identical-prefix burst: request 1 computes, the rest map)
+            self._try_reuse(req)
             pos = req.prefill_pos
             while pos < len(req.prompt_ids) and len(rows) < cfg.prefill_rows:
                 n = min(c, len(req.prompt_ids) - pos)
@@ -400,6 +625,17 @@ class PagedInferenceEngine(_EngineBase):
         self.stats["prefill_dispatches"] += 1
         toks = np.asarray(toks)
         lps = None if lps is None else np.asarray(lps)
+        if self._prefix_on:
+            page = cfg.page_size
+            for req, pos, n in rows:
+                # full prompt pages this row computed are misses; publish
+                # them immediately so the rest of the burst can reuse
+                # (their K/V is fully written once this dispatch returns)
+                lo, hi = pos // page, (pos + n) // page
+                self.stats["prefix_misses"] += hi - lo
+                hashes = self._prompt_hashes(req)
+                for j in range(lo, hi):
+                    self._register_page(req.pages[j], hashes[j])
         for i, (req, pos, n) in enumerate(rows):
             req.prefill_pos = pos + n
             if req.prefill_pos < len(req.prompt_ids):
@@ -662,6 +898,10 @@ class PagedInferenceEngine(_EngineBase):
         return {"prompt_ids": list(req.prompt_ids),
                 "first_token": int(first_token),
                 "page_size": self.cfg.page_size,
+                # chained content hashes of the FULL prompt pages, in page
+                # order: the decode side dedupes payload pages it already
+                # holds instead of re-allocating and re-scattering them
+                "page_hashes": list(self._prompt_hashes(req)),
                 "pages": pages}
 
     def prefill_export(self, prompt, params: SamplingParams) -> dict:
@@ -697,22 +937,62 @@ class PagedInferenceEngine(_EngineBase):
             self._next_rid += 1
             if not self._free_slots:
                 raise RuntimeError("no free decode slot")
-            req.slot = self._free_slots.pop(0)
-            if not self._ensure_pages(req, len(ids) + 1):
-                self._release(req)
-                raise RuntimeError("page pool exhausted importing prefill")
+            req.slot = self._free_slots.popleft()
+            n_pages = self._pages_needed(len(ids) + 1)
             n_in = len(payload["pages"][0]["k"])
-            if n_in != len(req.pages):
+            if n_in != n_pages:
                 self._release(req)
                 raise ValueError(
                     f"payload covers {n_in} pages but this engine "
-                    f"allocated {len(req.pages)} for the same prompt")
-            idx = jnp.asarray(np.asarray(req.pages, np.int32))
-            for li, layer in enumerate(self.caches):
-                layer["k"] = self._import_fn(
-                    layer["k"], idx, jnp.asarray(payload["pages"][li]["k"]))
-                layer["v"] = self._import_fn(
-                    layer["v"], idx, jnp.asarray(payload["pages"][li]["v"]))
+                    f"needs {n_pages} for the same prompt")
+            # dedupe: payload pages whose content hash this engine already
+            # holds are mapped (and pinned) instead of re-scattered — a
+            # decode replica serving many same-system-prompt imports keeps
+            # one copy of the shared prefix. The chain property means the
+            # reusable run is a prefix of the page list. Full pages only;
+            # the partial tail page is always private (decode writes into
+            # it at position len(ids)).
+            hashes = payload.get("page_hashes")
+            if hashes is None and self._prefix_on:
+                hashes = self._hash_chain(ids)
+            matched: list[int] = []
+            if self._prefix_on and hashes:
+                for h in hashes:      # chain property: a prefix run
+                    pid = self._hash_to_page.get(h)
+                    if pid is None:
+                        break
+                    matched.append(pid)
+            pages = self._claim_pages(matched, n_pages)
+            if pages is None:
+                self._release(req)
+                raise RuntimeError("page pool exhausted importing prefill")
+            fresh = list(range(len(matched), n_pages))
+            req.pages = pages
+            self._block_tables[req.slot, :n_pages] = pages
+            if self._prefix_on:
+                # hits/misses track page-level cache efficacy; deduped
+                # imports save scatter/transfer, NOT prefill compute (the
+                # prefill replica already counted any skipped prefill), so
+                # tokens_saved deliberately stays untouched here — fleet
+                # sums would otherwise double-count
+                self.stats["prefix_hits"] += len(matched)
+                nf = len(ids) // self.cfg.page_size  # full prompt pages
+                self.stats["prefix_misses"] += nf - len(matched)
+            if fresh:
+                idx = jnp.asarray(np.asarray(
+                    [pages[i] for i in fresh], np.int32))
+                sel = np.asarray(fresh)
+                for li, layer in enumerate(self.caches):
+                    layer["k"] = self._import_fn(
+                        layer["k"], idx,
+                        jnp.asarray(payload["pages"][li]["k"][sel]))
+                    layer["v"] = self._import_fn(
+                        layer["v"], idx,
+                        jnp.asarray(payload["pages"][li]["v"][sel]))
+                if self._prefix_on and hashes:
+                    for i in fresh:
+                        if i < len(hashes):
+                            self._register_page(pages[i], hashes[i])
             tok = int(payload["first_token"])
             req.out_ids.append(tok)
             self.stats["tokens_out"] += 1
@@ -736,9 +1016,17 @@ class PagedInferenceEngine(_EngineBase):
     # -- stats -------------------------------------------------------------
 
     def pool_stats(self) -> dict:
+        hits = self.stats["prefix_hits"]
+        misses = self.stats["prefix_misses"]
         return {
+            # free + cached together are the allocatable pool: cached
+            # pages hold reusable prefix KV but evict on demand, so a
+            # "full" pool with a deep cache is warm, not saturated
             "free_pages": len(self._free_pages),
+            "cached_pages": len(self._cached_lru),
             "total_pages": self.cfg.num_pages,
+            "prefix_hit_rate": round(hits / (hits + misses), 4)
+            if hits + misses else 0.0,
             "active": len(self._active),
             "prefilling": len(self._prefilling),
             "pending": len(self._pending),

@@ -33,6 +33,13 @@ Metric names (all prefixed ``rtpu_llm_``):
   spec_proposed_total    counter    speculative tokens proposed
   spec_accepted_total    counter    speculative tokens accepted
   dispatches_total       counter    device dispatches, by program family
+  prefix_cache_hits_total      counter  full prompt pages served from cache
+  prefix_cache_misses_total    counter  full prompt pages computed by prefill
+  prefix_cache_evictions_total counter  cached pages reclaimed under pressure
+  prefix_cache_tokens_saved_total counter  prompt tokens whose prefill was
+      skipped via cached pages
+  prefix_cached_pages    gauge      unreferenced pages retained for reuse
+  prefix_cache_hit_rate  gauge      hits / (hits + misses), cumulative
 """
 from __future__ import annotations
 
@@ -203,9 +210,23 @@ def on_step(engine) -> None:
     free = getattr(engine, "_free_pages", None)
     if free is not None:
         pool = cfg.num_pages - 1  # page 0 is the write sink
+        # cached (unreferenced, prefix-reusable) pages are reclaimable on
+        # demand: they count as capacity, not utilization — a warm cache
+        # must not read as a saturated pool
+        cached = len(getattr(engine, "_cached_lru", ()))
         _gauge("rtpu_llm_kv_utilization",
                "KV pages in use / pool size").set(
-            (pool - len(free)) / max(pool, 1), tags=gtags)
+            (pool - len(free) - cached) / max(pool, 1), tags=gtags)
+        if getattr(engine, "_prefix_on", False):
+            _gauge("rtpu_llm_prefix_cached_pages",
+                   "unreferenced KV pages retained for prefix reuse").set(
+                cached, tags=gtags)
+            hits = engine.stats.get("prefix_hits", 0)
+            misses = engine.stats.get("prefix_misses", 0)
+            if hits + misses:
+                _gauge("rtpu_llm_prefix_cache_hit_rate",
+                       "prefix cache hits / (hits + misses)").set(
+                    hits / (hits + misses), tags=gtags)
     stats = getattr(engine, "stats", None)
     if stats:
         _ship_stat_deltas(engine, stats, tags)
@@ -224,6 +245,14 @@ _STAT_COUNTERS = (
      "device dispatches by program family", "decode"),
     ("spec_dispatches", "rtpu_llm_dispatches_total",
      "device dispatches by program family", "verify"),
+    ("prefix_hits", "rtpu_llm_prefix_cache_hits_total",
+     "full prompt pages served from the prefix cache", None),
+    ("prefix_misses", "rtpu_llm_prefix_cache_misses_total",
+     "full prompt pages computed by prefill", None),
+    ("prefix_evictions", "rtpu_llm_prefix_cache_evictions_total",
+     "cached pages reclaimed under allocation pressure", None),
+    ("prefix_tokens_saved", "rtpu_llm_prefix_cache_tokens_saved_total",
+     "prompt tokens whose prefill was skipped via cached pages", None),
 )
 
 

@@ -8,14 +8,28 @@ Routing here tracks in-flight counts per handle (each handle routes its own
 traffic) and picks the lighter of two random replicas; the replica set is
 cached and refreshed from the controller when its version changes or a
 replica dies mid-call (retried once on a fresh set).
+
+Prefix affinity: LLM-style requests (a dict carrying ``prompt``, or an
+explicit ``session_id``) rendezvous-hash onto a stable replica so repeated
+prefixes — system prompts, multi-turn sessions — land where the paged
+engine's prefix cache already holds their KV pages (paged_engine.py
+enable_prefix_caching). The affinity choice yields to least-loaded when
+the preferred replica is clearly busier than the lightest one, so a hot
+prefix cannot hotspot a replica into queueing.
 """
 from __future__ import annotations
 
 import random
 import time
+from collections import deque
 from typing import Any, Optional
 
 from ..core.config import cfg as _cfg
+
+# affinity yields to load: the preferred replica is skipped when it has
+# this many more in-flight requests (on this handle) than the lightest
+# replica — a cache hit saves prefill, not a queueing delay
+_AFFINITY_SLACK = 4
 
 
 class DeploymentResponse:
@@ -74,7 +88,7 @@ class DeploymentResponseGenerator:
         self._replica = replica
         self._sid = sid
         self._on_done = on_done
-        self._buf: list = []
+        self._buf: deque = deque()
         self._done = False
 
     def __iter__(self):
@@ -93,7 +107,7 @@ class DeploymentResponseGenerator:
                 if self._on_done:
                     self._on_done()
                     self._on_done = None
-        return self._buf.pop(0)
+        return self._buf.popleft()
 
     def cancel(self):
         import ray_tpu
@@ -225,24 +239,58 @@ class DeploymentHandle:
             self._inflight = {i: 0 for i in range(len(replicas))}
         self._last_refresh = now
 
-    def _pick(self, replicas: list) -> int:
+    @staticmethod
+    def _affinity_key(args: tuple, kwargs: dict) -> Optional[str]:
+        """Prefix-affinity routing key for LLM-style calls: an explicit
+        ``session_id`` (kwarg or request field) wins; otherwise the head
+        of the request dict's prompt — the first N tokens/chars, which is
+        exactly the region the paged engine's prefix cache can reuse.
+        Non-LLM calls (no dict request, no session) return None and keep
+        pure least-loaded routing."""
+        req = args[0] if args and isinstance(args[0], dict) else None
+        sid = kwargs.get("session_id") or (
+            req.get("session_id") if req else None)
+        if sid:
+            return f"sid:{sid}"
+        if req is None:
+            return None
+        prompt = req.get("prompt")
+        if isinstance(prompt, str) and prompt:
+            return "tok:" + prompt[:256]
+        if isinstance(prompt, (list, tuple)) and prompt:
+            return "tok:" + ",".join(map(str, prompt[:64]))
+        return None
+
+    def _pick(self, replicas: list, affinity: Optional[str] = None) -> int:
         """Power-of-two-choices over local in-flight counts
         (reference: pow_2_router.py:27). With a multiplexed model id,
         rendezvous hashing over stable replica (actor) ids instead: same
         model → same replica while it lives, so its weights stay
-        cache-hot (multiplex.py routing note). Operates on the caller's
-        SNAPSHOT of the replica list — the listener thread may swap
-        self._replicas concurrently."""
+        cache-hot (multiplex.py routing note). An affinity key (shared
+        prompt prefix / session) rendezvous-hashes the same way — same
+        prefix → same replica → warm prefix cache — but yields to the
+        least-loaded replica when the preferred one is clearly busier.
+        Operates on the caller's SNAPSHOT of the replica list — the
+        listener thread may swap self._replicas concurrently."""
         n = len(replicas)
         if n == 1:
             return 0
-        if self._model_id:
-            import hashlib
+        import hashlib
+
+        def rendezvous(key):
             def score(i):
                 rid = replicas[i]._actor_id.hex()
-                return hashlib.md5(
-                    f"{self._model_id}:{rid}".encode()).digest()
+                return hashlib.md5(f"{key}:{rid}".encode()).digest()
             return max(range(n), key=score)
+
+        if self._model_id:
+            return rendezvous(self._model_id)
+        if affinity is not None:
+            pref = rendezvous(affinity)
+            loads = [self._inflight.get(i, 0) for i in range(n)]
+            if loads[pref] <= min(loads) + _AFFINITY_SLACK:
+                return pref
+            return loads.index(min(loads))
         i, j = random.sample(range(n), 2)
         return i if self._inflight.get(i, 0) <= self._inflight.get(j, 0) \
             else j
@@ -265,7 +313,7 @@ class DeploymentHandle:
                       if isinstance(v, DeploymentResponse) else v)
                   for k, v in kwargs.items()}
         replicas = self._replicas  # snapshot: listener may swap the list
-        idx = self._pick(replicas)
+        idx = self._pick(replicas, self._affinity_key(args, kwargs))
         replica = replicas[idx]
         self._inflight[idx] = self._inflight.get(idx, 0) + 1
 

@@ -169,6 +169,8 @@ def metrics_summary() -> dict:
           {count, mean, p50, p95, p99} in seconds
       kv_utilization / batch_occupancy — {<engine>: value of the
           most-loaded process}
+      prefix_cache — {hits, misses, evictions, tokens_saved, hit_rate,
+          cached_pages: {<engine>: pages on the deepest-cache process}}
       requests — {proxy, handle, replica, errors} cumulative counts
     Worker-side series ship on a ~2s cadence; a summary taken immediately
     after traffic may trail by one flush tick.
@@ -197,6 +199,24 @@ def metrics_summary() -> dict:
                 eng = next((v for k, v in kk if k == "engine"), "")
                 agg[eng] = max(agg.get(eng, 0.0), vv)
             out[key] = agg
+    hits = _counter_total(store.get("rtpu_llm_prefix_cache_hits_total"))
+    misses = _counter_total(store.get("rtpu_llm_prefix_cache_misses_total"))
+    if hits or misses:
+        cached: dict = {}
+        rec = store.get("rtpu_llm_prefix_cached_pages")
+        if rec:
+            for kk, vv in rec["series"].items():
+                eng = next((v for k, v in kk if k == "engine"), "")
+                cached[eng] = max(cached.get(eng, 0.0), vv)
+        out["prefix_cache"] = {
+            "hits": hits, "misses": misses,
+            "evictions": _counter_total(
+                store.get("rtpu_llm_prefix_cache_evictions_total")),
+            "tokens_saved": _counter_total(
+                store.get("rtpu_llm_prefix_cache_tokens_saved_total")),
+            "hit_rate": hits / (hits + misses),
+            "cached_pages": cached,
+        }
     out["requests"] = {
         "proxy": _counter_total(
             store.get("rtpu_serve_proxy_requests_total")),

@@ -57,6 +57,7 @@ def test_max_calls_retires_worker(ray):
     assert ray_tpu.get(nop.remote(), timeout=60) == "ok"
 
 
+@pytest.mark.slow
 def test_max_pending_calls_backpressure(ray):
     @ray_tpu.remote
     class Slow:

@@ -45,6 +45,7 @@ def test_config_factory_validates(tmp_path):
         autoscaler_from_config(str(p))
 
 
+@pytest.mark.slow
 def test_cli_head_autoscales_and_reports(tmp_path, fresh_driver_state):
     import ray_tpu
     from ray_tpu import state

@@ -61,6 +61,7 @@ def test_plan_min_workers_floor(small_head):
     assert to_launch == {"warm": 1}
 
 
+@pytest.mark.slow
 def test_end_to_end_scale_up_and_down(small_head):
     ray = small_head
 
@@ -108,6 +109,7 @@ def test_pg_demand_triggers_scale(small_head):
         asc.stop()
 
 
+@pytest.mark.slow
 def test_autoscaler_satisfies_training_gang(small_head):
     """End-to-end: a trainer gang bigger than the cluster drives scale-up
     (pending PG bundles are autoscaler demand), then trains."""

@@ -306,6 +306,7 @@ def test_prune_keeps_table_bounded(tmp_path):
     assert im.get(keep_alive.instance_id) is not None
 
 
+@pytest.mark.slow
 def test_e2e_fake_provider_satisfies_demand(head):
     """Real agents: demand -> v2 lifecycle -> agents join -> task runs."""
     ray = head

@@ -14,6 +14,7 @@ def ray(ray_start_regular):
     return ray_start_regular
 
 
+@pytest.mark.slow
 def test_worker_killer_tasks_survive_with_retries(ray):
     killer = WorkerKiller(kill_interval_s=0.15, max_kills=3, warmup_s=0.2)
     killer.start()
@@ -31,6 +32,7 @@ def test_worker_killer_tasks_survive_with_retries(ray):
     assert killer.stats()["kills"] >= 1, killer.stats()
 
 
+@pytest.mark.slow
 def test_worker_killer_actor_restarts(ray):
     @ray_tpu.remote(max_restarts=5, max_task_retries=10)
     class Counter:

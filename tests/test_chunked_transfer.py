@@ -166,6 +166,7 @@ class TestSpillStreaming:
 
 
 class TestEndToEnd:
+    @pytest.mark.slow
     def test_double_store_capacity_object_crosses_nodes(
             self, ray_start_regular):
         """A task on an own-store node returns an object ~2x ITS store

@@ -18,6 +18,11 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+# CLI/job integration: every test boots a head subprocess — tens of seconds each; tier-1 keeps the fast
+# unit surface elsewhere
+pytestmark = pytest.mark.slow
+
+
 def _cli(*args, timeout=90, env=None):
     e = dict(os.environ)
     e["RTPU_WORKER_PRESTART"] = "0"  # head boots fast; workers on demand

@@ -77,6 +77,7 @@ def test_expand_paths_glob_dir_mix(tmp_path):
 
 # -- data readers/writers through filesystems -------------------------------
 
+@pytest.mark.slow
 def test_read_write_parquet_file_uri(ray2, tmp_path):
     ds = rdata.range(100)
     out = tmp_path / "pq"
@@ -99,6 +100,7 @@ def test_read_csv_explicit_filesystem(ray2, tmp_path):
     assert [r["a"] for r in rows] == [1, 3]
 
 
+@pytest.mark.slow
 def test_read_json_text_uri(ray2, tmp_path):
     j = tmp_path / "x.jsonl"
     j.write_text('{"a": 1}\n{"a": 2}\n')
@@ -174,6 +176,7 @@ def test_copy_tree_streams(tmp_path):
     assert (dst / "sub" / "b.bin").read_bytes() == b"y" * 2000
 
 
+@pytest.mark.slow
 def test_read_binary_and_numpy(ray2, tmp_path):
     (tmp_path / "a.bin").write_bytes(b"\x01\x02\x03")
     rows = rdata.read_binary_files(str(tmp_path / "a.bin")).take_all()

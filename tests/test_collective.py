@@ -161,6 +161,7 @@ def test_store_backed_bulk_ops(ray):
         col.destroy_collective_group("gbulk")
 
 
+@pytest.mark.slow
 def test_bulk_broadcast_crosses_own_store_node(ray):
     """Broadcast between the head node and an own-store agent node: bulk
     bytes ride the object-transfer data plane, not the rendezvous actor."""

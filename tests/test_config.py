@@ -1,4 +1,5 @@
 """Config/flag system tests (ray_config_def.h analog)."""
+import pytest  # noqa: E402
 import os
 import subprocess
 import sys
@@ -58,6 +59,7 @@ def test_dump_and_describe():
     assert row["doc"]
 
 
+@pytest.mark.slow
 def test_flag_reaches_runtime():
     """RTPU_ env flag changes real runtime behavior in a fresh process."""
     code = (
@@ -79,6 +81,7 @@ def test_flag_reaches_runtime():
     assert "OK" in out.stdout
 
 
+@pytest.mark.slow
 def test_idle_workers_reaped_beyond_prestart():
     """Idle workers above the prestart floor exit after
     worker_idle_timeout_s (worker_pool idle eviction)."""

@@ -111,6 +111,7 @@ def test_actor_no_restart_dies(ray_start_regular):
         ray.get(m.alive.remote(), timeout=30)
 
 
+@pytest.mark.slow
 def test_node_removal_retries_tasks(ray_start_cluster):
     cluster = ray_start_cluster
     import ray_tpu as ray
@@ -161,6 +162,7 @@ def test_put_objects_not_reconstructable(shutdown_only):
     assert ray.get(ref) is not None
 
 
+@pytest.mark.slow
 def test_kill_right_after_get_does_not_clobber_result(ray_start_regular):
     """ray.get returns at object-seal; the done message may still be in
     flight when ray.kill lands. The sealed result must survive (the head

@@ -111,6 +111,7 @@ def test_compiled_faster_than_remote_calls(ray):
     assert dag_dt < remote_dt * 1.5, (dag_dt, remote_dt)
 
 
+@pytest.mark.slow
 def test_cross_node_pipeline(ray):
     """A compiled DAG spanning the head and an own-store agent node:
     cross-store edges ride the transfer service (producer pushes into the

@@ -19,17 +19,20 @@ def data(ray):
 
 
 class TestBasics:
+    @pytest.mark.slow
     def test_range_count_take(self, data):
         ds = data.range(100)
         assert ds.count() == 100
         assert [r["id"] for r in ds.take(5)] == [0, 1, 2, 3, 4]
         assert ds.num_blocks() > 1
 
+    @pytest.mark.slow
     def test_from_items_schema(self, data):
         ds = data.from_items([{"a": 1, "b": "x"}, {"a": 2, "b": "y"}])
         assert ds.count() == 2
         assert set(ds.schema().names) == {"a", "b"}
 
+    @pytest.mark.slow
     def test_from_numpy_roundtrip(self, data):
         arr = np.arange(24, dtype=np.float32).reshape(6, 4)
         ds = data.from_numpy(arr)
@@ -39,6 +42,7 @@ class TestBasics:
 
 
 class TestTransforms:
+    @pytest.mark.slow
     def test_map_chain_fuses_and_computes(self, data):
         ds = (data.range(50)
               .map_batches(lambda b: {"id": b["id"] * 2})
@@ -47,11 +51,13 @@ class TestTransforms:
         vals = sorted(r["v"] for r in ds.take_all())
         assert vals == [i * 4 + 1 for i in range(25)]
 
+    @pytest.mark.slow
     def test_flat_map(self, data):
         ds = data.from_items([{"x": 1}, {"x": 2}]).flat_map(
             lambda r: [{"x": r["x"]}, {"x": -r["x"]}])
         assert sorted(r["x"] for r in ds.take_all()) == [-2, -1, 1, 2]
 
+    @pytest.mark.slow
     def test_column_ops(self, data):
         ds = data.from_items([{"a": 1, "b": 2}])
         assert ds.select_columns(["a"]).schema().names == ["a"]
@@ -59,9 +65,11 @@ class TestTransforms:
         assert set(ds.rename_columns({"a": "c"}).schema().names) == \
             {"c", "b"}
 
+    @pytest.mark.slow
     def test_limit(self, data):
         assert data.range(100).limit(7).count() == 7
 
+    @pytest.mark.slow
     def test_union_zip(self, data):
         a = data.range(5)
         b = data.range(5)
@@ -72,17 +80,20 @@ class TestTransforms:
 
 
 class TestExchanges:
+    @pytest.mark.slow
     def test_repartition(self, data):
         ds = data.range(100).repartition(4)
         assert ds.num_blocks() == 4
         assert ds.count() == 100
 
+    @pytest.mark.slow
     def test_random_shuffle_preserves_multiset(self, data):
         ds = data.range(60).random_shuffle(seed=7)
         vals = [r["id"] for r in ds.take_all()]
         assert sorted(vals) == list(range(60))
         assert vals != list(range(60))  # actually shuffled
 
+    @pytest.mark.slow
     def test_sort(self, data):
         ds = data.from_items(
             [{"k": int(x)} for x in
@@ -92,6 +103,7 @@ class TestExchanges:
         got_desc = [r["k"] for r in ds.sort("k", descending=True).take_all()]
         assert got_desc == list(range(49, -1, -1))
 
+    @pytest.mark.slow
     def test_groupby_aggregations(self, data):
         rows = [{"g": i % 3, "v": float(i)} for i in range(30)]
         ds = data.from_items(rows)
@@ -102,6 +114,7 @@ class TestExchanges:
                 for r in ds.groupby("g").sum("v").take_all()}
         assert sums[0] == sum(float(i) for i in range(0, 30, 3))
 
+    @pytest.mark.slow
     def test_groupby_string_keys_cross_worker(self, data):
         rows = [{"g": f"key{i % 4}", "v": 1} for i in range(40)]
         counts = {r["g"]: r["count()"] for r in
@@ -110,17 +123,20 @@ class TestExchanges:
 
 
 class TestIterationAndSplit:
+    @pytest.mark.slow
     def test_iter_batches_sizes(self, data):
         ds = data.range(100)
         sizes = [len(b["id"]) for b in ds.iter_batches(batch_size=32)]
         assert sum(sizes) == 100
         assert sizes[:-1] == [32, 32, 32]
 
+    @pytest.mark.slow
     def test_iter_batches_drop_last(self, data):
         sizes = [len(b["id"]) for b in
                  data.range(100).iter_batches(batch_size=32, drop_last=True)]
         assert sizes == [32, 32, 32]
 
+    @pytest.mark.slow
     def test_streaming_split_disjoint_total(self, data):
         its = data.range(100).streaming_split(3)
         seen = []
@@ -128,6 +144,7 @@ class TestIterationAndSplit:
             seen.extend(r["id"] for r in it.iter_rows())
         assert sorted(seen) == list(range(100))
 
+    @pytest.mark.slow
     def test_iter_jax_batches(self, data):
         import jax.numpy as jnp
         ds = data.range(16)
@@ -136,6 +153,7 @@ class TestIterationAndSplit:
 
 
 class TestIO:
+    @pytest.mark.slow
     def test_parquet_roundtrip(self, data, tmp_path):
         ds = data.range(100).map_batches(
             lambda b: {"id": b["id"], "sq": b["id"] ** 2})
@@ -145,18 +163,21 @@ class TestIO:
         rows = back.sort("id").take(3)
         assert [r["sq"] for r in rows] == [0, 1, 4]
 
+    @pytest.mark.slow
     def test_csv_roundtrip(self, data, tmp_path):
         data.from_items([{"a": 1}, {"a": 2}]).write_csv(
             str(tmp_path / "csv"))
         back = data.read_csv(str(tmp_path / "csv"))
         assert sorted(r["a"] for r in back.take_all()) == [1, 2]
 
+    @pytest.mark.slow
     def test_json_roundtrip(self, data, tmp_path):
         data.from_items([{"a": 1}, {"a": 2}]).write_json(
             str(tmp_path / "js"))
         back = data.read_json(str(tmp_path / "js"))
         assert sorted(r["a"] for r in back.take_all()) == [1, 2]
 
+    @pytest.mark.slow
     def test_read_text(self, data, tmp_path):
         p = tmp_path / "t.txt"
         p.write_text("hello\nworld\n")
@@ -169,6 +190,7 @@ class TestStreamingExecutor:
     consumers as produced (reference: streaming_executor.py:52,
     select_operator_to_run backpressure)."""
 
+    @pytest.mark.slow
     def test_bounded_in_flight_over_100_blocks(self, data, tmp_path):
         from ray_tpu.data.context import DataContext
         from ray_tpu.data.dataset import Executor
@@ -184,6 +206,7 @@ class TestStreamingExecutor:
         assert seen_rows == 1000
         assert ex.max_in_flight_seen == 4  # it did run ahead of the consumer
 
+    @pytest.mark.slow
     def test_streaming_is_lazy_not_materialized(self, data, tmp_path):
         """Consuming ONE block must not have executed the whole plan:
         read tasks touch marker files; after the first pull at most
@@ -216,6 +239,7 @@ class TestStreamingExecutor:
         assert len(rest) == 39
         assert len(os.listdir(marker_dir)) == 40
 
+    @pytest.mark.slow
     def test_streaming_split_shards_are_picklable_to_actors(self, data):
         import ray_tpu as ray
 
@@ -233,6 +257,7 @@ class TestStreamingExecutor:
         # deterministic (a cold consumer may claim fewer blocks)
         assert sorted(got[0] + got[1]) == list(range(60))
 
+    @pytest.mark.slow
     def test_streaming_preserves_plan_order(self, data):
         """Blocks must arrive in plan order even when completion order
         differs (zip alignment, limit, seeded shuffles depend on it)."""
@@ -264,6 +289,7 @@ class TestStreamingExecutor:
         assert shards[0].count() == n0
 
 
+@pytest.mark.slow
 def test_from_huggingface(ray_start_regular):
     """HF arrow tables become blocks directly (ray.data.from_huggingface)."""
     import datasets as hf
@@ -279,6 +305,7 @@ def test_from_huggingface(ray_start_regular):
     assert rows[0]["text"] == "doc 0"
 
 
+@pytest.mark.slow
 def test_streaming_backpressure_on_store_pressure(ray_start_regular):
     """Past the spill threshold the submission window shrinks
     (deterministic: pressure is injected; the probe itself is exercised

@@ -17,6 +17,7 @@ def ray2():
     ray_tpu.shutdown()
 
 
+@pytest.mark.slow
 def test_column_aggregates(ray2):
     ds = rdata.range(100, override_num_blocks=4)  # id: 0..99
     assert ds.sum("id") == 4950
@@ -27,11 +28,13 @@ def test_column_aggregates(ray2):
     assert ds.columns() == ["id"]
 
 
+@pytest.mark.slow
 def test_unique(ray2):
     ds = rdata.from_items([{"v": i % 5} for i in range(40)])
     assert ds.unique("v") == [0, 1, 2, 3, 4]
 
 
+@pytest.mark.slow
 def test_random_sample(ray2):
     ds = rdata.range(2000, override_num_blocks=4)
     n = ds.random_sample(0.25, seed=0).count()
@@ -42,6 +45,7 @@ def test_random_sample(ray2):
         ds.random_sample(1.5)
 
 
+@pytest.mark.slow
 def test_train_test_split(ray2):
     ds = rdata.range(100, override_num_blocks=3)
     train, test = ds.train_test_split(0.2)
@@ -55,6 +59,7 @@ def test_train_test_split(ray2):
     assert got2 == list(range(100)) and te2.count() == 50
 
 
+@pytest.mark.slow
 def test_to_pandas(ray2):
     df = rdata.range(10).to_pandas()
     assert list(df["id"]) == list(range(10))

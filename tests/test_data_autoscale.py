@@ -32,6 +32,7 @@ def _run(ds):
     return ex, pairs
 
 
+@pytest.mark.slow
 def test_skewed_stage_scales_up_and_beats_fixed(ray4):
     n_blocks = 6
 
@@ -56,6 +57,7 @@ def test_skewed_stage_scales_up_and_beats_fixed(ray4):
     assert auto_s < fixed_s * 0.75, (fixed_s, auto_s)
 
 
+@pytest.mark.slow
 def test_pool_scales_back_down_toward_min(ray4):
     # a long tail of blocks after a burst: pool should retire actors once
     # more than half sit idle (never below min)
@@ -71,6 +73,7 @@ def test_pool_scales_back_down_toward_min(ray4):
         assert min(e["size"] for e in downs) >= 1
 
 
+@pytest.mark.slow
 def test_actor_pool_strategy_min_max(ray4):
     strat = rdata.ActorPoolStrategy(min_size=1, max_size=3)
     ds = rdata.range(40, override_num_blocks=8).map_batches(

@@ -72,6 +72,7 @@ class AddModel:
         return {"id": batch["id"], "out": batch["id"] + self.delta}
 
 
+@pytest.mark.slow
 def test_actor_pool_map_batches(ray):
     data = _data()
     ds = data.range(64).map_batches(
@@ -82,6 +83,7 @@ def test_actor_pool_map_batches(ray):
     assert rows[3]["out"] == 503
 
 
+@pytest.mark.slow
 def test_actor_pool_concurrency_kwarg(ray):
     data = _data()
     ds = data.range(32).map_batches(AddModel, concurrency=2)
@@ -89,6 +91,7 @@ def test_actor_pool_concurrency_kwarg(ray):
     assert rows[0]["out"] == 1000
 
 
+@pytest.mark.slow
 def test_actor_pool_then_block_ops_fuse(ray):
     """Block ops after the actor stage ride into the actor calls."""
     data = _data()

@@ -6,6 +6,11 @@ import ray_tpu
 from ray_tpu.experimental import DeviceObject, device_object_stats
 
 
+# experimental subsystem (ray_tpu.experimental.device_objects):
+# cross-process fetches cost seconds each; not tier-1 core
+pytestmark = pytest.mark.slow
+
+
 @pytest.fixture
 def ray(ray_start_regular):
     return ray_start_regular

@@ -44,6 +44,7 @@ def test_learner_reduces_td_error():
     assert last["td_error"] < first["td_error"] * 0.5, (first, last)
 
 
+@pytest.mark.slow
 def test_dqn_cartpole_learns(ray_start_regular):
     """End-to-end: DQN clearly beats random play on CartPole within a
     tight budget (random ~20; threshold 100 on the 100-episode mean)."""
@@ -134,6 +135,7 @@ def test_prioritized_replay_prefers_high_td():
     assert w[idx == 7].max() < w[idx != 7].min()
 
 
+@pytest.mark.slow
 def test_rainbow_components_cartpole(ray_start_regular):
     """n-step + dueling + PER together still clear the learning bar
     (reference: Rainbow's component stack on the DQN base)."""

@@ -125,6 +125,7 @@ def test_memory_monitor_policy():
     assert pick_victim(ws2).current.name == "b"  # newest busy fallback
 
 
+@pytest.mark.slow
 def test_memory_monitor_kills_and_task_retries(ray_start_regular):
     ray = ray_start_regular
     from ray_tpu.core import runtime as rt_mod

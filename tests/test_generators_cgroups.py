@@ -50,6 +50,7 @@ def test_dynamic_generator_local_mode():
         ray_tpu.shutdown()
 
 
+@pytest.mark.slow
 def test_concurrency_groups_isolate(ray):
     """A long call in one group must not block another group."""
     @ray.remote(max_concurrency=1)

@@ -101,6 +101,7 @@ def test_driver_survives_head_restart(tmp_path, fresh_driver_state):
                 p.wait(timeout=10)
 
 
+@pytest.mark.slow
 def test_named_actor_restored_after_restart(tmp_path, fresh_driver_state):
     import ray_tpu
     head1, info1 = _start_head(tmp_path)
@@ -147,6 +148,7 @@ def test_named_actor_restored_after_restart(tmp_path, fresh_driver_state):
                 p.wait(timeout=10)
 
 
+@pytest.mark.slow
 def test_reconnect_refuses_unrelated_cluster(tmp_path, fresh_driver_state):
     """A driver whose head died must NOT silently attach to some other
     local cluster that auto-resolve happens to find (cross-cluster

@@ -62,6 +62,7 @@ def test_vtrace_on_policy_reduces_to_nstep_returns():
     np.testing.assert_allclose(np.asarray(vs)[:, 0], want, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_impala_cartpole_learns(ray_start_regular):
     algo = (ImpalaAlgorithmConfig()
             .environment("CartPole-v1")

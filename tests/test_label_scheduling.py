@@ -73,6 +73,7 @@ def test_label_selector_actor_placement(labeled_cluster):
     assert ray_tpu.get(a.node.remote(), timeout=120) == edge_node
 
 
+@pytest.mark.slow
 def test_unmatchable_selector_stays_pending(labeled_cluster):
     @ray_tpu.remote(num_cpus=1)
     def nope():

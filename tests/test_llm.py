@@ -19,6 +19,7 @@ def engine():
     return InferenceEngine(cfg, rng_seed=0)
 
 
+@pytest.mark.slow
 def test_greedy_matches_full_forward(engine):
     """Greedy engine output must equal step-by-step argmax with the full
     (uncached) forward."""
@@ -68,6 +69,7 @@ def test_byte_tokenizer_roundtrip():
     assert tok.decode(ids) == "héllo"
 
 
+@pytest.mark.slow
 def test_llm_serve_deployment(ray_start_regular):
     from ray_tpu import serve
     from ray_tpu.llm.serving import LLMConfig, build_llm_deployment
@@ -90,6 +92,7 @@ def test_llm_serve_deployment(ray_start_regular):
         serve.shutdown()
 
 
+@pytest.mark.slow
 def test_batch_processor(ray_start_regular):
     from ray_tpu import data as rd
     from ray_tpu.llm.batch import ProcessorConfig, build_llm_processor
@@ -106,6 +109,7 @@ def test_batch_processor(ray_start_regular):
     assert all(o["num_generated_tokens"] == 4 for o in out)
 
 
+@pytest.mark.slow
 def test_completions_logprobs_and_echo(ray_start_regular):
     """OpenAI-surface logprobs + echo on /v1/completions (reference:
     the OpenAI completions params the llm router accepts)."""

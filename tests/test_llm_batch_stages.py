@@ -32,6 +32,7 @@ def _ecfg():
                         prefill_buckets=(16, 32))
 
 
+@pytest.mark.slow
 def test_chat_template_tokenize_engine_chain(ray4):
     cfg = ProcessorConfig(engine=_ecfg(),
                           sampling=SamplingParams(max_tokens=4))
@@ -61,6 +62,7 @@ def test_detokenize_roundtrip(ray4):
     assert rows[0]["generated_text"] == "hello"
 
 
+@pytest.mark.slow
 def test_engine_stage_autoscaling_pool(ray4):
     """concurrency=(min,max): engines run in an autoscaling actor pool."""
     cfg = ProcessorConfig(engine=_ecfg(),
@@ -75,6 +77,7 @@ def test_engine_stage_autoscaling_pool(ray4):
     assert all(isinstance(r["generated_ids"], list) for r in rows)
 
 
+@pytest.mark.slow
 def test_http_request_stage_against_serve(ray4):
     """HTTP stage fans rows out to a local OpenAI-compatible app."""
     from ray_tpu import serve

@@ -86,6 +86,7 @@ def test_llama_param_count_8b():
     assert 7.9e9 < n < 8.2e9  # llama-3-8B ≈ 8.03B
 
 
+@pytest.mark.slow
 def test_resnet18_forward_and_train_step():
     cfg = resnet.resnet18()
     variables = resnet.init(jax.random.PRNGKey(0), cfg)
@@ -97,6 +98,7 @@ def test_resnet18_forward_and_train_step():
     assert "batch_stats" in new_state
 
 
+@pytest.mark.slow
 def test_remat_save_attn_matches_full():
     """The save_attn remat policy must not change gradients."""
     import dataclasses

@@ -69,6 +69,7 @@ def test_moe_capacity_drops_overflow_tokens():
     assert zero_rows >= 8, f"only {zero_rows} dropped rows"
 
 
+@pytest.mark.slow
 def test_moe_model_trains_and_aux_flows():
     cfg = _moe_cfg()
     params = llama.init(jax.random.PRNGKey(0), cfg)

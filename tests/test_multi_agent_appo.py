@@ -72,6 +72,7 @@ class CoordinationGame(MultiAgentEnv):
         return self._obs(), rew, terms, truncs, {}
 
 
+@pytest.mark.slow
 def test_multi_agent_ppo_two_policies_converge(ray4):
     """Separate policies per agent on a 2-agent env reach near-max joint
     return (max = 16*(1+1+0.5) = 40; random ~ 16*(0.25+0.25+0.125))."""
@@ -131,6 +132,7 @@ def test_multi_agent_rejects_unknown_policy(ray4):
         cfg.build()
 
 
+@pytest.mark.slow
 def test_appo_cartpole_converges(ray4):
     cfg = (AppoAlgorithmConfig()
            .environment("CartPole-v1")

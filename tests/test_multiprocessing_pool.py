@@ -18,6 +18,7 @@ def _add(a, b):
     return a + b
 
 
+@pytest.mark.slow
 def test_map_and_chunking(ray):
     with Pool(processes=2) as p:
         assert p.map(_sq, range(50)) == [i * i for i in range(50)]
@@ -25,6 +26,7 @@ def test_map_and_chunking(ray):
                                                      for i in range(7)]
 
 
+@pytest.mark.slow
 def test_starmap_apply_async(ray):
     with Pool(processes=2) as p:
         assert p.starmap(_add, [(1, 2), (3, 4)]) == [3, 7]
@@ -33,6 +35,7 @@ def test_starmap_apply_async(ray):
         assert p.apply(_add, (2, 2)) == 4
 
 
+@pytest.mark.slow
 def test_imap_orders_and_unordered_completes(ray):
     with Pool(processes=2) as p:
         assert list(p.imap(_sq, range(10), chunksize=2)) == \
@@ -59,6 +62,7 @@ def test_initializer_and_closed_pool(ray):
         p.map(_sq, [1])
 
 
+@pytest.mark.slow
 def test_close_join_drains_outstanding(ray):
     import time
 

@@ -102,6 +102,7 @@ def test_agent_death_removes_node_and_fails_over(agent_cluster):
     assert ray.get(ping.remote(), timeout=60) == "pong"
 
 
+@pytest.mark.slow
 def test_hung_agent_detected_by_heartbeat_timeout(ray_start_regular):
     """A node agent that stops heartbeating (hung, not dead) is removed
     after health_check_timeout_s (gcs_health_check_manager analog)."""

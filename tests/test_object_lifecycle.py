@@ -22,6 +22,7 @@ def _rt(ray):
     return rt_mod.get_runtime_if_exists()
 
 
+@pytest.mark.slow
 def test_directory_bounded_over_many_tasks(ray):
     """Dropping result refs must free directory entries and store objects
     (previously both grew without bound)."""
@@ -76,6 +77,7 @@ def test_ref_in_flight_to_task_stays_alive(ray):
     assert ray.get(out, timeout=60) == 1000
 
 
+@pytest.mark.slow
 def test_bigger_than_store_object_roundtrips(ray):
     """An object ~2x the store capacity spills to disk and reads back."""
     rt = _rt(ray)

@@ -54,6 +54,7 @@ def test_lora_merge_changes_outputs():
                                np.asarray(merged2["layers"]["wq"]))
 
 
+@pytest.mark.slow
 def test_openai_completions_and_models(ray, tmp_path):
     cfg = _tiny_cfg()
     econf = PagedEngineConfig(model=cfg, max_batch_size=2, page_size=16,
@@ -81,6 +82,7 @@ def test_openai_completions_and_models(ray, tmp_path):
     assert chat["choices"][0]["message"]["role"] == "assistant"
 
 
+@pytest.mark.slow
 def test_openai_streaming_sse(ray):
     cfg = _tiny_cfg()
     econf = PagedEngineConfig(model=cfg, max_batch_size=2, page_size=16,
@@ -99,6 +101,7 @@ def test_openai_streaming_sse(ray):
     assert payloads[-1]["choices"][0]["finish_reason"] in ("stop", "length")
 
 
+@pytest.mark.slow
 def test_openai_http_path_routing(ray):
     cfg = _tiny_cfg()
     econf = PagedEngineConfig(model=cfg, max_batch_size=2, page_size=16,
@@ -120,6 +123,7 @@ def test_openai_http_path_routing(ray):
     assert models["data"][0]["id"] == "tiny"
 
 
+@pytest.mark.slow
 def test_lora_multiplexed_serving(ray, tmp_path):
     cfg = _tiny_cfg()
     # strong adapter incl. lm_head: random untrained weights sit in an

@@ -39,8 +39,10 @@ class TestEngineExportImport:
         payload = pre.prefill_export(prompt, GREEDY)
         assert payload["first_token"] == expected["token_ids"][0]
         # prefill replica released everything: reusable immediately
+        # (prefix caching parks retired pages in the cached LRU)
         st = pre.pool_stats()
-        assert st["active"] == 0 and st["free_pages"] == cfg.num_pages - 1
+        assert st["active"] == 0
+        assert st["free_pages"] + st["cached_pages"] == cfg.num_pages - 1
 
         req = dec.import_prefill(payload, GREEDY)
         dec.run_until_done([req])
@@ -68,7 +70,8 @@ class TestEngineExportImport:
             dec.run_until_done([req])
             assert dec._result(req)["token_ids"]
         st = dec.pool_stats()
-        assert st["active"] == 0 and st["free_pages"] == cfg.num_pages - 1
+        assert st["active"] == 0
+        assert st["free_pages"] + st["cached_pages"] == cfg.num_pages - 1
 
 
 class TestPDProxy:

@@ -58,6 +58,7 @@ def test_decode_replica_start_poll(ray):
     assert any(0 < n < seen[-1] for n in seen), seen
 
 
+@pytest.mark.slow
 def test_pd_streams_through_http_proxy(ray):
     """Full path: disaggregated app behind the OpenAI ingress; SSE chunks
     arrive over HTTP BEFORE the completion finishes."""

@@ -54,6 +54,7 @@ def test_zero_cpu_nested_blocking_no_deadlock(ray2):
                        timeout=120) == [2] * 8
 
 
+@pytest.mark.slow
 def test_cancel_queued_task(ray2):
     @ray_tpu.remote
     def slow():
@@ -69,6 +70,7 @@ def test_cancel_queued_task(ray2):
     assert ray_tpu.get(refs[:4], timeout=120) == ["done"] * 4
 
 
+@pytest.mark.slow
 def test_skew_rebalance(ray2):
     """Fast tasks queued behind one slow task migrate to idle workers."""
     @ray_tpu.remote

@@ -74,6 +74,7 @@ def test_delta_sync_counts_stay_linear():
     assert total == 2 * per_round * 5, total  # linear, not exponential
 
 
+@pytest.mark.slow
 def test_ppo_with_connectors_trains_and_syncs(ray4):
     pipe = ConnectorPipeline([MeanStdFilter()])
     cfg = (AlgorithmConfig()

@@ -33,6 +33,7 @@ def test_collect_transitions_schema():
     assert len(r["obs"]) == 4 and r["action"] in (0, 1)
 
 
+@pytest.mark.slow
 def test_bc_clones_expert(ray):
     ds = collect_transitions(ENV, 3000, policy=_expert, seed=1)
     algo = (BCConfig()
@@ -57,6 +58,7 @@ def test_bc_requires_dataset(ray):
         BCConfig().environment(ENV).build()
 
 
+@pytest.mark.slow
 def test_cql_learns_from_mixed_data(ray):
     """CQL trained on expert+random transitions must beat the random
     policy by a wide margin (conservatism keeps it near the dataset's
@@ -108,6 +110,7 @@ def test_cql_checkpoint_roundtrip(ray):
         algo2.stop()
 
 
+@pytest.mark.slow
 def test_marwil_learns_from_mixed_data(ray):
     """MARWIL on expert+random logs: advantage re-weighting must still
     produce a strong policy (the exp(beta*adv) weight suppresses the
@@ -137,6 +140,7 @@ def test_marwil_learns_from_mixed_data(ray):
         algo.stop()
 
 
+@pytest.mark.slow
 def test_marwil_beta_zero_is_bc(ray):
     """beta=0 reduces the policy term to plain NLL — the reference's BC
     literally subclasses MARWIL with beta pinned to 0."""

@@ -8,6 +8,10 @@ import pytest
 
 import ray_tpu
 
+# every test here builds/installs a venv — inherently tens of seconds and
+# exercised by the runtime-env unit tests in tier-1's budget's stead
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture
 def ray(ray_start_regular):

@@ -29,6 +29,7 @@ def test_tanh_gaussian_policy_bounds_and_logp():
                                                  np.asarray(q2))
 
 
+@pytest.mark.slow
 def test_sac_pendulum_learns(ray_start_regular):
     """SAC clearly improves over random play on Pendulum (random ~-1200;
     threshold -600 on the rolling mean)."""

@@ -10,6 +10,10 @@ NATIVE = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "ray_tpu", "core", "native")
 
 
+# whole-tree static hygiene scans — seconds each, not tier-1 core
+pytestmark = pytest.mark.slow
+
+
 def _build_and_run(sanitizer: str, tmp_path, threads=6, rounds=6):
     exe = str(tmp_path / f"stress_{sanitizer}")
     build = subprocess.run(

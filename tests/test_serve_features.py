@@ -54,6 +54,7 @@ def test_batch_error_fans_out():
     assert all(isinstance(r, RuntimeError) for r in results)
 
 
+@pytest.mark.slow
 def test_batched_deployment(ray):
     @serve.deployment(max_ongoing_requests=16)
     class Doubler:
@@ -77,6 +78,7 @@ def test_batched_deployment(ray):
     assert max(sizes) > 1, f"requests never batched: {sizes}"
 
 
+@pytest.mark.slow
 def test_streaming_response(ray):
     @serve.deployment
     def counter(n=5):
@@ -89,6 +91,7 @@ def test_streaming_response(ray):
     assert items == [{"i": 0}, {"i": 1}, {"i": 2}, {"i": 3}]
 
 
+@pytest.mark.slow
 def test_streaming_async_generator(ray):
     @serve.deployment
     class Streamer:
@@ -102,6 +105,7 @@ def test_streaming_async_generator(ray):
     assert got == ["tok0", "tok1", "tok2"]
 
 
+@pytest.mark.slow
 def test_multiplexed_routing_and_lru(ray):
     @serve.deployment(num_replicas=2)
     class MultiModel:
@@ -150,6 +154,7 @@ def test_multiplexed_requires_id():
         asyncio.new_event_loop().run_until_complete(main())
 
 
+@pytest.mark.slow
 def test_user_config_and_reconfigure(ray):
     """user_config applies at replica boot and updates live via
     reconfigure() without restarts (reference: lightweight updates)."""
@@ -185,6 +190,7 @@ def test_user_config_and_reconfigure(ray):
     assert any(o["pid"] == pid0 for o in outs)     # same replicas (no restart)
 
 
+@pytest.mark.slow
 def test_update_user_config_surfaces_errors(ray):
     """A reconfigure() that raises fails the update and does NOT persist
     the bad config for future replicas."""
@@ -207,6 +213,7 @@ def test_update_user_config_surfaces_errors(ray):
     assert h.remote().result(timeout_s=60) == 1
 
 
+@pytest.mark.slow
 def test_route_prefix_http(ray):
     """Explicit route_prefix maps URL paths to apps (longest match);
     default '/' keeps app-name addressing."""
@@ -237,6 +244,7 @@ def test_route_prefix_http(ray):
     assert out == {"v": 1}
 
 
+@pytest.mark.slow
 def test_route_prefix_validation(ray):
     @serve.deployment
     def f1(p=None):

@@ -133,6 +133,7 @@ def test_event_export_jsonl():
     assert {"PENDING", "RUNNING", "FINISHED"} <= states, states
 
 
+@pytest.mark.slow
 def test_iter_torch_batches(ray_start_regular):
     from ray_tpu import data
     ds = data.range(10)

@@ -70,6 +70,7 @@ class TestDiscovery:
 
 
 class TestSliceScheduling:
+    @pytest.mark.slow
     def test_gang_lands_on_one_slice(self, head):
         """Two 2-host fake slices; a 2-bundle same-label gang must not
         straddle them even though plain STRICT_SPREAD would."""
@@ -90,6 +91,7 @@ class TestSliceScheduling:
         finally:
             provider.shutdown()
 
+    @pytest.mark.slow
     def test_gang_bigger_than_any_slice_stays_pending(self, head):
         """3 same-slice bundles can't fit 2-host slices — even though the
         hosts exist cross-slice (a plain SPREAD pg of the same shape
@@ -140,6 +142,7 @@ class TestSliceScheduling:
 
 
 class TestLateSliceBoot:
+    @pytest.mark.slow
     def test_gang_places_after_retry_poller_expires(self):
         """A slice that boots slower than pg_retry_timeout_s must still
         receive its gang: node registration re-attempts pending PGs."""

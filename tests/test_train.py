@@ -7,6 +7,11 @@ import numpy as np
 import pytest
 
 
+# gang-training integration: every test reserves a PG gang — tens of seconds each; tier-1 keeps the fast
+# unit surface elsewhere
+pytestmark = pytest.mark.slow
+
+
 @pytest.fixture
 def ray(ray_start_regular):
     return ray_start_regular

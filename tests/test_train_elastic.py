@@ -19,6 +19,7 @@ def small_head():
     ray_tpu.shutdown()
 
 
+@pytest.mark.slow
 def test_joining_node_grows_gang_without_failure(small_head, tmp_path):
     """A 2-worker-max gang starts at width 1 (cluster too small); when a
     node joins mid-run the controller checkpoints and restarts at width 2

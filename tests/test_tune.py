@@ -42,6 +42,7 @@ def test_tuner_grid_best_result(ray, tmp_path):
     assert "config/x" in df.columns and len(df) == 5
 
 
+@pytest.mark.slow
 def test_asha_stops_bad_trials(ray, tmp_path):
     from ray_tpu import tune
     from ray_tpu.train.config import RunConfig
@@ -81,6 +82,7 @@ def test_stop_criteria_iterations(ray, tmp_path):
     assert grid[0].metrics["training_iteration"] == 3
 
 
+@pytest.mark.slow
 def test_pbt_perturbs_and_restores(ray, tmp_path):
     from ray_tpu import tune
     from ray_tpu.train.config import RunConfig

@@ -47,6 +47,7 @@ class TestHyperBandForBOHB:
         assert sched.on_result(
             trials[0], {"training_iteration": 9, "score": 60}) == STOP
 
+    @pytest.mark.slow
     def test_bohb_search_convergence(self, ray, tmp_path):
         from ray_tpu.train.config import RunConfig
 
@@ -76,6 +77,7 @@ class TestPB2:
         with pytest.raises(ValueError, match="bounds"):
             tune.PB2(hyperparam_bounds={})
 
+    @pytest.mark.slow
     def test_pb2_exploits_with_gp_suggestions(self, ray, tmp_path):
         from ray_tpu.train.config import RunConfig
 

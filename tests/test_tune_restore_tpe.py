@@ -50,6 +50,7 @@ def test_tpe_rejects_grid():
         tpe.setup({"a": tune.grid_search([1, 2])}, "m", "max")
 
 
+@pytest.mark.slow
 def test_tuner_with_tpe_search(ray, tmp_path):
     def objective(config):
         tune.report({"score": -(config["x"] - 0.5) ** 2})
@@ -70,6 +71,7 @@ def test_tuner_with_tpe_search(ray, tmp_path):
     assert abs(best.config["x"] - 0.5) < 0.45  # found something reasonable
 
 
+@pytest.mark.slow
 def test_tuner_restore_resumes_unfinished(ray, tmp_path):
     """Errored trials re-run on restore; finished ones keep results."""
     marker = tmp_path / "attempt2"

@@ -7,6 +7,10 @@ from ray_tpu.util import ActorPool
 from ray_tpu.util.queue import Empty, Full, Queue
 
 
+# utility-surface pool/queue tests — seconds each, not tier-1 core
+pytestmark = pytest.mark.slow
+
+
 @pytest.fixture
 def ray(ray_start_regular):
     return ray_start_regular
