@@ -5,6 +5,12 @@ Prints one JSON line per metric plus a combined gate line. Baselines are
 the reference's checked-in 2.47.0 numbers (BASELINE.md): single-client
 tasks 961/s, 1:1 actor calls sync 1960/s, async 8220/s, gets 10841/s,
 put 19.56 GiB/s.
+
+``--quick`` runs a few-hundred-op smoke of the control-plane metrics only
+(no put/collective/training hedges): same JSON line format, finishes in
+seconds, and is wired into the test suite as a slow-marked regression
+canary (tests/test_control_fastpath.py) so control-plane throughput
+collapses are visible in-tree, not only in the external bench harness.
 """
 import json
 import time
@@ -18,7 +24,7 @@ def timed(n, fn):
     return n / (time.perf_counter() - t0)
 
 
-def main():
+def main(quick: bool = False):
     import ray_tpu as ray
 
     # size the pool to the machine: on few-core hosts extra workers just
@@ -46,42 +52,52 @@ def main():
 
     results = {}
 
+    # --quick: few hundred ops per metric, control-plane metrics only
+    n_sync = 100 if quick else 500
+    n_async = 400 if quick else 2000
+    n_gets = 500 if quick else 3000
+
     # warmup: worker pool spin-up + code ship; then QUIESCE — on this
     # 1-core box a prestarted worker still finishing its imports steals
     # most of the core from any timed section (wall 3x cpu measured)
     ray.get([nop.remote() for _ in range(20)], timeout=120)
-    time.sleep(3.0)
+    time.sleep(0.5 if quick else 3.0)
 
     # single client tasks sync
     def tasks_sync():
-        for _ in range(500):
+        for _ in range(n_sync):
             ray.get(nop.remote(), timeout=60)
-    results["single_client_tasks_sync"] = (timed(500, tasks_sync), 961)
+    results["single_client_tasks_sync"] = (timed(n_sync, tasks_sync), 961)
 
     # single client tasks async (batch submit, one drain)
     def tasks_async():
-        ray.get([nop.remote() for _ in range(2000)], timeout=120)
-    results["single_client_tasks_async"] = (timed(2000, tasks_async), 6787)
+        ray.get([nop.remote() for _ in range(n_async)], timeout=120)
+    results["single_client_tasks_async"] = (timed(n_async, tasks_async), 6787)
 
     a = Actor.remote()
     ray.get(a.nop.remote(), timeout=60)
 
     def actor_sync():
-        for _ in range(500):
+        for _ in range(n_sync):
             ray.get(a.nop.remote(), timeout=60)
-    results["1_1_actor_calls_sync"] = (timed(500, actor_sync), 1960)
+    results["1_1_actor_calls_sync"] = (timed(n_sync, actor_sync), 1960)
 
     def actor_async():
-        ray.get([a.nop.remote() for _ in range(2000)], timeout=120)
-    results["1_1_actor_calls_async"] = (timed(2000, actor_async), 8220)
+        ray.get([a.nop.remote() for _ in range(n_async)], timeout=120)
+    results["1_1_actor_calls_async"] = (timed(n_async, actor_async), 8220)
 
     # single client get (small object, repeated)
     ref = ray.put(b"x" * 1024)
 
     def gets():
-        for _ in range(3000):
+        for _ in range(n_gets):
             ray.get(ref, timeout=60)
-    results["single_client_get_calls"] = (timed(3000, gets), 10841)
+    results["single_client_get_calls"] = (timed(n_gets, gets), 10841)
+
+    if quick:
+        ray.shutdown()
+        _report(results)
+        return
 
     # put throughput, steady state. Dropped refs free asynchronously, so
     # between passes poll until the store is EMPTY again — this both
@@ -159,21 +175,7 @@ def main():
 
     ray.shutdown()
 
-    worst = 1e9
-    for name, (value, base) in results.items():
-        ratio = value / base
-        worst = min(worst, ratio)
-        print(json.dumps({
-            "metric": name, "value": round(float(value), 2),
-            "unit": "GiB/s" if "gigabytes" in name else "ops/s",
-            "vs_baseline": round(ratio, 3),
-        }))
-    print(json.dumps({
-        "metric": "core_microbench_worst_ratio",
-        "value": round(worst, 3),
-        "unit": "min(ours/reference) across metrics",
-        "vs_baseline": round(worst, 3),
-    }))
+    _report(results)
 
     # TPU-down hedge: pinned CPU-mesh training-step trend (bench_trend.py)
     # — catches sharded-step regressions even when the tunnel is dead
@@ -212,5 +214,24 @@ def main():
                           "error": str(e)[:200]}))
 
 
+def _report(results):
+    worst = 1e9
+    for name, (value, base) in results.items():
+        ratio = value / base
+        worst = min(worst, ratio)
+        print(json.dumps({
+            "metric": name, "value": round(float(value), 2),
+            "unit": "GiB/s" if "gigabytes" in name else "ops/s",
+            "vs_baseline": round(ratio, 3),
+        }))
+    print(json.dumps({
+        "metric": "core_microbench_worst_ratio",
+        "value": round(worst, 3),
+        "unit": "min(ours/reference) across metrics",
+        "vs_baseline": round(worst, 3),
+    }))
+
+
 if __name__ == "__main__":
-    main()
+    import sys
+    main(quick="--quick" in sys.argv[1:])

@@ -79,6 +79,11 @@ class DriverRuntime(WorkerRuntime):
     plain tasks, and swaps onto the new session's object store so
     in-flight ``get``s resume against the re-executed results."""
 
+    # class-level defaults so the send pump (started by the base __init__,
+    # before our fields exist) can never crash on a missing attribute
+    _closing = False
+    _conn_gen = 0
+
     def __init__(self, store, conn, wid, spill=None, address_arg=None):
         super().__init__(store, conn, wid, spill)
         self.disconnected = threading.Event()
@@ -114,15 +119,29 @@ class DriverRuntime(WorkerRuntime):
             self._unresolved.pop(oid.binary(), None)
         return out
 
-    def send(self, msg):  # doc below; tracking hook first
-        if isinstance(msg, dict) and msg.get("t") == "ref_drop":
-            # the driver released its last local ref: it can never get()
-            # this result, so resubmitting its task on reconnect would be
-            # pure waste — and without this hook _unresolved grows
-            # unboundedly in fire-and-forget workloads
+    def _note_outgoing(self, msg):
+        # the driver released its last local ref: it can never get()
+        # this result, so resubmitting its task on reconnect would be
+        # pure waste — and without this hook _unresolved grows
+        # unboundedly in fire-and-forget workloads
+        if not isinstance(msg, dict):
+            return
+        t = msg.get("t")
+        if t == "ref_drop":
             with self._track_lock:
                 self._unresolved.pop(msg["oid"], None)
-        return self._send_riding_restarts(msg)
+        elif t == "ref_drops":
+            with self._track_lock:
+                for ob in msg["oids"]:
+                    self._unresolved.pop(ob, None)
+
+    def send(self, msg):
+        self._note_outgoing(msg)
+        return super().send(msg)
+
+    def send_async(self, msg):
+        self._note_outgoing(msg)
+        return super().send_async(msg)
 
     # -- liveness / reconnection ----------------------------------------- #
 
@@ -230,17 +249,50 @@ class DriverRuntime(WorkerRuntime):
                     for oid in live:
                         conn.send({"t": "ref_add", "oid": oid.binary()})
                     with self._track_lock:
-                        seen, specs = set(), []
+                        seen, cand = set(), []
                         for spec in self._unresolved.values():
                             if spec.task_id not in seen:
                                 seen.add(spec.task_id)
-                                specs.append(spec)
-                    for spec in specs:
+                                cand.append(spec)
+                    # submits still parked in the flush buffer were NEVER
+                    # sent (a failed flush requeues its frame before
+                    # raising, under send_lock — which we hold): they ship
+                    # themselves after the swap, ORDERED AFTER the
+                    # func_def replay above, so resubmitting them here
+                    # would run those tasks twice. Snapshot the buffer
+                    # AFTER reading _unresolved: a racing submit_task
+                    # appends to _sbuf before it registers in _unresolved,
+                    # so any spec the scan above saw is already visible
+                    # here if it is still unsent.
+                    with self._sbuf_lock:
+                        buffered_tids = set()
+                        for m in self._sbuf:
+                            if not isinstance(m, dict) or \
+                                    m.get("t") not in ("submit",
+                                                       "actor_call"):
+                                continue
+                            # they will flush into the NEW session: re-key
+                            # their owner like the replayed specs get,
+                            # else device-object fetches would route to
+                            # the dead session's wid
+                            m["spec"].owner = self.wid
+                            if m["t"] == "submit":
+                                buffered_tids.add(m["spec"].task_id)
+                    for spec in cand:
+                        if spec.task_id in buffered_tids:
+                            continue
                         spec.owner = self.wid
                         conn.send({"t": "submit", "spec": spec})
                 except (OSError, ValueError, BrokenPipeError):
                     continue  # head died again mid-replay; retry dial
             self._conn_gen += 1
+            # kick the flush buffer: parked rider threads retry on their
+            # own, but messages whose rider already gave up (deadline)
+            # would otherwise strand until the next send
+            try:
+                super()._try_flush()
+            except Exception:
+                pass
             # the restarted head's metric store is empty: re-mark gauge
             # series dirty (last-write-wins values only live on the head)
             # and re-ship everything on the spot
@@ -253,20 +305,44 @@ class DriverRuntime(WorkerRuntime):
             return True
         return False
 
-    def _send_riding_restarts(self, msg):
-        """Sends ride out a head restart: block until the reconnect loop
-        swaps in a live connection (or give up with ConnectionError)."""
-        import time
-        from .config import cfg
-        deadline = time.monotonic() + max(
-            cfg.driver_reconnect_timeout_s, 1.0)
-        while True:
+    def _flush_now(self):
+        self._ride(super()._flush_now)
+
+    def _try_flush(self):
+        self._ride(super()._try_flush)
+
+    def _ride(self, flush_fn):
+        """Flushes ride out a head restart: a failed drain has already
+        requeued its messages at the front of the buffer (base class), so
+        this blocks until the reconnect loop swaps in a live connection
+        and then re-flushes — the replay saw the parked messages in the
+        buffer and excluded them from resubmission, so a ridden-out
+        restart delivers them exactly once. On give-up (ConnectionError
+        after the reconnect deadline) the messages STAY queued: a later
+        successful reconnect may still deliver them, so a caller that saw
+        the error must treat its submits as at-most-once-PLUS-pending,
+        not as never-sent, before resubmitting side-effecting work."""
+        deadline = None  # computed on first failure: the happy path runs
+        while True:     # flush_fn with zero per-send overhead
             gen = self._conn_gen
             try:
-                return super().send(msg)
-            except (OSError, ValueError, BrokenPipeError):
+                return flush_fn()
+            except (OSError, EOFError, ValueError, BrokenPipeError) as err:
                 if self._closing:
                     raise
+                if isinstance(err, ValueError) and \
+                        not getattr(self.conn, "closed", True):
+                    # deterministic serialization failure on a LIVE
+                    # connection (the drain already isolated/dropped it):
+                    # not a head restart — parking here would stall the
+                    # caller for the whole reconnect deadline and then
+                    # mask the real error with a bogus ConnectionError
+                    raise
+                import time
+                from .config import cfg
+                if deadline is None:
+                    deadline = time.monotonic() + max(
+                        cfg.driver_reconnect_timeout_s, 1.0)
                 while (self._conn_gen == gen
                        and not self.disconnected.is_set()
                        and time.monotonic() < deadline):
@@ -274,19 +350,26 @@ class DriverRuntime(WorkerRuntime):
                 if self._conn_gen == gen:
                     raise ConnectionError(
                         "head connection lost and not re-established")
+                # reconnected: a flush spanning ANOTHER restart gets a
+                # fresh ride budget per leg, not the first leg's remnant
+                deadline = None
 
     def timeline(self):
         return self._rpc("timeline")
 
     def shutdown(self):
-        # _closing FIRST: it makes _send_riding_restarts fail fast, so the
-        # final flush ships over a live head but never stalls teardown for
-        # the reconnect deadline when the head is already gone (the deltas
+        # _closing FIRST: it makes _ride fail fast, so the final flush
+        # ships over a live head but never stalls teardown for the
+        # reconnect deadline when the head is already gone (the deltas
         # were lost with the head's store anyway)
         self._closing = True
         try:
             from ..util.metrics import shutdown_flush
             shutdown_flush()  # last counter deltas before the conn dies
+        except Exception:
+            pass
+        try:
+            self.flush()  # buffered submits/drops, best effort
         except Exception:
             pass
         self.disconnected.set()

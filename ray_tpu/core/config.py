@@ -180,6 +180,21 @@ _FLAGS = [
     Flag("pg_retry_timeout_s", 120.0,
          "how long placement groups keep retrying reservation"),
     # ---- control plane ---------------------------------------------- #
+    Flag("control_batching", True,
+         "coalesce control-plane messages (submit/done/ref traffic) into "
+         "batch frames via the adaptive flush buffer, and coalesce burst "
+         "submissions into shared scheduling passes on the head; off "
+         "restores one-message-per-write for debugging (results must be "
+         "identical either way)"),
+    Flag("send_batch_max", 512,
+         "force-flush the control-plane send buffer at this many queued "
+         "messages (bounds per-frame pickle size and head-side latency)"),
+    Flag("submit_burst_window_us", 100.0,
+         "an in-process submit arriving within this window of the "
+         "previous one is treated as part of a burst: its scheduling "
+         "pass is deferred to the scheduler pump so one pass (and one "
+         "batched frame per worker) serves the whole burst; 0 schedules "
+         "every submit inline"),
     Flag("rpc_pool_workers", 32,
          "threads serving worker->head RPCs (pg_wait parks here)"),
     Flag("task_records_max", 10000,

@@ -20,13 +20,27 @@ import threading
 # ids must look random end to end.
 _LOCAL = threading.local()
 
+# Fork detection WITHOUT a per-mint getpid(): glibc >= 2.25 makes every
+# getpid() a real syscall (~10-20us on virtualized hosts — it dominated
+# burst submission). The child-side at-fork hook bumps the epoch instead;
+# a mint compares two Python ints. Threads other than the forking one
+# don't survive a fork, so their stale thread-locals can never be read.
+# Accepted blind spot: a native library calling fork(2) directly bypasses
+# Python's at-fork hooks — but a child like that re-entering the
+# interpreter is unsupported by CPython generally (thread/lock state),
+# and every Python-level fork (os.fork, multiprocessing, pty) runs hooks.
+_FORK_EPOCH = [0]
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(
+        after_in_child=lambda: _FORK_EPOCH.__setitem__(0, _FORK_EPOCH[0] + 1))
+
 
 def _mint(size: int) -> bytes:
     gen = getattr(_LOCAL, "gen", None)
-    if gen is None or gen[1] != os.getpid():
+    if gen is None or gen[1] != _FORK_EPOCH[0]:
         # (re)seed on first use and after fork — a forked worker must
         # not continue its parent's stream
-        gen = (random.Random(os.urandom(24)), os.getpid())
+        gen = (random.Random(os.urandom(24)), _FORK_EPOCH[0])
         _LOCAL.gen = gen
     return gen[0].getrandbits(size * 8).to_bytes(size, "little")
 

@@ -102,7 +102,7 @@ struct Header {
   uint32_t pad0;
   pthread_mutex_t mutex;
   uint32_t seal_seq;        // bumped on every seal/delete; futex wait target
-  uint32_t pad1;
+  uint32_t n_waiters;       // processes blocked in futex_wait on seal_seq
   uint64_t lru_counter;
   uint64_t free_head;       // offset of first free block (0 = none)
   uint64_t bytes_in_use;
@@ -117,6 +117,12 @@ struct Handle {
   uint8_t* base;
   Header* hdr;
   ObjEntry* entries;
+  // getpid() cached at create/attach: glibc >= 2.25 makes every getpid() a
+  // real syscall, and pin bookkeeping calls it on the get/release hot path
+  // (measured ~13us per syscall on virtualized hosts — more than the whole
+  // rest of os_get). One Handle per process: attach after fork, never share
+  // a handle across fork, or pin accounting keys on the wrong pid.
+  int32_t pid;
 };
 
 inline ObjEntry* entry_table(Header* h) {
@@ -145,13 +151,47 @@ int futex_wait_abs(uint32_t* addr, uint32_t expected,
                       nullptr, FUTEX_BITSET_MATCH_ANY);
 }
 
+// Absolute CLOCK_MONOTONIC deadline `timeout_ms` from now (the one
+// deadline computation every blocking wait entry point shares).
+struct timespec abs_deadline(int64_t timeout_ms) {
+  struct timespec d;
+  clock_gettime(CLOCK_MONOTONIC, &d);
+  d.tv_sec += timeout_ms / 1000;
+  d.tv_nsec += (timeout_ms % 1000) * 1000000L;
+  if (d.tv_nsec >= 1000000000L) { d.tv_sec++; d.tv_nsec -= 1000000000L; }
+  return d;
+}
+
 void futex_wake_all(uint32_t* addr) {
   syscall(SYS_futex, addr, FUTEX_WAKE, INT_MAX, nullptr, nullptr, 0);
 }
 
+// Seal/delete notification. The seq bump is unconditional (waiters key
+// their re-check on it), but the FUTEX_WAKE syscall is elided when no one
+// is registered in n_waiters — on hosts with slow syscalls the wake was
+// costing every uncontended seal ~10-20us. Ordering: a waiter increments
+// n_waiters (seq_cst) BEFORE loading seal_seq for its futex_wait, so any
+// seal that the waiter's load missed must observe n_waiters > 0 and wake.
+// A waiter SIGKILLed inside futex_wait leaks its count, which only makes
+// wakes unconditional again (never lost) — saturating, self-limiting.
 void bump_seal_seq(Handle* h) {
   __atomic_fetch_add(&h->hdr->seal_seq, 1, __ATOMIC_SEQ_CST);
-  futex_wake_all(&h->hdr->seal_seq);
+  if (__atomic_load_n(&h->hdr->n_waiters, __ATOMIC_SEQ_CST) != 0)
+    futex_wake_all(&h->hdr->seal_seq);
+}
+
+// Register/deregister around a futex_wait on seal_seq.
+inline void waiter_enter(Handle* h) {
+  __atomic_fetch_add(&h->hdr->n_waiters, 1, __ATOMIC_SEQ_CST);
+}
+
+inline void waiter_exit(Handle* h) {
+  // saturating: never go below zero even if a leaked count was clamped
+  uint32_t n = __atomic_load_n(&h->hdr->n_waiters, __ATOMIC_SEQ_CST);
+  while (n != 0 && !__atomic_compare_exchange_n(
+             &h->hdr->n_waiters, &n, n - 1, false, __ATOMIC_SEQ_CST,
+             __ATOMIC_SEQ_CST)) {
+  }
 }
 
 // Per-pid pin bookkeeping. Caller holds the store mutex.
@@ -401,7 +441,7 @@ void* os_store_create(const char* path, uint64_t capacity, uint32_t max_entries)
   hdr->free_head = hdr->heap_off;
   hdr->magic = kMagic;  // written last: attachers spin on this
 
-  Handle* h = new Handle{fd, base, hdr, entry_table(hdr)};
+  Handle* h = new Handle{fd, base, hdr, entry_table(hdr), (int32_t)getpid()};
   return h;
 }
 
@@ -415,7 +455,7 @@ void* os_store_attach(const char* path) {
   if (base == MAP_FAILED) { close(fd); return nullptr; }
   Header* hdr = reinterpret_cast<Header*>(base);
   if (hdr->magic != kMagic) { munmap(base, st.st_size); close(fd); return nullptr; }
-  Handle* h = new Handle{fd, base, hdr, entry_table(hdr)};
+  Handle* h = new Handle{fd, base, hdr, entry_table(hdr), (int32_t)getpid()};
   return h;
 }
 
@@ -424,6 +464,14 @@ void os_store_close(void* hv) {
   munmap(h->base, h->hdr->capacity);
   close(h->fd);
   delete h;
+}
+
+// Refresh the handle's cached pid after a fork: a child inheriting the
+// parent's handle must pin under ITS pid, or os_reclaim_pid(parent) would
+// strip pins the child still relies on (Python registers this via
+// os.register_at_fork, object_store.py).
+void os_store_refresh_pid(void* hv) {
+  reinterpret_cast<Handle*>(hv)->pid = (int32_t)getpid();
 }
 
 // Allocate an object buffer. Returns payload offset (>0), 0 if out of memory
@@ -446,7 +494,7 @@ uint64_t os_create(void* hv, const uint8_t* id, uint64_t size) {
   e->size = size;
   e->refcnt = 1;  // creator holds a pin until seal
   e->lru_tick = ++h->hdr->lru_counter;
-  e->creator_pid = (int32_t)getpid();
+  e->creator_pid = h->pid;
   e->overflow_pins = 0;
   memset(e->pins, 0, sizeof(e->pins));
   e->state = kCreated;
@@ -479,17 +527,12 @@ int os_seal(void* hv, const uint8_t* id) {
 int os_get(void* hv, const uint8_t* id, int64_t timeout_ms,
            uint64_t* offset, uint64_t* size) {
   Handle* h = reinterpret_cast<Handle*>(hv);
-  struct timespec deadline;
-  clock_gettime(CLOCK_MONOTONIC, &deadline);
-  deadline.tv_sec += timeout_ms / 1000;
-  deadline.tv_nsec += (timeout_ms % 1000) * 1000000L;
-  if (deadline.tv_nsec >= 1000000000L) { deadline.tv_sec++; deadline.tv_nsec -= 1000000000L; }
-  int32_t me = (int32_t)getpid();
+  struct timespec deadline = abs_deadline(timeout_ms);
   lock(h);
   while (true) {
     ObjEntry* e = find(h, id);
     if (e && e->state == kSealed) {
-      pin(e, me);
+      pin(e, h->pid);
       e->lru_tick = ++h->hdr->lru_counter;
       *offset = e->offset;
       *size = e->size;
@@ -497,13 +540,86 @@ int os_get(void* hv, const uint8_t* id, int64_t timeout_ms,
       return 0;
     }
     if (timeout_ms == 0) { unlock(h); return -2; }
+    waiter_enter(h);  // BEFORE the seq load — see bump_seal_seq
     uint32_t seq = __atomic_load_n(&h->hdr->seal_seq, __ATOMIC_SEQ_CST);
     unlock(h);
     int rc = futex_wait_abs(&h->hdr->seal_seq, seq, &deadline);
+    waiter_exit(h);
     if (rc != 0 && errno == ETIMEDOUT) return -1;
     // 0 (woken), EAGAIN (seq already moved) or EINTR: re-check under lock.
     lock(h);
   }
+}
+
+// Multi-object wait: block until at least `min_count` of the `n` ids are
+// sealed in the store, or the timeout expires. out[i] is set to 1 once
+// id i has been OBSERVED sealed (sticky for the duration of the call —
+// a concurrent evict after observation does not unset it; callers that
+// then read the object re-enter through os_get and retry on a miss).
+// Returns the number of set out[] flags. timeout_ms == 0 is a single
+// non-blocking scan. This is the control plane's seal-notification
+// primitive: one futex wait services whichever of N results seals first
+// (worker-side bulk ray.get / ray.wait), replacing per-ref poll slices.
+// Each wake rescans only the not-yet-observed ids, so a call over n ids
+// costs O(n) probes per seal event while waiting — fine for the list
+// sizes get()/wait() see; callers with huge lists should chunk.
+int os_wait_sealed(void* hv, const uint8_t* ids, uint32_t n,
+                   uint32_t min_count, int64_t timeout_ms, uint8_t* out) {
+  Handle* h = reinterpret_cast<Handle*>(hv);
+  struct timespec deadline = abs_deadline(timeout_ms);
+  if (min_count > n) min_count = n;
+  memset(out, 0, n);
+  uint32_t have = 0;
+  lock(h);
+  while (true) {
+    for (uint32_t i = 0; i < n && have < n; i++) {
+      if (out[i]) continue;
+      ObjEntry* e = find(h, ids + (uint64_t)i * kIdSize);
+      if (e && e->state == kSealed) { out[i] = 1; have++; }
+    }
+    if (have >= min_count || timeout_ms == 0) { unlock(h); return (int)have; }
+    waiter_enter(h);
+    uint32_t seq = __atomic_load_n(&h->hdr->seal_seq, __ATOMIC_SEQ_CST);
+    unlock(h);
+    int rc = futex_wait_abs(&h->hdr->seal_seq, seq, &deadline);
+    waiter_exit(h);
+    if (rc != 0 && errno == ETIMEDOUT) {
+      // final rescan: a seal may have slipped between our last scan and
+      // the wait (its wake then raced the timeout)
+      lock(h);
+      for (uint32_t i = 0; i < n; i++) {
+        if (out[i]) continue;
+        ObjEntry* e = find(h, ids + (uint64_t)i * kIdSize);
+        if (e && e->state == kSealed) { out[i] = 1; have++; }
+      }
+      unlock(h);
+      return (int)have;
+    }
+    lock(h);
+  }
+}
+
+// Seqlock-style building blocks for chunked multi-waits from Python: read
+// the seal sequence, scan in bounded chunks (each a short mutex hold),
+// then block until the sequence moves. Any seal/delete wakes the waiter;
+// the caller rescans. Lets a partial wait over a huge id list avoid one
+// O(n) probe pass under the mutex per seal event.
+uint32_t os_seal_seq(void* hv) {
+  Handle* h = reinterpret_cast<Handle*>(hv);
+  return __atomic_load_n(&h->hdr->seal_seq, __ATOMIC_SEQ_CST);
+}
+
+// Block until seal_seq != seq or timeout. 0 = changed, -1 = timeout.
+int os_wait_seq(void* hv, uint32_t seq, int64_t timeout_ms) {
+  Handle* h = reinterpret_cast<Handle*>(hv);
+  struct timespec deadline = abs_deadline(timeout_ms);
+  waiter_enter(h);
+  while (__atomic_load_n(&h->hdr->seal_seq, __ATOMIC_SEQ_CST) == seq) {
+    int rc = futex_wait_abs(&h->hdr->seal_seq, seq, &deadline);
+    if (rc != 0 && errno == ETIMEDOUT) { waiter_exit(h); return -1; }
+  }
+  waiter_exit(h);
+  return 0;
 }
 
 int os_contains(void* hv, const uint8_t* id) {
@@ -519,7 +635,7 @@ void os_release(void* hv, const uint8_t* id) {
   Handle* h = reinterpret_cast<Handle*>(hv);
   lock(h);
   ObjEntry* e = find(h, id);
-  if (e) unpin(e, (int32_t)getpid());
+  if (e) unpin(e, h->pid);
   unlock(h);
 }
 

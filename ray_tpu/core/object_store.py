@@ -80,8 +80,20 @@ def _load_lib() -> ctypes.CDLL:
     lib.os_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.os_reclaim_pid.restype = ctypes.c_int
     lib.os_reclaim_pid.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.os_wait_sealed.restype = ctypes.c_int
+    lib.os_wait_sealed.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint32,
+        ctypes.c_int64, ctypes.c_char_p,
+    ]
+    lib.os_seal_seq.restype = ctypes.c_uint32
+    lib.os_seal_seq.argtypes = [ctypes.c_void_p]
+    lib.os_wait_seq.restype = ctypes.c_int
+    lib.os_wait_seq.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
+                                ctypes.c_int64]
     lib.os_prefault.restype = None
     lib.os_prefault.argtypes = [ctypes.c_void_p]
+    lib.os_store_refresh_pid.restype = None
+    lib.os_store_refresh_pid.argtypes = [ctypes.c_void_p]
     for fn in ("os_capacity", "os_bytes_in_use", "os_num_objects", "os_evictions"):
         getattr(lib, fn).restype = ctypes.c_uint64
         getattr(lib, fn).argtypes = [ctypes.c_void_p]
@@ -91,6 +103,13 @@ def _load_lib() -> ctypes.CDLL:
 class _FramedValue:
     """One serialization of a value in the store's wire framing, writable
     to either a shm buffer or a spill file (serialize once, place anywhere).
+
+    Copy audit (put-bandwidth path): pickle-5 out-of-band buffers are
+    REFERENCED (`b.raw()` is a view into the caller's array), never copied
+    at serialize time; the single copy a put pays is write_into's memmove
+    from the source array into the (MADV_HUGEPAGE-advised, optionally
+    prefaulted) store mapping. Spill streams the same pieces to disk
+    without materializing the frame (SpillStore.spill_frame).
     """
 
     def __init__(self, value: Any, is_exception: bool):
@@ -223,11 +242,14 @@ class SpillStore:
         return self.spill_frame(oid, _FramedValue(value, is_exception))
 
     def spill_frame(self, oid: ObjectID, frame: "_FramedValue") -> int:
-        buf = bytearray(frame.total)
-        frame.write_into(buf)
+        # stream the frame piecewise: materializing a full-size bytearray
+        # first doubled the copy volume for multi-GiB spills (write_into +
+        # write); the out-of-band buffers go straight from their owner's
+        # memory to the page cache
         tmp = self._path(oid) + ".tmp"
         with open(tmp, "wb") as f:
-            f.write(buf)
+            for piece in frame.iter_wire():
+                f.write(piece)
         os.replace(tmp, self._path(oid))
         return frame.total
 
@@ -243,6 +265,29 @@ class SpillStore:
             os.unlink(self._path(oid))
         except OSError:
             pass
+
+
+# Live stores in this process, so the at-fork hook can re-key their
+# cached pid (the native handle pins objects under Handle.pid; a forked
+# child inheriting the parent's handle must pin under ITS pid or the
+# parent's exit reclaim would strip pins the child still reads through).
+import weakref
+
+_LIVE_STORES: "weakref.WeakSet[SharedObjectStore]" = weakref.WeakSet()
+
+
+def _refresh_store_pids_after_fork() -> None:
+    for s in list(_LIVE_STORES):
+        h = s._h
+        if h:
+            try:
+                s._lib.os_store_refresh_pid(h)
+            except Exception:
+                pass
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_refresh_store_pids_after_fork)
 
 
 class SharedObjectStore:
@@ -264,6 +309,10 @@ class SharedObjectStore:
         self._advise_mapping(create)
         self._view = memoryview(self._mm)
         self._owner = create
+        # heap capacity is fixed for the store's lifetime: cache it so the
+        # per-put spill-threshold check costs one ctypes call, not two
+        self._capacity = int(self._lib.os_capacity(self._h))
+        _LIVE_STORES.add(self)
 
     # Linux madvise constants Python's mmap module doesn't export yet.
     _MADV_HUGEPAGE = 14
@@ -341,6 +390,70 @@ class SharedObjectStore:
 
     def contains(self, oid: ObjectID) -> bool:
         return bool(self._lib.os_contains(self._handle(), oid.binary()))
+
+    # Chunk bound for full waits: os_wait_sealed rescans the whole
+    # not-yet-observed set under the store mutex on every seal event, so
+    # waiting on one huge list costs O(n^2) probes while serializing other
+    # processes' store ops. A full wait (min_count >= n) decomposes
+    # exactly into waiting each chunk to completion in turn.
+    _WAIT_CHUNK = 1024
+
+    def wait_sealed(self, oids, min_count: int,
+                    timeout_ms: int) -> list[bool]:
+        """Block until at least `min_count` of `oids` are sealed (or the
+        timeout fires); returns one observed-sealed flag per oid. One futex
+        wait on the store header's seal-sequence word services whichever
+        object seals first — the event-driven multi-object primitive behind
+        bulk get()/wait() (timeout_ms=0 is a non-blocking bulk contains).
+        Spilled objects never seal in shm: callers re-check their spill
+        fallback between bounded slices."""
+        n = len(oids)
+        if n == 0:
+            return []
+        if n > self._WAIT_CHUNK:
+            return self._wait_sealed_chunked(oids, min_count, timeout_ms)
+        return self._wait_sealed_call(oids, min_count, timeout_ms)
+
+    def _wait_sealed_chunked(self, oids, min_count: int,
+                             timeout_ms: int) -> list[bool]:
+        """wait_sealed over a huge list: seqlock-style. Scan in bounded
+        chunks (each a short store-mutex hold, so other processes' store
+        ops never stall behind one O(n) probe pass), then block on the
+        seal-sequence word until something seals and rescan only the
+        still-unmet ids."""
+        import time as _time
+        n = len(oids)
+        deadline = _time.monotonic() + timeout_ms / 1000.0
+        flags = [False] * n
+        unmet = list(range(n))
+        while True:
+            seq = self._lib.os_seal_seq(self._handle())
+            for s in range(0, len(unmet), self._WAIT_CHUNK):
+                idxs = unmet[s:s + self._WAIT_CHUNK]
+                got = self._wait_sealed_call([oids[i] for i in idxs], 0, 0)
+                for i, f in zip(idxs, got):
+                    if f:
+                        flags[i] = True
+            unmet = [i for i in unmet if not flags[i]]
+            if n - len(unmet) >= min_count or not unmet:
+                return flags
+            if timeout_ms == 0:
+                return flags
+            remain_ms = int((deadline - _time.monotonic()) * 1000)
+            if remain_ms <= 0:
+                return flags
+            # a seal between our seq read and this wait returns
+            # immediately (seq moved); otherwise any seal/delete wakes us
+            self._lib.os_wait_seq(self._handle(), seq, remain_ms)
+
+    def _wait_sealed_call(self, oids, min_count: int,
+                          timeout_ms: int) -> list[bool]:
+        n = len(oids)
+        ids = b"".join(o.binary() for o in oids)
+        out = ctypes.create_string_buffer(n)
+        self._lib.os_wait_sealed(self._handle(), ids, n,
+                                 max(0, min_count), timeout_ms, out)
+        return [b != 0 for b in out.raw]
 
     def delete(self, oid: ObjectID) -> None:
         self._lib.os_delete(self._handle(), oid.binary())
@@ -437,7 +550,7 @@ class SharedObjectStore:
     # -- stats -------------------------------------------------------------
 
     def capacity(self) -> int:
-        return self._lib.os_capacity(self._handle())
+        return self._capacity
 
     def bytes_in_use(self) -> int:
         return self._lib.os_bytes_in_use(self._handle())

@@ -19,6 +19,29 @@ GCS snapshots embed SNAPSHOT_SCHEMA_VERSION the same way so
 ``init(resume_from=...)`` across an incompatible upgrade fails with a
 clear message instead of restoring garbage state (reference analog: the
 GCS table schema version in gcs_storage).
+
+The batch frame (v3)
+--------------------
+Every peer may coalesce consecutive control messages into one frame::
+
+    {"t": "batch", "msgs": [msg, msg, ...]}
+
+with the contained messages processed strictly in list order — a batch is
+a transport optimization, never a reordering point, so per-connection
+FIFO invariants (func_def before the submits that reference it, ref_add
+before a later ref_drop) survive batching unchanged. Senders fill batches
+through an adaptive flush buffer (core/worker.py WorkerRuntime.send_async)
+drained combining-lock style — no flusher thread: an async sender appends
+and try-acquires the connection, shipping its own message immediately
+when uncontended, while under a burst the first sender becomes the
+shipper and everything appended during its pipe write coalesces into
+large frames (one pickle + one syscall amortized over N; every holder
+re-checks the buffer after releasing, so nothing strands). Synchronous
+messages (ensure/blocked/rpc/...) drain the buffer in-order and ship
+immediately with it. Receivers handle a batch with one scheduler lock
+acquisition and one deferred scheduling pass (head: Runtime._handle_batch;
+workers splice batches into their ordered backlog). v2 peers know none of
+this, so v3 is a handshake-incompatible bump.
 """
 from __future__ import annotations
 
@@ -26,7 +49,10 @@ from __future__ import annotations
 # v2: submit/actor_call imply the submitter's interest in return_ids
 #     (no per-task ref_add), batched ref_drops, positional-tuple
 #     TaskSpec/ActorSpec pickling (+ max_calls field).
-PROTOCOL_VERSION = 2
+# v3: client->head "batch" frames (adaptive flush buffer, see module
+#     docstring); multi-oid "ensure" remains but is now sent once up
+#     front for every missing ref of a bulk get/wait.
+PROTOCOL_VERSION = 3
 
 # Bump on any incompatible change to the sqlite snapshot contents.
 # v2: named-actor keys are namespace-qualified ("ns/name"); v1 snapshots

@@ -118,7 +118,21 @@ class RemoteFunction:
         if self._blob is None:
             self._blob = cloudpickle.dumps(self._fn)
             self._fid = hashlib.sha1(self._blob).hexdigest()[:16]
-        rt.register_function(self._fid, self._blob)
+        # once per runtime, not once per call: register_function takes the
+        # (contended) runtime lock, which burst submission must not pay
+        # per task. Reconnecting drivers re-ship from their own blob table
+        # (client.py _fid_blobs), so skipping here stays correct across
+        # head restarts; a re-init creates a NEW runtime object. Weakref:
+        # this cache must not pin a shut-down runtime (and its store
+        # mapping) alive for the life of a module-level @remote function.
+        import weakref
+        reg = getattr(self, "_reg_rt", None)
+        if reg is None or reg() is not rt:
+            rt.register_function(self._fid, self._blob)
+            try:
+                self._reg_rt = weakref.ref(rt)
+            except TypeError:
+                self._reg_rt = None  # unweakrefable runtime (test double)
 
     def remote(self, *args, **kwargs) -> Any:
         rt = _runtime()

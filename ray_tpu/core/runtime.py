@@ -431,6 +431,20 @@ class Runtime:
         # open per-worker message batch for the current scheduling pass
         # (see _schedule_locked); None outside a pass
         self._send_buf: dict | None = None
+        # deferred-scheduling state (control-plane fast path): while
+        # _defer_sched > 0, _schedule_locked only records that a pass is
+        # wanted — a client batch frame or a submit burst then pays ONE
+        # pass (and one batched frame per worker) instead of one per
+        # message. _sched_evt wakes the scheduler pump for deferred
+        # in-process submits.
+        self._defer_sched = 0
+        self._sched_wanted = False
+        self._last_submit_ts = 0.0
+        self._burst_window = (cfg.submit_burst_window_us / 1e6
+                              if cfg.control_batching else 0.0)
+        self._sched_evt = threading.Event()
+        threading.Thread(target=self._sched_pump_loop, daemon=True,
+                         name="rtpu-sched-pump").start()
         # merged user-defined metrics (util/metrics.py):
         # name -> {kind, desc, series: {tag-tuple: value}}
         self.user_metrics: dict[str, dict] = {}
@@ -781,9 +795,60 @@ class Runtime:
             if wid is not None:
                 self._on_worker_death(wid)
 
+    def _sched_pump_loop(self):
+        """Runs the scheduling passes that burst submissions defer
+        (submit_task): one pass drains every submit that accumulated
+        while the previous pass ran, so its per-worker dispatches
+        coalesce into one batched frame each."""
+        while True:
+            self._sched_evt.wait()
+            self._sched_evt.clear()
+            if self._shutdown:
+                return
+            try:
+                with self.lock:
+                    self._schedule_locked()
+            except Exception:
+                if self._shutdown:
+                    return
+                traceback.print_exc()
+
+    def _handle_batch(self, wid: str, msgs: list):
+        """A client batch frame: one scheduler-lock acquisition serves
+        every contained message (in order), and all the scheduling passes
+        they request collapse into ONE at the end — whose per-worker task
+        dispatches ride one batched frame each (_send_buf). A bad message
+        must not poison the rest, same contract as the recv loop."""
+        with self.lock:
+            opened = self._send_buf is None
+            if opened:
+                self._send_buf = {}
+            self._defer_sched += 1
+            try:
+                for m in msgs:
+                    try:
+                        self._handle_msg(wid, m)
+                    except Exception:
+                        traceback.print_exc()
+            finally:
+                self._defer_sched -= 1
+                try:
+                    if self._sched_wanted and not self._defer_sched:
+                        self._sched_wanted = False
+                        self._schedule_locked()  # rides the open send buf
+                finally:
+                    # restore + flush even if the pass raises: leaking an
+                    # open _send_buf would silently black-hole every
+                    # future worker dispatch
+                    if opened:
+                        buf, self._send_buf = self._send_buf, None
+                        self._flush_wsend_buf(buf)
+
     def _handle_msg(self, wid: str, msg: dict):
         t = msg["t"]
-        if t == "done":
+        if t == "batch":
+            self._handle_batch(wid, msg["msgs"])
+        elif t == "done":
             if "span" in msg:
                 self.record_trace_span(msg["span"])
             self._on_task_done(wid, msg)
@@ -1303,6 +1368,10 @@ class Runtime:
 
     def _on_worker_death(self, wid: str):
         with self.lock:
+            if self._shutdown:
+                # shutdown() already tears every worker down; running the
+                # death path now would race the closing object store
+                return
             w = self.workers.get(wid)
             if w is None or w.state == "dead":
                 return
@@ -1601,6 +1670,27 @@ class Runtime:
             # between submit and ref construction must not see an
             # unreferenced result and free it
             refs = [ObjectRef(o) for o in spec.return_ids]
+            bw = self._burst_window
+            if bw > 0.0:
+                now = time.monotonic()
+                burst = now - self._last_submit_ts < bw
+                self._last_submit_ts = now
+                if burst:
+                    # burst submission (in-process driver): defer the
+                    # scheduling pass to the pump so one pass — and one
+                    # batched pipe frame per worker — serves the whole
+                    # burst. An isolated submit (interval >= the window,
+                    # i.e. anything with a round-trip in between) still
+                    # schedules inline with zero added latency.
+                    self._defer_sched += 1
+                    try:
+                        self._submit_locked(spec)
+                    finally:
+                        self._defer_sched -= 1
+                    if self._sched_wanted and not self._defer_sched:
+                        self._sched_wanted = False
+                        self._sched_evt.set()
+                    return refs
             self._submit_locked(spec)
         return refs
 
@@ -1751,22 +1841,32 @@ class Runtime:
         costs one syscall, not four)."""
         if self._shutdown:
             return
+        if self._defer_sched:
+            # inside a batch frame / deferred submit: one pass at the end
+            # serves every request made during it
+            self._sched_wanted = True
+            return
         if self._send_buf is None:
             self._send_buf = {}
             try:
                 self._schedule_pass_locked()
             finally:
                 buf, self._send_buf = self._send_buf, None
-                dead = []
-                for w, msgs in buf.items():
-                    msg = (msgs[0] if len(msgs) == 1
-                           else {"t": "batch", "msgs": msgs})
-                    if not w.send(msg):
-                        dead.append(w.wid)
-                for wid in dead:
-                    self._on_worker_death(wid)
+                self._flush_wsend_buf(buf)
             return
         self._schedule_pass_locked()
+
+    def _flush_wsend_buf(self, buf: dict) -> None:
+        """Ship the per-worker message batches accumulated by _wsend —
+        one pipe write per worker per pass/batch."""
+        dead = []
+        for w, msgs in buf.items():
+            msg = (msgs[0] if len(msgs) == 1
+                   else {"t": "batch", "msgs": msgs})
+            if not w.send(msg):
+                dead.append(w.wid)
+        for wid in dead:
+            self._on_worker_death(wid)
 
     def _wsend(self, w: WorkerInfo, msg) -> bool:
         """Send to a worker, coalescing into the current scheduling
@@ -2824,39 +2924,56 @@ class Runtime:
 
     def wait(self, refs, num_returns=1, timeout: float | None = None,
              fetch_local=True):
+        # event-driven: one multi-oid futex wait (store.wait_sealed)
+        # services whichever result seals first — a completion wakes this
+        # waiter immediately instead of on the next 5ms poll boundary.
+        # The growing slice only bounds how often directory states
+        # (FAILED/SPILLED never seal in shm) are re-checked and evicted
+        # READY objects re-ensured.
         ref_list = list(refs)
         if num_returns > len(ref_list):
             raise ValueError("num_returns exceeds number of refs")
         deadline = None if timeout is None else time.monotonic() + timeout
         ready: list[ObjectRef] = []
         pending = list(ref_list)
-        iters = 0
-        while True:
+        slice_ms = 0          # first round is a non-blocking scan
+        next_ensure = 0.0
+        while len(ready) < num_returns and pending:
+            if deadline is not None and slice_ms:
+                remain = deadline - time.monotonic()
+                if remain <= 0:
+                    break
+                slice_ms = min(slice_ms, max(1, int(remain * 1000)))
+            flags = self.store.wait_sealed(
+                [r.id() for r in pending],
+                num_returns - len(ready), slice_ms)
+            now = time.monotonic()
+            do_ensure = now >= next_ensure
+            if do_ensure:
+                next_ensure = now + 0.2
             still = []
-            for r in pending:
-                if self.store.contains(r.id()):
-                    ready.append(r)
-                else:
-                    with self.lock:
-                        e = self.directory.get(r.id())
-                        if e is not None and e.state in (FAILED, SPILLED):
-                            # errors count as ready; spilled objects are
-                            # readable from disk
-                            ready.append(r)
-                            continue
-                        if iters % 40 == 0:
-                            # evicted-but-READY objects need lineage re-exec,
-                            # same as get() (object_recovery_manager.h:43)
-                            self._ensure_available_locked(r.id())
-                            self._schedule_locked()
+            with self.lock:
+                for r, f in zip(pending, flags):
+                    if f:
+                        ready.append(r)
+                        continue
+                    e = self.directory.get(r.id())
+                    if e is not None and e.state in (FAILED, SPILLED):
+                        # errors count as ready; spilled objects are
+                        # readable from disk
+                        ready.append(r)
+                        continue
+                    if do_ensure:
+                        # evicted-but-READY objects need lineage re-exec,
+                        # same as get() (object_recovery_manager.h:43)
+                        self._ensure_available_locked(r.id())
                     still.append(r)
+                if do_ensure and still:
+                    self._schedule_locked()
             pending = still
-            iters += 1
-            if len(ready) >= num_returns or not pending:
-                break
             if deadline is not None and time.monotonic() >= deadline:
                 break
-            time.sleep(0.005)
+            slice_ms = min(max(slice_ms * 2, 2), 50)  # backoff fallback
         # reference contract: at most num_returns refs in ready; extra
         # already-ready refs stay in the remaining list
         return ready[:num_returns], ready[num_returns:] + pending
@@ -2990,6 +3107,7 @@ class Runtime:
                 node.agent.send({"t": "shutdown"})
         # wake pg_wait blockers so rpc-pool threads exit promptly, then
         # release the pool without joining in-flight handlers
+        self._sched_evt.set()  # release the scheduler pump
         for pg in self.pgs.values():
             pg.ready_event.set()
         self._rpc_pool.shutdown(wait=False, cancel_futures=True)

@@ -71,11 +71,27 @@ class WorkerRuntime:
 
     def __init__(self, store: SharedObjectStore, conn, wid: str,
                  spill=None):
+        from .config import cfg
         self.store = store
         self.spill = spill
         self.conn = conn
         self.wid = wid
         self.send_lock = threading.Lock()
+        # adaptive flush buffer (protocol v3 batch frames), combining-lock
+        # style: an async send appends and then TRY-acquires the
+        # connection — uncontended it ships its own message immediately
+        # (same cost as an unbuffered send: no extra thread, no wakeup
+        # syscall), while under a burst the first sender becomes the
+        # shipper and drains everything that accumulates during its pipe
+        # writes into batch frames (one pickle + one syscall amortized
+        # over N). Wire order is exactly buffer-append order: synchronous
+        # send() drains the buffer in-order ahead of its own message, so
+        # FIFO invariants (func_def before submit, ref_add before a later
+        # drop) hold with batching on or off.
+        self._batching = cfg.control_batching
+        self._batch_max = max(1, cfg.send_batch_max)
+        self._sbuf: list = []
+        self._sbuf_lock = threading.Lock()
         self.func_registry: dict[str, object] = {}
         self._sent_fids: set[str] = set()
         self._sent_renvs: set[str] = set()
@@ -90,6 +106,7 @@ class WorkerRuntime:
         self._rpc_reply_evt = threading.Event()
         self._rpc_abandoned: set[bytes] = set()
         self._last_fetch: dict = {}
+        self._last_fetch_sweep = 0.0
         self.current_task_name = ""
         # process-local ObjectRef counts; 0<->1 transitions notify the head
         # (reference_count.h:73 borrower protocol, simplified)
@@ -123,8 +140,10 @@ class WorkerRuntime:
                 self._presumed.discard(oid)
                 return  # the submit message registers this interest
             if c == 0 or from_transfer:
-                self.send({"t": "ref_add", "oid": oid.binary(),
-                           "transfer": from_transfer})
+                # async: appended under _ref_lock, so ordering against the
+                # drop loop's sends (also under _ref_lock) is buffer order
+                self.send_async({"t": "ref_add", "oid": oid.binary(),
+                                 "transfer": from_transfer})
 
     def ref_deleted(self, oid):
         self._drop_q.put(oid)
@@ -155,29 +174,173 @@ class WorkerRuntime:
                         else:
                             self._ref_counts[oid] = c
                     if len(dead) == 1:
-                        self.send({"t": "ref_drop", "oid": dead[0]})
+                        self.send_async({"t": "ref_drop", "oid": dead[0]})
                     elif dead:
-                        self.send({"t": "ref_drops", "oids": dead})
-            except Exception:
+                        self.send_async({"t": "ref_drops", "oids": dead})
+            except (OSError, EOFError):
                 return  # connection gone: worker is exiting
+            except Exception:
+                # a combining drain can surface ANOTHER thread's poison-
+                # message error here; this thread must keep servicing
+                # drops or head-side refcounts leak for the process's life
+                traceback.print_exc()
 
     def ref_serialized(self, oid):
-        self.send({"t": "ref_xfer", "oid": oid.binary()})
+        # async is safe: the xfer pin is appended BEFORE the message that
+        # carries the serialized ref (same thread), so it reaches the head
+        # first and the pin exists before any receiver can deserialize
+        self.send_async({"t": "ref_xfer", "oid": oid.binary()})
 
     # -- messaging ---------------------------------------------------------
 
     def send(self, msg):
-        with self.send_lock:
-            self.conn.send(msg)
+        """Synchronous send: drains the flush buffer in-order ahead of
+        `msg` and ships everything as one frame."""
+        with self._sbuf_lock:
+            self._sbuf.append(msg)
+        self._flush_now()
+
+    def send_async(self, msg):
+        """Buffered send: `msg` ships with this call when the connection
+        is free, or rides the current shipper's next drain round when
+        another thread is mid-write. Use for fire-and-forget control
+        traffic; anything the caller waits on must go through send()."""
+        if not self._batching:
+            return self.send(msg)
+        with self._sbuf_lock:
+            self._sbuf.append(msg)
+        self._try_flush()
+
+    def flush(self):
+        """Ship everything in the flush buffer now (no-op when empty)."""
+        self._flush_now()
+
+    def _try_flush(self):
+        # Combining-lock drain. The liveness invariant: whoever sees a
+        # non-empty buffer either drains it or observes send_lock held —
+        # and every holder re-checks the buffer after releasing, so an
+        # append racing a holder's final empty-check is picked up by that
+        # holder's re-check (or by our next loop iteration). No message
+        # can strand without a live shipper.
+        while True:
+            if not self.send_lock.acquire(blocking=False):
+                return  # current holder's post-release re-check covers us
+            try:
+                self._drain_locked()
+            finally:
+                self.send_lock.release()
+            with self._sbuf_lock:
+                if not self._sbuf:
+                    return
+
+    def _flush_now(self):
+        while True:
+            with self.send_lock:
+                self._drain_locked()
+            with self._sbuf_lock:
+                if not self._sbuf:
+                    return
+
+    def _drain_locked(self):
+        # pop + send are atomic under send_lock: a reconnecting driver
+        # (client.py) holds send_lock while it replays state, so messages
+        # still in the buffer are visible to (and excluded by) the replay
+        while True:
+            # batching off: one frame per message (the documented
+            # debugging mode), still FIFO through the same buffer
+            limit = self._batch_max if self._batching else 1
+            with self._sbuf_lock:
+                if not self._sbuf:
+                    return
+                if len(self._sbuf) > limit:
+                    msgs = self._sbuf[:limit]
+                    del self._sbuf[:limit]
+                else:
+                    msgs, self._sbuf = self._sbuf, []
+            try:
+                self.conn.send(msgs[0] if len(msgs) == 1
+                               else {"t": "batch", "msgs": msgs})
+            except (OSError, EOFError, KeyboardInterrupt, SystemExit):
+                # transport failure (or an interrupt that may have landed
+                # mid-write): put the unsent messages back at the FRONT,
+                # in order — a reconnect replay (driver) or a later retry
+                # must see them; re-sending individually here could
+                # double-deliver bytes that already hit the wire
+                with self._sbuf_lock:
+                    self._sbuf[0:0] = msgs
+                raise
+            except BaseException as frame_err:
+                if getattr(self.conn, "closed", False):
+                    # e.g. ValueError from a connection torn down mid-send
+                    # (a restart racing close): a transport symptom, not a
+                    # bad payload — requeue for the ride/replay machinery
+                    with self._sbuf_lock:
+                        self._sbuf[0:0] = msgs
+                    raise
+                # deterministic failure (e.g. an unpicklable user payload
+                # in a device_* message): Connection.send pickles BEFORE
+                # writing, so nothing hit the wire — re-send individually
+                # to isolate the poison message instead of requeueing a
+                # frame that can never serialize (which would wedge every
+                # later done/ref/put behind it forever)
+                if len(msgs) == 1:
+                    self._poison_dropped(msgs[0], frame_err)
+                    raise frame_err
+                poison = None
+                for k, m in enumerate(msgs):
+                    try:
+                        self.conn.send(m)
+                    except (OSError, EOFError, KeyboardInterrupt,
+                            SystemExit):
+                        with self._sbuf_lock:
+                            self._sbuf[0:0] = msgs[k:]
+                        raise
+                    except BaseException as e:
+                        if getattr(self.conn, "closed", False):
+                            with self._sbuf_lock:
+                                self._sbuf[0:0] = msgs[k:]
+                            raise
+                        if poison is None:
+                            poison = e
+                        traceback.print_exc()
+                        self._poison_dropped(m, e)
+                if poison is not None:
+                    # raised to whichever thread is draining (the sender
+                    # itself when uncontended); a submit's refs are made
+                    # to error via _poison_dropped either way
+                    raise poison
+
+    def _poison_dropped(self, msg, err: BaseException) -> None:
+        """A message was dropped because it can never serialize. If it
+        was a submit, its return refs would otherwise hang every waiter
+        forever (the head never learns of the task — and under combining
+        the drop may surface in a DIFFERENT thread than the submitter):
+        seal the error into the return oids so ray.get raises it."""
+        try:
+            if not isinstance(msg, dict) or \
+                    msg.get("t") not in ("submit", "actor_call"):
+                return
+            spec = msg["spec"]
+            werr = exc.RayTaskError(
+                getattr(spec, "name", "task"),
+                err if isinstance(err, Exception) else RuntimeError(
+                    repr(err)))
+            for oid in getattr(spec, "return_ids", ()):
+                try:
+                    self.store.put(oid, werr, is_exception=True)
+                except Exception:
+                    pass
+        except Exception:
+            pass
 
     def _ship_func(self, fid: str, blob: bytes):
         if fid not in self._sent_fids:
-            self.send({"t": "func_def", "fid": fid, "blob": blob})
+            self.send_async({"t": "func_def", "fid": fid, "blob": blob})
             self._sent_fids.add(fid)
 
     def register_renv(self, h: str, blob: bytes):
         if h not in self._sent_renvs:
-            self.send({"t": "renv_def", "hash": h, "blob": blob})
+            self.send_async({"t": "renv_def", "hash": h, "blob": blob})
             self._sent_renvs.add(h)
 
     def register_function(self, fid: str, blob: bytes):
@@ -206,26 +369,124 @@ class WorkerRuntime:
             spilled = self.store.put_or_spill(oid, value, is_exception,
                                               self.spill)
         if inner_ids:
-            self.send({"t": "contained", "oid": oid.binary(),
-                       "inner": [i.binary() for i in inner_ids]})
+            self.send_async({"t": "contained", "oid": oid.binary(),
+                             "inner": [i.binary() for i in inner_ids]})
         if spilled:
-            self.send({"t": "put_spilled", "oid": oid.binary()})
+            self.send_async({"t": "put_spilled", "oid": oid.binary()})
         elif notify_put:
-            self.send({"t": "put", "oid": oid})
+            self.send_async({"t": "put", "oid": oid})
 
     def get(self, refs, timeout: float | None = None):
         single = isinstance(refs, ObjectRef)
         ref_list = [refs] if single else list(refs)
         deadline = None if timeout is None else time.monotonic() + timeout
         out = []
-        blocked = False
         try:
+            if len(ref_list) > 1:
+                # bulk fast path: one ensure for every missing ref up
+                # front + one event-driven multi-oid wait, instead of a
+                # per-ref ensure and a fresh poll slice per ref
+                self._wait_all_present([r.id() for r in ref_list], deadline)
             for r in ref_list:
                 out.append(self._get_one(r.id(), deadline,
                                          lambda: self._block(True)))
         finally:
             self._block(False)
         return out[0] if single else out
+
+    def _sealed_is_exception(self, oid) -> bool:
+        """Peek a sealed object's frame flags without deserializing."""
+        view = self.store.get_raw(oid, timeout_ms=0)
+        if view is None:
+            return False
+        try:
+            from .object_store import _FLAG_EXCEPTION
+            return bool(view[0] & _FLAG_EXCEPTION)
+        finally:
+            del view
+            self.store.release(oid)
+
+    def _spilled_is_exception(self, oid) -> bool:
+        """Peek a spilled frame's flags byte (same wire framing)."""
+        try:
+            from .object_store import _FLAG_EXCEPTION
+            with open(self.spill._path(oid), "rb") as f:
+                b = f.read(1)
+            return bool(b and b[0] & _FLAG_EXCEPTION)
+        except OSError:
+            return False
+
+    def _present_is_exception(self, oid, sealed: bool) -> bool:
+        return (self._sealed_is_exception(oid) if sealed
+                else self._spilled_is_exception(oid))
+
+    def _wait_all_present(self, oids, deadline):
+        """Wait until every oid that the ordered materialization loop will
+        actually reach is sealed in the store (or readable from spill),
+        servicing whichever seals first via os_wait_sealed — the
+        futex-on-seal notification path. Slices are only the re-check
+        cadence for spill/cross-node fetches and grow exponentially; a
+        seal wakes the waiter immediately regardless of slice length.
+        Sequential-get parity: _get_one raises a stored task error at the
+        FIRST errored index once everything before it resolved — so a
+        sealed exception at index j stops this wait from blocking on
+        anything at or past j (an error ahead of a never-completing ref
+        must surface now, not after the hang). Returns on deadline expiry
+        and leaves the per-ref timeout error (and value/error
+        materialization) to _get_one."""
+        flags = self.store.wait_sealed(oids, len(oids), 0)
+        missing = [(i, o) for i, (o, f) in enumerate(zip(oids, flags))
+                   if not f]
+        if self.spill is not None and missing:
+            missing = [(i, o) for i, o in missing
+                       if not self.spill.contains(o)]
+        if not missing:
+            return  # all present: no waiting, no exception peeking
+        # index of the first already-errored ref (sealed OR spilled
+        # exception): only the prefix before it has to resolve before
+        # _get_one can raise it in order. Peeked only now that we know
+        # we'd otherwise block, and only up to the last missing index
+        # (an error past every missing ref doesn't shrink the wait).
+        err_before = len(oids)
+        miss_idx = {i for i, _ in missing}
+        for i in range(missing[-1][0]):
+            if i in miss_idx:
+                continue
+            if self._present_is_exception(oids[i], sealed=flags[i]):
+                err_before = i
+                break
+        missing = [(i, o) for i, o in missing if i < err_before]
+        if not missing:
+            return
+        self._block(True)
+        self.send({"t": "ensure",
+                   "oids": [o.binary() for _, o in missing]})
+        slice_ms = 10
+        while True:
+            active = [(i, o) for i, o in missing if i < err_before]
+            if not active:
+                return
+            if deadline is not None:
+                remain = deadline - time.monotonic()
+                if remain <= 0:
+                    return
+                slice_ms = min(slice_ms, max(1, int(remain * 1000)))
+            got = self.store.wait_sealed([o for _, o in active],
+                                         len(active), slice_ms)
+            still = []
+            for (i, o), f in zip(active, got):
+                spilled = (not f and self.spill is not None
+                           and self.spill.contains(o))
+                if f or spilled:
+                    if self._present_is_exception(o, sealed=f):
+                        err_before = min(err_before, i)
+                    continue
+                # ANY worker may need a cross-node pull (throttled to one
+                # locate per object per second inside _try_fetch)
+                self._try_fetch(o)
+                still.append((i, o))
+            missing = still
+            slice_ms = min(slice_ms * 2, 200)
 
     _did_block = False
 
@@ -275,6 +536,17 @@ class WorkerRuntime:
         if now - self._last_fetch.get(oid, 0.0) < 1.0:
             return False
         self._last_fetch[oid] = now
+        if len(self._last_fetch) > 1024 and \
+                now - self._last_fetch_sweep > 10.0:
+            # bounded: entries for refs that never fetch successfully are
+            # only popped on success, so expire anything far outside the
+            # 1s throttle window or a long-lived driver leaks the dict.
+            # Time-gated so a bulk wait over >1024 hot refs (nothing
+            # expirable yet) doesn't rebuild the dict on every attempt.
+            self._last_fetch_sweep = now
+            cutoff = now - 10.0
+            self._last_fetch = {o: t for o, t in self._last_fetch.items()
+                                if t > cutoff}
         try:
             addrs = self._rpc("locate", oid.binary(), timeout=10.0)
         except Exception:
@@ -294,29 +566,50 @@ class WorkerRuntime:
         return False
 
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        # multi-oid wait primitive (os_wait_sealed): a seal wakes this
+        # waiter immediately; the growing slice is only the fallback
+        # cadence for spill re-checks and cross-node fetch retries —
+        # replaces the fixed 2ms sleep poll that burned CPU and added up
+        # to 2ms latency per completion
         ref_list = list(refs)
         deadline = None if timeout is None else time.monotonic() + timeout
-        ready, pending = [], list(ref_list)
+        self.flush()  # buffered submits must ship before we park
+        ready, pending = [], []
+        flags = self.store.wait_sealed([r.id() for r in ref_list],
+                                       len(ref_list), 0)
+        for r, f in zip(ref_list, flags):
+            present = f or (self.spill is not None
+                            and self.spill.contains(r.id()))
+            (ready if present else pending).append(r)
         notified = False
-        while True:
-            still = []
-            for r in pending:
-                present = self.store.contains(r.id()) or (
-                    self.spill is not None and self.spill.contains(r.id()))
-                (ready if present else still).append(r)
-            pending = still
-            if len(ready) >= num_returns or not pending:
-                break
-            if deadline is not None and time.monotonic() >= deadline:
-                break
+        slice_ms = 2
+        while len(ready) < num_returns and pending:
+            if deadline is not None:
+                remain = deadline - time.monotonic()
+                if remain <= 0:
+                    break
+                slice_ms = min(slice_ms, max(1, int(remain * 1000)))
             if not notified:
+                # ONE ensure covering every pending ref up front (the old
+                # loop also ensured once, but a later-starting wait on the
+                # same refs never refreshed it)
                 self.send({"t": "ensure",
                            "oids": [r.id().binary() for r in pending]})
                 notified = True
-            if fetch_local:
-                for r in pending:
+            flags = self.store.wait_sealed(
+                [r.id() for r in pending],
+                num_returns - len(ready), slice_ms)
+            still = []
+            for r, f in zip(pending, flags):
+                if f or (self.spill is not None
+                         and self.spill.contains(r.id())):
+                    ready.append(r)
+                    continue
+                if fetch_local:
                     self._try_fetch(r.id())
-            time.sleep(0.002)
+                still.append(r)
+            pending = still
+            slice_ms = min(slice_ms * 2, 200)  # exponential backoff
         # reference contract: at most num_returns refs in ready; extra
         # already-ready refs stay in the remaining list
         return ready[:num_returns], ready[num_returns:] + pending
@@ -331,7 +624,7 @@ class WorkerRuntime:
         with self._ref_lock:
             self._presumed.update(spec.return_ids)
         refs = [ObjectRef(o) for o in spec.return_ids]
-        self.send({"t": "submit", "spec": spec})
+        self.send_async({"t": "submit", "spec": spec})
         return refs
 
     def create_actor(self, spec: ActorSpec):
@@ -342,7 +635,7 @@ class WorkerRuntime:
         with self._ref_lock:
             self._presumed.update(spec.return_ids)  # see submit_task
         refs = [ObjectRef(o) for o in spec.return_ids]
-        self.send({"t": "actor_call", "spec": spec})
+        self.send_async({"t": "actor_call", "spec": spec})
         return refs
 
     def kill_actor(self, actor_id, no_restart=True):
@@ -573,8 +866,8 @@ class WorkerLoop:
         if getattr(self, "_dynamic_items", None):
             done_msg["dynamic_items"] = self._dynamic_items
             self._dynamic_items = None
-        self.rt.send(done_msg)
         mc = getattr(spec, "max_calls", 0)
+        retire = False
         if mc:
             # @remote(max_calls=N): retire this worker after N executions
             # of the function — the release valve for user code that
@@ -584,8 +877,16 @@ class WorkerLoop:
             # requeues via _on_worker_death.
             n = self._fn_calls[spec.func_id] = \
                 self._fn_calls.get(spec.func_id, 0) + 1
-            if n >= mc:
-                os._exit(0)
+            retire = n >= mc
+        if retire:
+            # synchronous: the done (and everything buffered before it)
+            # must be on the wire before os._exit
+            self.rt.send(done_msg)
+            os._exit(0)
+        # async: the result is already SEALED in the store (that futex
+        # wake is what unblocks a ray.get), so the done only feeds head
+        # bookkeeping — back-to-back completions coalesce into one frame
+        self.rt.send_async(done_msg)
 
     def _run_actor_create(self, spec: ActorSpec):
         # the actor lives in its creating job's namespace: __init__ AND
@@ -708,7 +1009,7 @@ class WorkerLoop:
                     "dur": time.time() - t0}
         if span_rec is not None:
             done_msg["span"] = span_rec
-        self.rt.send(done_msg)
+        self.rt.send_async(done_msg)
 
     def _cancel_current(self, task_id):
         """Best-effort cooperative cancel: raise TaskCancelledError inside the
@@ -827,6 +1128,10 @@ class WorkerLoop:
                         tmod.zero_proc_gauges()
                     from ..util.metrics import shutdown_flush
                     shutdown_flush()   # final counter deltas to the head
+                except Exception:
+                    pass
+                try:
+                    self.rt.flush()    # buffered dones/refs before _exit
                 except Exception:
                     pass
                 if _pre_exit_hook is not None:
