@@ -325,7 +325,7 @@ class AutoscalerV2:
                     try:
                         self.provider.terminate_node(inst.provider_id)
                     except Exception:
-                        continue
+                        continue  # provider hiccup; next reconcile retries
                     self.im.update(
                         inst.instance_id, ALLOCATION_FAILED,
                         reason="allocation timeout", provider_id=None,

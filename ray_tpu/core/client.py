@@ -204,7 +204,7 @@ class DriverRuntime(WorkerRuntime):
                     try:
                         conn.close()
                     except Exception:
-                        pass
+                        pass  # already closing a failed dial
                     conn = reply = None
                     continue
                 break
@@ -292,7 +292,7 @@ class DriverRuntime(WorkerRuntime):
             try:
                 super()._try_flush()
             except Exception:
-                pass
+                pass  # riders retry on their own; kick is best-effort
             # the restarted head's metric store is empty: re-mark gauge
             # series dirty (last-write-wins values only live on the head)
             # and re-ship everything on the spot
@@ -301,7 +301,7 @@ class DriverRuntime(WorkerRuntime):
                 _um.mark_gauges_dirty()
                 _um.flush()
             except Exception:
-                pass
+                pass  # next 2s flush tick re-ships
             return True
         return False
 
@@ -367,20 +367,20 @@ class DriverRuntime(WorkerRuntime):
             from ..util.metrics import shutdown_flush
             shutdown_flush()  # last counter deltas before the conn dies
         except Exception:
-            pass
+            pass  # deltas died with the head's store
         try:
             self.flush()  # buffered submits/drops, best effort
         except Exception:
-            pass
+            pass  # head may already be gone
         self.disconnected.set()
         try:
             self.conn.close()
         except Exception:
-            pass
+            pass  # conn already dead/closed
         try:
             self.store.close(unlink=False)
         except Exception:
-            pass
+            pass  # unmap is best-effort at exit
         if rt_mod.get_runtime_if_exists() is self:
             rt_mod.set_runtime(None)
 

@@ -81,7 +81,7 @@ class MemoryMonitor:
             try:
                 self.tick()
             except Exception:
-                pass
+                pass  # monitor outlives a bad poll (/proc races)
 
     def tick(self) -> bool:
         """One check; returns True if a worker was killed."""

@@ -3,6 +3,16 @@
 The reference ships prebuilt bazel binaries (src/ray/object_manager/plasma);
 here we compile on first import and cache next to the source. g++ is in the
 image; the build takes <2s.
+
+Sanitizer mode (the reference runs its C++ store tests under ASan/TSan in
+CI): set ``RTPU_OBJSTORE_SANITIZE=address,undefined`` (any comma-joined
+``-fsanitize=`` list) and every process that builds/loads the store in that
+environment gets a ``libobjstore.<mode>.so`` debug build (-O1 -g, frame
+pointers) instead of the production one. The sanitized variant caches
+under its own name + source-hash file, so flipping the env never clobbers
+the production binary. Loading an ASan build into a non-instrumented
+python requires LD_PRELOADing libasan/libubsan — tests/test_sanitizers.py
+shows the full recipe.
 """
 from __future__ import annotations
 
@@ -13,9 +23,20 @@ import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "objstore.cc")
-_LIB = os.path.join(_DIR, "libobjstore.so")
-_HASH = _LIB + ".srchash"
 _lock = threading.Lock()
+
+
+def _san_mode() -> str:
+    """Normalized sanitizer list from the env ('' = production build)."""
+    mode = os.environ.get("RTPU_OBJSTORE_SANITIZE", "").strip()
+    return ",".join(s.strip() for s in mode.split(",") if s.strip())
+
+
+def _lib_path(mode: str) -> str:
+    if not mode:
+        return os.path.join(_DIR, "libobjstore.so")
+    tag = mode.replace(",", "-")
+    return os.path.join(_DIR, f"libobjstore.{tag}.so")
 
 
 def _src_hash() -> str:
@@ -23,43 +44,51 @@ def _src_hash() -> str:
         return hashlib.sha256(f.read()).hexdigest()
 
 
-def _compile_and_swap() -> None:
+def _compile_and_swap(mode: str) -> None:
     """Compile to a tmp path and atomically replace the .so + hash.
     Caller holds _lock. Raises CalledProcessError on compile errors and
     OSError when the compiler is missing / checkout is read-only."""
-    tmp = _LIB + ".tmp"
+    lib = _lib_path(mode)
+    tmp = lib + ".tmp"
+    if mode:
+        # debug-grade opt level + frame pointers: sanitizer reports with
+        # usable stacks beat a fast binary nobody profiles
+        flags = [f"-fsanitize={mode}", "-O1", "-g",
+                 "-fno-omit-frame-pointer"]
+    else:
+        flags = ["-O2", "-g"]
     subprocess.run(
-        [
-            "g++", "-O2", "-g", "-shared", "-fPIC", "-std=c++17",
-            "-o", tmp, _SRC, "-lpthread",
-        ],
+        ["g++", *flags, "-shared", "-fPIC", "-std=c++17",
+         "-o", tmp, _SRC, "-lpthread"],
         check=True,
         capture_output=True,
     )
-    os.replace(tmp, _LIB)
-    with open(_HASH, "w") as f:
+    os.replace(tmp, lib)
+    with open(lib + ".srchash", "w") as f:
         f.write(_src_hash())
 
 
 def ensure_built() -> str:
-    """Compile objstore.cc -> libobjstore.so if missing or stale.
+    """Compile objstore.cc -> libobjstore[.<san>].so if missing or stale.
 
     Staleness is a CONTENT hash of the source, not mtimes: a fresh git
     checkout gives every file the same mtime, which let a committed .so
     shadow newer committed source (missing-symbol crashes at import).
     """
+    mode = _san_mode()
+    lib = _lib_path(mode)
     with _lock:
         want = _src_hash()
         have = None
-        if os.path.exists(_LIB) and os.path.exists(_HASH):
+        if os.path.exists(lib) and os.path.exists(lib + ".srchash"):
             try:
-                with open(_HASH) as f:
+                with open(lib + ".srchash") as f:
                     have = f.read().strip()
             except OSError:
                 pass
         if have != want:
             try:
-                _compile_and_swap()
+                _compile_and_swap(mode)
             except subprocess.CalledProcessError as e:
                 # a real compile error must surface (silently loading the
                 # stale .so is the failure mode this hash scheme prevents)
@@ -68,10 +97,12 @@ def ensure_built() -> str:
                     + e.stderr.decode(errors="replace")) from e
             except OSError:
                 # no compiler / read-only checkout: a shipped .so is still
-                # usable (it may just predate the latest source)
-                if not os.path.exists(_LIB):
+                # usable (it may just predate the latest source). Only the
+                # production variant ships — a sanitizer build with no
+                # compiler has nothing to fall back to.
+                if mode or not os.path.exists(lib):
                     raise
-    return _LIB
+    return lib
 
 
 def rebuild() -> str:
@@ -82,12 +113,13 @@ def rebuild() -> str:
     compiler-less host, or a checkout shared over NFS with hosts where
     the shipped binary loads fine, must never lose it to a failed
     attempt."""
+    mode = _san_mode()
     with _lock:
         try:
-            _compile_and_swap()
+            _compile_and_swap(mode)
         except (subprocess.CalledProcessError, OSError) as e:
             stderr = getattr(e, "stderr", None) or b""
             raise RuntimeError(
                 "libobjstore.so failed to load and recompiling for this "
                 "host failed:\n" + stderr.decode(errors="replace")) from e
-    return _LIB
+    return _lib_path(mode)

@@ -132,7 +132,7 @@ class NodeAgent:
             try:
                 p.kill()
             except Exception:
-                pass
+                pass  # already exited
         self.procs.clear()
         deadline = time.monotonic() + self.reconnect_timeout_s
         delay = 0.25
@@ -188,7 +188,7 @@ class NodeAgent:
         try:
             self.send({"t": "worker_exit", "wid": wid, "rc": rc})
         except Exception:
-            pass
+            pass  # head gone; its EOF cleanup covers this
 
     def _heartbeat_loop(self):
         from .config import cfg
@@ -231,7 +231,7 @@ class NodeAgent:
                             try:
                                 self.local_store.delete(ObjectID(ob))
                             except Exception:
-                                pass
+                                pass  # already evicted/deleted
                             self.local_spill.delete(ObjectID(ob))
                 elif t == "kill_worker":
                     p = self.procs.get(msg["wid"])
@@ -239,7 +239,7 @@ class NodeAgent:
                         try:
                             p.kill()
                         except Exception:
-                            pass
+                            pass  # already exited
                 elif t == "shutdown":
                     break
         except (EOFError, OSError):
@@ -263,27 +263,34 @@ class NodeAgent:
         # flag AFTER masking SIGTERM: a signal landing between the two
         # would abort this run while the atexit retry no-ops on the flag
         self._torn_down = True
+        try:
+            # announce the exit so the head removes this node NOW instead
+            # of on conn EOF / heartbeat timeout (runtime._agent_loop's
+            # "deregister" branch); moot when the head initiated it
+            self.send({"t": "deregister"})
+        except Exception:
+            pass  # head already gone; EOF-side cleanup covers it
         for p in list(self.procs.values()):
             try:
                 p.kill()
             except Exception:
-                pass
+                pass  # already exited
         deadline = time.monotonic() + 2.0
         for p in list(self.procs.values()):
             try:
                 p.wait(timeout=max(0.01, deadline - time.monotonic()))
             except Exception:
-                pass
+                pass  # unkillable child; we exit anyway
         if self.data_server is not None:
             try:
                 self.data_server.stop()
             except Exception:
-                pass
+                pass  # server thread died with its socket
         if self.local_store is not None:
             try:
                 self.local_store.close(unlink=True)
             except Exception:
-                pass
+                pass  # shm file may already be unlinked
 
 
 def main(argv=None):

@@ -283,7 +283,7 @@ def _refresh_store_pids_after_fork() -> None:
             try:
                 s._lib.os_store_refresh_pid(h)
             except Exception:
-                pass
+                pass  # store handle mid-close in the parent
 
 
 if hasattr(os, "register_at_fork"):

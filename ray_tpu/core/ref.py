@@ -96,7 +96,7 @@ class ObjectRef:
                 rt.ref_created(oid, _transfer)
                 self._tracked = True
             except Exception:
-                pass
+                pass  # runtime torn down mid-construct; ref untracked
 
     def __del__(self):
         if getattr(self, "_tracked", False):
@@ -135,7 +135,7 @@ class ObjectRef:
                 try:
                     rt.ref_serialized(self._id)
                 except Exception:
-                    pass
+                    pass  # runtime gone: pickling for a dead cluster
         return (_deserialize_ref, (self._id.binary(),))
 
     # Allow `await ref` inside async actors.

@@ -380,32 +380,34 @@ class Runtime:
         self.lock = threading.RLock()
         self.cv = threading.Condition(self.lock)
 
-        self.directory: dict[ObjectID, DirEntry] = {}
+        # graftlint GL001 enforces the annotations: every touch of these
+        # outside `with self.lock` (or a *_locked method) is a finding
+        self.directory: dict[ObjectID, DirEntry] = {}  # guarded by: self.lock
         # distributed refcounting (reference_count.h:73 analog):
         # which processes hold >=1 live ObjectRef, serialized-copy pins
         # (may go negative when a receiver's add outruns the sender's pin —
         # per-connection FIFO makes that transient), driver-local counts,
         # and driver-side store pins from ray.put
-        self.interest: dict[ObjectID, set[str]] = {}
-        self.xfer_pins: dict[ObjectID, int] = {}
+        self.interest: dict[ObjectID, set[str]] = {}  # guarded by: self.lock
+        self.xfer_pins: dict[ObjectID, int] = {}  # guarded by: self.lock
         # standing programmatic demand floor (autoscaler/sdk.py
         # request_resources); the autoscaler plans these every tick
         self.resource_requests: list[dict] = []
-        self._local_refs: dict[ObjectID, int] = {}
-        self._pinned: set[ObjectID] = set()
+        self._local_refs: dict[ObjectID, int] = {}  # guarded by: self.lock
+        self._pinned: set[ObjectID] = set()  # guarded by: self.lock
         # containment edges: outer stored object -> refs pickled inside it
         # (the outer holds interest in its inners until the outer is freed)
-        self.contained: dict[ObjectID, list[ObjectID]] = {}
+        self.contained: dict[ObjectID, list[ObjectID]] = {}  # guarded by: self.lock
         self.func_registry: dict[str, bytes] = {}
         # runtime-env blobs (working_dir / py_modules zips), hash-addressed
         # (reference analog: the GCS KV store runtime-env uploads)
         self.renv_registry: dict[str, bytes] = {}
         self.nodes: dict[NodeID, NodeInfo] = {}
-        self.workers: dict[str, WorkerInfo] = {}
+        self.workers: dict[str, WorkerInfo] = {}  # guarded by: self.lock
         self.actors: dict[ActorID, ActorInfo] = {}
         self.named_actors: dict[str, ActorID] = {}
         self.pgs: dict[PlacementGroupID, PlacementGroupState] = {}
-        self.pending = _PendingQueues()
+        self.pending = _PendingQueues()  # guarded by: self.lock
         self._sweeping_failed_deps = False
         self._abandoned_rpcs: set[ObjectID] = set()
         # timeline events, bounded so a long-lived driver doesn't grow
@@ -624,18 +626,20 @@ class Runtime:
                 # survives a concurrent close), so run the removal here —
                 # the loop's eventual EOF cleanup double-calls remove_node,
                 # which no-ops on a dead node
-                for wid in list(n.workers):
-                    w = self.workers.get(wid)
-                    if w is not None and isinstance(w.proc, _RemoteProc):
-                        w.proc.mark_exited(-1)
+                with self.lock:
+                    for wid in list(n.workers):
+                        w = self.workers.get(wid)
+                        if w is not None and isinstance(w.proc,
+                                                        _RemoteProc):
+                            w.proc.mark_exited(-1)
                 try:
                     self.remove_node(n.node_id)
                 except Exception:
-                    pass
+                    pass  # agent-loop EOF path already removed it
                 try:
                     n.agent.conn.close()
                 except Exception:
-                    pass
+                    pass  # already closed
 
     def _pipeline_rebalance_loop(self):
         """Periodic work-stealing fallback (own timer — NOT coupled to the
@@ -717,7 +721,7 @@ class Runtime:
                     try:
                         conn.send({"t": "rejected", "error": str(e)})
                     except Exception:
-                        pass
+                        pass  # peer hung up before reading the refusal
                     conn.close()
                     return
             if msg.get("t") == "register_node":
@@ -1029,9 +1033,11 @@ class Runtime:
                         if w is not None and isinstance(w.proc, _RemoteProc):
                             w.proc.pid = m["pid"]
                 elif t == "worker_exit":
-                    w = self.workers.get(m["wid"])
-                    if w is not None and isinstance(w.proc, _RemoteProc):
-                        w.proc.mark_exited(m.get("rc"))
+                    with self.lock:
+                        w = self.workers.get(m["wid"])
+                        if w is not None and isinstance(w.proc,
+                                                        _RemoteProc):
+                            w.proc.mark_exited(m.get("rc"))
                     self._on_worker_death(m["wid"])
                 elif t == "deregister":
                     break
@@ -1041,20 +1047,19 @@ class Runtime:
             try:
                 conn.close()
             except Exception:
-                pass
+                pass  # already closed
             # complete every orphaned remote proc first so remove_node's
             # per-worker proc.wait() returns immediately instead of timing
             # out sequentially
             with self.lock:
-                wids = list(node.workers)
-            for wid in wids:
-                w = self.workers.get(wid)
-                if w is not None and isinstance(w.proc, _RemoteProc):
-                    w.proc.mark_exited(-1)
+                for wid in list(node.workers):
+                    w = self.workers.get(wid)
+                    if w is not None and isinstance(w.proc, _RemoteProc):
+                        w.proc.mark_exited(-1)
             try:
                 self.remove_node(node.node_id)
             except Exception:
-                pass
+                pass  # double remove_node is a benign no-op
 
     # Worker→head request/reply: the reply value is written into the shared
     # store at a worker-chosen oid (reference analog: the CoreWorkerService /
@@ -1166,7 +1171,7 @@ class Runtime:
         try:
             self.store.put(ObjectID(reply_oid), payload)
         except Exception:
-            pass
+            pass  # store full/closing: requester times out
 
     def device_fetch(self, owner: str, key: str, reply_oid: bytes,
                      requester: str = "driver") -> None:
@@ -1223,11 +1228,12 @@ class Runtime:
         replies ride the control connection instead."""
         if wid is None:
             return False
-        w = self.workers.get(wid)
-        if w is None:
-            return False
-        n = self.nodes.get(w.node_id)
-        return n is not None and n.own_store
+        with self.lock:
+            w = self.workers.get(wid)
+            if w is None:
+                return False
+            n = self.nodes.get(w.node_id)
+            return n is not None and n.own_store
 
     def _handle_worker_rpc(self, msg: dict, wid: str | None = None):
         oid = ObjectID(msg["reply_oid"])
@@ -1235,8 +1241,11 @@ class Runtime:
 
         def reply(payload):
             if via_conn:
-                w = self.workers.get(wid)
+                with self.lock:
+                    w = self.workers.get(wid)
                 if w is not None:
+                    # outside the lock: w.send pickles + writes the pipe
+                    # under its own per-worker send_lock
                     w.send({"t": "rpc_reply", "reply_oid": oid.binary(),
                             "payload": payload})
             else:
@@ -1347,7 +1356,7 @@ class Runtime:
         try:
             w.proc.wait()
         except Exception:
-            pass
+            pass  # reaped elsewhere; death path runs below
         self._on_worker_death(w.wid)
 
     def _returns_complete_locked(self, spec) -> bool:
@@ -1381,7 +1390,7 @@ class Runtime:
             try:
                 self.store.reclaim_pid(w.proc.pid)
             except Exception:
-                pass
+                pass  # store closing; pins die with it
             # zero the dead process's per-proc gauge series (host:pid
             # label, llm/telemetry.py): gauges are last-write-wins with
             # no owner left to update them, so a killed replica's last
@@ -1399,7 +1408,7 @@ class Runtime:
                                        for k, v in key):
                             rec["series"][key] = 0.0
             except Exception:
-                pass
+                pass  # gauge cleanup must never block reaping
             # and its refcount interest (it will never send ref_drop)
             for oid in [o for o, s in self.interest.items() if wid in s]:
                 self._ref_drop_locked(oid, wid)
@@ -1440,7 +1449,7 @@ class Runtime:
         try:
             w.proc.wait(timeout=1)
         except Exception:
-            pass
+            pass  # slow exit; the OS reaps the zombie
 
     def _release_to_node(self, w: WorkerInfo):
         node = self.nodes.get(w.node_id)
@@ -1594,11 +1603,11 @@ class Runtime:
             try:
                 self.store.release(oid)
             except Exception:
-                pass
+                pass  # store closing; the pin dies with it
         try:
             self.store.delete(oid)
         except Exception:
-            pass
+            pass  # already evicted
         self.spill.delete(oid)
         self.xfer_pins.pop(oid, None)
         # the freed outer no longer keeps its inners alive
@@ -1611,7 +1620,7 @@ class Runtime:
             self.store.delete(oid)
             self.store.put(oid, err, is_exception=True)
         except Exception:
-            pass
+            pass  # store full/closing; directory marks FAILED
 
     def _ensure_available_locked(self, oid: ObjectID):
         """If `oid` was evicted, restore it from spill or resubmit its
@@ -2600,7 +2609,7 @@ class Runtime:
             try:
                 w.proc.kill()
             except Exception:
-                pass
+                pass  # already dead
         # death is observed by the recv loop EOF → _on_worker_death
 
     def get_actor_by_name(self, name: str):
@@ -2824,12 +2833,13 @@ class Runtime:
         self.pubsub.publish("nodes", {"node_id": node_id.hex(),
                                       "event": "removed", "name": node.name})
         for wid in wids:
-            w = self.workers.get(wid)
+            with self.lock:
+                w = self.workers.get(wid)
             if w is not None:
                 try:
                     w.proc.kill()
                 except Exception:
-                    pass
+                    pass  # already dead
                 self._on_worker_death(wid)
 
     # ------------------------------------------------------------------ #
@@ -2999,7 +3009,7 @@ class Runtime:
                         try:
                             w.proc.kill()
                         except Exception:
-                            pass
+                            pass  # already dead
                     else:
                         w.send({"t": "cancel", "task_id": spec.task_id})
                     return
@@ -3083,7 +3093,7 @@ class Runtime:
             try:
                 self._log_tail_scan()
             except Exception:
-                pass
+                pass  # final log echo is best-effort
         # final metric flush BEFORE the snapshot: counter deltas recorded
         # since the last 2s tick merge into user_metrics and persist
         from ..util.metrics import shutdown_flush
@@ -3098,7 +3108,7 @@ class Runtime:
             from .gcs_store import snapshot
             snapshot(self)
         except Exception:
-            pass
+            pass  # failed snapshot must not block teardown
         self.jobs.shutdown()
         for w in workers:
             w.send({"t": "exit"})
@@ -3121,12 +3131,12 @@ class Runtime:
                 try:
                     w.proc.kill()
                 except Exception:
-                    pass
+                    pass  # already dead
         for lst in (self.listener, self.tcp_listener):
             try:
                 lst.close()
             except Exception:
-                pass
+                pass  # already closed
         # sever control-plane connections so recv threads exit before the
         # store mapping goes away (they may touch the store while handling
         # late messages)
@@ -3135,16 +3145,16 @@ class Runtime:
                 if w.conn is not None:
                     w.conn.close()
             except Exception:
-                pass
+                pass  # already closed
         try:
             from .usage import write_usage_file
             write_usage_file(self.session_dir)
         except Exception:
-            pass
+            pass  # usage file is best-effort
         try:
             self.kv.close()
         except Exception:
-            pass
+            pass  # sqlite already closed
         self.store.close(unlink=True)
         try:
             os.unlink(self.cluster_file)  # address='auto' must not find us

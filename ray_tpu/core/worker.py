@@ -90,7 +90,7 @@ class WorkerRuntime:
         # drop) hold with batching on or off.
         self._batching = cfg.control_batching
         self._batch_max = max(1, cfg.send_batch_max)
-        self._sbuf: list = []
+        self._sbuf: list = []  # guarded by: self._sbuf_lock
         self._sbuf_lock = threading.Lock()
         self.func_registry: dict[str, object] = {}
         self._sent_fids: set[str] = set()
@@ -110,12 +110,12 @@ class WorkerRuntime:
         self.current_task_name = ""
         # process-local ObjectRef counts; 0<->1 transitions notify the head
         # (reference_count.h:73 borrower protocol, simplified)
-        self._ref_counts: dict = {}
+        self._ref_counts: dict = {}  # guarded by: self._ref_lock
         self._ref_lock = threading.Lock()
         # return-ids of a task being submitted: their first ObjectRef needs
         # no ref_add send — the v2 submit/actor_call message itself carries
         # the submitter's interest (runtime._handle_msg "submit")
-        self._presumed: set = set()
+        self._presumed: set = set()  # guarded by: self._ref_lock
         # __del__ may fire from a GC pass triggered INSIDE send() or
         # ref_created() on the same thread; doing IPC or taking these locks
         # there would self-deadlock. Drops only enqueue (SimpleQueue.put is
@@ -226,7 +226,9 @@ class WorkerRuntime:
             if not self.send_lock.acquire(blocking=False):
                 return  # current holder's post-release re-check covers us
             try:
-                self._drain_locked()
+                # send_lock IS held here — via the try-acquire above,
+                # which the with-block heuristic can't see
+                self._drain_locked()  # graftlint: disable=GL001
             finally:
                 self.send_lock.release()
             with self._sbuf_lock:
@@ -329,9 +331,9 @@ class WorkerRuntime:
                 try:
                     self.store.put(oid, werr, is_exception=True)
                 except Exception:
-                    pass
+                    pass  # store full/closing; waiters time out
         except Exception:
-            pass
+            pass  # must never mask the original send error
 
     def _ship_func(self, fid: str, blob: bytes):
         if fid not in self._sent_fids:
@@ -716,6 +718,29 @@ class WorkerRuntime:
         pass
 
 
+def _dial_head(addr: str, authkey: bytes, timeout_s: float = 15.0):
+    """Connect to the head's control listener, retrying transient connect
+    failures. Under load (single-CPU CI, a burst of worker spawns) the
+    AF_UNIX connect can hit the listener's backlog and fail with EAGAIN
+    (BlockingIOError) — the head's accept loop just hasn't been scheduled
+    yet. Giving up on the first try killed the worker at birth, failing
+    its dispatched task with WorkerCrashedError."""
+    deadline = time.monotonic() + timeout_s
+    delay = 0.05
+    while True:
+        try:
+            if os.environ.get("RTPU_HEAD_FAMILY") == "AF_INET":
+                host, port = addr.rsplit(":", 1)
+                return Client((host, int(port)), authkey=authkey)
+            return Client(addr, "AF_UNIX", authkey=authkey)
+        except (BlockingIOError, InterruptedError, ConnectionRefusedError,
+                ConnectionResetError):
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(delay)
+            delay = min(delay * 2, 0.5)
+
+
 class WorkerLoop:
     def __init__(self):
         store_path = os.environ["RTPU_STORE_PATH"]
@@ -725,11 +750,7 @@ class WorkerLoop:
         self.store = SharedObjectStore(store_path)
         spill_dir = os.environ.get("RTPU_SPILL_DIR")
         spill = SpillStore(spill_dir) if spill_dir else None
-        if os.environ.get("RTPU_HEAD_FAMILY") == "AF_INET":
-            host, port = addr.rsplit(":", 1)
-            self.conn = Client((host, int(port)), authkey=authkey)
-        else:
-            self.conn = Client(addr, "AF_UNIX", authkey=authkey)
+        self.conn = _dial_head(addr, authkey)
         self.rt = WorkerRuntime(self.store, self.conn, self.wid, spill)
         rt_mod.set_runtime(self.rt)
         self.actor_instance = None
@@ -853,7 +874,7 @@ class WorkerLoop:
                         self.store.delete(oid)
                         self._store_value(oid, werr, is_exception=True)
                     except Exception:
-                        pass
+                        pass  # store full/closing; done msg carries err
         finally:
             self._current_task_id = None
             _ACTIVE_NS.reset(ns_tok)
@@ -1003,7 +1024,7 @@ class WorkerLoop:
                     self.store.delete(oid)
                     self.store.put(oid, werr, is_exception=True)
                 except Exception:
-                    pass
+                    pass  # store full/closing; done msg carries err
         done_msg = {"t": "done", "task_id": spec.task_id, "ok": ok,
                     "err": err, "retryable": False, "name": spec.name,
                     "dur": time.time() - t0}
@@ -1129,11 +1150,11 @@ class WorkerLoop:
                     from ..util.metrics import shutdown_flush
                     shutdown_flush()   # final counter deltas to the head
                 except Exception:
-                    pass
+                    pass  # final flush is best-effort on exit
                 try:
                     self.rt.flush()    # buffered dones/refs before _exit
                 except Exception:
-                    pass
+                    pass  # conn may be gone; exiting anyway
                 if _pre_exit_hook is not None:
                     _pre_exit_hook()   # profiler dump (main() sets it)
                 os._exit(0)

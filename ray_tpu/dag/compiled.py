@@ -17,6 +17,7 @@ from __future__ import annotations
 import hashlib
 import os
 import time
+from collections import deque
 from typing import Any, Optional
 
 from ..core.ids import ObjectID
@@ -127,7 +128,7 @@ class CompiledDAG:
         self.max_inflight = max_inflight
         self.dag_id = os.urandom(8)
         self._seq = 0
-        self._outstanding: list[CompiledDAGRef] = []
+        self._outstanding: deque[CompiledDAGRef] = deque()
         stop_digest = hashlib.sha1(self.dag_id + b"stop").digest()
         self.stop_oid = ObjectID(stop_digest[:ObjectID.SIZE])
         self._torn_down = False
@@ -263,7 +264,7 @@ class CompiledDAG:
             raise RuntimeError("DAG is torn down")
         if len(self._outstanding) >= self.max_inflight:
             # ring full: auto-drain the oldest so slots recycle
-            self._outstanding.pop(0).get()
+            self._outstanding.popleft().get()
         slot = self._seq % self.max_inflight
         self._seq += 1
         from ..core.object_store import _FramedValue
@@ -301,8 +302,8 @@ class CompiledDAG:
         try:
             ray_tpu.get(self._loop_refs, timeout=timeout_s)
         except Exception:
-            pass
+            pass  # loops may have errored; teardown continues
         try:
             self.store.delete(self.stop_oid)
         except Exception:
-            pass
+            pass  # store closing; the oid dies with it

@@ -397,14 +397,14 @@ class Executor:
                     try:
                         ray.kill(pool[j])
                     except Exception:
-                        pass
+                        pass  # actor already dead
                 yield pair
         finally:
             for j in active():
                 try:
                     ray.kill(pool[j])
                 except Exception:
-                    pass
+                    pass  # actor already dead
 
     def _stream(self, thunks, window=_DEFAULT):
         """Bounded-window submission loop (the scheduling loop of the

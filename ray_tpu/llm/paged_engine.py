@@ -654,10 +654,18 @@ class PagedInferenceEngine(_EngineBase):
             self._prefilling.remove(req)
             if getattr(req, "prefill_only", False):
                 # disaggregated prefill: export the KV pages + first token
-                # instead of decoding here (llm/pd_disagg.py)
-                req.export_payload = self._export_kv_locked(req, tok)
+                # instead of decoding here (llm/pd_disagg.py). Under the
+                # pool lock: _release mutates _free_slots/_page_refs,
+                # which a concurrent submit/import_prefill (replica
+                # threads) also touches — and the export must not observe
+                # a cache swap mid-gather. _finish_request stays OUTSIDE
+                # it: the span emit can write a pipe, and blocking I/O
+                # under the admission lock stalls every replica thread
+                # (the GL002 bug class).
+                with self._lock:
+                    req.export_payload = self._export_kv_locked(req, tok)
+                    self._release(req)
                 self._finish_request(req, "export")
-                self._release(req)
                 continue
             self._active[req.slot] = req
             self._maybe_finish(req, tok)

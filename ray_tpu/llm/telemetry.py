@@ -95,7 +95,7 @@ def zero_proc_gauges() -> None:
         from ..util import metrics as um
         um.zero_gauges(("proc", _proc()))
     except Exception:
-        pass
+        pass  # lost telemetry on exit is acceptable
 
 
 def _never_raise(fn):
@@ -108,7 +108,7 @@ def _never_raise(fn):
         try:
             return fn(*args, **kw)
         except Exception:
-            pass
+            pass  # contract: degrade to lost telemetry
     return wrapped
 
 
@@ -131,7 +131,7 @@ def on_submit(engine, req) -> None:
         from ..serve.context import get_request_context
         req.request_id = get_request_context().request_id
     except Exception:
-        pass
+        pass  # tracing/request context are optional
 
 
 @_never_raise
@@ -290,4 +290,4 @@ def _emit_request_span(req) -> None:
             rec["request_id"] = req.request_id
         tracing.record_span(rec)
     except Exception:
-        pass
+        pass  # span loss must never break retire

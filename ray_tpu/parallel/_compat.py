@@ -1,0 +1,46 @@
+"""jax API version compatibility for the parallel layer.
+
+`shard_map` moved twice upstream: jax < 0.6 ships it as
+``jax.experimental.shard_map.shard_map`` with a ``check_rep`` flag; newer
+releases export ``jax.shard_map`` with ``check_rep`` renamed to
+``check_vma``. The container pins jax 0.4.x while the code targets the
+current API, which broke every shard_map-based test with
+``AttributeError: module 'jax' has no attribute 'shard_map'``. This shim
+presents ONE surface (the current one: keyword mesh/in_specs/out_specs +
+``check_vma``) over whichever implementation is importable.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: Any = None):
+    """Current-API shard_map over whichever jax provides.
+
+    ``check_vma`` maps onto the old API's ``check_rep`` (same meaning:
+    verify replication invariants of the out_specs); None means the
+    implementation default.
+    """
+    import jax
+    impl = getattr(jax, "shard_map", None)
+    if impl is not None:  # jax >= 0.6: the current API, pass through
+        kwargs = {} if check_vma is None else {"check_vma": check_vma}
+        return impl(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                    **kwargs)
+    from jax.experimental.shard_map import shard_map as legacy
+    kwargs = {} if check_vma is None else {"check_rep": check_vma}
+    return legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  **kwargs)
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a named mesh axis, from inside shard_map.
+
+    ``jax.lax.axis_size`` only exists on newer jax; on older releases
+    ``psum(1, axis)`` of the Python constant is constant-folded to the
+    axis size as a plain int (the long-standing pmap idiom)."""
+    import jax
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return jax.lax.psum(1, axis_name)

@@ -291,7 +291,7 @@ def destroy_collective_group(group_name: str = "default") -> None:
     try:
         ray.kill(ray.get_actor(_COORD_PREFIX + group_name))
     except Exception:
-        pass
+        pass  # already dead / never created
 
 
 def is_group_initialized(group_name: str = "default") -> bool:

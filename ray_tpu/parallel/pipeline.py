@@ -57,7 +57,7 @@ def pipeline_apply(stage_fn: Callable, stage_params, x: jax.Array,
     The per-shard batch must divide into num_microbatches equal
     microbatches; interleaving additionally needs num_microbatches % S == 0.
     """
-    from jax import shard_map  # current API (check_vma, not check_rep)
+    from ._compat import shard_map  # current API on old/new jax alike
 
     S = mesh.shape.get("pp", 1)
     V = num_chunks

@@ -145,7 +145,7 @@ class AlgorithmBase:
             try:
                 self._ray.kill(r)
             except Exception:
-                pass
+                pass  # runner already dead
 
     # -- bookkeeping shared by training_steps ------------------------------ #
 

@@ -66,7 +66,9 @@ class ConnectorPipeline(Connector):
         return self
 
     def prepend(self, piece: Connector) -> "ConnectorPipeline":
-        self.pieces.insert(0, piece)
+        # pipeline construction, not a hot queue: runs once at setup on
+        # a handful of pieces
+        self.pieces.insert(0, piece)  # graftlint: disable=GL004
         return self
 
     def __call__(self, obs: np.ndarray, update: bool = True) -> np.ndarray:

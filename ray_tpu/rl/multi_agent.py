@@ -315,4 +315,4 @@ class MultiAgentPPO:
             try:
                 self._ray.kill(r)
             except Exception:
-                pass
+                pass  # runner already dead

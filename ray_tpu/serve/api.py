@@ -225,15 +225,15 @@ def status() -> dict:
 def delete(name: str = "default") -> None:
     import ray_tpu
 
-    from .local_mode import delete_local_app, get_local_app
-    # drop any local-mode app of this name; fall through to the cluster
-    # only if one is ALREADY running (a purely-local session must not
-    # boot a whole cluster just to tear down an in-process app)
-    had_local = get_local_app(name) is not None
+    from .local_mode import delete_local_app
     delete_local_app(name)
-    if had_local and not ray_tpu.is_initialized():
+    if not ray_tpu.is_initialized():
+        # nothing cluster-side to delete — and NEVER boot a whole cluster
+        # just to tear down an app (a test-teardown delete() after
+        # ray.shutdown() used to do exactly that, leaking a live Runtime
+        # + prestarted worker pool into the rest of the process)
         return
-    ray = _ray()
+    ray = ray_tpu
     try:
         ctrl = _controller(create=False)
     except ValueError:
@@ -254,7 +254,7 @@ def shutdown() -> None:
         try:
             ray.get(gp.stop.remote())
         except Exception:
-            pass
+            pass  # proxy dying; kill below finishes it
         ray.kill(gp)
     except ValueError:
         pass
@@ -265,8 +265,8 @@ def shutdown() -> None:
     try:
         ray.get(ctrl.shutdown.remote())
     except Exception:
-        pass
+        pass  # controller dying; kill below finishes it
     try:
         ray.kill(ctrl)
     except Exception:
-        pass
+        pass  # already dead

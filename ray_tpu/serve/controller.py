@@ -92,7 +92,7 @@ class ReplicaActor:
             sm.replica_requests().inc(
                 1.0, tags={**tags, "outcome": outcome})
         except Exception:
-            pass
+            pass  # telemetry must never fail a request
 
     async def handle_request(self, method: str, args: tuple, kwargs: dict,
                              context: Optional[dict] = None):
@@ -348,7 +348,7 @@ class ServeController:
             try:
                 ray_tpu.kill(victim)
             except Exception:
-                pass
+                pass  # already dead
 
     # -- routing state -----------------------------------------------------
 
@@ -454,7 +454,7 @@ class ServeController:
                 try:
                     ray_tpu.kill(r)
                 except Exception:
-                    pass
+                    pass  # already dead
             # gauges are last-write-wins: without an explicit zero the
             # deleted deployment's queue_depth/replicas series hold their
             # final value on /metrics forever
@@ -464,7 +464,7 @@ class ServeController:
                 sm.queue_depth().set(0.0, tags=tags)
                 sm.replica_count().set(0.0, tags=tags)
             except Exception:
-                pass
+                pass  # metrics store gone mid-shutdown
 
     async def shutdown(self) -> None:
         self._shutdown = True
@@ -475,7 +475,7 @@ class ServeController:
             try:
                 ray_tpu.kill(self._proxy)
             except Exception:
-                pass
+                pass  # already dead
 
     # -- reconcile + autoscaling ------------------------------------------
 
@@ -503,7 +503,7 @@ class ServeController:
                             try:
                                 ray_tpu.kill(r)
                             except Exception:
-                                pass
+                                pass  # already dead
                     st.replicas = alive
                     # membership check right before the write (no await in
                     # between, and the controller is single-event-loop):
@@ -520,7 +520,7 @@ class ServeController:
                             sm.replica_count().set(len(st.replicas),
                                                    tags=tags)
                         except Exception:
-                            pass
+                            pass  # telemetry is best-effort here
                     cfg = st.spec.autoscaling_config
                     if cfg is not None:
                         self._autoscale(st, cfg, ongoing)
@@ -552,7 +552,7 @@ class ServeController:
                     "app": st.app, "deployment": st.spec.name,
                     "direction": direction})
             except Exception:
-                pass
+                pass  # telemetry is best-effort here
 
     @staticmethod
     def _last(st: _DeploymentState, which: str) -> float:

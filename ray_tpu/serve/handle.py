@@ -116,7 +116,7 @@ class DeploymentResponseGenerator:
             try:
                 ray_tpu.get(self._replica.stream_cancel.remote(self._sid))
             except Exception:
-                pass
+                pass  # replica died; stream is gone either way
             if self._on_done:
                 self._on_done()
                 self._on_done = None

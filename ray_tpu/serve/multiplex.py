@@ -53,7 +53,7 @@ class _ModelCache:
                         if asyncio.iscoroutine(res):
                             await res
                     except Exception:
-                        pass
+                        pass  # user hook failed; eviction proceeds
             return model
 
 

@@ -114,7 +114,7 @@ class Checkpoint:
                 return jax.tree.unflatten(
                     treedef, jax.tree.leaves(state_dict))
             except Exception:
-                pass
+                pass  # foreign pytree: fall back to raw dict
         return state_dict
 
     # -- orbax backend (sharded/multi-host pytrees) ------------------------
@@ -277,4 +277,4 @@ class CheckpointManager:
                 fs_, p = c._resolved()
                 fsutil.delete_dir(fs_, p)
             except Exception:
-                pass
+                pass  # retention delete races shared storage

@@ -154,13 +154,13 @@ class _TrainWorker:
                 try:
                     jax.distributed.shutdown()
                 except Exception:
-                    pass
+                    pass  # backend may never have initialized
             if tdist:
                 try:
                     import torch.distributed as td
                     td.destroy_process_group()
                 except Exception:
-                    pass
+                    pass  # backend may never have initialized
         return "done"
 
     def _init_jax_distributed(self, rank: int, world: int) -> bool:
@@ -279,7 +279,7 @@ class DataParallelTrainer:
             try:
                 remove_placement_group(pg)
             except Exception:
-                pass
+                pass  # PG already gone with the failed attempt
         raise TrainingFailedError(
             f"no gang of {n_min}..{n_max} × {sc.bundle()} workers became "
             f"ready (cluster too small?)")
@@ -466,7 +466,7 @@ class DataParallelTrainer:
             try:
                 ray.kill(bus)
             except Exception:
-                pass
+                pass  # already dead
 
         if error is not None:
             raise TrainingFailedError(
@@ -482,11 +482,11 @@ class DataParallelTrainer:
             try:
                 ray.kill(w)
             except Exception:
-                pass
+                pass  # already dead
         try:
             remove_placement_group(pg)
         except Exception:
-            pass
+            pass  # already removed
 
 
 class JaxTrainer(DataParallelTrainer):

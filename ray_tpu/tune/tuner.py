@@ -287,7 +287,7 @@ class Tuner:
                 try:
                     ray.kill(trial.actor)
                 except Exception:
-                    pass
+                    pass  # already dead
                 trial.actor = None
             if searcher is not None and status in (
                     Trial.TERMINATED, Trial.STOPPED, Trial.ERROR) and \
@@ -383,11 +383,11 @@ class Tuner:
             try:
                 ray.kill(bus)
             except Exception:
-                pass
+                pass  # already dead
             try:
                 self._save_experiment(storage, trials, fn_blob)
             except Exception:
-                pass
+                pass  # best-effort final save
             if reporter is not None:
                 try:
                     # a misbehaving user reporter must never mask the
@@ -396,7 +396,7 @@ class Tuner:
                         reporter.on_trial_complete(t.index, t.status)
                     reporter.final()
                 except Exception:
-                    pass
+                    pass  # reporter is cosmetic; results collected
 
         return ResultGrid([TrialResult(t) for t in trials],
                           tc.metric, tc.mode)

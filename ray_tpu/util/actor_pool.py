@@ -5,6 +5,7 @@ map_unordered/submit/get_next over a set of actor handles).
 """
 from __future__ import annotations
 
+from collections import deque
 from typing import Any, Callable, Iterable
 
 
@@ -14,7 +15,7 @@ class ActorPool:
         if not self._idle:
             raise ValueError("ActorPool needs at least one actor")
         self._future_to_actor: dict = {}
-        self._pending: list = []           # completion-order buffer
+        self._pending: deque = deque()     # completion-order buffer
         self._index_to_future: dict = {}
         self._next_task_index = 0
         self._next_return_index = 0
@@ -41,7 +42,7 @@ class ActorPool:
     def _return_actor(self, actor) -> None:
         self._idle.append(actor)
         if self._pending:
-            fn, value = self._pending.pop(0)
+            fn, value = self._pending.popleft()
             self.submit(fn, value)
 
     # -- retrieval -------------------------------------------------------- #

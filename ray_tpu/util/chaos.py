@@ -95,7 +95,7 @@ class WorkerKiller(_KillerBase):
         try:
             proc.kill()
         except Exception:
-            return None
+            return None  # already exited: report no kill
         return wid
 
 

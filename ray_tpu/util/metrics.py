@@ -222,7 +222,7 @@ def _ensure_flusher():
             try:
                 _flush_once()
             except Exception:
-                pass
+                pass  # flusher survives transient head loss
 
     threading.Thread(target=loop, daemon=True,
                      name="rtpu-user-metrics").start()
@@ -240,7 +240,7 @@ def shutdown_flush() -> None:
     try:
         _flush_once()
     except Exception:
-        pass
+        pass  # teardown proceeds regardless (docstring)
 
 
 def zero_gauges(label: tuple) -> None:

@@ -72,7 +72,7 @@ class PlacementGroup:
                 try:
                     rt.put_at(oid, e, is_exception=True)
                 except BaseException:
-                    pass
+                    pass  # store closing; waiter times out
         threading.Thread(target=_waiter, daemon=True).start()
         self._ready_ref = ObjectRef(oid)
         return self._ready_ref

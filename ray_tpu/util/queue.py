@@ -134,4 +134,4 @@ class Queue:
         try:
             ray_tpu.kill(self._actor)
         except Exception:
-            pass
+            pass  # already dead

@@ -121,7 +121,7 @@ def record_span(rec: dict) -> None:
         try:
             rt.send({"t": "trace_span", "span": rec})
         except Exception:
-            pass
+            pass  # conn gone; span loss is acceptable
 
 
 def _export_otel(rec: dict) -> None:
@@ -130,7 +130,7 @@ def _export_otel(rec: dict) -> None:
     try:
         from opentelemetry import trace as _ot  # noqa: F401
     except Exception:
-        return
+        return  # SDK absent: soft-gated exporter
     try:
         tracer = _ot.get_tracer("ray_tpu")
         sp = tracer.start_span(rec["name"],
@@ -141,4 +141,4 @@ def _export_otel(rec: dict) -> None:
             sp.set_attribute("rtpu.parent_id", rec["parent_id"])
         sp.end(end_time=int((rec["start_s"] + rec["dur_s"]) * 1e9))
     except Exception:
-        pass
+        pass  # exporter must never break traced code

@@ -1,0 +1,120 @@
+"""Multi-process object-store stress driver (not a pytest module).
+
+Run by tests/test_sanitizers.py under an ASan/UBSan build of the native
+store (RTPU_OBJSTORE_SANITIZE + LD_PRELOAD'd sanitizer runtimes): a head
+process creates the store and forks N children that hammer
+create/seal/get/release/delete and the multi-oid os_wait_sealed barrier
+against each other. Every round:
+
+  1. each worker creates+writes+seals its own object;
+  2. all workers park in ONE wait_sealed over the round's N ids (the
+     futex-on-seal path) until everyone's seal lands;
+  3. each worker reads+releases every object of the round, checking the
+     creator's byte pattern;
+  4. each worker re-reads a RANDOMLY-OLD object whose creator may be
+     concurrently deleting it (the delete-vs-pinned-get race), then
+     deletes its own object from two rounds back.
+
+Worker 0 exits via os._exit while still holding a read pin and an
+unsealed create, so the head exercises os_reclaim_pid against a truly
+dead process.
+
+Usage:  python tests/_objstore_stress.py head <n_workers> <rounds>
+        python tests/_objstore_stress.py child <store> <w> <n> <rounds>
+"""
+import hashlib
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ray_tpu.core.ids import ObjectID  # noqa: E402
+from ray_tpu.core.object_store import SharedObjectStore  # noqa: E402
+
+
+def oid_for(w: int, r: int) -> ObjectID:
+    return ObjectID(hashlib.sha1(f"{w}:{r}".encode()).digest()[:16])
+
+
+def _size(w: int, r: int) -> int:
+    return 1024 + (w * 7919 + r * 104729) % 4096
+
+
+def child(store_path: str, w: int, n: int, rounds: int) -> None:
+    store = SharedObjectStore(store_path)
+    stale_hits = 0
+    for r in range(rounds):
+        oid = oid_for(w, r)
+        size = _size(w, r)
+        buf = store.create_raw(oid, size)
+        buf[:] = bytes([w % 251]) * size
+        del buf
+        store.seal(oid)
+        # one event-driven wait over the whole round: whoever seals last
+        # wakes everyone (os_wait_sealed services seals in ANY order)
+        oids = [oid_for(x, r) for x in range(n)]
+        flags = store.wait_sealed(oids, n, 30_000)
+        assert all(flags), f"worker {w} round {r}: barrier timeout {flags}"
+        for x, o in enumerate(oids):
+            view = store.get_raw(o, timeout_ms=5000)
+            assert view is not None, f"worker {w} round {r}: lost {x}"
+            assert view[0] == x % 251, f"worker {w} round {r}: bad byte"
+            del view
+            store.release(o)
+        if r >= 2:
+            # a racy LATE read of an object its creator may be deleting
+            # right now (they are at most one round apart): the store
+            # must serve it whole or not at all — never a torn view
+            victim = oid_for((w + 1) % n, r - 2)
+            view = store.get_raw(victim, timeout_ms=0)
+            if view is not None:
+                assert view[0] == (w + 1) % n % 251
+                del view
+                store.release(victim)
+            else:
+                stale_hits += 1
+            store.delete(oid_for(w, r - 2))
+    print(f"child {w} done stale_hits={stale_hits}", flush=True)
+    if w == 0:
+        # die ugly: a held read pin + an unsealed create for the head's
+        # os_reclaim_pid to mop up (the dead-worker reclaim path)
+        pinned = store.get_raw(oid_for(0, rounds - 1), timeout_ms=1000)
+        assert pinned is not None
+        store.create_raw(ObjectID(b"unsealed-w0-last"), 512)
+        os._exit(0)
+    store.close()
+
+
+def head(n: int, rounds: int) -> None:
+    path = f"/dev/shm/rtpu_sanstress_{os.getpid()}"
+    store = SharedObjectStore(path, capacity=16 << 20, max_entries=4096,
+                              create=True)
+    try:
+        procs = [subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "child", path,
+             str(w), str(n), str(rounds)]) for w in range(n)]
+        deadline = time.monotonic() + 240
+        rcs = [p.wait(timeout=max(1, deadline - time.monotonic()))
+               for p in procs]
+        assert all(rc == 0 for rc in rcs), f"child exit codes: {rcs}"
+        # worker 0 died holding a pin + an unsealed create
+        reclaimed = store.reclaim_pid(procs[0].pid)
+        assert reclaimed >= 1, f"reclaim_pid found nothing ({reclaimed})"
+        for r in range(rounds):
+            for w in range(n):
+                store.delete(oid_for(w, r))
+        print(f"objstore stress done n={n} rounds={rounds} "
+              f"reclaimed={reclaimed} evictions={store.evictions()} "
+              f"objects_left={store.num_objects()}", flush=True)
+    finally:
+        store.close(unlink=True)
+
+
+if __name__ == "__main__":
+    if sys.argv[1] == "head":
+        head(int(sys.argv[2]), int(sys.argv[3]))
+    else:
+        child(sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
+              int(sys.argv[5]))

@@ -1,0 +1,552 @@
+"""graftlint: per-rule unit tests on inline fixtures (positive,
+suppressed, negative) + the tier-1 zero-findings gate over ray_tpu/.
+
+The gate test is what turns the analyzer into CI: a PR that reintroduces
+a list.pop(0) hot queue, a comment-less silent except, an off-lock touch
+of a guarded attribute, or a handler-less wire frame fails HERE, not in
+review."""
+import json
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tools.graftlint import lint_source, run_lint
+from tools.graftlint.engine import (REPO_ROOT, Finding, apply_baseline,
+                                    load_baseline)
+
+
+def lint(src, rules=None):
+    return lint_source(textwrap.dedent(src), rules=rules)
+
+
+def rules_of(findings):
+    return sorted(f.rule for f in findings)
+
+
+# ------------------------------------------------------------------ #
+# GL001 lock discipline
+# ------------------------------------------------------------------ #
+
+GL001_CLASS = """
+    import threading
+
+    class Sched:
+        def __init__(self):
+            self.lock = threading.RLock()
+            self.cv = threading.Condition(self.lock)
+            self.pending = []  # guarded by: self.lock
+
+        def _schedule_locked(self):
+            return len(self.pending)   # caller holds the lock: exempt
+
+        def ok_with(self):
+            with self.lock:
+                self.pending.append(1)
+                self._schedule_locked()
+
+        def ok_via_cv(self):
+            with self.cv:              # Condition(self.lock) aliases it
+                self.pending.append(1)
+
+        def nested_def_resets(self):
+            def later():
+                with self.lock:
+                    self._schedule_locked()
+            return later
+"""
+
+
+def test_gl001_clean_class_passes():
+    assert lint(GL001_CLASS, rules={"GL001"}) == []
+
+
+def test_gl001_flags_offlock_attr_and_locked_call():
+    bad = GL001_CLASS + textwrap.dedent("""
+        def bad(self):
+            self.pending.append(2)
+            self._schedule_locked()
+    """).replace("\n", "\n        ")
+    found = lint(bad, rules={"GL001"})
+    msgs = [f.message for f in found]
+    assert len(found) == 2
+    assert any("self.pending is declared guarded" in m for m in msgs)
+    assert any("_schedule_locked" in m for m in msgs)
+
+
+def test_gl001_nested_function_does_not_inherit_lock():
+    src = GL001_CLASS + textwrap.dedent("""
+        def leaky(self):
+            with self.lock:
+                def later():
+                    self.pending.append(3)   # runs off-thread later
+                return later
+    """).replace("\n", "\n        ")
+    found = lint(src, rules={"GL001"})
+    assert len(found) == 1 and "self.pending" in found[0].message
+
+
+def test_gl001_suppression():
+    src = GL001_CLASS + textwrap.dedent("""
+        def manual_acquire(self):
+            self.lock.acquire()
+            try:
+                self._schedule_locked()  # graftlint: disable=GL001
+            finally:
+                self.lock.release()
+    """).replace("\n", "\n        ")
+    assert lint(src, rules={"GL001"}) == []
+
+
+def test_gl001_comment_above_declares_guard():
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._mu = threading.Lock()
+                # guarded by: self._mu
+                self.items = {}
+
+            def bad(self):
+                return self.items
+    """
+    found = lint(src, rules={"GL001"})
+    assert len(found) == 1 and "self.items" in found[0].message
+
+
+# ------------------------------------------------------------------ #
+# GL002 blocking under a lock
+# ------------------------------------------------------------------ #
+
+def test_gl002_positive_sleep_subprocess_join():
+    src = """
+        import subprocess
+        import threading
+        import time
+
+        lock = threading.Lock()
+
+        def f(t):
+            with lock:
+                time.sleep(1)
+                subprocess.run(["ls"])
+                t.join()
+    """
+    found = lint(src, rules={"GL002"})
+    assert len(found) == 3
+    assert all("while holding lock" in f.message for f in found)
+
+
+def test_gl002_conn_lock_allows_sends_bans_sleep():
+    src = """
+        import threading
+        import time
+
+        class W:
+            def __init__(self, conn):
+                self.send_lock = threading.Lock()
+                self.conn = conn
+
+            def drain(self, msg):
+                with self.send_lock:
+                    self.conn.send(msg)      # the lock's purpose: fine
+
+            def bad(self):
+                with self.send_lock:
+                    time.sleep(0.1)
+    """
+    found = lint(src, rules={"GL002"})
+    assert len(found) == 1 and "time.sleep" in found[0].message
+
+
+def test_gl002_send_under_scheduler_lock_flagged():
+    src = """
+        import threading
+
+        class R:
+            def __init__(self, conn):
+                self.lock = threading.RLock()
+                self.conn = conn
+
+            def bad(self, msg):
+                with self.lock:
+                    self.conn.send(msg)
+    """
+    found = lint(src, rules={"GL002"})
+    assert len(found) == 1 and "pipe/socket" in found[0].message
+
+
+def test_gl002_negative_cv_wait_and_nested_def():
+    src = """
+        import threading
+        import time
+
+        class R:
+            def __init__(self):
+                self.lock = threading.RLock()
+                self.cv = threading.Condition(self.lock)
+
+            def waiter(self):
+                with self.cv:
+                    self.cv.wait(1.0)        # releases the lock: fine
+
+            def retry(self):
+                with self.lock:
+                    def later():
+                        time.sleep(0.5)      # runs outside the lock
+                    return later
+    """
+    assert lint(src, rules={"GL002"}) == []
+
+
+def test_gl002_suppression():
+    src = """
+        import threading
+        import time
+        lock = threading.Lock()
+
+        def f():
+            with lock:
+                time.sleep(0)  # graftlint: disable=GL002
+    """
+    assert lint(src, rules={"GL002"}) == []
+
+
+# ------------------------------------------------------------------ #
+# GL003 blocking in async def
+# ------------------------------------------------------------------ #
+
+def test_gl003_positive():
+    src = """
+        import time
+        from urllib.request import urlopen
+
+        async def handler(req):
+            time.sleep(0.1)
+            return urlopen("http://x")
+    """
+    found = lint(src, rules={"GL003"})
+    assert len(found) == 2
+
+
+def test_gl003_negative_asyncio_and_executor():
+    src = """
+        import asyncio
+        import time
+        from asyncio import sleep
+
+        async def handler(loop):
+            await asyncio.sleep(0.1)
+            await sleep(0.1)
+            def work():
+                time.sleep(1)        # runs in the executor: fine
+            return await loop.run_in_executor(None, work)
+    """
+    assert lint(src, rules={"GL003"}) == []
+
+
+def test_gl003_nested_async_def_reports_once():
+    src = """
+        import time
+
+        async def outer():
+            async def inner():
+                time.sleep(1)
+            return inner
+    """
+    found = lint(src, rules={"GL003"})
+    assert len(found) == 1 and "inner" in found[0].message
+
+
+def test_gl003_suppression():
+    src = """
+        import time
+
+        async def h():
+            time.sleep(0)  # graftlint: disable=GL003
+    """
+    assert lint(src, rules={"GL003"}) == []
+
+
+# ------------------------------------------------------------------ #
+# GL004 hot-path queue ops
+# ------------------------------------------------------------------ #
+
+def test_gl004_positive():
+    src = """
+        def f(q):
+            q.pop(0)
+            q.insert(0, 1)
+    """
+    assert rules_of(lint(src, rules={"GL004"})) == ["GL004", "GL004"]
+
+
+def test_gl004_negative_sys_path_and_indexed_pop():
+    src = """
+        import sys
+
+        def f(q, paths):
+            sys.path.insert(0, "x")
+            paths.insert(0, "y")
+            q.pop()          # tail pop: O(1)
+            q.pop(0, None)   # dict.pop with default
+    """
+    assert lint(src, rules={"GL004"}) == []
+
+
+def test_gl004_suppression():
+    src = """
+        def f(q):
+            q.pop(0)  # graftlint: disable=GL004
+    """
+    assert lint(src, rules={"GL004"}) == []
+
+
+# ------------------------------------------------------------------ #
+# GL005 import hygiene (project rule: needs a package tree)
+# ------------------------------------------------------------------ #
+
+def _write_pkg(tmp_path, files: dict):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return tmp_path
+
+
+def test_gl005_flags_heavy_import_on_eager_path(tmp_path):
+    _write_pkg(tmp_path, {
+        "ray_tpu/__init__.py": "from .core import api\n",
+        "ray_tpu/core/__init__.py": "",
+        "ray_tpu/core/api.py": "import jax\n",
+    })
+    found = run_lint([str(tmp_path / "ray_tpu")], root=str(tmp_path),
+                     rules={"GL005"})
+    assert len(found) == 1
+    assert found[0].rule == "GL005" and "jax" in found[0].message
+    assert found[0].file.endswith("core/api.py")
+
+
+def test_gl005_lazy_and_offpath_imports_pass(tmp_path):
+    _write_pkg(tmp_path, {
+        "ray_tpu/__init__.py": "from .core import api\n",
+        "ray_tpu/core/__init__.py": "",
+        "ray_tpu/core/api.py": ("def f():\n"
+                                "    import jax  # lazy: fine\n"),
+        # models is NOT imported by __init__: heavy is fine there
+        "ray_tpu/models/llama.py": "import jax\n",
+    })
+    assert run_lint([str(tmp_path / "ray_tpu")], root=str(tmp_path),
+                    rules={"GL005"}) == []
+
+
+def test_gl005_type_checking_guard_is_exempt(tmp_path):
+    _write_pkg(tmp_path, {
+        "ray_tpu/__init__.py": (
+            "from typing import TYPE_CHECKING\n"
+            "if TYPE_CHECKING:\n"
+            "    import jax\n"),
+    })
+    assert run_lint([str(tmp_path / "ray_tpu")], root=str(tmp_path),
+                    rules={"GL005"}) == []
+
+
+# ------------------------------------------------------------------ #
+# GL006 frame parity (acceptance: catches an injected frame type)
+# ------------------------------------------------------------------ #
+
+def test_gl006_catches_injected_handlerless_frame(tmp_path):
+    """Copy the real core modules, inject a sent-but-unhandled frame
+    into worker.py, and assert GL006 reports exactly it."""
+    import shutil
+    from tools.graftlint.rules import FRAME_MODULES
+    for rel in FRAME_MODULES + ("ray_tpu/core/protocol.py",):
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(f"{REPO_ROOT}/{rel}", dst)
+    wp = tmp_path / "ray_tpu/core/worker.py"
+    wp.write_text(wp.read_text().replace(
+        'self.send({"t": "blocked"})',
+        'self.send({"t": "blocked_zz9"})'))
+    found = run_lint([str(tmp_path / "ray_tpu")], root=str(tmp_path),
+                     rules={"GL006"})
+    msgs = [f.message for f in found]
+    assert any('"blocked_zz9" is sent but no peer handles it' in m
+               for m in msgs)
+    # ...and the inventory-changed-without-version-bump pin fires too
+    assert any("PROTOCOL_VERSION" in m for m in msgs)
+
+
+def test_gl006_real_tree_is_in_parity():
+    assert run_lint(["ray_tpu"], rules={"GL006"}) == []
+
+
+# ------------------------------------------------------------------ #
+# GL007 metric conventions
+# ------------------------------------------------------------------ #
+
+def test_gl007_naming():
+    src = """
+        from ray_tpu.util.metrics import Counter, cached_metric
+
+        BAD = Counter("my_requests_total")
+        WRONG_NS = cached_metric(Counter, "rtpu_engine_requests_total")
+        OK = Counter("rtpu_core_tasks_total")
+    """
+    found = lint(src, rules={"GL007"})
+    assert len(found) == 2
+    assert all("does not match" in f.message for f in found)
+
+
+def test_gl007_per_call_construction():
+    src = """
+        from ray_tpu.util.metrics import Counter, Gauge, cached_metric
+
+        TOP = Counter("rtpu_core_ok_total")       # module scope: fine
+
+        def hot_path():
+            c = Counter("rtpu_core_hits_total")   # re-registers per call
+            c.inc()
+
+        def cached_ok():
+            return cached_metric(Gauge, "rtpu_core_depth")
+    """
+    found = lint(src, rules={"GL007"})
+    assert len(found) == 1 and "constructed inside a function" \
+        in found[0].message
+
+
+def test_gl007_suppression():
+    src = """
+        from ray_tpu.util.metrics import Counter
+        C = Counter("legacy_name")  # graftlint: disable=GL007
+    """
+    assert lint(src, rules={"GL007"}) == []
+
+
+# ------------------------------------------------------------------ #
+# GL008 swallowed exceptions
+# ------------------------------------------------------------------ #
+
+def test_gl008_positive():
+    src = """
+        def f(x):
+            try:
+                x()
+            except:
+                pass
+            try:
+                x()
+            except Exception:
+                pass
+    """
+    found = lint(src, rules={"GL008"})
+    assert len(found) == 2
+    assert "bare" in found[0].message
+
+
+def test_gl008_comment_or_narrow_or_handling_passes():
+    src = """
+        def f(x, log):
+            try:
+                x()
+            except Exception:
+                pass  # teardown: best-effort
+            try:
+                x()
+            except OSError:
+                pass
+            try:
+                x()
+            except Exception as e:
+                log(e)
+    """
+    assert lint(src, rules={"GL008"}) == []
+
+
+def test_gl008_file_suppression():
+    src = """
+        # graftlint: disable-file=GL008
+        def f(x):
+            try:
+                x()
+            except Exception:
+                pass
+    """
+    assert lint(src, rules={"GL008"}) == []
+
+
+# ------------------------------------------------------------------ #
+# engine: baseline mechanics + CLI
+# ------------------------------------------------------------------ #
+
+def test_baseline_matches_on_rule_file_message_not_line():
+    f = Finding("GL004", "a.py", 10, 0, "q.pop(0) is O(n)")
+    moved = Finding("GL004", "a.py", 99, 4, "q.pop(0) is O(n)")
+    base = [{"rule": "GL004", "file": "a.py", "line": 10,
+             "message": "q.pop(0) is O(n)", "why": "ring buffer, n<=4"}]
+    new, stale = apply_baseline([moved], base)
+    assert new == [] and stale == []
+    other = Finding("GL004", "b.py", 1, 0, "q.pop(0) is O(n)")
+    new, stale = apply_baseline([other], base)
+    assert new == [other] and stale == base
+
+
+def test_cli_update_frames_refuses_partial_tree():
+    run = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "--update-frames",
+         "ray_tpu/core/worker.py"],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=120)
+    assert run.returncode == 2
+    assert "full tree" in run.stderr
+
+
+def test_cli_errors_on_nonexistent_path():
+    run = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "ray_tpu/nope.py"],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=120)
+    assert run.returncode == 2
+    assert "no such path" in run.stderr
+
+
+def test_cli_exits_nonzero_on_new_findings(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(q):\n    q.pop(0)\n")
+    run = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", str(bad), "--json"],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=120)
+    assert run.returncode == 1
+    out = json.loads(run.stdout)
+    assert out["findings"][0]["rule"] == "GL004"
+
+
+def test_cli_baseline_update_then_clean(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(q):\n    q.pop(0)\n")
+    base = tmp_path / "baseline.json"
+    run = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", str(bad),
+         "--baseline", str(base), "--baseline-update"],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=120)
+    assert run.returncode == 0, run.stdout + run.stderr
+    entries = json.loads(base.read_text())["findings"]
+    assert len(entries) == 1 and entries[0]["rule"] == "GL004"
+    run = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", str(bad),
+         "--baseline", str(base)],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=120)
+    assert run.returncode == 0, run.stdout + run.stderr
+    assert "1 baselined" in run.stdout
+
+
+# ------------------------------------------------------------------ #
+# the tier-1 gate: the whole tree lints clean
+# ------------------------------------------------------------------ #
+
+def test_ray_tpu_tree_has_zero_nonbaselined_findings():
+    findings = run_lint(["ray_tpu"])
+    new, _stale = apply_baseline(findings, load_baseline())
+    assert new == [], "graftlint regressions:\n" + "\n".join(
+        f.render() for f in new)

@@ -42,3 +42,52 @@ def test_objstore_under_asan(tmp_path):
 def test_objstore_under_tsan(tmp_path):
     out = _build_and_run("thread", tmp_path)
     assert "evictions=" in out
+
+
+def _sanitizer_runtime(name: str) -> str:
+    """Absolute path of gcc's runtime for -fsanitize=<name>, or ''."""
+    try:
+        out = subprocess.run(["gcc", f"-print-file-name=lib{name}.so"],
+                             capture_output=True, text=True, timeout=30)
+    except OSError:
+        return ""
+    path = out.stdout.strip()
+    return path if os.path.isabs(path) else ""
+
+
+def test_objstore_asan_multiprocess_stress():
+    """The REAL store (ctypes path, shm file, cross-process futexes)
+    under an ASan+UBSan build: head + 4 child processes hammer
+    create/seal/get/release/delete/os_wait_sealed against each other,
+    one child dies holding pins (os_reclaim_pid). The env-gated
+    RTPU_OBJSTORE_SANITIZE build mode in native/build.py produces the
+    instrumented libobjstore.<mode>.so; loading it into an
+    uninstrumented python requires LD_PRELOADing the sanitizer
+    runtimes."""
+    libasan = _sanitizer_runtime("asan")
+    libubsan = _sanitizer_runtime("ubsan")
+    if not libasan or not libubsan:
+        pytest.skip("gcc sanitizer runtimes unavailable")
+    driver = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "_objstore_stress.py")
+    env = dict(os.environ)
+    env["RTPU_OBJSTORE_SANITIZE"] = "address,undefined"
+    env["LD_PRELOAD"] = f"{libasan} {libubsan}"
+    # python itself "leaks" (interned objects, arenas): leak checking
+    # would drown real reports. halt_on_error stays default-on, so any
+    # true finding fails the child's exit code too.
+    env["ASAN_OPTIONS"] = "detect_leaks=0"
+    env["UBSAN_OPTIONS"] = "print_stacktrace=1"
+    run = subprocess.run([sys.executable, driver, "head", "4", "30"],
+                         env=env, capture_output=True, text=True,
+                         timeout=480)
+    assert run.returncode == 0, run.stdout + run.stderr
+    assert "objstore stress done" in run.stdout, run.stdout + run.stderr
+    assert "objects_left=0" in run.stdout, run.stdout
+    for needle in ("AddressSanitizer", "UndefinedBehaviorSanitizer",
+                   "runtime error:"):
+        assert needle not in run.stderr, run.stderr
+    # the sanitized variant caches under its own name: the production
+    # libobjstore.so must be untouched by this run
+    assert os.path.exists(os.path.join(
+        NATIVE, "libobjstore.address-undefined.so"))

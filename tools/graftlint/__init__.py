@@ -1,0 +1,17 @@
+"""graftlint — concurrency & invariant static analysis for ray_tpu.
+
+Usage:
+    python -m tools.graftlint ray_tpu/            # lint, text output
+    python -m tools.graftlint --json ray_tpu/     # machine-readable
+    python -m tools.graftlint --baseline-update   # re-baseline findings
+    python -m tools.graftlint --update-frames     # re-pin GL006 manifest
+
+See engine.py for the architecture and rules.py for the rule catalogue
+(GL001-GL008). The tier-1 suite (tests/test_graftlint.py) runs the lint
+over ray_tpu/ and fails on any non-baselined finding.
+"""
+from .engine import (Finding, apply_baseline, lint_source, load_baseline,
+                     run_lint, write_baseline)
+
+__all__ = ["Finding", "apply_baseline", "lint_source", "load_baseline",
+           "run_lint", "write_baseline"]

@@ -1,0 +1,95 @@
+"""CLI: python -m tools.graftlint [paths...]
+
+Exit status: 0 when every finding is suppressed or baselined, 1 when new
+findings exist (so CI and the tier-1 suite fail on regressions), 2 on
+usage errors.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .engine import (DEFAULT_BASELINE, REPO_ROOT, apply_baseline,
+                     load_baseline, parse_files, run_lint, write_baseline)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="AST-based concurrency/invariant lint for ray_tpu")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files or directories to lint (default: ray_tpu/)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as JSON")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default: tools/graftlint/"
+                         "baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the baseline")
+    ap.add_argument("--baseline-update", action="store_true",
+                    help="rewrite the baseline to the current findings "
+                         "(existing justifications are kept)")
+    ap.add_argument("--update-frames", action="store_true",
+                    help="re-pin the GL006 frame manifest to the current "
+                         "frame inventory + PROTOCOL_VERSION")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids to run (default: all)")
+    args = ap.parse_args(argv)
+
+    paths = args.paths or ["ray_tpu"]
+    rules = set(r.strip() for r in args.rules.split(",")) \
+        if args.rules else None
+
+    try:
+        return _run(args, paths, rules)
+    except FileNotFoundError as e:
+        print(e, file=sys.stderr)
+        return 2
+
+
+def _run(args, paths, rules) -> int:
+    if args.update_frames:
+        from . import rules as rules_mod
+        ctxs, _ = parse_files(paths, REPO_ROOT)
+        manifest = rules_mod.update_frames_manifest(ctxs)
+        print(f"pinned {len(manifest['frames'])} frame types at "
+              f"protocol v{manifest['protocol_version']} -> "
+              f"{rules_mod.FRAMES_MANIFEST}")
+        return 0
+
+    findings = run_lint(paths, REPO_ROOT, rules=rules)
+
+    if args.baseline_update:
+        prev = load_baseline(args.baseline)
+        write_baseline(findings, args.baseline, prev=prev)
+        print(f"baseline updated: {len(findings)} finding(s) -> "
+              f"{args.baseline}")
+        return 0
+
+    baseline = [] if args.no_baseline else load_baseline(args.baseline)
+    new, stale = apply_baseline(findings, baseline)
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.as_dict() for f in new],
+            "baselined": len(findings) - len(new),
+            "stale_baseline_entries": stale,
+        }, indent=1))
+    else:
+        for f in new:
+            print(f.render())
+        n_base = len(findings) - len(new)
+        summary = f"graftlint: {len(new)} finding(s)"
+        if n_base:
+            summary += f", {n_base} baselined"
+        if stale:
+            summary += (f", {len(stale)} stale baseline entr"
+                        f"{'y' if len(stale) == 1 else 'ies'} "
+                        f"(--baseline-update to prune)")
+        print(summary)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

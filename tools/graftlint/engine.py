@@ -1,0 +1,261 @@
+"""graftlint core: rule registry, suppressions, baseline, and the runner.
+
+The runtime's concurrency invariants (lock discipline, no blocking under
+the scheduler lock, deque-only hot queues, frame-handler parity, metric
+naming, lazy heavy imports) used to live only in review comments; this
+engine turns them into machine-checked rules. Reference analog: the
+sanitizer + clang-tidy CI the C++ core of the reference runs — here the
+control plane is Python, so the checks are AST-based and repo-native.
+
+Design:
+  - a *file rule* sees one parsed module (``FileContext``) and yields
+    ``Finding``s;
+  - a *project rule* sees every parsed module at once (cross-file
+    invariants like protocol-frame parity);
+  - per-line ``# graftlint: disable=GL00X`` and file-level
+    ``# graftlint: disable-file=GL00X`` comments suppress findings at
+    the source, for cases where the code is right and the rule's
+    heuristic is not;
+  - a checked-in baseline (``baseline.json``) grandfathers findings that
+    are intentional, each with a one-line justification. Baseline
+    entries match on (rule, file, message) — not line numbers — so they
+    survive unrelated edits.
+
+The CLI (``python -m tools.graftlint``) exits non-zero on any finding
+that is neither suppressed nor baselined; the tier-1 suite runs it over
+``ray_tpu/`` so regressions fail tests, not just style.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Callable, Iterable, Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+)")
+_SUPPRESS_FILE_RE = re.compile(
+    r"#\s*graftlint:\s*disable-file=([A-Za-z0-9_,\s]+)")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    file: str          # repo-relative path
+    line: int
+    col: int
+    message: str
+
+    def key(self) -> tuple:
+        # baseline identity: line numbers drift with unrelated edits, so
+        # they are NOT part of it
+        return (self.rule, self.file, self.message)
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}:{self.col}: {self.rule} " \
+               f"{self.message}"
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class FileContext:
+    """One parsed module plus everything rules need: source lines,
+    comment map, and suppression directives."""
+
+    def __init__(self, path: str, source: str, relpath: str):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.line_suppressions: dict[int, set[str]] = {}
+        self.file_suppressions: set[str] = set()
+        for i, ln in enumerate(self.lines, start=1):
+            if "graftlint" not in ln:
+                continue
+            m = _SUPPRESS_FILE_RE.search(ln)
+            if m:
+                self.file_suppressions.update(
+                    r.strip() for r in m.group(1).split(",") if r.strip())
+                continue
+            m = _SUPPRESS_RE.search(ln)
+            if m:
+                self.line_suppressions.setdefault(i, set()).update(
+                    r.strip() for r in m.group(1).split(",") if r.strip())
+
+    def suppressed(self, f: Finding) -> bool:
+        if f.rule in self.file_suppressions or \
+                "all" in self.file_suppressions:
+            return True
+        rules = self.line_suppressions.get(f.line, ())
+        return f.rule in rules or "all" in rules
+
+    def comment_on(self, lineno: int) -> str:
+        """The comment text on a source line ('' when none). Good enough
+        for directive/annotation comments, which never live inside
+        strings containing '#' in this codebase."""
+        if 1 <= lineno <= len(self.lines):
+            ln = self.lines[lineno - 1]
+            if "#" in ln:
+                return ln[ln.index("#"):]
+        return ""
+
+    def statement_comment(self, node: ast.AST) -> str:
+        """Comments attached to a (possibly multi-line) statement."""
+        end = getattr(node, "end_lineno", node.lineno)
+        return " ".join(filter(None, (self.comment_on(i)
+                                      for i in range(node.lineno, end + 1))))
+
+
+# rule registry -------------------------------------------------------- #
+
+FILE_RULES: list[tuple[str, Callable[[FileContext], Iterable[Finding]]]] = []
+PROJECT_RULES: list[tuple[str, Callable[[dict], Iterable[Finding]]]] = []
+
+
+def file_rule(rule_id: str):
+    def deco(fn):
+        fn.rule_id = rule_id
+        FILE_RULES.append((rule_id, fn))
+        return fn
+    return deco
+
+
+def project_rule(rule_id: str):
+    def deco(fn):
+        fn.rule_id = rule_id
+        PROJECT_RULES.append((rule_id, fn))
+        return fn
+    return deco
+
+
+# running -------------------------------------------------------------- #
+
+def iter_py_files(paths: list[str]) -> list[str]:
+    out = []
+    for p in paths:
+        if not os.path.exists(p):
+            # a typo'd path or wrong cwd must not make the gate pass
+            # vacuously with "0 findings"
+            raise FileNotFoundError(f"graftlint: no such path: {p}")
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames
+                           if d != "__pycache__" and not d.startswith(".")]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return sorted(set(out))
+
+
+def _relpath(path: str, root: str) -> str:
+    ap = os.path.abspath(path)
+    root = os.path.abspath(root)
+    if ap.startswith(root + os.sep):
+        return os.path.relpath(ap, root)
+    return path
+
+
+def parse_files(paths: list[str], root: str = REPO_ROOT,
+                ) -> tuple[dict[str, FileContext], list[Finding]]:
+    ctxs: dict[str, FileContext] = {}
+    findings: list[Finding] = []
+    for path in iter_py_files(paths):
+        rel = _relpath(path, root)
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                src = f.read()
+            ctxs[rel] = FileContext(path, src, rel)
+        except SyntaxError as e:
+            findings.append(Finding(
+                "GL000", rel, e.lineno or 0, e.offset or 0,
+                f"syntax error: {e.msg}"))
+    return ctxs, findings
+
+
+def run_lint(paths: list[str], root: str = REPO_ROOT,
+             rules: Optional[set[str]] = None) -> list[Finding]:
+    """All unsuppressed findings for `paths` (baseline NOT applied)."""
+    from . import rules as _rules  # noqa: F401  (registers on import)
+    ctxs, findings = parse_files(paths, root)
+    for rule_id, fn in FILE_RULES:
+        if rules is not None and rule_id not in rules:
+            continue
+        for ctx in ctxs.values():
+            findings.extend(fn(ctx))
+    for rule_id, fn in PROJECT_RULES:
+        if rules is not None and rule_id not in rules:
+            continue
+        findings.extend(fn(ctxs))
+    out = []
+    for f in findings:
+        ctx = ctxs.get(f.file)
+        if ctx is not None and ctx.suppressed(f):
+            continue
+        out.append(f)
+    out.sort(key=lambda f: (f.file, f.line, f.rule))
+    return out
+
+
+def lint_source(source: str, filename: str = "snippet.py",
+                rules: Optional[set[str]] = None) -> list[Finding]:
+    """Lint an in-memory snippet with the file rules (unit-test helper)."""
+    from . import rules as _rules  # noqa: F401
+    ctx = FileContext(filename, source, filename)
+    findings: list[Finding] = []
+    for rule_id, fn in FILE_RULES:
+        if rules is not None and rule_id not in rules:
+            continue
+        findings.extend(fn(ctx))
+    return [f for f in findings if not ctx.suppressed(f)]
+
+
+# baseline ------------------------------------------------------------- #
+
+def load_baseline(path: str = DEFAULT_BASELINE) -> list[dict]:
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        data = json.load(f)
+    return data.get("findings", [])
+
+
+def apply_baseline(findings: list[Finding], baseline: list[dict],
+                   ) -> tuple[list[Finding], list[dict]]:
+    """-> (new findings not in the baseline, stale baseline entries)."""
+    keys = {(b["rule"], b["file"], b["message"]) for b in baseline}
+    new = [f for f in findings if f.key() not in keys]
+    live = {f.key() for f in findings}
+    stale = [b for b in baseline
+             if (b["rule"], b["file"], b["message"]) not in live]
+    return new, stale
+
+
+def write_baseline(findings: list[Finding], path: str = DEFAULT_BASELINE,
+                   prev: Optional[list[dict]] = None) -> None:
+    """Write the baseline for the current findings, carrying forward the
+    `why` justification of entries that already existed."""
+    prev_whys = {(b["rule"], b["file"], b["message"]): b.get("why", "")
+                 for b in (prev or [])}
+    entries = [{
+        "rule": f.rule, "file": f.file, "line": f.line,
+        "message": f.message,
+        "why": prev_whys.get(f.key(), "TODO: justify or fix"),
+    } for f in findings]
+    with open(path, "w") as fh:
+        json.dump({"comment": "graftlint grandfathered findings; every "
+                              "entry needs a one-line `why`. Regenerate "
+                              "with --baseline-update (existing whys are "
+                              "kept).",
+                   "findings": entries}, fh, indent=1, sort_keys=False)
+        fh.write("\n")

@@ -1,0 +1,816 @@
+"""graftlint rules GL001-GL008.
+
+Each rule encodes an invariant the runtime actually relies on (see the
+per-rule docstrings for the motivating subsystem). All checks are
+lexical/AST-level and intra-procedural: a blocking call hidden behind a
+helper method is not traced through the call graph. That keeps the pass
+fast and predictable; the suppression/baseline machinery absorbs the
+residue where the heuristic and the code disagree.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import Iterable, Optional
+
+from .engine import (Finding, FileContext, file_rule, project_rule)
+
+# --------------------------------------------------------------------- #
+# shared helpers
+# --------------------------------------------------------------------- #
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """Render a Name/Attribute chain as 'a.b.c' (None for anything else)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _last_segment(name: Optional[str]) -> str:
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+# a with-statement context expression that acquires a lock, by naming
+# convention: with self.lock / with _lock / with w.send_lock / with self.cv
+_LOCKISH_RE = re.compile(r"(lock|cv|cond|mutex)$", re.IGNORECASE)
+# locks that exist to serialize a pipe/socket write: sending (and the
+# pickling Connection.send does) under them is their very purpose
+_CONN_LOCK_RE = re.compile(r"(send|sbuf|conn)", re.IGNORECASE)
+
+
+def _lockish(expr: ast.AST) -> Optional[str]:
+    d = dotted(expr)
+    if d and _LOCKISH_RE.search(_last_segment(d)):
+        return d
+    return None
+
+
+def _is_funcdef(node: ast.AST) -> bool:
+    return isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda))
+
+
+# --------------------------------------------------------------------- #
+# GL001 — lock discipline
+# --------------------------------------------------------------------- #
+# Motivation: Runtime (core/runtime.py) keys its entire object directory,
+# refcount, and scheduler state off ONE RLock; helper methods that assume
+# the lock is held are named *_locked (the repo's long-standing idiom).
+# The rule makes both halves checkable:
+#   - an attribute annotated `# guarded by: self.<lock>` at its
+#     declaration may only be touched under `with self.<lock>` (or from a
+#     *_locked method, whose caller holds it by contract, or __init__);
+#   - a call to self.<anything>_locked(...) must itself happen under a
+#     class lock or from another *_locked method.
+
+_GUARDED_RE = re.compile(r"guarded by:\s*self\.([A-Za-z_][A-Za-z0-9_]*)")
+_LOCK_CTORS = ("Lock", "RLock")
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _collect_class_locks(ctx: FileContext, cls: ast.ClassDef):
+    """-> (lock_attrs, cond_aliases {cv_attr: wrapped_lock_attr},
+    guarded {attr: lock_attr})."""
+    locks: set[str] = set()
+    cond: dict[str, str] = {}
+    decls: list[tuple[str, ast.AST]] = []
+    for node in ast.walk(cls):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        if len(targets) != 1:
+            continue
+        attr = _self_attr(targets[0])
+        if attr is None:
+            continue
+        decls.append((attr, node))
+        value = node.value
+        if isinstance(value, ast.Call):
+            ctor = _last_segment(dotted(value.func))
+            if ctor in _LOCK_CTORS:
+                locks.add(attr)
+            elif ctor == "Condition":
+                locks.add(attr)
+                if value.args:
+                    wrapped = _self_attr(value.args[0])
+                    if wrapped:
+                        cond[attr] = wrapped
+    guarded: dict[str, str] = {}
+    for attr, node in decls:
+        if attr in locks or attr in cond:
+            continue  # a lock is never "guarded by" anything (itself)
+        # `self.x = ...  # guarded by: self.lock` (same line(s), or a
+        # pure-comment line directly above the declaration)
+        above = ctx.lines[node.lineno - 2].strip() \
+            if node.lineno >= 2 else ""
+        comment = ctx.statement_comment(node)
+        if above.startswith("#"):
+            comment += " " + above
+        m = _GUARDED_RE.search(comment)
+        if m:
+            guarded[attr] = m.group(1)
+    return locks, cond, guarded
+
+
+@file_rule("GL001")
+def check_lock_discipline(ctx: FileContext) -> Iterable[Finding]:
+    findings: list[Finding] = []
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        locks, cond, guarded = _collect_class_locks(ctx, cls)
+        if not locks and not guarded:
+            continue
+        lock_names = locks | set(cond)
+        for meth in cls.body:
+            if not isinstance(meth, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if "_locked" in meth.name or meth.name == "__init__":
+                continue  # caller-holds-the-lock contract / construction
+
+            def walk(node: ast.AST, held: frozenset):
+                if _is_funcdef(node):
+                    # a nested function runs at an unknown time: check
+                    # its body against an EMPTY held set (its own with
+                    # blocks still count)
+                    body = [node.body] if isinstance(node, ast.Lambda) \
+                        else node.body
+                    for ch in body:
+                        walk(ch, frozenset())
+                    return
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    new = set(held)
+                    for item in node.items:
+                        walk(item.context_expr, held)
+                        attr = _self_attr(item.context_expr)
+                        if attr in lock_names:
+                            new.add(attr)
+                            if attr in cond:
+                                new.add(cond[attr])
+                    for ch in node.body:
+                        walk(ch, frozenset(new))
+                    return
+                attr = _self_attr(node)
+                if attr is not None and attr in guarded and \
+                        guarded[attr] not in held:
+                    findings.append(Finding(
+                        "GL001", ctx.relpath, node.lineno, node.col_offset,
+                        f"self.{attr} is declared guarded by "
+                        f"self.{guarded[attr]} but is touched in "
+                        f"{cls.name}.{meth.name} without holding it"))
+                if isinstance(node, ast.Call):
+                    cattr = _self_attr(node.func)
+                    if cattr and "_locked" in cattr and \
+                            lock_names and not (held & lock_names):
+                        findings.append(Finding(
+                            "GL001", ctx.relpath, node.lineno,
+                            node.col_offset,
+                            f"self.{cattr}() (caller-holds-lock contract)"
+                            f" called from {cls.name}.{meth.name} without"
+                            f" a class lock held"))
+                for ch in ast.iter_child_nodes(node):
+                    walk(ch, held)
+
+            for stmt in meth.body:
+                walk(stmt, frozenset())
+    return findings
+
+
+# --------------------------------------------------------------------- #
+# GL002 — blocking call while holding a lock
+# --------------------------------------------------------------------- #
+# Motivation: PR 3's combining-lock flush drain had to be designed so no
+# sleep/subprocess/join ever happens while the scheduler or a connection
+# lock is held — one blocked holder stalls every other sender/scheduling
+# pass. Conn-style locks (send_lock/_sbuf_lock) exist to serialize pipe
+# writes, so sends and the pickling inside Connection.send are allowed
+# under them; everything else on the ban list is not.
+
+_GL002_BANNED_DOTTED = {
+    "time.sleep": "time.sleep",
+    "sleep": "time.sleep",          # from time import sleep
+    "subprocess.run": "subprocess.run",
+    "subprocess.Popen": "subprocess.Popen",
+    "subprocess.call": "subprocess.call",
+    "subprocess.check_call": "subprocess.check_call",
+    "subprocess.check_output": "subprocess.check_output",
+    "os.system": "os.system",
+    "os.waitpid": "os.waitpid",
+}
+_GL002_PICKLE = {"pickle.dumps", "pickle.loads", "cloudpickle.dumps",
+                 "cloudpickle.loads"}
+_SENDY = {"send", "sendall", "sendmsg", "send_bytes"}
+_CONN_RECV = {"recv", "recv_bytes", "accept"}
+
+
+def _conn_receiver(func: ast.Attribute) -> bool:
+    seg = _last_segment(dotted(func.value)) if dotted(func.value) else ""
+    return seg in ("conn", "sock", "socket", "connection") or \
+        seg.endswith("_conn") or seg.endswith("_sock")
+
+
+def _cv_receiver(func: ast.Attribute) -> bool:
+    seg = _last_segment(dotted(func.value)) if dotted(func.value) else ""
+    return "cv" in seg or "cond" in seg
+
+
+def _gl002_check_call(node: ast.Call, conn_only: bool) -> Optional[str]:
+    """Why this call must not run under the held lock(s), or None."""
+    d = dotted(node.func)
+    if d is not None:
+        if d in _GL002_BANNED_DOTTED:
+            return f"{_GL002_BANNED_DOTTED[d]}() blocks"
+        if _last_segment(d) == "sleep" and "time" in d.split(".")[0]:
+            return "time.sleep() blocks"  # import time as _time, etc.
+        if not conn_only and d in _GL002_PICKLE:
+            return f"{d}() serializes arbitrary payloads"
+    if isinstance(node.func, ast.Attribute):
+        meth = node.func.attr
+        if meth == "join" and not node.args and not node.keywords:
+            return ".join() blocks until another thread/process exits"
+        if not conn_only:
+            if meth == "wait" and not _cv_receiver(node.func):
+                return ".wait() parks the holder (only a condition " \
+                       "variable's wait releases the lock)"
+            if meth in _SENDY and _conn_receiver(node.func):
+                return f".{meth}() writes to a pipe/socket (can block " \
+                       f"on a full buffer)"
+            if meth in _CONN_RECV and _conn_receiver(node.func):
+                return f".{meth}() blocks on the peer"
+    return None
+
+
+@file_rule("GL002")
+def check_blocking_under_lock(ctx: FileContext) -> Iterable[Finding]:
+    findings: list[Finding] = []
+
+    def walk(node: ast.AST, held: frozenset):
+        if _is_funcdef(node):
+            body = [node.body] if isinstance(node, ast.Lambda) else node.body
+            for ch in body:
+                walk(ch, frozenset())
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new = set(held)
+            for item in node.items:
+                walk(item.context_expr, held)
+                lk = _lockish(item.context_expr)
+                if lk:
+                    new.add(lk)
+            for ch in node.body:
+                walk(ch, frozenset(new))
+            return
+        if held and isinstance(node, ast.Call):
+            conn_only = all(_CONN_LOCK_RE.search(_last_segment(lk))
+                            for lk in held)
+            why = _gl002_check_call(node, conn_only)
+            if why:
+                findings.append(Finding(
+                    "GL002", ctx.relpath, node.lineno, node.col_offset,
+                    f"{why} while holding {', '.join(sorted(held))}"))
+        for ch in ast.iter_child_nodes(node):
+            walk(ch, held)
+
+    walk(ctx.tree, frozenset())
+    return findings
+
+
+# --------------------------------------------------------------------- #
+# GL003 — blocking call inside `async def`
+# --------------------------------------------------------------------- #
+# Motivation: serve's proxy/handle/multiplex and the OpenAI endpoint run
+# on shared asyncio loops; one synchronous sleep or network call stalls
+# EVERY in-flight request on that loop (and the local-mode loop guard in
+# serve/local_mode.py exists for exactly this failure class).
+
+_GL003_BANNED = {
+    "time.sleep": "time.sleep() stalls the event loop; use "
+                  "asyncio.sleep()",
+    "sleep": "time.sleep() stalls the event loop; use asyncio.sleep()",
+    "subprocess.run": "subprocess.run() blocks the loop",
+    "subprocess.call": "subprocess.call() blocks the loop",
+    "subprocess.check_call": "subprocess.check_call() blocks the loop",
+    "subprocess.check_output": "subprocess.check_output() blocks the loop",
+    "os.system": "os.system() blocks the loop",
+    "urllib.request.urlopen": "urlopen() does blocking I/O on the loop",
+    "urlopen": "urlopen() does blocking I/O on the loop",
+    "socket.create_connection": "blocking connect on the loop",
+}
+
+
+@file_rule("GL003")
+def check_blocking_in_async(ctx: FileContext) -> Iterable[Finding]:
+    findings: list[Finding] = []
+
+    def scan_async(fn: ast.AsyncFunctionDef):
+        def walk(node: ast.AST, awaited: bool = False):
+            if _is_funcdef(node):
+                # nested sync defs may run in an executor; nested ASYNC
+                # defs get their own scan from the module walk below
+                # (descending here double-reported every finding)
+                return
+            if isinstance(node, ast.Await):
+                walk(node.value, awaited=True)
+                return
+            if isinstance(node, ast.Call) and not awaited:
+                d = dotted(node.func)
+                msg = _GL003_BANNED.get(d)
+                if msg is None and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "join" and not node.args \
+                        and not node.keywords:
+                    msg = ".join() blocks the event loop"
+                if msg:
+                    findings.append(Finding(
+                        "GL003", ctx.relpath, node.lineno,
+                        node.col_offset,
+                        f"{msg} (inside async def {fn.name})"))
+            for ch in ast.iter_child_nodes(node):
+                walk(ch)
+        for stmt in fn.body:
+            walk(stmt)
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.AsyncFunctionDef):
+            scan_async(node)
+    return findings
+
+
+# --------------------------------------------------------------------- #
+# GL004 — O(n) list ops on hot queues
+# --------------------------------------------------------------------- #
+# Motivation: PR 2 swept the engine/handle/worker hot queues onto
+# collections.deque after list.pop(0) showed up in profiles; this keeps
+# the stragglers (and future reintroductions) out. sys.path-style
+# prepends are exempt — they are rare, tiny, and order-semantic.
+
+@file_rule("GL004")
+def check_hot_queue_ops(ctx: FileContext) -> Iterable[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            continue
+        meth = node.func.attr
+        if meth not in ("pop", "insert") or not node.args:
+            continue
+        arg0 = node.args[0]
+        if not (isinstance(arg0, ast.Constant) and arg0.value == 0):
+            continue
+        if meth == "pop" and len(node.args) != 1:
+            continue
+        if meth == "insert" and len(node.args) != 2:
+            continue
+        recv = dotted(node.func.value)
+        seg = _last_segment(recv).lower() if recv else ""
+        if seg in ("path", "paths") or seg.endswith("path") \
+                or seg.endswith("paths"):
+            continue  # sys.path.insert(0, ...) and friends
+        findings.append(Finding(
+            "GL004", ctx.relpath, node.lineno, node.col_offset,
+            f"{seg or 'list'}.{meth}(0{', ...' if meth == 'insert' else ''}"
+            f") is O(n); use collections.deque "
+            f"({'popleft' if meth == 'pop' else 'appendleft'})"))
+    return findings
+
+
+# --------------------------------------------------------------------- #
+# GL005 — import hygiene (static counterpart of test_no_heavy_imports)
+# --------------------------------------------------------------------- #
+# Motivation: worker fork/startup cost is dominated by imports (jax alone
+# is hundreds of ms); `import ray_tpu` must stay light. The dynamic test
+# catches a leak only at runtime — this walks the STATIC top-level import
+# closure of ray_tpu/__init__ and flags any heavy import inside it, with
+# the exact file:line to fix.
+
+HEAVY_MODULES = {"jax", "jaxlib", "flax", "optax", "aiohttp",
+                 "opentelemetry", "torch", "tensorflow", "pandas",
+                 "scipy", "sklearn"}
+IMPORT_ROOT = "ray_tpu"
+
+
+def _module_name(relpath: str) -> Optional[str]:
+    if not relpath.endswith(".py"):
+        return None
+    parts = relpath[:-3].replace(os.sep, "/").split("/")
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts) if parts else None
+
+
+def _is_type_checking_if(node: ast.If) -> bool:
+    d = dotted(node.test)
+    return d in ("TYPE_CHECKING", "typing.TYPE_CHECKING")
+
+
+def _top_level_imports(tree: ast.Module):
+    """Yield Import/ImportFrom nodes that execute at module import time
+    (including inside top-level try/if, excluding `if TYPE_CHECKING`)."""
+    def scan(body):
+        for node in body:
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                yield node
+            elif isinstance(node, ast.Try):
+                yield from scan(node.body)
+                for h in node.handlers:
+                    yield from scan(h.body)
+                yield from scan(node.orelse)
+                yield from scan(node.finalbody)
+            elif isinstance(node, ast.If) and not _is_type_checking_if(node):
+                yield from scan(node.body)
+                yield from scan(node.orelse)
+    yield from scan(tree.body)
+
+
+@project_rule("GL005")
+def check_import_hygiene(ctxs: dict[str, FileContext]) -> Iterable[Finding]:
+    modules: dict[str, FileContext] = {}
+    for rel, ctx in ctxs.items():
+        name = _module_name(rel)
+        if name and (name == IMPORT_ROOT
+                     or name.startswith(IMPORT_ROOT + ".")):
+            modules[name] = ctx
+    if IMPORT_ROOT not in modules:
+        return []
+
+    def deps_of(name: str, ctx: FileContext) -> set[str]:
+        deps: set[str] = set()
+
+        def add(target: str):
+            # importing a.b.c imports a and a.b too (__init__ chain)
+            parts = target.split(".")
+            for i in range(1, len(parts) + 1):
+                cand = ".".join(parts[:i])
+                if cand in modules:
+                    deps.add(cand)
+
+        pkg = name if modules[name].relpath.endswith("__init__.py") \
+            else name.rsplit(".", 1)[0]
+        for node in _top_level_imports(ctx.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    add(alias.name)
+            else:
+                if node.level:
+                    base_parts = pkg.split(".")
+                    up = node.level - 1
+                    if up:
+                        base_parts = base_parts[:-up] if up < len(
+                            base_parts) else []
+                    base = ".".join(base_parts)
+                else:
+                    base = ""
+                mod = (base + "." + node.module if base and node.module
+                       else (node.module or base))
+                if mod:
+                    add(mod)
+                    for alias in node.names:
+                        add(mod + "." + alias.name)
+        return deps
+
+    # BFS the import closure from the package root
+    closure: set[str] = set()
+    frontier = [IMPORT_ROOT]
+    while frontier:
+        name = frontier.pop()
+        if name in closure:
+            continue
+        closure.add(name)
+        frontier.extend(deps_of(name, modules[name]) - closure)
+
+    findings: list[Finding] = []
+    for name in sorted(closure):
+        ctx = modules[name]
+        for node in _top_level_imports(ctx.tree):
+            roots = []
+            if isinstance(node, ast.Import):
+                roots = [a.name.split(".")[0] for a in node.names]
+            elif node.level == 0 and node.module:
+                roots = [node.module.split(".")[0]]
+            for r in roots:
+                if r in HEAVY_MODULES:
+                    findings.append(Finding(
+                        "GL005", ctx.relpath, node.lineno,
+                        node.col_offset,
+                        f"top-level `import {r}` in a module on the "
+                        f"eager `import {IMPORT_ROOT}` path; import it "
+                        f"lazily inside the function that needs it"))
+    return findings
+
+
+# --------------------------------------------------------------------- #
+# GL006 — control-plane frame parity, pinned to PROTOCOL_VERSION
+# --------------------------------------------------------------------- #
+# Motivation: every `{"t": ...}` frame a peer sends must have a handler
+# on the receiving side — a handler-less frame type is silently dropped
+# (or worse, poisons a batch). The full frame inventory is additionally
+# pinned to PROTOCOL_VERSION via frames.json: changing the wire
+# vocabulary without bumping the version (protocol.py's contract) is
+# itself a finding. Regenerate the manifest with --update-frames.
+
+FRAME_MODULES = (
+    "ray_tpu/core/worker.py",
+    "ray_tpu/core/client.py",
+    "ray_tpu/core/runtime.py",
+    "ray_tpu/core/node_agent.py",
+    "ray_tpu/util/metrics.py",
+    "ray_tpu/util/tracing.py",
+    "ray_tpu/util/chaos.py",
+    "ray_tpu/experimental/device_objects.py",
+)
+PROTOCOL_FILE = "ray_tpu/core/protocol.py"
+FRAMES_MANIFEST = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "frames.json")
+
+
+def _t_ish(node: ast.AST) -> bool:
+    """Does this expression read a frame's type tag? t / msg["t"] /
+    m.get("t") / reply.get("t")."""
+    if isinstance(node, ast.Name) and node.id == "t":
+        return True
+    if isinstance(node, ast.Subscript):
+        sl = node.slice
+        return isinstance(sl, ast.Constant) and sl.value == "t"
+    if isinstance(node, ast.Call) and \
+            isinstance(node.func, ast.Attribute) and \
+            node.func.attr == "get" and node.args:
+        a0 = node.args[0]
+        return isinstance(a0, ast.Constant) and a0.value == "t"
+    return False
+
+
+def _collect_frames(ctx: FileContext):
+    """-> (sent {type: (line)}, handled {type: line})."""
+    sent: dict[str, int] = {}
+    handled: dict[str, int] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                if isinstance(k, ast.Constant) and k.value == "t" and \
+                        isinstance(v, ast.Constant) and \
+                        isinstance(v.value, str):
+                    sent.setdefault(v.value, node.lineno)
+        elif isinstance(node, ast.Compare):
+            sides = [node.left] + list(node.comparators)
+            if not any(_t_ish(s) for s in sides):
+                continue
+            for s in sides:
+                if isinstance(s, ast.Constant) and isinstance(s.value, str):
+                    handled.setdefault(s.value, node.lineno)
+                elif isinstance(s, (ast.Tuple, ast.List, ast.Set)):
+                    for el in s.elts:
+                        if isinstance(el, ast.Constant) and \
+                                isinstance(el.value, str):
+                            handled.setdefault(el.value, node.lineno)
+        elif isinstance(node, ast.Subscript) and \
+                isinstance(node.value, ast.Dict) and _t_ish(node.slice):
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    handled.setdefault(k.value, node.lineno)
+    return sent, handled
+
+
+def _protocol_version(ctx: FileContext) -> Optional[int]:
+    for node in ctx.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == "PROTOCOL_VERSION" and \
+                isinstance(node.value, ast.Constant):
+            return node.value.value
+    return None
+
+
+def compute_frame_inventory(ctxs: dict[str, FileContext]):
+    sent: dict[str, tuple[str, int]] = {}
+    handled: dict[str, tuple[str, int]] = {}
+    for rel in FRAME_MODULES:
+        ctx = ctxs.get(rel)
+        if ctx is None:
+            continue
+        s, h = _collect_frames(ctx)
+        for ty, line in s.items():
+            sent.setdefault(ty, (rel, line))
+        for ty, line in h.items():
+            handled.setdefault(ty, (rel, line))
+    return sent, handled
+
+
+@project_rule("GL006")
+def check_frame_parity(ctxs: dict[str, FileContext]) -> Iterable[Finding]:
+    present = [rel for rel in FRAME_MODULES if rel in ctxs]
+    if len(present) < len(FRAME_MODULES):
+        return []  # partial-tree lint (unit tests, single files)
+    sent, handled = compute_frame_inventory(ctxs)
+    findings: list[Finding] = []
+    for ty in sorted(set(sent) - set(handled)):
+        rel, line = sent[ty]
+        findings.append(Finding(
+            "GL006", rel, line, 0,
+            f'frame type "{ty}" is sent but no peer handles it '
+            f"(silently dropped on receive)"))
+    for ty in sorted(set(handled) - set(sent)):
+        rel, line = handled[ty]
+        findings.append(Finding(
+            "GL006", rel, line, 0,
+            f'frame type "{ty}" has a handler but no sender '
+            f"(dead handler, or the sender bypasses the scanned "
+            f"modules)"))
+
+    # version pinning
+    pctx = ctxs.get(PROTOCOL_FILE)
+    pv = _protocol_version(pctx) if pctx else None
+    frames = sorted(set(sent) | set(handled))
+    if pv is not None:
+        if not os.path.exists(FRAMES_MANIFEST):
+            findings.append(Finding(
+                "GL006", PROTOCOL_FILE, 1, 0,
+                "frame manifest missing; run `python -m tools.graftlint "
+                "--update-frames`"))
+        else:
+            with open(FRAMES_MANIFEST) as f:
+                manifest = json.load(f)
+            if manifest.get("frames") != frames:
+                if manifest.get("protocol_version") == pv:
+                    findings.append(Finding(
+                        "GL006", PROTOCOL_FILE, 1, 0,
+                        f"wire frame inventory changed but "
+                        f"PROTOCOL_VERSION is still {pv}; bump it "
+                        f"(core/protocol.py contract) and run "
+                        f"`python -m tools.graftlint --update-frames`"))
+                else:
+                    findings.append(Finding(
+                        "GL006", PROTOCOL_FILE, 1, 0,
+                        f"PROTOCOL_VERSION is {pv} but the frame "
+                        f"manifest was pinned at "
+                        f"{manifest.get('protocol_version')}; run "
+                        f"`python -m tools.graftlint --update-frames`"))
+    return findings
+
+
+def update_frames_manifest(ctxs: dict[str, FileContext]) -> dict:
+    missing = [rel for rel in FRAME_MODULES + (PROTOCOL_FILE,)
+               if rel not in ctxs]
+    if missing:
+        # re-pinning from a subtree would silently shrink the manifest
+        # to a partial inventory and break the GL006 gate for everyone
+        raise FileNotFoundError(
+            "--update-frames needs the full tree (run it over ray_tpu/); "
+            "missing: " + ", ".join(missing))
+    sent, handled = compute_frame_inventory(ctxs)
+    pctx = ctxs.get(PROTOCOL_FILE)
+    pv = _protocol_version(pctx) if pctx else None
+    manifest = {"protocol_version": pv,
+                "frames": sorted(set(sent) | set(handled))}
+    with open(FRAMES_MANIFEST, "w") as f:
+        json.dump(manifest, f, indent=1)
+        f.write("\n")
+    return manifest
+
+
+# --------------------------------------------------------------------- #
+# GL007 — metric naming + once-only registration
+# --------------------------------------------------------------------- #
+# Motivation: the head merges every process's series by NAME; names
+# outside the rtpu_(core|llm|serve)_ namespaces silently fall off the
+# dashboards and the metrics_summary() aggregations. Constructing a
+# Metric per call re-validates against the registry on a hot path —
+# construct at module scope or through cached_metric (llm/telemetry.py's
+# pattern).
+
+_METRIC_CTORS = ("Counter", "Gauge", "Histogram")
+_METRIC_NAME_RE = re.compile(r"^rtpu_(core|llm|serve)_[a-z0-9_]+$")
+_GL007_EXEMPT_FILES = ("ray_tpu/util/metrics.py",)
+
+
+def _metric_name_arg(node: ast.Call) -> Optional[ast.Constant]:
+    fn = _last_segment(dotted(node.func))
+    idx = None
+    if fn in _METRIC_CTORS:
+        idx = 0
+    elif fn == "cached_metric":
+        idx = 1
+    elif fn and any(s in fn.lower()
+                    for s in ("metric", "hist", "gauge", "counter")):
+        idx = 0
+    if idx is None:
+        return None
+    for kw in node.keywords:
+        if kw.arg == "name" and isinstance(kw.value, ast.Constant):
+            return kw.value
+    if len(node.args) > idx and isinstance(node.args[idx], ast.Constant):
+        return node.args[idx]
+    return None
+
+
+@file_rule("GL007")
+def check_metric_conventions(ctx: FileContext) -> Iterable[Finding]:
+    if ctx.relpath in _GL007_EXEMPT_FILES:
+        return []
+    findings: list[Finding] = []
+
+    # which Call nodes sit inside a function body?
+    in_func: set[int] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for ch in ast.walk(node):
+                if isinstance(ch, ast.Call):
+                    in_func.add(id(ch))
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = _last_segment(dotted(node.func))
+        name_node = _metric_name_arg(node)
+        if name_node is not None and isinstance(name_node.value, str):
+            name = name_node.value
+            strict = fn in _METRIC_CTORS or fn == "cached_metric"
+            if not _METRIC_NAME_RE.match(name) and (
+                    strict or name.startswith("rtpu_")):
+                findings.append(Finding(
+                    "GL007", ctx.relpath, node.lineno, node.col_offset,
+                    f'metric name "{name}" does not match '
+                    f"rtpu_(core|llm|serve)_[a-z0-9_]+"))
+        if fn in _METRIC_CTORS and id(node) in in_func:
+            findings.append(Finding(
+                "GL007", ctx.relpath, node.lineno, node.col_offset,
+                f"{fn}(...) constructed inside a function (per-call "
+                f"re-registration); construct at module scope or via "
+                f"cached_metric()"))
+    return findings
+
+
+# --------------------------------------------------------------------- #
+# GL008 — swallowed exceptions
+# --------------------------------------------------------------------- #
+# Motivation: daemon threads (recv loops, drop loops, flushers) and
+# actor loops die silently on an uncaught exception — and live wrongly
+# on an over-caught one. A bare `except:` eats KeyboardInterrupt/
+# SystemExit (it has stranded worker teardown before); a broad
+# `except Exception: pass` with no comment hides bugs from the one
+# person who will ever see them: the reader.
+
+_BROAD = ("Exception", "BaseException")
+
+
+def _is_silent_body(body: list[ast.stmt]) -> bool:
+    for stmt in body:
+        if isinstance(stmt, ast.Pass) or isinstance(stmt, ast.Continue):
+            continue
+        if isinstance(stmt, ast.Return) and (
+                stmt.value is None
+                or (isinstance(stmt.value, ast.Constant)
+                    and stmt.value.value is None)):
+            continue
+        return False
+    return True
+
+
+def _handler_types(node: ast.ExceptHandler) -> list[str]:
+    if node.type is None:
+        return []
+    elts = node.type.elts if isinstance(node.type, ast.Tuple) \
+        else [node.type]
+    return [_last_segment(dotted(e)) or "?" for e in elts]
+
+
+@file_rule("GL008")
+def check_swallowed_exceptions(ctx: FileContext) -> Iterable[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            findings.append(Finding(
+                "GL008", ctx.relpath, node.lineno, node.col_offset,
+                "bare `except:` catches KeyboardInterrupt/SystemExit; "
+                "use `except Exception` (with a comment) or narrower"))
+            continue
+        types = _handler_types(node)
+        if not any(t in _BROAD for t in types):
+            continue
+        if not _is_silent_body(node.body):
+            continue
+        end = max(getattr(s, "end_lineno", s.lineno) for s in node.body)
+        has_comment = any(ctx.comment_on(i)
+                          for i in range(node.lineno, end + 1))
+        if not has_comment:
+            findings.append(Finding(
+                "GL008", ctx.relpath, node.lineno, node.col_offset,
+                f"broad `except {'/'.join(types)}` silently swallowed; "
+                f"add a `# why` comment or handle/narrow it"))
+    return findings
