@@ -50,6 +50,9 @@ def main(quick: bool = False):
         def nop(self):
             return None
 
+        def step(self, x):
+            return x
+
     results = {}
 
     # --quick: few hundred ops per metric, control-plane metrics only
@@ -93,6 +96,44 @@ def main(quick: bool = False):
         for _ in range(n_gets):
             ray.get(ref, timeout=60)
     results["single_client_get_calls"] = (timed(n_gets, gets), 10841)
+
+    # await-based burst: refs awaited concurrently through the shared
+    # completion multiplexer (ObjectRef.__await__ -> core/completion.py)
+    # — tracks the async completion fast path the serve handles ride
+    import asyncio
+
+    n_await = 200 if quick else 1000
+
+    async def _await_burst():
+        await asyncio.gather(*[nop.remote() for _ in range(n_await)])
+
+    def await_burst():
+        asyncio.run(_await_burst())
+    results["async_burst"] = (timed(n_await, await_burst), 6787)
+
+    # compiled-DAG roundtrip vs the equivalent uncompiled actor chain:
+    # the "baseline" here is OUR OWN uncompiled rate measured in the same
+    # run, so vs_baseline is the compile speedup (acceptance bar: >= 2x)
+    from ray_tpu.dag import InputNode
+    d1, d2 = Actor.remote(), Actor.remote()
+    ray.get([d1.step.remote(0), d2.step.remote(0)], timeout=60)
+    n_dag = 100 if quick else 400
+
+    def chain():
+        for i in range(n_dag):
+            ray.get(d2.step.remote(d1.step.remote(i)), timeout=60)
+    uncompiled_rate = timed(n_dag, chain)
+    with InputNode() as inp:
+        out = d2.step.bind(d1.step.bind(inp))
+    cdag = out.experimental_compile(max_inflight=2)
+    cdag.execute(0).get()
+
+    def dag_loop():
+        for i in range(n_dag):
+            cdag.execute(i).get()
+    dag_rate = timed(n_dag, dag_loop)
+    cdag.teardown()
+    results["compiled_dag_roundtrip"] = (dag_rate, uncompiled_rate)
 
     if quick:
         ray.shutdown()
@@ -214,14 +255,23 @@ def main(quick: bool = False):
                           "error": str(e)[:200]}))
 
 
+# metrics whose vs_baseline is NOT a vs-reference ratio (self-relative
+# speedup, or a tracking scenario with no reference analog): reported,
+# but excluded from the worst-ratio gate line
+_NON_GATING = {"compiled_dag_roundtrip", "async_burst"}
+
+
 def _report(results):
     worst = 1e9
     for name, (value, base) in results.items():
         ratio = value / base
-        worst = min(worst, ratio)
+        if name not in _NON_GATING:
+            worst = min(worst, ratio)
         print(json.dumps({
             "metric": name, "value": round(float(value), 2),
-            "unit": "GiB/s" if "gigabytes" in name else "ops/s",
+            "unit": ("ops/s (vs uncompiled actor chain)"
+                     if name == "compiled_dag_roundtrip"
+                     else "GiB/s" if "gigabytes" in name else "ops/s"),
             "vs_baseline": round(ratio, 3),
         }))
     print(json.dumps({

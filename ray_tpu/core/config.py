@@ -197,6 +197,22 @@ _FLAGS = [
          "every submit inline"),
     Flag("rpc_pool_workers", 32,
          "threads serving worker->head RPCs (pg_wait parks here)"),
+    Flag("driver_submit_queue", True,
+         "in-process driver submits enqueue to the scheduler pump (one "
+         "lock acquisition + one scheduling pass per burst, v2-style "
+         "presumed interest) instead of taking the runtime lock per "
+         ".remote(); off restores per-call inline submission for "
+         "debugging — results must be identical either way"),
+    Flag("dag_sealed_channels", True,
+         "compiled-DAG edges ride sealed ring channels (futex wait on "
+         "{data, stop}, ack-object ring retirement, zero-copy reads "
+         "allowed) instead of the legacy delete-and-recreate polling "
+         "transport; off restores the polling transport — results must "
+         "be bit-identical either way"),
+    Flag("dag_ref_wait_executor", False,
+         "await ObjectRef falls back to the legacy one-thread-per-await "
+         "executor hop instead of the shared wait_sealed completion "
+         "multiplexer (debugging)"),
     Flag("task_records_max", 10000,
          "bounded task-state records kept for the state API"),
     Flag("timeline_events_max", 20000,
@@ -213,6 +229,16 @@ _FLAGS = [
          "listener pushes changes promptly"),
     Flag("serve_autoscale_period_s", 1.0,
          "controller reconcile/autoscale loop period"),
+    Flag("serve_static_decode_plan", True,
+         "streaming serve responses ride a sealed ring channel (replica "
+         "drains the generator into shm, the handle reads it directly: "
+         "zero control-plane dispatches per item in steady state) when "
+         "handle and replica share an object store; off (or no shared "
+         "store) falls back to per-chunk stream_next actor calls — "
+         "items must be identical either way"),
+    Flag("serve_stream_ring", 64,
+         "in-flight item bound of the static decode plan's ring channel "
+         "(producer blocks once this far ahead of the consumer)"),
     # ---- observability ----------------------------------------------- #
     Flag("metrics_export_port", 0,
          "Prometheus /metrics port (0 = ephemeral)"),

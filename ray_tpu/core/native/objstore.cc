@@ -551,6 +551,45 @@ int os_get(void* hv, const uint8_t* id, int64_t timeout_ms,
   }
 }
 
+// Stop-aware blocking get — the consumer half of a sealed ring channel
+// (ray_tpu/dag/channel.py). Like os_get, but a second `stop_id` aborts
+// the wait the INSTANT it seals: one native call both waits for the
+// message and watches teardown, so a channel read costs exactly what a
+// plain blocking get does (the old transport burned an extra
+// os_wait_sealed round-trip per message, measurable under cross-process
+// mutex contention). Data wins over a concurrent stop — consumers drain
+// what was produced, then observe the close.
+// Returns 0 ok (object pinned; caller must os_release), -1 timeout,
+// -2 would-block (timeout_ms == 0 and absent), -3 stop sealed and data
+// absent.
+int os_chan_get(void* hv, const uint8_t* id, const uint8_t* stop_id,
+                int64_t timeout_ms, uint64_t* offset, uint64_t* size) {
+  Handle* h = reinterpret_cast<Handle*>(hv);
+  struct timespec deadline = abs_deadline(timeout_ms);
+  lock(h);
+  while (true) {
+    ObjEntry* e = find(h, id);
+    if (e && e->state == kSealed) {
+      pin(e, h->pid);
+      e->lru_tick = ++h->hdr->lru_counter;
+      *offset = e->offset;
+      *size = e->size;
+      unlock(h);
+      return 0;
+    }
+    ObjEntry* s = find(h, stop_id);
+    if (s && s->state == kSealed) { unlock(h); return -3; }
+    if (timeout_ms == 0) { unlock(h); return -2; }
+    waiter_enter(h);  // BEFORE the seq load — see bump_seal_seq
+    uint32_t seq = __atomic_load_n(&h->hdr->seal_seq, __ATOMIC_SEQ_CST);
+    unlock(h);
+    int rc = futex_wait_abs(&h->hdr->seal_seq, seq, &deadline);
+    waiter_exit(h);
+    if (rc != 0 && errno == ETIMEDOUT) return -1;
+    lock(h);
+  }
+}
+
 // Multi-object wait: block until at least `min_count` of the `n` ids are
 // sealed in the store, or the timeout expires. out[i] is set to 1 once
 // id i has been OBSERVED sealed (sticky for the duration of the call —

@@ -51,6 +51,11 @@ class GetTimeoutError(TimeoutError):
     pass
 
 
+class ChannelStopped(Exception):
+    """A stop-aware channel get aborted: the stop flag sealed while
+    waiting and the data slot never arrived (dag/channel.py teardown)."""
+
+
 def _load_lib() -> ctypes.CDLL:
     try:
         lib = ctypes.CDLL(ensure_built())
@@ -84,6 +89,11 @@ def _load_lib() -> ctypes.CDLL:
     lib.os_wait_sealed.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint32,
         ctypes.c_int64, ctypes.c_char_p,
+    ]
+    lib.os_chan_get.restype = ctypes.c_int
+    lib.os_chan_get.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
     ]
     lib.os_seal_seq.restype = ctypes.c_uint32
     lib.os_seal_seq.argtypes = [ctypes.c_void_p]
@@ -510,17 +520,44 @@ class SharedObjectStore:
         re-raises stored exceptions. With cfg.zero_copy_get, large buffers
         come back as read-only views pinned in the store until their
         arrays are GC'd (plasma semantics). Pass zero_copy=False to force
-        the copy path — required by consume-once readers (DAG channels)
-        whose delete-then-recreate of the same id cannot tolerate a lazy,
-        pin-deferred delete."""
+        the copy path — required by LEGACY consume-once readers (polling
+        DAG channels) whose delete-then-recreate of the same id cannot
+        tolerate a lazy, pin-deferred delete; sealed ring channels never
+        reuse an id, so they read under the cfg default."""
+        view = self.get_raw(oid, timeout_ms)
+        if view is None:
+            raise GetTimeoutError(f"timed out waiting for {oid}")
+        return self._materialize(oid, view, zero_copy)
+
+    def get_chan(self, oid: ObjectID, stop_oid: ObjectID,
+                 timeout_ms: int = -1,
+                 zero_copy: Optional[bool] = None) -> Any:
+        """Stop-aware channel get (os_chan_get): one native blocking call
+        that wakes on either the data seal or the stop seal. Raises
+        ChannelStopped when the stop flag sealed and no data arrived;
+        otherwise behaves like get()."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        if timeout_ms < 0:
+            timeout_ms = 2**31  # ~24 days; effectively infinite
+        rc = self._lib.os_chan_get(self._handle(), oid.binary(),
+                                   stop_oid.binary(), timeout_ms,
+                                   ctypes.byref(off), ctypes.byref(size))
+        if rc == -3:
+            raise ChannelStopped(f"stop flag sealed while waiting for {oid}")
+        if rc != 0:
+            raise GetTimeoutError(f"timed out waiting for {oid}")
+        view = self._view[off.value:off.value + size.value]
+        return self._materialize(oid, view, zero_copy)
+
+    def _materialize(self, oid: ObjectID, view, zero_copy: Optional[bool]):
+        """Shared tail of get()/get_chan(): deserialize a pinned view and
+        manage the read pin across the copy and zero-copy paths."""
         from .config import cfg
         if zero_copy is None:
             # _PinnedBuffer needs __buffer__ (PEP 688, CPython >= 3.12);
             # older interpreters silently fall back to the copy path
             zero_copy = cfg.zero_copy_get and sys.version_info >= (3, 12)
-        view = self.get_raw(oid, timeout_ms)
-        if view is None:
-            raise GetTimeoutError(f"timed out waiting for {oid}")
         if not zero_copy:
             try:
                 return _parse_frame(view)

@@ -52,7 +52,14 @@ from __future__ import annotations
 # v3: client->head "batch" frames (adaptive flush buffer, see module
 #     docstring); multi-oid "ensure" remains but is now sent once up
 #     front for every missing ref of a bulk get/wait.
-PROTOCOL_VERSION = 3
+# v4: sealed ring channels (dag/channel.py). No NEW control frames, but
+#     two cross-build store contracts changed: the native store gained
+#     os_chan_get (stop-aware blocking get — an old-build worker's
+#     libobjstore lacks the symbol, and channel consumers rely on its
+#     stop-wake semantics), and serve's handle_request_streaming grew a
+#     `chan` argument whose dict reply an old-build handle would treat
+#     as a stream id. Same-build clusters only, as ever.
+PROTOCOL_VERSION = 4
 
 # Bump on any incompatible change to the sqlite snapshot contents.
 # v2: named-actor keys are namespace-qualified ("ns/name"); v1 snapshots

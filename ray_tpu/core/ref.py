@@ -138,32 +138,21 @@ class ObjectRef:
                     pass  # runtime gone: pickling for a dead cluster
         return (_deserialize_ref, (self._id.binary(),))
 
-    # Allow `await ref` inside async actors.
+    # Allow `await ref` inside async actors. One shared wait_sealed
+    # multiplexer thread resolves every awaited ref (core/completion.py)
+    # — no per-ref executor hop, and await latency stays flat as the
+    # in-flight count grows. get_running_loop (not the deprecated
+    # get_event_loop) so awaiting never mis-binds a foreign loop.
     def __await__(self):
-        from .api import get as _get
         import asyncio
 
-        def _resolve():
-            return _get(self)
-
-        loop = asyncio.get_event_loop()
-        return loop.run_in_executor(None, _resolve).__await__()
+        from .completion import async_future
+        loop = asyncio.get_running_loop()
+        return async_future(self, loop).__await__()
 
     def future(self):
-        import concurrent.futures
-
-        fut: concurrent.futures.Future = concurrent.futures.Future()
-
-        def _resolve():
-            from .api import get as _get
-            try:
-                fut.set_result(_get(self))
-            except BaseException as e:  # noqa: BLE001
-                fut.set_exception(e)
-
-        import threading
-        threading.Thread(target=_resolve, daemon=True).start()
-        return fut
+        from .completion import sync_future
+        return sync_future(self)
 
 
 def _deserialize_ref(binary: bytes) -> ObjectRef:

@@ -444,6 +444,25 @@ class Runtime:
         self._last_submit_ts = 0.0
         self._burst_window = (cfg.submit_burst_window_us / 1e6
                               if cfg.control_batching else 0.0)
+        # in-process driver submit fast path (the v2 "submit carries the
+        # submitter's interest" protocol applied to the LOCAL driver):
+        # .remote() appends the spec here and marks its return oids
+        # presumed; the scheduler pump registers interest and admits a
+        # whole burst under ONE lock acquisition + ONE scheduling pass,
+        # mirroring _handle_batch for remote clients. The driver thread
+        # itself never touches the runtime lock on the submit hot path.
+        self._submit_q: deque = deque()
+        self._submitq_on = bool(cfg.driver_submit_queue)
+        # live driver-ref counts for oids whose spec is still queued;
+        # migrated into _local_refs when the pump admits the spec
+        self._presumed: dict[ObjectID, int] = {}  # guarded by: self._presumed_lock
+        # oids whose every presumed ref died before the pump saw the
+        # spec: the pump must NOT register driver interest for them
+        self._dropped_early: set[ObjectID] = set()  # guarded by: self._presumed_lock
+        self._presumed_lock = threading.Lock()
+        # serializes queue drains so specs admit in FIFO order even when
+        # cancel() drains concurrently with the pump
+        self._submitq_drain_lock = threading.Lock()
         self._sched_evt = threading.Event()
         threading.Thread(target=self._sched_pump_loop, daemon=True,
                          name="rtpu-sched-pump").start()
@@ -800,16 +819,17 @@ class Runtime:
                 self._on_worker_death(wid)
 
     def _sched_pump_loop(self):
-        """Runs the scheduling passes that burst submissions defer
-        (submit_task): one pass drains every submit that accumulated
-        while the previous pass ran, so its per-worker dispatches
-        coalesce into one batched frame each."""
+        """Admits queued driver submits (one lock hold + one scheduling
+        pass per accumulated batch — see _drain_submit_q) and runs the
+        scheduling passes that deferred burst submissions request; a
+        burst's per-worker dispatches coalesce into one frame each."""
         while True:
             self._sched_evt.wait()
             self._sched_evt.clear()
             if self._shutdown:
                 return
             try:
+                self._drain_submit_q()
                 with self.lock:
                     self._schedule_locked()
             except Exception:
@@ -1526,6 +1546,15 @@ class Runtime:
     # -- refcounting (reference: reference_count.h:73) ---------------------
 
     def ref_created(self, oid: ObjectID, from_transfer: bool):
+        if not from_transfer:
+            # submit fast path: refs of a still-queued spec count under
+            # the (cheap, uncontended) presumed lock; the pump migrates
+            # the count and registers interest when it admits the spec
+            with self._presumed_lock:
+                c = self._presumed.get(oid)
+                if c is not None:
+                    self._presumed[oid] = c + 1
+                    return
         with self.lock:
             c = self._local_refs.get(oid, 0)
             self._local_refs[oid] = c + 1
@@ -1544,6 +1573,27 @@ class Runtime:
             if oid is None:
                 return
             try:
+                # presumed drops settle under the presumed lock ALONE
+                # (never nested inside self.lock here — the pump nests the
+                # other way around); a ref created before the pump admits
+                # its spec and dropped after is attributed here by oid,
+                # which can transiently mis-attribute when the same oid
+                # also has transfer-created refs — worst case a leaked
+                # interest entry, never a premature free
+                handled = False
+                with self._presumed_lock:
+                    c = self._presumed.get(oid)
+                    if c is not None:
+                        handled = True
+                        if c <= 1:
+                            self._presumed.pop(oid, None)
+                            # every local ref died before the pump saw
+                            # the spec: interest must never be registered
+                            self._dropped_early.add(oid)
+                        else:
+                            self._presumed[oid] = c - 1
+                if handled:
+                    continue
                 with self.lock:
                     c = self._local_refs.get(oid, 0) - 1
                     if c <= 0:
@@ -1673,7 +1723,86 @@ class Runtime:
         with self.lock:
             self.func_registry.setdefault(fid, blob)
 
+    def _queue_submit(self, kind: str, spec: TaskSpec) -> list[ObjectRef]:
+        """Driver submit fast path: mark the return oids presumed (their
+        ObjectRefs count under the presumed lock, not the runtime lock),
+        queue the spec, and wake the pump. Interest lands when the pump
+        admits the spec — BEFORE the task can run, the same guarantee
+        the v2 submit message gives remote clients."""
+        with self._presumed_lock:
+            for o in spec.return_ids:
+                self._presumed.setdefault(o, 0)
+        refs = [ObjectRef(o) for o in spec.return_ids]
+        self._submit_q.append((kind, spec))
+        self._sched_evt.set()
+        return refs
+
+    def _drain_submit_q(self):
+        """Admit every queued driver spec: one lock acquisition and one
+        deferred scheduling pass per batch (same shape as _handle_batch
+        for remote clients). Single drainer at a time so specs admit in
+        queue order (actor-call ordering depends on it)."""
+        with self._submitq_drain_lock:
+            while self._submit_q:
+                batch = []
+                # bounded batches: the first specs of a burst dispatch
+                # after a short admission pass (workers start while the
+                # rest of the burst admits), and done-processing recv
+                # threads never stall behind one long lock hold
+                while self._submit_q and len(batch) < 128:
+                    try:
+                        batch.append(self._submit_q.popleft())
+                    except IndexError:
+                        break
+                if not batch:
+                    return
+                with self.lock:
+                    opened = self._send_buf is None
+                    if opened:
+                        self._send_buf = {}
+                    self._defer_sched += 1
+                    try:
+                        for kind, spec in batch:
+                            try:
+                                self._admit_driver_spec_locked(kind, spec)
+                            except Exception:
+                                traceback.print_exc()
+                    finally:
+                        self._defer_sched -= 1
+                        try:
+                            if self._sched_wanted and not self._defer_sched:
+                                self._sched_wanted = False
+                                self._schedule_locked()
+                        finally:
+                            if opened:
+                                buf, self._send_buf = self._send_buf, None
+                                self._flush_wsend_buf(buf)
+
+    def _admit_driver_spec_locked(self, kind: str, spec: TaskSpec):
+        # migrate presumed ref counts into the lock-guarded table and
+        # register the driver's interest — exactly what _handle_msg
+        # "submit" does for a remote client's return oids
+        with self._presumed_lock:
+            settled = []
+            for oid in spec.return_ids:
+                cnt = self._presumed.pop(oid, None)
+                early = oid in self._dropped_early
+                self._dropped_early.discard(oid)
+                settled.append((oid, cnt, early))
+        for oid, cnt, early in settled:
+            if early:
+                continue  # every ref died pre-admission: no interest
+            if cnt:
+                self._local_refs[oid] = self._local_refs.get(oid, 0) + cnt
+            self._ref_add_locked(oid, "driver", False)
+        if kind == "actor":
+            self._submit_actor_task_locked(spec)
+        else:
+            self._submit_locked(spec)
+
     def submit_task(self, spec: TaskSpec) -> list[ObjectRef]:
+        if self._submitq_on and not self._shutdown:
+            return self._queue_submit("task", spec)
         with self.lock:
             # interest BEFORE the task can run: a fast task finishing
             # between submit and ref construction must not see an
@@ -2481,6 +2610,8 @@ class Runtime:
             self.cv.notify_all()
 
     def submit_actor_task_spec(self, spec: TaskSpec) -> list[ObjectRef]:
+        if self._submitq_on and not self._shutdown:
+            return self._queue_submit("actor", spec)
         with self.lock:
             refs = [ObjectRef(o) for o in spec.return_ids]  # interest first
             self._submit_actor_task_locked(spec)
@@ -2850,10 +2981,161 @@ class Runtime:
         single = isinstance(refs, ObjectRef)
         ref_list = [refs] if single else list(refs)
         deadline = None if timeout is None else time.monotonic() + timeout
+        if len(ref_list) > 1:
+            # bulk fast path: park in chunked wait_sealed calls (GIL
+            # released, one futex wait services whichever result seals
+            # first) until everything is readable, THEN materialize in
+            # order — instead of a blocking store.get per ref, each of
+            # which woke the driver on every unrelated seal
+            self._wait_all_present([r.id() for r in ref_list], deadline)
         out = []
         for r in ref_list:
             out.append(self._get_one(r.id(), deadline))
         return out[0] if single else out
+
+    def _sealed_is_exception(self, oid: ObjectID) -> bool:
+        """Peek a sealed object's frame flags without deserializing."""
+        view = self.store.get_raw(oid, timeout_ms=0)
+        if view is None:
+            return False
+        try:
+            from .object_store import _FLAG_EXCEPTION
+            return bool(view[0] & _FLAG_EXCEPTION)
+        finally:
+            del view
+            self.store.release(oid)
+
+    def _spilled_is_exception(self, oid: ObjectID) -> bool:
+        """Peek a spilled frame's flags byte (same wire framing)."""
+        try:
+            from .object_store import _FLAG_EXCEPTION
+            with open(self.spill._path(oid), "rb") as f:
+                b = f.read(1)
+            return bool(b and b[0] & _FLAG_EXCEPTION)
+        except OSError:
+            return False
+
+    def _satisfiable_elsewhere_locked(self, oid: ObjectID) -> bool:
+        """True when _get_one can resolve `oid` without a LOCAL seal:
+        spilled to disk, terminally failed, or a live remote copy that
+        the per-ref loop will pull over."""
+        e = self.directory.get(oid)
+        if e is None:
+            return False
+        if e.state in (SPILLED, FAILED):
+            return True
+        if e.state == READY and e.locations:
+            alive = {n.node_id.hex() for n in self.nodes.values()
+                     if n.alive}
+            return bool(e.locations & alive)
+        return False
+
+    def _wait_all_present(self, oids, deadline):
+        """Block until every oid the ordered materialization loop will
+        actually reach is readable (sealed locally, spilled, failed, or
+        pullable from a live remote copy). Sequential-get parity: a
+        stored task error at index j stops this wait from blocking on
+        anything at or past j — an error ahead of a never-completing ref
+        must surface now, not after the hang. Returns on deadline expiry
+        and leaves the per-ref timeout error to _get_one. The growing
+        slice only bounds how often directory states are re-checked and
+        evicted READY objects re-ensured; a seal wakes the wait
+        immediately regardless."""
+        flags = self.store.wait_sealed(oids, len(oids), 0)
+        missing = [(i, o) for i, (o, f) in enumerate(zip(oids, flags))
+                   if not f]
+        err_before = len(oids)
+        if missing:
+            with self.lock:
+                still = []
+                for i, o in missing:
+                    if not self._satisfiable_elsewhere_locked(o):
+                        still.append((i, o))
+                        continue
+                    e = self.directory.get(o)
+                    if e is not None and e.state == FAILED:
+                        # terminally failed with NO sealed/spilled frame
+                        # (e.g. a lost spill with no lineage): _get_one
+                        # raises here — never block past this index
+                        err_before = min(err_before, i)
+                missing = still
+        if not missing:
+            return
+        # index of the first already-errored ref: only the prefix before
+        # it must resolve before _get_one raises it in order. Peeked only
+        # now that we know we'd otherwise block, and only up to the last
+        # missing index.
+        miss_idx = {i for i, _ in missing}
+        for i in range(min(err_before, missing[-1][0])):
+            if i in miss_idx:
+                continue
+            present_err = (self._sealed_is_exception(oids[i]) if flags[i]
+                           else self._spilled_is_exception(oids[i]))
+            if present_err:
+                err_before = i
+                break
+        missing = [(i, o) for i, o in missing if i < err_before]
+        slice_ms = 10
+        next_ensure = 0.0
+        while missing:
+            if deadline is not None:
+                remain = deadline - time.monotonic()
+                if remain <= 0:
+                    return
+                slice_ms = min(slice_ms, max(1, int(remain * 1000)))
+            got = self.store.wait_sealed([o for _, o in missing],
+                                         len(missing), slice_ms)
+            now = time.monotonic()
+            do_ensure = now >= next_ensure
+            if do_ensure:
+                next_ensure = now + 0.2
+            still = []
+            with self.lock:
+                for (i, o), f in zip(missing, got):
+                    if f:
+                        if self._sealed_is_exception(o):
+                            err_before = min(err_before, i)
+                        continue
+                    if self._satisfiable_elsewhere_locked(o):
+                        e = self.directory.get(o)
+                        if (e is not None and e.state == FAILED) or \
+                                self._spilled_is_exception(o):
+                            err_before = min(err_before, i)
+                        continue
+                    if do_ensure:
+                        # evicted READY objects need lineage re-exec,
+                        # same as get() (object_recovery_manager.h:43)
+                        self._ensure_available_locked(o)
+                    still.append((i, o))
+                if do_ensure and still:
+                    self._schedule_locked()
+            missing = [(i, o) for i, o in still if i < err_before]
+            slice_ms = min(slice_ms * 2, 200)
+
+    def _mux_nudge(self, oid: ObjectID):
+        """Completion-mux recovery hook (core/completion.py): an awaited
+        oid stayed unsealed past the nudge window — re-ensure it (lineage
+        re-execution of evicted objects) and, when a live remote copy
+        exists, pull it off-thread so the mux never blocks on transfer
+        IO."""
+        pull = False
+        with self.lock:
+            e = self.directory.get(oid)
+            if e is None or e.state == PENDING:
+                # producer still running (the common case for a slow
+                # awaited task): nothing to recover, and a scheduling
+                # pass per nudge would just contend with the hot path
+                return
+            self._ensure_available_locked(oid)
+            e = self.directory.get(oid)
+            if e is not None and e.state == PENDING:
+                # the re-ensure requeued its lineage: run one pass so
+                # the reconstruction actually dispatches
+                self._schedule_locked()
+            elif e is not None and e.state == READY and e.locations:
+                pull = True
+        if pull:
+            self._rpc_pool.submit(self._fetch_remote, oid)
 
     def _recover_lost_spill(self, oid: ObjectID) -> None:
         """A SPILLED object's file is gone and no live node holds a copy:
@@ -2990,6 +3272,9 @@ class Runtime:
 
     def cancel(self, ref: ObjectRef, force: bool = False,
                recursive: bool = True):
+        # queued driver submits are invisible to the scans below: admit
+        # them first so a cancel-right-after-submit finds its task
+        self._drain_submit_q()
         with self.lock:
             # pending?
             for spec in list(self.pending):

@@ -490,6 +490,14 @@ class WorkerRuntime:
             missing = still
             slice_ms = min(slice_ms * 2, 200)
 
+    def _mux_nudge(self, oid: ObjectID):
+        """Completion-mux recovery hook (core/completion.py): an awaited
+        oid stayed unsealed past the nudge window — ask the head to make
+        it available (lineage re-exec of evicted objects) and try a
+        cross-node pull (throttled inside _try_fetch)."""
+        self.send({"t": "ensure", "oids": [oid.binary()]})
+        self._try_fetch(oid)
+
     _did_block = False
 
     def _block(self, flag: bool):
@@ -670,17 +678,26 @@ class WorkerRuntime:
                     raise exc.GetTimeoutError(
                         f"head rpc {method} timed out") from None
                 continue
-            try:
-                status, payload = self.store.get(reply, timeout_ms=100)
+            # event-driven: the reply's seal wakes this futex wait
+            # immediately (was: a 100ms store.get poll slice per pass);
+            # the bounded slice only re-arms against a reconnect-swapped
+            # store object
+            remain_ms = int((deadline - time.monotonic()) * 1000)
+            if remain_ms <= 0:
+                # let the head reclaim the reply if it lands later
+                self.send({"t": "rpc_abandon",
+                           "reply_oid": reply.binary()})
+                raise exc.GetTimeoutError(
+                    f"head rpc {method} timed out") from None
+            sealed = self.store.wait_sealed(
+                [reply], 1, min(1000, remain_ms))[0]
+            if sealed:
+                try:
+                    status, payload = self.store.get(reply, timeout_ms=0)
+                except StoreTimeout:
+                    continue  # evicted between seal and read: retry
                 self.store.delete(reply)
                 break
-            except StoreTimeout:
-                if time.monotonic() > deadline:
-                    # let the head reclaim the reply if it lands later
-                    self.send({"t": "rpc_abandon",
-                               "reply_oid": reply.binary()})
-                    raise exc.GetTimeoutError(
-                        f"head rpc {method} timed out") from None
         if status == "err":
             raise payload
         return payload

@@ -1,0 +1,272 @@
+"""Sealed ring channels — the event-driven shm transport behind compiled
+DAGs and the serve static decode plan.
+
+Protocol (replaces the delete-and-recreate polling transport):
+
+- A channel is a pair of 12-byte id *bases* (``data``, ``ack``); message
+  ``seq`` lives at ``ObjectID(base[:12] + uint32le(seq))``. Ids are unique
+  for the channel's lifetime (4B seqs), so a slot is never rewritten under
+  an id a stale reader might still pin — which is what makes **zero-copy**
+  reads safe here (the old transport recreated the SAME id every ring pass
+  and had to force the copy path; see store.get's zero_copy note).
+- The producer seals slot ``seq``; the consumer parks in ONE
+  ``os_wait_sealed`` futex wait over ``{data[seq], stop}`` and wakes the
+  instant either seals — no 100ms ``store.get`` poll slices, no
+  ``contains(stop)`` probe per slice.
+- After reading, the consumer deletes the data slot (lazy if zero-copy
+  views still pin it — harmless, the id is never reused).
+- **Backpressure** is credit-based and optional: a FREE-RUNNING producer
+  (serve decode streams) writing ``seq`` first waits on
+  ``{ack[seq - ring], stop}`` — the consumer seals the tiny ack object
+  for each message it reads — and deletes the observed ack; that retires
+  the ring position and bounds the channel to ``ring`` in-flight
+  messages without any delete-and-recreate. Driver-PACED pipelines
+  (compiled DAGs) skip acks entirely: the driver only feeds input ``n``
+  after draining output ``n - ring``, which already proves every edge
+  consumed ``n - ring`` (all nodes are ancestors of the output node).
+- Teardown seals ``stop`` in every participating store; every parked
+  wait in the channel wakes and raises :class:`ChannelClosed`.
+
+Cross-store edges: data pushes into the consumer's store and acks push
+back into the producer's (``object_transfer.push_object``); same-store
+edges are plain seals. Channel objects are invisible to the head's object
+directory on purpose — lifetime is fully owned by the seal/ack handshake.
+"""
+from __future__ import annotations
+
+import struct
+import time
+from typing import Any, Optional
+
+from ..core.ids import ObjectID
+
+# how long one futex park lasts before the waiter re-checks its deadline
+# and (optionally) its liveness callback; a seal/stop wakes it instantly
+# regardless, so this bounds failure detection latency, not throughput
+_WAIT_SLICE_MS = 500
+
+
+class ChannelClosed(Exception):
+    """The channel's stop flag sealed while waiting (teardown/cancel)."""
+
+
+def slot_oid(base: bytes, seq: int) -> ObjectID:
+    return ObjectID(base[:12] + struct.pack("<I", seq & 0xFFFFFFFF))
+
+
+def ack_base_for(base: bytes) -> bytes:
+    """The ack-channel id base paired with a data base (derived, so only
+    the data base needs plumbing through plans and channel specs)."""
+    import hashlib
+    return hashlib.sha1(base + b"/ack").digest()[:16]
+
+
+def _store_frame(store, oid: ObjectID, frame) -> None:
+    """Write a pre-serialized _FramedValue under `oid` (serialize once,
+    fan out to many targets)."""
+    buf = store.create_raw(oid, frame.total)
+    frame.write_into(buf)
+    del buf
+    store.seal(oid)
+
+
+def write_slot(store, base: bytes, seq: int, value: Any = None,
+               frame=None, push_addr: Optional[str] = None) -> None:
+    """Seal message `seq` into the channel. With `push_addr`, the value
+    lands in the remote store behind it (cross-store edge); `frame` is an
+    optional pre-built _FramedValue shared across fan-out targets."""
+    oid = slot_oid(base, seq)
+    if push_addr is not None:
+        from ..core.object_store import _FramedValue
+        from ..core.object_transfer import push_object
+        if frame is None:
+            frame = _FramedValue(value, False)
+        if not push_object(push_addr, oid, frame=frame):
+            raise RuntimeError(
+                f"channel push to {push_addr} rejected (store full?)")
+    elif frame is not None:
+        _store_frame(store, oid, frame)
+    else:
+        store.put(oid, value)
+
+
+def read_slot(store, base: bytes, seq: int, stop_oid: ObjectID,
+              timeout_s: Optional[float] = None,
+              zero_copy: Optional[bool] = None,
+              ack_base: Optional[bytes] = None,
+              ack_push_addr: Optional[str] = None, on_idle=None) -> Any:
+    """Consume message `seq`: block on {data, stop}, read, delete the
+    slot, optionally ack.
+
+    The block+read is ONE stop-aware native call (os_chan_get) — same
+    cost as a plain blocking get, and teardown wakes it instantly.
+    Raises ChannelClosed if the stop flag seals with no data present
+    (data wins over a concurrent stop: drain, then close). `on_idle`
+    runs between wait slices — liveness probes ("did the producing actor
+    die?") hook in there and may raise. The delete is lazy while
+    zero-copy views pin the payload — safe, the id is never reused.
+    With `ack_base`, the 1-byte ack for `seq` seals into the producer's
+    store (free-running producers need it for ring backpressure;
+    driver-paced DAGs don't — the output auto-drain already bounds every
+    edge to the ring)."""
+    from ..core.object_store import ChannelStopped, GetTimeoutError
+    oid = slot_oid(base, seq)
+    deadline = None if timeout_s is None else time.monotonic() + timeout_s
+    while True:
+        slice_ms = _WAIT_SLICE_MS if (on_idle is not None
+                                      or deadline is not None) else -1
+        if deadline is not None:
+            remain = deadline - time.monotonic()
+            if remain <= 0:
+                raise GetTimeoutError(
+                    f"timed out waiting for channel slot {seq}")
+            slice_ms = max(1, min(slice_ms, int(remain * 1000)))
+        try:
+            val = store.get_chan(oid, stop_oid, timeout_ms=slice_ms,
+                                 zero_copy=zero_copy)
+            break
+        except ChannelStopped:
+            raise ChannelClosed("channel stop flag sealed") from None
+        except GetTimeoutError:
+            if on_idle is not None:
+                on_idle()
+    store.delete(oid)
+    if ack_base is not None:
+        send_ack(store, ack_base, seq, ack_push_addr)
+    return val
+
+
+def send_ack(store, ack_base: bytes, seq: int,
+             push_addr: Optional[str] = None) -> None:
+    """Seal the 1-byte ack for `seq` into the producer's store."""
+    oid = slot_oid(ack_base, seq)
+    if push_addr is not None:
+        from ..core.object_transfer import push_object
+        push_object(push_addr, oid, value=True)
+        return
+    buf = store.create_raw(oid, 1)
+    buf[0:1] = b"\x01"
+    del buf
+    store.seal(oid)
+
+
+def await_ack(store, ack_base: bytes, seq: int, stop_oid: ObjectID,
+              timeout_s: Optional[float] = None, on_idle=None) -> None:
+    """Producer-side ring retirement: block until the consumer acked
+    `seq`, then delete the ack object. Raises ChannelClosed on stop."""
+    from ..core.object_store import GetTimeoutError
+    oid = slot_oid(ack_base, seq)
+    deadline = None if timeout_s is None else time.monotonic() + timeout_s
+    while True:
+        slice_ms = _WAIT_SLICE_MS
+        if deadline is not None:
+            remain = deadline - time.monotonic()
+            if remain <= 0:
+                raise GetTimeoutError(
+                    f"timed out waiting for channel ack {seq}")
+            slice_ms = max(1, min(slice_ms, int(remain * 1000)))
+        acked, stopped = store.wait_sealed([oid, stop_oid], 1, slice_ms)
+        if acked:
+            store.delete(oid)
+            return
+        if stopped:
+            raise ChannelClosed("channel stop flag sealed")
+        if on_idle is not None:
+            on_idle()
+
+
+def signal_stop(store, stop_oid: ObjectID) -> None:
+    """Seal the stop flag locally (idempotent): every parked channel wait
+    in this store wakes and raises ChannelClosed."""
+    try:
+        store.put(stop_oid, True)
+    except FileExistsError:
+        pass  # already stopped
+
+
+def drain_stale_slots(store, bases: list[bytes], lo: int, hi: int) -> None:
+    """Best-effort teardown sweep: delete any [lo, hi) slots still in the
+    local store for the given bases. The ack handshake bounds live slots
+    to the last ring positions, so callers pass a window, not the full
+    history."""
+    for base in bases:
+        for seq in range(max(0, lo), hi):
+            try:
+                store.delete(slot_oid(base, seq))
+            except Exception:
+                return  # store closing; slots die with it
+
+
+class RingWriter:
+    """Sequential producer end (serve decode streams; DAG edges use the
+    functional API since one loop step writes many channels)."""
+
+    def __init__(self, store, base: bytes, stop_oid: ObjectID, ring: int,
+                 push_addr: Optional[str] = None,
+                 ack_base: Optional[bytes] = None):
+        self.store = store
+        self.base = base
+        self.ack_base = ack_base if ack_base is not None \
+            else ack_base_for(base)
+        self.stop = stop_oid
+        self.ring = max(1, ring)
+        self.push_addr = push_addr
+        self.seq = 0
+
+    def closed(self) -> bool:
+        return self.store.contains(self.stop)
+
+    def write(self, value: Any, timeout_s: Optional[float] = None) -> None:
+        n = self.seq
+        if n >= self.ring:
+            await_ack(self.store, self.ack_base, n - self.ring, self.stop,
+                      timeout_s)
+        write_slot(self.store, self.base, n, value,
+                   push_addr=self.push_addr)
+        self.seq = n + 1
+
+
+class RingReader:
+    """Sequential consumer end."""
+
+    def __init__(self, store, base: bytes, stop_oid: ObjectID, ring: int,
+                 ack_push_addr: Optional[str] = None,
+                 zero_copy: Optional[bool] = None,
+                 ack_base: Optional[bytes] = None):
+        self.store = store
+        self.base = base
+        self.ack_base = ack_base if ack_base is not None \
+            else ack_base_for(base)
+        self.stop = stop_oid
+        self.ring = max(1, ring)
+        self.ack_push_addr = ack_push_addr
+        self.zero_copy = zero_copy
+        self.seq = 0
+
+    def read(self, timeout_s: Optional[float] = None, on_idle=None) -> Any:
+        val = read_slot(self.store, self.base, self.seq, self.stop,
+                        timeout_s, self.zero_copy, self.ack_base,
+                        self.ack_push_addr, on_idle)
+        self.seq += 1
+        return val
+
+    def retire(self) -> None:
+        """Call once the stream has ENDED (final sentinel consumed): the
+        producer wrote its last message at seq-1 and consumed acks only
+        up to seq-1-ring, so the trailing ring of ack objects this
+        reader sealed would otherwise leak one store entry each, every
+        stream. Local-store readers only (pushed acks live in the
+        producer's store, which sweeps on its own exit)."""
+        if self.ack_push_addr is None:
+            drain_stale_slots(self.store, [self.ack_base],
+                              self.seq - self.ring - 1, self.seq)
+
+    def close(self) -> None:
+        """Consumer-side cancel: seal the stop flag so the producer's
+        next ack wait (or stop probe) aborts the stream and sweeps its
+        window; also sweep the slots/acks around OUR cursor in case the
+        producer already exited normally and will never observe the
+        stop."""
+        signal_stop(self.store, self.stop)
+        drain_stale_slots(self.store, [self.base, self.ack_base],
+                          self.seq - self.ring - 1, self.seq + self.ring)

@@ -3,14 +3,20 @@
 See package docstring for the channel protocol. Compilation:
 
 1. topo-sort the graph; group ClassMethodNodes by owning actor;
-2. allocate channel id rings per cross-process edge (deterministic ids:
-   sha1(dag_id, producer, consumer) + slot byte);
-3. install one `_dag_actor_loop` per actor via `handle._exec` — a
-   long-running actor task stepping that actor's nodes in topo order
+2. allocate sealed ring channels per cross-process edge (deterministic id
+   bases: sha1(dag_id, producer, consumer); message ``seq`` maps to
+   ``base[:12] + uint32(seq)`` — see dag/channel.py for the seal/ack
+   protocol that retires ring positions without delete-and-recreate);
+3. install one ``_dag_actor_loop_sealed`` per actor via ``handle._exec``
+   — a long-running actor task stepping that actor's nodes in topo order
    (same-actor edges pass values in-process, no shm hop);
-4. `execute()` writes the input channels and returns a CompiledDAGRef
+4. ``execute()`` writes the input channels and returns a CompiledDAGRef
    over the output channel; the ring bounds in-flight executions
    (auto-draining the oldest when full).
+
+``cfg.dag_sealed_channels = False`` restores the legacy polling transport
+(consume-once slots, delete-and-recreate, 100ms poll slices, copies
+forced on every read) — results must be bit-identical either way.
 """
 from __future__ import annotations
 
@@ -21,6 +27,7 @@ from collections import deque
 from typing import Any, Optional
 
 from ..core.ids import ObjectID
+from . import channel as ch
 from .nodes import ClassMethodNode, DAGNode, InputNode
 
 _STOP = "__rtpu_dag_stop__"
@@ -32,8 +39,11 @@ def _slot_oid(base: bytes, slot: int) -> ObjectID:
 
 def _read_channel(store, oid: ObjectID, stop_oid: ObjectID,
                   timeout_s: Optional[float] = None):
-    """Blocking consume-once read: wait for the object, read, DELETE.
-    Returns _STOP if the stop flag appears while waiting."""
+    """LEGACY transport: blocking consume-once read — wait for the
+    object, read, DELETE. Returns _STOP if the stop flag appears while
+    waiting. Kept behind cfg.dag_sealed_channels=False as the
+    bit-identical fallback; the sealed-channel path replaces the poll
+    slices below with one futex wait over {data, stop}."""
     from ..core.object_store import GetTimeoutError
     deadline = None if timeout_s is None else time.monotonic() + timeout_s
     while True:
@@ -41,7 +51,8 @@ def _read_channel(store, oid: ObjectID, stop_oid: ObjectID,
             # zero_copy=False: a channel slot is deleted and RECREATED
             # under the same id each ring pass; a zero-copy pin would make
             # the delete lazy and the recreate collide or read stale data
-            val = store.get(oid, timeout_ms=100, zero_copy=False)
+            val = store.get(oid, timeout_ms=100,  # graftlint: disable=GL009
+                            zero_copy=False)
             store.delete(oid)
             return val
         except GetTimeoutError:
@@ -52,8 +63,8 @@ def _read_channel(store, oid: ObjectID, stop_oid: ObjectID,
 
 
 def _dag_actor_loop(instance, plan: list, stop_hex: str, max_inflight: int):
-    """Installed in each participating actor (via __rtpu_exec__): steps
-    this actor's nodes forever until the stop flag object appears."""
+    """LEGACY transport loop (cfg.dag_sealed_channels=False): steps this
+    actor's nodes forever until the stop flag object appears."""
     from ..core import runtime as rt_mod
     rt = rt_mod.get_runtime_if_exists()
     store = rt.store
@@ -71,8 +82,9 @@ def _dag_actor_loop(instance, plan: list, stop_hex: str, max_inflight: int):
                     args.append(val)
                 elif kind == "local":
                     args.append(local[val])
-                else:  # chan
-                    v = _read_channel(store, _slot_oid(val, slot), stop_oid)
+                else:  # chan: the edge's data base
+                    v = _read_channel(store, _slot_oid(val, slot),
+                                      stop_oid)
                     if v is _STOP:
                         return seq
                     args.append(v)
@@ -96,24 +108,83 @@ def _dag_actor_loop(instance, plan: list, stop_hex: str, max_inflight: int):
         seq += 1
 
 
+def _dag_actor_loop_sealed(instance, plan: list, stop_hex: str, ring: int):
+    """Sealed-channel transport loop: an in-edge read is one native get
+    when the slot is already sealed (the pipelined steady state), else
+    one futex wait over {data, stop} (dag/channel.py read_slot). No ack
+    traffic: the driver paces the whole pipeline (execute() drains output
+    seq-ring before feeding seq), which bounds every edge to the ring.
+    Values cross the DAG zero-copy when cfg.zero_copy_get allows."""
+    from . import channel as _ch
+    from ..core import runtime as rt_mod
+    rt = rt_mod.get_runtime_if_exists()
+    store = rt.store
+    stop_oid = ObjectID(bytes.fromhex(stop_hex))
+    seq = 0
+    try:
+        while True:
+            local: dict[int, Any] = {}
+            for step in plan:
+                args = []
+                for kind, val in step["args"]:
+                    if kind == "const":
+                        args.append(val)
+                    elif kind == "local":
+                        args.append(local[val])
+                    else:  # chan: the edge's data base
+                        args.append(_ch.read_slot(store, val, seq,
+                                                  stop_oid))
+                out = getattr(instance, step["method"])(*args)
+                local[step["idx"]] = out
+                outs = step["out_chans"]
+                if not outs:
+                    continue
+                frame = None   # serialize once, fan out to every target
+                if len(outs) > 1 or any(a is not None for _, a in outs):
+                    from ..core.object_store import _FramedValue
+                    frame = _FramedValue(out, False)
+                for base, addr in outs:
+                    _ch.write_slot(store, base, seq, out, frame=frame,
+                                   push_addr=addr)
+            seq += 1
+    except _ch.ChannelClosed:
+        return seq  # teardown: stop flag sealed while waiting
+
+
 class CompiledDAGRef:
     """Result handle for one execute() (reference: CompiledDAGRef).
-    get() consumes the output channel; repeated get() returns the cache."""
+    get() consumes the output channel; repeated get() returns the cache.
+    If a participating actor dies mid-loop, get() raises instead of
+    hanging (the liveness probe runs between wait slices)."""
 
-    def __init__(self, store, oid: ObjectID, stop_oid: ObjectID):
+    def __init__(self, store, oid: ObjectID, stop_oid: ObjectID,
+                 dag: Optional["CompiledDAG"] = None,
+                 seq: Optional[int] = None):
         self._store = store
-        self._oid = oid
+        self._oid = oid          # legacy transport slot (None when sealed)
         self._stop = stop_oid
+        self._dag = dag
+        self._seq = seq          # sealed transport message seq
         self._value: Any = None
         self._consumed = False
 
     def get(self, timeout_s: Optional[float] = 60.0):
-        if not self._consumed:
+        if self._consumed:
+            return self._value
+        if self._seq is None:
             v = _read_channel(self._store, self._oid, self._stop, timeout_s)
             if v is _STOP:
                 raise RuntimeError("compiled DAG was torn down")
-            self._value = v
-            self._consumed = True
+        else:
+            dag = self._dag
+            try:
+                v = ch.read_slot(self._store, dag.output_chan, self._seq,
+                                 self._stop, timeout_s,
+                                 on_idle=dag._probe_loops)
+            except ch.ChannelClosed:
+                raise RuntimeError("compiled DAG was torn down") from None
+        self._value = v
+        self._consumed = True
         return self._value
 
 
@@ -121,11 +192,13 @@ class CompiledDAG:
     def __init__(self, output_node: DAGNode, max_inflight: int = 2):
         import ray_tpu
         from ..core import runtime as rt_mod
+        from ..core.config import cfg
         self._rt = rt_mod.get_runtime_if_exists()
         if self._rt is None:
             raise RuntimeError("ray_tpu.init() first")
         self.store = self._rt.store
         self.max_inflight = max_inflight
+        self.sealed = bool(cfg.dag_sealed_channels)
         self.dag_id = os.urandom(8)
         self._seq = 0
         self._outstanding: deque[CompiledDAGRef] = deque()
@@ -161,7 +234,7 @@ class CompiledDAG:
             return hashlib.sha1(self.dag_id + tag.encode()).digest()[
                 :ObjectID.SIZE]
 
-        self.input_chans: list[bytes] = []
+        self.input_chans: list = []
         self.output_chan = chan_base("out")
         # per-actor plans
         plans: dict[bytes, list] = {}
@@ -202,14 +275,13 @@ class CompiledDAG:
                 s["out_chans"].append((self.output_chan, None))
 
         # ---- cross-store channel routing ------------------------------ #
-        # A consumer polls its node-LOCAL store, so the producer of every
-        # cross-store edge PUSHES the value into the consumer's store via
-        # the transfer service (reference: aDAG remote channels over RPC,
-        # local ones over shm — compiled_dag_node.py:808). Same-store
-        # edges stay plain store writes. Resolve placement by pinging each
-        # actor (forces scheduling), then mapping it to its node's data
-        # address (None = shares the driver's store).
-        from ..core import runtime as rt_mod
+        # A consumer waits on its node-LOCAL store, so the producer of
+        # every cross-store edge PUSHES the value into the consumer's
+        # store via the transfer service (reference: aDAG remote channels
+        # over RPC, local ones over shm — compiled_dag_node.py:808).
+        # Same-store edges stay plain store seals. Resolve placement by
+        # pinging each actor (forces scheduling), then mapping it to its
+        # node's data address (None = shares the driver's store).
         from ..core.ids import ActorID
         actor_addr: dict[bytes, Optional[str]] = {a: None for a in plans}
         head_addr: Optional[str] = None
@@ -227,26 +299,27 @@ class CompiledDAG:
                     if n is not None and n.own_store:
                         actor_addr[aid] = n.data_addr
 
-        def route(producer_addr: Optional[str],
-                  consumer_addr: Optional[str]) -> Optional[str]:
-            """Where the producer must place the value; None = its own
-            local store."""
-            target = consumer_addr if consumer_addr is not None else \
-                head_addr
-            own = producer_addr if producer_addr is not None else head_addr
+        def route(src_addr: Optional[str],
+                  dst_addr: Optional[str]) -> Optional[str]:
+            """Where a value produced on `src` must be placed to be
+            visible to `dst`; None = the producer's own local store."""
+            target = dst_addr if dst_addr is not None else head_addr
+            own = src_addr if src_addr is not None else head_addr
             return None if target == own else target
 
-        def consumer_addr(c) -> Optional[str]:
+        def addr_of(c) -> Optional[str]:
             return actor_addr[c] if c is not None else None
 
         for aid, plan in plans.items():
             for step in plan:
+                # data flows producer -> consumer store
                 step["out_chans"] = [
-                    (base, route(actor_addr[aid], consumer_addr(c)))
+                    (base, route(actor_addr[aid], addr_of(c)))
                     for base, c in step["out_chans"]]
-        # driver-side channel targets (driver writes/reads the head store)
+        # driver-side channels (driver writes inputs / reads the output
+        # against the head store)
         self.input_chans = [
-            (base, route(None, consumer_addr(c)))
+            (base, route(None, addr_of(c)))
             for base, c in self.input_chans]
         self._push_addrs = sorted({addr for addr in actor_addr.values()
                                    if addr is not None})
@@ -254,10 +327,31 @@ class CompiledDAG:
         # ---- install loops -------------------------------------------- #
         self._loop_refs = []
         for aid, plan in plans.items():
-            self._loop_refs.append(actors[aid]._exec(
-                _dag_actor_loop, plan, self.stop_oid.hex(), max_inflight))
+            if self.sealed:
+                self._loop_refs.append(actors[aid]._exec(
+                    _dag_actor_loop_sealed, plan, self.stop_oid.hex(),
+                    max_inflight))
+            else:
+                self._loop_refs.append(actors[aid]._exec(
+                    _dag_actor_loop, plan, self.stop_oid.hex(),
+                    max_inflight))
 
     # ------------------------------------------------------------------- #
+
+    def _probe_loops(self):
+        """Between wait slices: raise if any actor loop exited while the
+        DAG is live (actor death / a step raising) — a CompiledDAGRef
+        must never hang on a pipeline that can no longer produce."""
+        if self._torn_down:
+            return
+        import ray_tpu
+        ready, _ = ray_tpu.wait(self._loop_refs,
+                                num_returns=1, timeout=0)
+        if ready:
+            val = ray_tpu.get(ready[0])   # raises ActorDiedError & co.
+            raise RuntimeError(
+                f"compiled DAG actor loop exited mid-pipeline "
+                f"(returned {val!r}); tear the DAG down")
 
     def execute(self, value: Any) -> CompiledDAGRef:
         if self._torn_down:
@@ -265,11 +359,35 @@ class CompiledDAG:
         if len(self._outstanding) >= self.max_inflight:
             # ring full: auto-drain the oldest so slots recycle
             self._outstanding.popleft().get()
-        slot = self._seq % self.max_inflight
+        seq = self._seq
         self._seq += 1
+        if self.sealed:
+            ref = self._execute_sealed(seq, value)
+        else:
+            ref = self._execute_poll(seq, value)
+        self._outstanding.append(ref)
+        return ref
+
+    def _execute_sealed(self, seq: int, value: Any) -> CompiledDAGRef:
+        frame = None   # serialize once per execute, reuse across targets
+        if len(self.input_chans) > 1 or any(
+                a is not None for _, a in self.input_chans):
+            from ..core.object_store import _FramedValue
+            frame = _FramedValue(value, False)
+        # no ack wait: the auto-drain in execute() already proved every
+        # stage consumed seq - max_inflight (all nodes are ancestors of
+        # the drained output node), so this ring position is retired
+        for base, addr in self.input_chans:
+            ch.write_slot(self.store, base, seq, value, frame=frame,
+                          push_addr=addr)
+        return CompiledDAGRef(self.store, None, self.stop_oid,
+                              dag=self, seq=seq)
+
+    def _execute_poll(self, seq: int, value: Any) -> CompiledDAGRef:
+        slot = seq % self.max_inflight
         from ..core.object_store import _FramedValue
         from ..core.object_transfer import push_object
-        frame = None   # serialize once per execute, reuse across targets
+        frame = None
         for base, addr in self.input_chans:
             if addr is None:
                 self.store.put(_slot_oid(base, slot), value)
@@ -281,17 +399,15 @@ class CompiledDAG:
                     raise RuntimeError(
                         f"DAG input push to {addr} rejected "
                         "(consumer store full?)")
-        ref = CompiledDAGRef(self.store, _slot_oid(self.output_chan, slot),
-                             self.stop_oid)
-        self._outstanding.append(ref)
-        return ref
+        return CompiledDAGRef(self.store, _slot_oid(self.output_chan, slot),
+                              self.stop_oid)
 
     def teardown(self, timeout_s: float = 30.0):
         if self._torn_down:
             return
         self._torn_down = True
-        self.store.put(self.stop_oid, True)
-        # own-store actors poll their LOCAL stores for the flag
+        ch.signal_stop(self.store, self.stop_oid)
+        # own-store actors wait on their LOCAL stores for the flag
         from ..core.object_transfer import push_object
         for addr in self._push_addrs:
             try:
@@ -303,6 +419,16 @@ class CompiledDAG:
             ray_tpu.get(self._loop_refs, timeout=timeout_s)
         except Exception:
             pass  # loops may have errored; teardown continues
+        if self.sealed:
+            # sweep unconsumed slots (inputs never read, outputs never
+            # got) so a torn-down DAG leaves no channel objects behind in
+            # the store; the driver pacing bounds live slots to the
+            # trailing ring window
+            bases = [base for base, _ in self.input_chans]
+            bases.append(self.output_chan)
+            ch.drain_stale_slots(self.store, bases,
+                                 self._seq - 2 * self.max_inflight,
+                                 self._seq)
         try:
             self.store.delete(self.stop_oid)
         except Exception:

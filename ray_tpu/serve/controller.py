@@ -116,7 +116,8 @@ class ReplicaActor:
 
     async def handle_request_streaming(self, method: str, args: tuple,
                                        kwargs: dict,
-                                       context: Optional[dict] = None) -> int:
+                                       context: Optional[dict] = None,
+                                       chan: Optional[dict] = None):
         import time
         self._ongoing += 1
         self._total += 1
@@ -138,7 +139,84 @@ class ReplicaActor:
         self._stream_seq += 1
         sid = self._stream_seq
         self._streams[sid] = out
+        if chan is not None and self._start_stream_channel(sid, out, chan,
+                                                           context):
+            # static decode plan accepted: the caller reads items from
+            # the ring channel; no stream_next dispatches will follow
+            return {"chan": sid}
         return sid
+
+    def _start_stream_channel(self, sid: int, gen, chan: dict,
+                              context: Optional[dict]) -> bool:
+        """Serve this stream over a sealed ring channel: a drain thread
+        pulls the generator and seals each item into shm; the handle
+        reads them directly — zero control-plane dispatches per item
+        (reference analog: compiling the decode step into a static plan
+        instead of one stream_next RPC per chunk). Returns False when
+        this replica can't share a store with the caller (own-store
+        node) so the handle falls back to the poll transport."""
+        import os
+        if os.environ.get("RTPU_OWN_STORE") == "1":
+            return False
+        from ..core import runtime as rt_mod
+        from ..core.ids import ObjectID
+        from ..dag.channel import (ChannelClosed, RingWriter,
+                                   drain_stale_slots)
+        rt = rt_mod.get_runtime_if_exists()
+        store = getattr(rt, "store", None)
+        if store is None:
+            return False
+        import asyncio as _aio
+        import threading
+        loop = _aio.get_running_loop()
+        stop_oid = ObjectID(chan["stop"])
+        writer = RingWriter(store, chan["base"], stop_oid,
+                            int(chan["ring"]))
+        is_async = hasattr(gen, "__anext__")
+
+        def drain():
+            # items are counted by the CONSUMING handle (symmetric with
+            # the poll transport) — no replica-side inc, or the series
+            # would double
+            try:
+                while True:
+                    if writer.closed():
+                        break  # consumer cancelled: stop pulling
+                    try:
+                        if is_async:
+                            item = _aio.run_coroutine_threadsafe(
+                                gen.__anext__(), loop).result()
+                        else:
+                            item = next(gen)
+                    except (StopIteration, StopAsyncIteration):
+                        writer.write(("e", None))
+                        break
+                    except BaseException as e:  # noqa: BLE001 — shipped
+                        writer.write(("x", e))
+                        break
+                    writer.write(("i", item))
+            except ChannelClosed:
+                pass  # consumer cancelled mid-write
+            except Exception:
+                import traceback
+                traceback.print_exc()
+            finally:
+                try:
+                    # cancelled streams leave the stop flag and a ring
+                    # window of unread slots behind: sweep them
+                    if store.contains(stop_oid):
+                        drain_stale_slots(
+                            store,
+                            [chan["base"], writer.ack_base],
+                            writer.seq - int(chan["ring"]), writer.seq)
+                        store.delete(stop_oid)
+                except Exception:
+                    pass  # store closing: slots die with it
+                loop.call_soon_threadsafe(self._drop_stream, sid)
+
+        threading.Thread(target=drain, daemon=True,
+                         name=f"serve-stream-chan-{sid}").start()
+        return True
 
     async def stream_next(self, sid: int, max_items: int = 8):
         """(items, done): blocks for the FIRST item only, then takes up to

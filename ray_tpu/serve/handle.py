@@ -79,15 +79,103 @@ class DeploymentResponse:
         return gen()
 
 
+class ChannelResponseGenerator:
+    """Iterator over a streaming response served by the STATIC DECODE
+    PLAN: the replica drains its generator into a sealed ring channel
+    (dag/channel.py) and this end reads items straight out of shm —
+    zero control-plane dispatches per item in steady state (the only
+    actor calls are the setup and, when the stream goes quiet for a long
+    time, a liveness probe so a dead replica raises instead of hanging).
+    Falls out of DeploymentHandle.remote() when the replica shares the
+    caller's object store and cfg.serve_static_decode_plan is on."""
+
+    # probe the replica after this many idle 0.5s wait-slices in a row
+    # (a healthy but slow decode costs at most one probe dispatch per
+    # ~30s of silence — still amortized-zero)
+    _PROBE_IDLE_SLICES = 60
+
+    def __init__(self, replica, chan: dict, on_done, tags: dict):
+        from ..core import runtime as rt_mod
+        from ..core.ids import ObjectID
+        from ..dag.channel import RingReader
+        rt = rt_mod.get_runtime_if_exists()
+        self._replica = replica
+        self._reader = RingReader(rt.store, chan["base"],
+                                  ObjectID(chan["stop"]),
+                                  int(chan["ring"]))
+        self._on_done = on_done
+        self._tags = {**tags, "transport": "chan"}
+        self._done = False
+        self._idle = 0
+
+    def __iter__(self):
+        return self
+
+    def _probe(self):
+        self._idle += 1
+        if self._idle % self._PROBE_IDLE_SLICES:
+            return
+        import ray_tpu
+        try:
+            from . import metrics as sm
+            sm.stream_dispatches().inc(1.0, tags=self._tags)
+        except Exception:
+            pass  # telemetry must never fail a stream
+        ray_tpu.get(self._replica.stats.remote(), timeout=30)  # liveness
+
+    def __next__(self):
+        from ..dag.channel import ChannelClosed
+        if self._done:
+            raise StopIteration
+        try:
+            kind, payload = self._reader.read(on_idle=self._probe)
+        except ChannelClosed:
+            self._reader.retire()
+            self._settle()
+            raise StopIteration from None
+        self._idle = 0
+        if kind == "i":
+            try:
+                from . import metrics as sm
+                sm.stream_items().inc(1.0, tags=self._tags)
+            except Exception:
+                pass  # telemetry must never fail a stream
+            return payload
+        self._reader.retire()  # sweep the trailing ack ring (leak-free)
+        self._settle()
+        if kind == "x":
+            raise payload
+        raise StopIteration
+
+    def _settle(self):
+        if not self._done:
+            self._done = True
+            if self._on_done:
+                self._on_done()
+                self._on_done = None
+
+    def cancel(self):
+        if self._done:
+            return
+        # sealing the stop flag is the whole cancellation: the replica's
+        # drain thread observes it (its next write/closed() check) and
+        # sweeps the channel — no actor call, zero dispatches
+        self._reader.close()
+        self._settle()
+
+
 class DeploymentResponseGenerator:
     """Iterator over a streaming deployment response (reference:
     handle.py DeploymentResponseGenerator). Pulls batched chunks from the
-    replica-retained generator via stream_next."""
+    replica-retained generator via stream_next — the fallback transport
+    when the static decode plan can't engage (no shared store, or
+    cfg.serve_static_decode_plan off)."""
 
-    def __init__(self, replica, sid: int, on_done):
+    def __init__(self, replica, sid: int, on_done, tags=None):
         self._replica = replica
         self._sid = sid
         self._on_done = on_done
+        self._tags = {**(tags or {}), "transport": "poll"}
         self._buf: deque = deque()
         self._done = False
 
@@ -101,6 +189,14 @@ class DeploymentResponseGenerator:
                 raise StopIteration
             items, done = ray_tpu.get(
                 self._replica.stream_next.remote(self._sid))
+            try:
+                from . import metrics as sm
+                sm.stream_dispatches().inc(1.0, tags=self._tags)
+                if items:
+                    sm.stream_items().inc(float(len(items)),
+                                          tags=self._tags)
+            except Exception:
+                pass  # telemetry must never fail a stream
             self._buf.extend(items)
             if done:
                 self._done = True
@@ -295,6 +391,23 @@ class DeploymentHandle:
         return i if self._inflight.get(i, 0) <= self._inflight.get(j, 0) \
             else j
 
+    @staticmethod
+    def _make_chan_spec():
+        """Channel spec for the static decode plan, or None when it
+        can't engage from this process (flag off, no shm store — local
+        mode — or this caller sits on an own-store node and can't share
+        a store with a head-store replica)."""
+        if not _cfg.serve_static_decode_plan:
+            return None
+        from ..core import runtime as rt_mod
+        rt = rt_mod.get_runtime_if_exists()
+        if getattr(rt, "store", None) is None or \
+                getattr(rt, "own_store", False):
+            return None
+        import os
+        return {"base": os.urandom(16), "stop": os.urandom(16),
+                "ring": max(2, _cfg.serve_stream_ring)}
+
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         import ray_tpu
         t0 = time.perf_counter()
@@ -339,9 +452,22 @@ class DeploymentHandle:
 
         if self._stream:
             import ray_tpu
-            sid = ray_tpu.get(replica.handle_request_streaming.remote(
-                self._method, args, kwargs, context))
-            return DeploymentResponseGenerator(replica, sid, done)
+            tags = {"app": self.app_name, "deployment": self.deployment_name}
+            chan = self._make_chan_spec()
+            resp = ray_tpu.get(replica.handle_request_streaming.remote(
+                self._method, args, kwargs, context, chan))
+            try:
+                from . import metrics as sm
+                sm.stream_dispatches().inc(1.0, tags={
+                    **tags, "transport": "chan" if isinstance(resp, dict)
+                    else "poll"})
+            except Exception:
+                pass  # telemetry must never fail a request
+            if isinstance(resp, dict) and resp.get("chan") is not None:
+                # static decode plan engaged: items arrive over the ring
+                # channel, no per-chunk actor calls
+                return ChannelResponseGenerator(replica, chan, done, tags)
+            return DeploymentResponseGenerator(replica, resp, done, tags)
 
         def retry():
             self._refresh(force=True)

@@ -22,6 +22,10 @@ Metric names and label sets:
   rtpu_serve_autoscale_decisions_total{app,deployment,direction} counter
   rtpu_serve_batch_size{fn}                              histogram
   rtpu_serve_batch_wait_seconds{fn}                      histogram
+  rtpu_serve_stream_dispatches_total{app,deployment,transport} counter
+      (control-plane dispatches serving streams — the static decode
+      plan's "dispatches per token -> ~0" headline reads from this)
+  rtpu_serve_stream_items_total{app,deployment,transport} counter
 
 ``metrics_summary()`` condenses the merged store into finite p50/p95/p99
 latencies (TTFT, e2e, replica) plus the headline gauges/counters — the
@@ -97,6 +101,21 @@ def autoscale_decisions() -> Counter:
     return _metric(Counter, "rtpu_serve_autoscale_decisions_total",
                    "autoscaler retarget decisions",
                    tag_keys=("app", "deployment", "direction"))
+
+
+def stream_dispatches() -> Counter:
+    return _metric(Counter, "rtpu_serve_stream_dispatches_total",
+                   "control-plane dispatches (actor calls) made to serve "
+                   "streaming responses: setup + per-chunk pulls on the "
+                   "poll transport, setup + liveness probes only on the "
+                   "static decode plan (chan transport)",
+                   tag_keys=("app", "deployment", "transport"))
+
+
+def stream_items() -> Counter:
+    return _metric(Counter, "rtpu_serve_stream_items_total",
+                   "items delivered by streaming responses, by transport",
+                   tag_keys=("app", "deployment", "transport"))
 
 
 def batch_size() -> Histogram:
@@ -217,6 +236,22 @@ def metrics_summary() -> dict:
             "hit_rate": hits / (hits + misses),
             "cached_pages": cached,
         }
+    disp = store.get("rtpu_serve_stream_dispatches_total")
+    items = store.get("rtpu_serve_stream_items_total")
+    if disp or items:
+        by_transport: dict = {}
+        for rec, field in ((disp, "dispatches"), (items, "items")):
+            for kk, vv in (rec or {}).get("series", {}).items():
+                tr = next((v for k, v in kk if k == "transport"), "")
+                by_transport.setdefault(tr, {})[field] = \
+                    by_transport.get(tr, {}).get(field, 0.0) + vv
+        for tr, rec in by_transport.items():
+            n_items = rec.get("items", 0.0)
+            if n_items:
+                # the decode-plan headline: ~0 for "chan" in steady state
+                rec["dispatches_per_item"] = \
+                    rec.get("dispatches", 0.0) / n_items
+        out["stream"] = by_transport
     out["requests"] = {
         "proxy": _counter_total(
             store.get("rtpu_serve_proxy_requests_total")),

@@ -479,6 +479,74 @@ def test_gl008_file_suppression():
 
 
 # ------------------------------------------------------------------ #
+# GL009 short-slice seal polling
+# ------------------------------------------------------------------ #
+
+def test_gl009_short_get_slice_in_loop():
+    src = """
+        def read(store, oid, stop):
+            while True:
+                try:
+                    return store.get(oid, timeout_ms=100)
+                except TimeoutError:
+                    if store.contains(stop):
+                        return None
+    """
+    found = lint(src, rules={"GL009"})
+    assert len(found) == 1 and "wait_sealed" in found[0].message
+
+
+def test_gl009_sleep_probe_loop():
+    src = """
+        import time
+
+        def wait(store, oid):
+            while not store.contains(oid):
+                time.sleep(0.01)
+    """
+    found = lint(src, rules={"GL009"})
+    assert len(found) == 1 and "sleep(0.01)" in found[0].message
+
+
+def test_gl009_negatives():
+    # long re-check slices (spill/directory fallback cadence), plain
+    # sleeps with no store probe, non-blocking timeout_ms=0 probes, and
+    # dict .get() calls are all fine
+    src = """
+        import time
+
+        def ok(store, oid, objects):
+            while True:
+                try:
+                    return store.get(oid, timeout_ms=200)
+                except TimeoutError:
+                    pass
+            while objects.get(oid) is None:
+                time.sleep(0.001)
+
+        def probe(store, oid):
+            while True:
+                view = store.get(oid, timeout_ms=0)
+                if view is not None:
+                    return view
+                time.sleep(1.0)
+    """
+    assert lint(src, rules={"GL009"}) == []
+
+
+def test_gl009_suppression():
+    src = """
+        def legacy(store, oid):
+            while True:
+                try:
+                    return store.get(oid, timeout_ms=100)  # graftlint: disable=GL009
+                except TimeoutError:
+                    pass
+    """
+    assert lint(src, rules={"GL009"}) == []
+
+
+# ------------------------------------------------------------------ #
 # engine: baseline mechanics + CLI
 # ------------------------------------------------------------------ #
 

@@ -788,6 +788,85 @@ def _handler_types(node: ast.ExceptHandler) -> list[str]:
     return [_last_segment(dotted(e)) or "?" for e in elts]
 
 
+# --------------------------------------------------------------------- #
+# GL009 — short-slice seal polling where event-driven waits exist
+# --------------------------------------------------------------------- #
+# Motivation: the native store exposes event-driven seal notification
+# (os_wait_sealed multi-oid waits, os_chan_get stop-aware blocking get,
+# os_wait_seq) — a futex wake delivers a completion the instant it seals.
+# A `while` loop re-issuing `store.get(..., timeout_ms=<short>)` slices,
+# or sleeping briefly between `contains()` probes, burns a syscall + GIL
+# round-trip per slice and adds up to a slice of latency per message;
+# the compiled-DAG channel transport was rebuilt precisely to retire
+# this pattern. Long slices (>150ms) that exist to re-check out-of-band
+# state (spill files, directory entries, reconnect-swapped stores) are
+# NOT flagged — they are the documented fallback cadence, with the futex
+# still delivering the fast path.
+
+_GL009_MAX_SLICE_MS = 150
+_GL009_MAX_SLEEP_S = 0.25
+
+
+def _const_num(node: ast.AST):
+    if isinstance(node, ast.Constant) and isinstance(node.value, (int, float)):
+        return node.value
+    return None
+
+
+@file_rule("GL009")
+def check_seal_polling(ctx: FileContext) -> Iterable[Finding]:
+    findings: list[Finding] = []
+    def loop_calls(loop: ast.While) -> list[ast.Call]:
+        """Call nodes executed BY the loop body: nested function/lambda
+        bodies run elsewhere, so recurse without descending into them
+        (ast.walk can't prune, it flattens everything)."""
+        out: list[ast.Call] = []
+
+        def visit(node: ast.AST):
+            for child in ast.iter_child_nodes(node):
+                if _is_funcdef(child):
+                    continue
+                if isinstance(child, ast.Call):
+                    out.append(child)
+                visit(child)
+
+        visit(loop)
+        return out
+
+    for loop in ast.walk(ctx.tree):
+        if not isinstance(loop, ast.While):
+            continue
+        calls = loop_calls(loop)
+        has_contains = any(
+            isinstance(c.func, ast.Attribute) and c.func.attr == "contains"
+            for c in calls)
+        for c in calls:
+            if not isinstance(c.func, ast.Attribute):
+                continue
+            meth = c.func.attr
+            if meth == "get":
+                for kw in c.keywords:
+                    if kw.arg != "timeout_ms":
+                        continue
+                    v = _const_num(kw.value)
+                    if v is not None and 0 < v <= _GL009_MAX_SLICE_MS:
+                        findings.append(Finding(
+                            "GL009", ctx.relpath, c.lineno, c.col_offset,
+                            f"{meth}(timeout_ms={v:g}) retry slice inside "
+                            f"a while loop polls for a seal; use "
+                            f"wait_sealed / get_chan (futex wakes on "
+                            f"seal) and keep only long re-check slices"))
+            elif meth == "sleep" and has_contains and c.args:
+                v = _const_num(c.args[0])
+                if v is not None and 0 < v <= _GL009_MAX_SLEEP_S:
+                    findings.append(Finding(
+                        "GL009", ctx.relpath, c.lineno, c.col_offset,
+                        f"sleep({v:g}) between contains() probes polls "
+                        f"for a seal; use wait_sealed (futex wakes on "
+                        f"seal) instead of a sleep-probe loop"))
+    return findings
+
+
 @file_rule("GL008")
 def check_swallowed_exceptions(ctx: FileContext) -> Iterable[Finding]:
     findings: list[Finding] = []
