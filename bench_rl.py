@@ -1,0 +1,166 @@
+"""RL rollout benchmark: Sebulba env-steps/s scaling + transport A/B.
+
+Prints one JSON line per metric ({"metric", "value", "unit",
+"vs_baseline"} — the bench_core.py/bench_serve.py format):
+
+  rl_sebulba_env_steps_scaling   1 vs N env-runner actors on a
+      LATENCY-BOUND env (CartPole + a fixed per-step delay — the env
+      class actor scaling exists for: game servers / simulators whose
+      step time dominates; a pure-compute env on a small host measures
+      core count, not the substrate), medians over interleaved reps
+      (this box's perf swings, so only interleaved medians are
+      comparable); vs_baseline = ratio / 2.5 (the acceptance bar:
+      >= 2.5x from 1 -> 4 actors)
+  rl_fragment_transport_ab       sealed-channel RolloutQueue vs one
+      actor call per fragment, same runner count, interleaved;
+      vs_baseline = chan/actor env-steps/s ratio (>= 1 means the
+      channel plane pays for itself) — the unit string carries the
+      counter-verified dispatches/fragment for both transports
+  rl_anakin_env_steps            fused jitted env+update throughput on
+      the host mesh (tracking scenario, no reference baseline)
+
+``--quick``: fewer/shorter reps; same line format (wired into the test
+suite as a slow-marked smoke so the bench itself can't rot).
+"""
+import json
+import os
+import statistics
+import sys
+import time
+
+
+def _delayed_env():
+    """CartPole with a fixed per-step delay: stands in for the env class
+    Sebulba actor scaling targets (env servers, simulators, anything
+    whose step latency dominates the runner's loop)."""
+    import gymnasium as gym
+
+    class DelayedStep(gym.Wrapper):
+        def step(self, action):
+            time.sleep(0.002)
+            return self.env.step(action)
+
+    return DelayedStep(gym.make("CartPole-v1"))
+
+
+def _transport_counters():
+    from ray_tpu.rl.podracer import metrics_summary
+    out = {}
+    for tr, rec in metrics_summary().get("transport", {}).items():
+        out[tr] = (rec.get("fragments", 0.0), rec.get("dispatches", 0.0))
+    return out
+
+
+def run_sebulba(num_runners: int, transport: str, iters: int,
+                rollout_len: int = 32, num_envs: int = 4,
+                env=None) -> float:
+    """One measured Sebulba session: returns steady-state env-steps/s
+    (wall time over `iters` iterations, after a warmup iteration that
+    absorbs actor spawn + jit compile)."""
+    from ray_tpu.rl.podracer import SebulbaConfig, SebulbaTrainer
+    cfg = SebulbaConfig(
+        env=env if env is not None else "CartPole-v1",
+        num_env_runners=num_runners, num_envs_per_runner=num_envs,
+        rollout_len=rollout_len, ring=2, transport=transport,
+        runner_resources={"CPU": 0.25})
+    trainer = SebulbaTrainer(cfg)
+    try:
+        trainer.train(timeout_s=180)    # warmup: spawn + compile
+        t0 = time.perf_counter()
+        steps = 0
+        for _ in range(iters):
+            r = trainer.train(timeout_s=180)
+        steps = (r["num_env_steps_sampled_lifetime"]
+                 - num_runners * num_envs * rollout_len)
+        return steps / (time.perf_counter() - t0)
+    finally:
+        trainer.stop(timeout_s=10)
+
+
+def main(quick: bool = False):
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        # before any jax import: the anakin scenario shards over a
+        # virtual host mesh, like the test suite's 8-device CPU mesh
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import ray_tpu as ray
+    from ray_tpu.core.config import cfg as rcfg
+
+    reps = 2 if quick else 3
+    iters = 2 if quick else 6
+    scale_n = 4
+    rcfg.override(worker_prestart=scale_n)
+    ray.init(num_cpus=float(max(os.cpu_count() or 2, scale_n + 1)),
+             object_store_memory=512 << 20)
+
+    # ---- scaling: 1 vs N runners on the latency-bound env -------------- #
+    ones, ns = [], []
+    for _ in range(reps):
+        ones.append(run_sebulba(1, "chan", iters, env=_delayed_env))
+        ns.append(run_sebulba(scale_n, "chan", iters, env=_delayed_env))
+    m1, mn = statistics.median(ones), statistics.median(ns)
+    ratio = mn / max(m1, 1e-9)
+    print(json.dumps({
+        "metric": "rl_sebulba_env_steps_scaling",
+        "value": round(ratio, 3),
+        "unit": (f"x env-steps/s 1->{scale_n} env-runner actors, 2ms-step"
+                 f" env (1r={m1:.0f} sps, {scale_n}r={mn:.0f} sps; medians"
+                 f" of {reps} interleaved reps, {os.cpu_count()} host "
+                 f"cores)"),
+        "vs_baseline": round(ratio / 2.5, 3),
+    }))
+
+    # ---- transport A/B: sealed channel vs actor call per fragment ------ #
+    ab_runners = 2
+    chan, actor = [], []
+    before = _transport_counters()
+    for _ in range(reps):
+        chan.append(run_sebulba(ab_runners, "chan", iters))
+        actor.append(run_sebulba(ab_runners, "actor", iters))
+    after = _transport_counters()
+    mc, ma = statistics.median(chan), statistics.median(actor)
+
+    def dpf(tr: str) -> float:
+        f0, d0 = before.get(tr, (0.0, 0.0))
+        f1, d1 = after.get(tr, (0.0, 0.0))
+        return (d1 - d0) / max(f1 - f0, 1e-9)
+
+    print(json.dumps({
+        "metric": "rl_fragment_transport_ab",
+        "value": round(mc, 1),
+        "unit": (f"env-steps/s sealed-channel transport (actor-call="
+                 f"{ma:.0f} sps; dispatches/fragment chan={dpf('chan'):.3f}"
+                 f" vs actor={dpf('actor'):.3f}; {ab_runners} runners, "
+                 f"medians of {reps} interleaved reps)"),
+        "vs_baseline": round(mc / max(ma, 1e-9), 3),
+    }))
+    ray.shutdown()
+
+    # ---- anakin: fused jitted env+update on the host mesh -------------- #
+    try:
+        from ray_tpu.rl.podracer import AnakinConfig, AnakinTrainer
+        acfg = AnakinConfig(batch_per_device=8 if quick else 32,
+                            rollout_len=16)
+        tr = AnakinTrainer(acfg)
+        tr.train()                              # compile
+        rates = []
+        for _ in range(3 if quick else 10):
+            rates.append(tr.train()["env_steps_per_sec"])
+        rate = statistics.median(rates)
+        print(json.dumps({
+            "metric": "rl_anakin_env_steps",
+            "value": round(rate, 1),
+            "unit": (f"env-steps/s fused jitted env+update "
+                     f"({tr._num_devices}-device host mesh, "
+                     f"{acfg.batch_per_device} envs/device)"),
+            "vs_baseline": None,
+        }))
+    except Exception as e:  # noqa: BLE001 — the hedge must never fail the bench
+        print(json.dumps({"metric": "rl_anakin_env_steps", "value": None,
+                          "unit": "env-steps/s", "error": str(e)[:200]}))
+
+
+if __name__ == "__main__":
+    main(quick="--quick" in sys.argv[1:])

@@ -456,6 +456,16 @@ class SharedObjectStore:
             # immediately (seq moved); otherwise any seal/delete wakes us
             self._lib.os_wait_seq(self._handle(), seq, remain_ms)
 
+    def wait_sealed_indices(self, oids, min_count: int,
+                            timeout_ms: int) -> list[int]:
+        """wait_sealed, returning the INDICES observed sealed instead of
+        per-oid flags. The multi-producer fan-in consumers (rl rollout
+        queue over dag/channel.MultiRingReader) park in one of these over
+        {every producer's next slot, stop} and service whichever sealed —
+        the multi-oid analog of os_chan_get's {data, stop} pair."""
+        return [i for i, f in enumerate(
+            self.wait_sealed(oids, min_count, timeout_ms)) if f]
+
     def _wait_sealed_call(self, oids, min_count: int,
                           timeout_ms: int) -> list[bool]:
         n = len(oids)

@@ -1098,7 +1098,16 @@ class WorkerLoop:
                 try:
                     msg = self.conn.recv()
                 except (EOFError, OSError):
-                    return
+                    # head gone (SIGKILL/crash — not the graceful "exit"
+                    # frame). A plain return would hang interpreter
+                    # shutdown joining executor threads: long-lived actor
+                    # loops (compiled-DAG node loops, rl rollout
+                    # producers) park in channel waits whose stop flag
+                    # the dead head can never seal. Nothing left to
+                    # flush to — exit hard, never orphan the process.
+                    if _pre_exit_hook is not None:
+                        _pre_exit_hook()   # profiler dump (main() sets it)
+                    os._exit(0)
             if msg["t"] == "batch":
                 # one pipe write from the head's scheduling pass carrying
                 # several ordered control messages; they run BEFORE any

@@ -27,6 +27,12 @@ Protocol (replaces the delete-and-recreate polling transport):
 - Teardown seals ``stop`` in every participating store; every parked
   wait in the channel wakes and raises :class:`ChannelClosed`.
 
+- **Multi-producer fan-in** (:class:`MultiRingReader`): N producers each
+  own a (data, ack) base pair sharing one stop flag; the consumer parks
+  in ONE ``os_wait_sealed`` over {every producer's next slot, stop} and
+  services whichever seals first, acking per-producer so credit windows
+  stay independent (rl/podracer's RolloutQueue rides this).
+
 Cross-store edges: data pushes into the consumer's store and acks push
 back into the producer's (``object_transfer.push_object``); same-store
 edges are plain seals. Channel objects are invisible to the head's object
@@ -195,6 +201,110 @@ def drain_stale_slots(store, bases: list[bytes], lo: int, hi: int) -> None:
                 store.delete(slot_oid(base, seq))
             except Exception:
                 return  # store closing; slots die with it
+
+
+class MultiRingReader:
+    """Fan-in consumer over N independent ring channels sharing ONE stop
+    flag (multi-producer support: each producer owns its own (data, ack)
+    base pair, so per-producer seqs never interleave and a slot id is
+    still never reused). The consumer parks in ONE ``os_wait_sealed``
+    futex wait spanning every producer's next-expected slot plus the
+    stop flag and services whichever seals first — the multi-oid analog
+    of ``os_chan_get``'s {data, stop} pair, with the same semantics:
+    data wins over a concurrent stop (drain, then close).
+
+    Fairness: when several producers have a sealed slot in the same
+    wake, service rotates round-robin from the last producer served, so
+    a fast producer can't starve the rest. Backpressure stays
+    per-producer: each read acks into THAT producer's ack channel, so
+    one producer's credit window never throttles another's.
+    """
+
+    def __init__(self, store, bases: list[bytes], stop_oid: ObjectID,
+                 ring: int, zero_copy: Optional[bool] = None,
+                 ack_push_addrs: Optional[list] = None):
+        self.store = store
+        self.bases = list(bases)
+        self.ack_bases = [ack_base_for(b) for b in self.bases]
+        self.stop = stop_oid
+        self.ring = max(1, ring)
+        self.zero_copy = zero_copy
+        self.ack_push_addrs = (list(ack_push_addrs) if ack_push_addrs
+                               else [None] * len(self.bases))
+        self.seqs = [0] * len(self.bases)
+        self._rr = 0  # next producer index favoured by the rotation
+
+    def _slots(self) -> list[ObjectID]:
+        return [slot_oid(b, s) for b, s in zip(self.bases, self.seqs)]
+
+    def sealed_now(self) -> list[bool]:
+        """Non-blocking: which producers have their next slot sealed."""
+        return self.store.wait_sealed(self._slots(), 0, 0)
+
+    def depth(self) -> int:
+        """Sealed-but-unread messages across all producers, scanning each
+        producer's credit window (bounded: ring slots per producer).
+        Telemetry only — one bulk non-blocking wait_sealed probe."""
+        oids = [slot_oid(b, s + k)
+                for b, s in zip(self.bases, self.seqs)
+                for k in range(self.ring)]
+        return len(self.store.wait_sealed_indices(oids, 0, 0))
+
+    def read_any(self, timeout_s: Optional[float] = None,
+                 on_idle=None) -> tuple[int, Any]:
+        """Block until ANY producer's next message seals; consume it and
+        return ``(producer_index, value)``. Raises ChannelClosed when the
+        stop flag seals with no data pending, GetTimeoutError past the
+        deadline. ``on_idle`` runs between wait slices (liveness probes
+        — "did a producer actor die?" — hook in there and may raise)."""
+        from ..core.object_store import GetTimeoutError
+        deadline = None if timeout_s is None \
+            else time.monotonic() + timeout_s
+        n = len(self.bases)
+        while True:
+            oids = self._slots() + [self.stop]
+            slice_ms = _WAIT_SLICE_MS
+            if deadline is not None:
+                remain = deadline - time.monotonic()
+                if remain <= 0:
+                    raise GetTimeoutError(
+                        "timed out waiting for any rollout channel slot")
+                slice_ms = max(1, min(slice_ms, int(remain * 1000)))
+            sealed = self.store.wait_sealed(oids, 1, slice_ms)
+            ready = [i for i in range(n) if sealed[i]]
+            if ready:
+                # round-robin among the producers that are ready NOW
+                idx = min(ready, key=lambda i: (i - self._rr) % n)
+                self._rr = (idx + 1) % n
+                return idx, self._take(idx)
+            if sealed[n]:
+                raise ChannelClosed("channel stop flag sealed")
+            if on_idle is not None:
+                on_idle()
+
+    def _take(self, idx: int) -> Any:
+        """Consume producer `idx`'s next (already sealed) slot: read,
+        delete, ack — retiring its ring position."""
+        seq = self.seqs[idx]
+        oid = slot_oid(self.bases[idx], seq)
+        val = self.store.get(oid, timeout_ms=5000,
+                             zero_copy=self.zero_copy)
+        self.store.delete(oid)
+        send_ack(self.store, self.ack_bases[idx], seq,
+                 self.ack_push_addrs[idx])
+        self.seqs[idx] = seq + 1
+        return val
+
+    def close(self) -> None:
+        """Consumer-side teardown: seal the stop flag (every producer's
+        parked ack wait / closed() probe aborts) and sweep the slot and
+        ack windows around every cursor, in case a producer already
+        exited and will never observe the stop."""
+        signal_stop(self.store, self.stop)
+        for base, ack_base, seq in zip(self.bases, self.ack_bases,
+                                       self.seqs):
+            drain_stale_slots(self.store, [base, ack_base],
+                              seq - self.ring - 1, seq + self.ring)
 
 
 class RingWriter:

@@ -22,6 +22,25 @@ from .module import MLPConfig
 from .offline import (BC, BCConfig, CQL, CQLConfig, MARWIL,
                       MARWILConfig, collect_transitions)
 
+# Podracer (Sebulba/Anakin) exports resolve lazily (PEP 562): the
+# subsystem pulls gymnasium/optax (and jax via the learners) on USE, so
+# reaching the rest of ray_tpu.rl never pays for them and GL005's static
+# heavy-import closure of `import ray_tpu` stays green.
+_PODRACER_EXPORTS = (
+    "PodracerTrainer", "SebulbaConfig", "SebulbaTrainer",
+    "AnakinConfig", "AnakinTrainer", "RolloutQueue", "RolloutQueueSpec",
+    "JaxCartPole",
+)
+
+
+def __getattr__(name):
+    if name == "podracer" or name in _PODRACER_EXPORTS:
+        import importlib
+        mod = importlib.import_module(".podracer", __name__)
+        return mod if name == "podracer" else getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 __all__ = [
     "APPO", "AppoAlgorithmConfig", "AppoConfig", "AppoLearner",
     "Connector", "ConnectorPipeline", "FlattenObs", "ClipObs",
@@ -34,5 +53,5 @@ __all__ = [
     "PPO", "AlgorithmConfig", "EnvRunner", "make_gym_env",
     "PPOConfig", "PPOLearner", "compute_gae", "MLPConfig",
     "BC", "BCConfig", "CQL", "CQLConfig", "MARWIL", "MARWILConfig",
-    "collect_transitions",
+    "collect_transitions", "podracer", *_PODRACER_EXPORTS,
 ]

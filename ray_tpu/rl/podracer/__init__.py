@@ -1,0 +1,48 @@
+"""ray_tpu.rl.podracer — Podracer rollout substrate (Sebulba + Anakin).
+
+Reference: "Podracer architectures for scalable Reinforcement Learning"
+(PAPERS.md). Two architectures over the repo's actor/channel substrate:
+
+- **Sebulba** (sebulba.py): N vectorized env-runner actors stream
+  time-major rollout fragments into a multi-producer RolloutQueue built
+  on sealed ring channels (queue.py over dag/channel.MultiRingReader) —
+  zero control-plane dispatches per fragment in steady state; V-trace
+  corrects the behaviour-policy lag; weights broadcast runner-ward via
+  one objstore put per iteration.
+- **Anakin** (anakin.py): env step + update fused into ONE jitted
+  shard_map program over the mesh, for jittable envs (jax_env.py).
+
+``PodracerTrainer`` (trainer.py) drives either with CheckpointManager
+save/resume; telemetry.py's ``rtpu_rl_*`` series feed
+``metrics_summary()``.
+
+Lazy exports (PEP 562): importing this package must not pay for jax /
+gymnasium / optax — workers and the GL005 import-hygiene gate rely on
+``import ray_tpu`` (and cheap ``ray_tpu.rl`` subimports) staying light.
+"""
+import importlib
+
+_EXPORTS = {
+    "RolloutQueue": "queue", "RolloutQueueSpec": "queue",
+    "RolloutProducer": "queue", "ChannelClosed": "queue",
+    "SebulbaConfig": "sebulba", "SebulbaTrainer": "sebulba",
+    "SebulbaEnvRunner": "sebulba", "WeightBroadcast": "sebulba",
+    "WeightSubscriber": "sebulba",
+    "AnakinConfig": "anakin", "AnakinTrainer": "anakin",
+    "JaxCartPole": "jax_env",
+    "PodracerTrainer": "trainer",
+    "metrics_summary": "telemetry",
+}
+_MODULES = ("queue", "sebulba", "anakin", "jax_env", "telemetry",
+            "trainer")
+
+__all__ = list(_EXPORTS) + list(_MODULES)
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        mod = importlib.import_module(f".{_EXPORTS[name]}", __name__)
+        return getattr(mod, name)
+    if name in _MODULES:
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

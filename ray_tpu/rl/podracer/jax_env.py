@@ -1,0 +1,87 @@
+"""Jittable environments for the Anakin architecture.
+
+Anakin (PAPERS.md: "Podracer architectures for scalable Reinforcement
+Learning" §2) fuses env step + learner update into ONE jitted program,
+which requires the environment itself to be a pure JAX function. The
+protocol (duck-typed, no base class needed):
+
+    env.obs_dim     : int          flat observation size
+    env.num_actions : int          discrete action count
+    env.reset(key)  -> (state, obs)
+    env.step(state, action) -> (state, obs, reward, done)
+
+``state`` is a pytree carrying EVERYTHING mutable (physics, step count,
+PRNG key); both methods must be traceable (vmap/scan/jit-safe) and
+``step`` must AUTO-RESET when the episode ends — a terminated env in a
+vectorized batch immediately restarts, so the batch never blocks on
+episode boundaries (the Anakin convention; the returned ``done`` flag
+still marks the boundary for bootstrapping).
+
+``JaxCartPole`` is the reference implementation: the classic-control
+cart-pole (Barto, Sutton & Anderson 1983) with gymnasium's CartPole-v1
+constants, Euler integration and the 500-step truncation — so Anakin
+convergence numbers compare directly against the gym-based trainers.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class JaxCartPole:
+    """Pure-JAX CartPole-v1 (gymnasium-equivalent dynamics/limits)."""
+
+    gravity: float = 9.8
+    masscart: float = 1.0
+    masspole: float = 0.1
+    length: float = 0.5          # half the pole's length
+    force_mag: float = 10.0
+    tau: float = 0.02            # integration step, seconds
+    x_threshold: float = 2.4
+    theta_threshold: float = 0.20943951023931953   # 12 degrees
+    max_steps: int = 500         # CartPole-v1 truncation
+
+    obs_dim: int = 4
+    num_actions: int = 2
+
+    def _spawn(self, key):
+        import jax
+        return jax.random.uniform(key, (4,), minval=-0.05, maxval=0.05)
+
+    def reset(self, key):
+        import jax
+        key, sub = jax.random.split(key)
+        phys = self._spawn(sub)
+        import jax.numpy as jnp
+        state = {"phys": phys, "t": jnp.zeros((), jnp.int32), "key": key}
+        return state, phys
+
+    def step(self, state, action):
+        import jax
+        import jax.numpy as jnp
+        x, x_dot, theta, theta_dot = state["phys"]
+        force = jnp.where(action == 1, self.force_mag, -self.force_mag)
+        costheta, sintheta = jnp.cos(theta), jnp.sin(theta)
+        total_mass = self.masscart + self.masspole
+        polemass_length = self.masspole * self.length
+        temp = (force + polemass_length * theta_dot ** 2 * sintheta) \
+            / total_mass
+        thetaacc = (self.gravity * sintheta - costheta * temp) / (
+            self.length * (4.0 / 3.0
+                           - self.masspole * costheta ** 2 / total_mass))
+        xacc = temp - polemass_length * thetaacc * costheta / total_mass
+        phys = jnp.stack([x + self.tau * x_dot,
+                          x_dot + self.tau * xacc,
+                          theta + self.tau * theta_dot,
+                          theta_dot + self.tau * thetaacc])
+        t = state["t"] + 1
+        terminated = (jnp.abs(phys[0]) > self.x_threshold) \
+            | (jnp.abs(phys[2]) > self.theta_threshold)
+        done = terminated | (t >= self.max_steps)
+        # auto-reset: the batch never blocks on an episode boundary
+        key, sub = jax.random.split(state["key"])
+        fresh = self._spawn(sub)
+        phys = jnp.where(done, fresh, phys)
+        t = jnp.where(done, 0, t)
+        new_state = {"phys": phys, "t": t, "key": key}
+        return new_state, phys, jnp.float32(1.0), done

@@ -1,0 +1,168 @@
+"""RolloutQueue: multi-producer fragment transport on sealed ring channels.
+
+The Sebulba data plane (PAPERS.md: "Podracer architectures for scalable
+Reinforcement Learning" §3 — actor/learner split with rollout fragments
+streaming from many env-runner actors into the learner). Built on
+dag/channel.py's sealed-channel protocol + the os_wait_sealed multi-oid
+primitive (PR 3/5 machinery):
+
+- Each producer owns its own (data, ack) id-base pair; message ``seq``
+  seals at ``base[:12] + uint32(seq)`` — ids are never reused, so
+  zero-copy reads stay safe and nothing is delete-and-recreated.
+- The consumer parks in ONE futex wait spanning every producer's
+  next-expected slot plus the shared stop flag
+  (``dag.channel.MultiRingReader``) and services whichever seals first:
+  **zero control-plane dispatches per fragment** in steady state — the
+  only actor calls are the one loop-start per producer and teardown.
+- **Backpressure is credit-based per producer**: a producer writing
+  ``seq`` first waits on its own ``ack[seq - ring]``, so a slow learner
+  throttles sampling to the ring window instead of flooding the store,
+  and one stalled producer never steals another's credits.
+- Teardown seals the stop flag: every parked producer write and the
+  consumer wait wake instantly and sweep their slot/ack windows, so a
+  closed queue leaves the store at its pre-queue object count.
+
+Producers on own-store nodes cannot share the consumer's shm store; the
+producer constructor raises there so callers fall back to the actor-call
+transport (SebulbaConfig.transport="actor" — also the bench A/B).
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Any, Optional
+
+from ...core.ids import ObjectID
+from ...dag.channel import (ChannelClosed, MultiRingReader, RingWriter,
+                            drain_stale_slots)
+from . import telemetry as tm
+
+__all__ = ["RolloutQueueSpec", "RolloutQueue", "RolloutProducer",
+           "ChannelClosed"]
+
+
+@dataclasses.dataclass(frozen=True)
+class RolloutQueueSpec:
+    """Picklable wiring for one queue: ships to producer actors as a
+    plain value (the id bases ARE the channel — no handles to plumb)."""
+
+    bases: tuple  # one data id-base per producer
+    stop: bytes   # shared stop-flag oid bytes
+    ring: int     # per-producer credit window (in-flight fragments)
+
+    @classmethod
+    def create(cls, num_producers: int, ring: int = 2) -> "RolloutQueueSpec":
+        if num_producers < 1:
+            raise ValueError("need at least one producer")
+        return cls(bases=tuple(os.urandom(16) for _ in range(num_producers)),
+                   stop=os.urandom(16), ring=max(1, ring))
+
+    def stop_oid(self) -> ObjectID:
+        return ObjectID(self.stop[:ObjectID.SIZE])
+
+
+def _local_store():
+    from ...core import runtime as rt_mod
+    rt = rt_mod.get_runtime_if_exists()
+    store = getattr(rt, "store", None)
+    if store is None:
+        raise RuntimeError(
+            "rollout queue needs a running shm object store "
+            "(ray_tpu.init(); local_mode has none)")
+    return store
+
+
+class RolloutQueue:
+    """Consumer end (learner side). ``get()`` blocks in one futex wait
+    across all producers and returns ``(producer_index, fragment)``."""
+
+    def __init__(self, spec: RolloutQueueSpec, store=None):
+        self.spec = spec
+        self.store = store if store is not None else _local_store()
+        self._reader = MultiRingReader(self.store, list(spec.bases),
+                                       spec.stop_oid(), spec.ring)
+        self._closed = False
+
+    def get(self, timeout_s: Optional[float] = None,
+            on_idle=None) -> tuple[int, Any]:
+        """Next fragment from ANY producer (round-robin-fair among the
+        ready ones). Raises ChannelClosed after close(), GetTimeoutError
+        past the deadline; ``on_idle`` runs between wait slices — the
+        trainer's producer-liveness probe hooks in there so a dead
+        env-runner actor raises promptly instead of hanging the learner."""
+        t0 = time.perf_counter()
+        idx, val = self._reader.read_any(timeout_s, on_idle)
+        try:
+            tm.fragment_wait().observe(time.perf_counter() - t0,
+                                       tags={"transport": "chan"})
+            tm.fragments().inc(1.0, tags={"transport": "chan"})
+        except Exception:
+            pass  # telemetry must never fail the data plane
+        return idx, val
+
+    def depth(self) -> int:
+        """Sealed-but-unread fragments across producers (bounded probe:
+        ring slots per producer); also feeds the queue-depth gauge."""
+        d = self._reader.depth()
+        try:
+            tm.queue_depth().set(float(d))
+        except Exception:
+            pass  # telemetry must never fail the data plane
+        return d
+
+    def close(self) -> None:
+        """Seal the stop flag and sweep the consumer-side windows. Every
+        producer parked in a credit wait (and any in-flight ``get``)
+        wakes with ChannelClosed. Idempotent — a second call re-sweeps
+        the windows, which teardown uses to catch slots a straggling
+        producer sealed after the first sweep. Call ``release()`` only
+        once no producer can still be running (joined or force-killed)
+        to drop the stop object itself."""
+        self._closed = True
+        self._reader.close()
+
+    def release(self) -> None:
+        """Drop the stop flag object once every producer has observed it
+        (deleting it earlier would strand a producer's closed() probe)."""
+        try:
+            self.store.delete(self.spec.stop_oid())
+        except Exception:
+            pass  # store closing: the flag dies with it
+
+
+class RolloutProducer:
+    """Producer end, constructed INSIDE an env-runner actor from the
+    picklable spec. ``write()`` seals one fragment and blocks on the
+    producer's own credit window when the learner lags."""
+
+    def __init__(self, spec: RolloutQueueSpec, index: int, store=None):
+        if os.environ.get("RTPU_OWN_STORE") == "1":
+            raise RuntimeError(
+                "sealed-channel rollout transport needs a store shared "
+                "with the learner; this runner sits on an own-store node "
+                "— use SebulbaConfig(transport='actor')")
+        self.spec = spec
+        self.index = index
+        store = store if store is not None else _local_store()
+        self._writer = RingWriter(store, spec.bases[index],
+                                  spec.stop_oid(), spec.ring)
+        self._store = store
+
+    def write(self, fragment: Any,
+              timeout_s: Optional[float] = None) -> None:
+        """Seal the next fragment (raises ChannelClosed on teardown)."""
+        self._writer.write(fragment, timeout_s)
+
+    def closed(self) -> bool:
+        return self._writer.closed()
+
+    def sweep(self) -> None:
+        """Producer-exit cleanup: when the queue was torn down (stop
+        sealed), delete this producer's unconsumed slots and trailing
+        acks so nothing outlives the loop."""
+        w = self._writer
+        if self._store.contains(w.stop):
+            drain_stale_slots(self._store, [w.base, w.ack_base],
+                              w.seq - self.spec.ring - 1,
+                              w.seq + self.spec.ring)

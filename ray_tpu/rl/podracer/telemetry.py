@@ -1,0 +1,157 @@
+"""Podracer telemetry: rtpu_rl_* metrics + metrics_summary().
+
+TorchTitan-style training observability (PAPERS.md: "TorchTitan" §3.3 —
+throughput/MFU/comm logging as a first-class part of the trainer) over the
+repo's metric pipeline (ray_tpu.util.metrics): every series merges on the
+head and renders on /metrics with zero new transport, exactly like
+rtpu_llm_* / rtpu_serve_*.
+
+Metric names and label sets:
+  rtpu_rl_env_steps_total{arch}                counter (arch=sebulba|anakin)
+  rtpu_rl_fragments_total{transport}           counter (transport=chan|actor)
+  rtpu_rl_dispatches_total{transport}          counter — control-plane actor
+      calls the trainer issues for fragment delivery; the Sebulba
+      channel transport's headline is dispatches/fragment -> ~0 in
+      steady state (loop-start + teardown calls only), the actor-call
+      transport pays >= 1 per fragment (bench_rl.py A/B reads this)
+  rtpu_rl_fragment_wait_seconds{transport}     histogram — learner blocked
+      waiting for the next fragment (queue starvation signal)
+  rtpu_rl_queue_depth                          gauge — sealed-but-unread
+      fragments across all producers (sampled per iteration)
+  rtpu_rl_learner_update_seconds{arch}         histogram — one SGD update
+  rtpu_rl_weight_sync_lag_seconds              histogram — publish-to-consume
+      age of the params a fragment was sampled with
+  rtpu_rl_param_staleness                      histogram — how many weight
+      versions behind the learner a fragment's behaviour policy was
+      (the off-policy gap V-trace corrects; buckets 0..32)
+  rtpu_rl_weight_broadcasts_total              counter
+  rtpu_rl_checkpoints_total{kind}              counter (kind=save|restore)
+
+``metrics_summary()`` condenses the merged store into the numbers a run
+report cites (env steps/s needs a wall-clock denominator, so trainers
+report it in their result dicts; the summary exposes totals/quantiles).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ...util.metrics import (LATENCY_BUCKETS as _LAT, Counter, Gauge,
+                             Histogram, cached_metric as _metric,
+                             collect_store as _collect_store,
+                             histogram_stats as _hist_stats)
+
+# version-lag buckets: 0 = on-policy, small powers of two cover the
+# plausible lag of a credit-bounded queue (ring x producers)
+_STALENESS = (0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0)
+
+
+def env_steps() -> Counter:
+    return _metric(Counter, "rtpu_rl_env_steps_total",
+                   "environment steps sampled", tag_keys=("arch",))
+
+
+def fragments() -> Counter:
+    return _metric(Counter, "rtpu_rl_fragments_total",
+                   "rollout fragments delivered to the learner",
+                   tag_keys=("transport",))
+
+
+def dispatches() -> Counter:
+    return _metric(Counter, "rtpu_rl_dispatches_total",
+                   "control-plane actor calls issued for fragment "
+                   "delivery", tag_keys=("transport",))
+
+
+def fragment_wait() -> Histogram:
+    return _metric(Histogram, "rtpu_rl_fragment_wait_seconds",
+                   "learner time blocked waiting for a fragment",
+                   boundaries=_LAT, tag_keys=("transport",))
+
+
+def queue_depth() -> Gauge:
+    return _metric(Gauge, "rtpu_rl_queue_depth",
+                   "sealed-but-unread fragments across producers")
+
+
+def learner_update() -> Histogram:
+    return _metric(Histogram, "rtpu_rl_learner_update_seconds",
+                   "one learner SGD update", boundaries=_LAT,
+                   tag_keys=("arch",))
+
+
+def weight_sync_lag() -> Histogram:
+    return _metric(Histogram, "rtpu_rl_weight_sync_lag_seconds",
+                   "publish-to-consume age of a fragment's params",
+                   boundaries=_LAT)
+
+
+def param_staleness() -> Histogram:
+    return _metric(Histogram, "rtpu_rl_param_staleness",
+                   "weight versions behind the learner a fragment's "
+                   "behaviour policy was", boundaries=_STALENESS)
+
+
+def weight_broadcasts() -> Counter:
+    return _metric(Counter, "rtpu_rl_weight_broadcasts_total",
+                   "weight versions published runner-ward")
+
+
+def checkpoints() -> Counter:
+    return _metric(Counter, "rtpu_rl_checkpoints_total",
+                   "trainer checkpoint events", tag_keys=("kind",))
+
+
+# --------------------------------------------------------------------- #
+# summary
+# --------------------------------------------------------------------- #
+
+def _by_tag(rec: Optional[dict], tag: str) -> dict:
+    out: dict = {}
+    for key, val in (rec or {}).get("series", {}).items():
+        label = next((v for k, v in key if k == tag), "")
+        out[label] = out.get(label, 0.0) + val
+    return out
+
+
+def metrics_summary() -> dict:
+    """Condense the merged rtpu_rl_* store: per-transport fragment /
+    dispatch totals with the dispatches_per_fragment headline (~0 for
+    the Sebulba channel transport in steady state), env-step totals per
+    architecture, queue depth, and quantiles for fragment wait, learner
+    update, weight-sync lag and param staleness. Store merge + histogram
+    fold are the util/metrics.py helpers serve.metrics_summary() uses."""
+    store = _collect_store()
+    out: dict = {}
+    frags = _by_tag(store.get("rtpu_rl_fragments_total"), "transport")
+    disp = _by_tag(store.get("rtpu_rl_dispatches_total"), "transport")
+    if frags or disp:
+        transports: dict = {}
+        for tr in set(frags) | set(disp):
+            rec = {"fragments": frags.get(tr, 0.0),
+                   "dispatches": disp.get(tr, 0.0)}
+            if rec["fragments"]:
+                rec["dispatches_per_fragment"] = (
+                    rec["dispatches"] / rec["fragments"])
+            transports[tr] = rec
+        out["transport"] = transports
+    steps = _by_tag(store.get("rtpu_rl_env_steps_total"), "arch")
+    if steps:
+        out["env_steps"] = steps
+    rec = store.get("rtpu_rl_queue_depth")
+    if rec and rec["series"]:
+        out["queue_depth"] = max(rec["series"].values())
+    for key, name in (
+            ("fragment_wait", "rtpu_rl_fragment_wait_seconds"),
+            ("learner_update", "rtpu_rl_learner_update_seconds"),
+            ("weight_sync_lag", "rtpu_rl_weight_sync_lag_seconds"),
+            ("param_staleness", "rtpu_rl_param_staleness")):
+        stats = _hist_stats(store.get(name))
+        if stats is not None:
+            out[key] = stats
+    bcasts = _by_tag(store.get("rtpu_rl_weight_broadcasts_total"), "")
+    if bcasts:
+        out["weight_broadcasts"] = sum(bcasts.values())
+    ckpts = _by_tag(store.get("rtpu_rl_checkpoints_total"), "kind")
+    if ckpts:
+        out["checkpoints"] = ckpts
+    return out

@@ -1,0 +1,136 @@
+"""PodracerTrainer: one driver over both Podracer architectures.
+
+Wraps :class:`SebulbaTrainer` (actor/learner split over the rollout
+queue) or :class:`AnakinTrainer` (fused jitted env+update) — picked by
+config type — and adds the TorchTitan-style production loop (PAPERS.md:
+"TorchTitan" §3.2 checkpointing): periodic checkpoints through
+``train.CheckpointManager``, automatic resume from the latest checkpoint
+in ``storage_dir`` (kill the process mid-run, start a new trainer on the
+same directory, training continues from the last save), and the
+``rtpu_rl_*`` telemetry surfaced through
+``rl.podracer.metrics_summary()``.
+
+    cfg = SebulbaConfig(env="CartPole-v1", num_env_runners=4)
+    trainer = PodracerTrainer(cfg, storage_dir="/ckpts/run1",
+                              checkpoint_every=10)
+    result = trainer.fit(num_iterations=200, target_return=450)
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+from . import telemetry as tm
+from .anakin import AnakinConfig, AnakinTrainer
+from .sebulba import SebulbaConfig, SebulbaTrainer
+
+
+class PodracerTrainer:
+    def __init__(self, config: Any, storage_dir: Optional[str] = None,
+                 checkpoint_every: int = 10,
+                 num_to_keep: Optional[int] = 2,
+                 score_attribute: Optional[str] = None,
+                 resume: bool = True):
+        if isinstance(config, SebulbaConfig):
+            self.arch = "sebulba"
+            self._inner = SebulbaTrainer(config)
+        elif isinstance(config, AnakinConfig):
+            self.arch = "anakin"
+            self._inner = AnakinTrainer(config)
+        else:
+            raise TypeError(
+                f"config must be a SebulbaConfig or AnakinConfig, got "
+                f"{type(config).__name__}")
+        self.config = config
+        self.checkpoint_every = max(1, checkpoint_every)
+        self._last_saved = -1   # iteration of the newest checkpoint
+        self._manager = None
+        if storage_dir:
+            from ...train import CheckpointManager
+            self._manager = CheckpointManager(
+                storage_dir, num_to_keep=num_to_keep,
+                score_attribute=score_attribute)
+            if self._manager.scan_existing() and resume:
+                # newest first; a SIGKILL mid-write can leave a truncated
+                # checkpoint behind, so fall back until one loads
+                for ckpt, _ in reversed(self._manager.history):
+                    try:
+                        self._restore(ckpt)
+                        break
+                    except Exception:
+                        continue  # partial/corrupt checkpoint: try older
+
+    # -- training loop --------------------------------------------------- #
+
+    @property
+    def iteration(self) -> int:
+        return self._inner.iteration
+
+    def train(self) -> dict:
+        """One inner iteration + the periodic checkpoint."""
+        result = self._inner.train()
+        if self._manager is not None and \
+                self._inner.iteration % self.checkpoint_every == 0:
+            self.save(result)
+        return result
+
+    def fit(self, num_iterations: int,
+            target_return: Optional[float] = None) -> dict:
+        """Train until ``num_iterations`` TOTAL iterations have run
+        (resume-aware: a restored trainer only runs the remainder) or
+        the trailing mean return reaches ``target_return``. Saves a
+        final checkpoint for any progress not already covered by the
+        periodic one, returns the last result."""
+        result = {"training_iteration": self._inner.iteration}
+        while self._inner.iteration < num_iterations:
+            result = self.train()
+            ret = result.get("episode_return_mean")
+            if target_return is not None and ret is not None \
+                    and not math.isnan(ret) and ret >= target_return:
+                break
+        if self._manager is not None and \
+                self._last_saved != self._inner.iteration:
+            self.save(result)
+        return result
+
+    def evaluate(self, num_episodes: int = 5) -> dict:
+        if not hasattr(self._inner, "evaluate"):
+            raise NotImplementedError(
+                f"{self.arch} has no evaluation path")
+        return self._inner.evaluate(num_episodes)
+
+    # -- checkpointing --------------------------------------------------- #
+
+    def save(self, metrics: Optional[dict] = None):
+        """Checkpoint now (also called by the periodic hook). Returns
+        the managed Checkpoint."""
+        if self._manager is None:
+            raise RuntimeError("no storage_dir configured")
+        from ...train import Checkpoint
+        meta = {"arch": self.arch,
+                "iteration": self._inner.iteration}
+        for k, v in (metrics or {}).items():
+            if isinstance(v, (int, float, str)) and not (
+                    isinstance(v, float) and math.isnan(v)):
+                meta[k] = v
+        ckpt = Checkpoint.from_state(self._inner.save_state(),
+                                     metadata=meta)
+        managed = self._manager.register(ckpt, meta)
+        self._last_saved = self._inner.iteration
+        try:
+            tm.checkpoints().inc(1.0, tags={"kind": "save"})
+        except Exception:
+            pass  # telemetry must never fail a checkpoint
+        return managed
+
+    def _restore(self, ckpt) -> None:
+        self._inner.restore_state(ckpt.load_state())
+        self._last_saved = self._inner.iteration  # already on disk
+        try:
+            tm.checkpoints().inc(1.0, tags={"kind": "restore"})
+        except Exception:
+            pass  # telemetry must never fail a restore
+        self.restored_from = ckpt.path
+
+    def stop(self) -> None:
+        self._inner.stop()

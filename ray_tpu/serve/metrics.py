@@ -37,7 +37,8 @@ from typing import Optional
 
 from ..util.metrics import (LATENCY_BUCKETS as _LAT, Counter, Gauge,
                             Histogram, cached_metric as _metric,
-                            histogram_quantiles)
+                            collect_store as _um_collect_store,
+                            histogram_stats as _um_histogram_stats)
 
 _SIZES = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
 
@@ -134,46 +135,10 @@ def batch_wait() -> Histogram:
 # summary
 # --------------------------------------------------------------------- #
 
-def _collect_store() -> dict:
-    """The merged user-metric store: head tables on the head driver, the
-    user_metrics_dump RPC from a remote driver/worker, this process's
-    registry when no runtime exists (bench / unit tests)."""
-    from ..core import runtime as rt_mod
-    from ..util import metrics as um
-    um.flush()   # ship this process's deltas first
-    rt = rt_mod.get_runtime_if_exists()
-    if rt is None:
-        return um.local_store()
-    if isinstance(rt, rt_mod.Runtime):
-        with rt.lock:
-            return {n: {"kind": r["kind"], "desc": r["desc"],
-                        "series": dict(r["series"])}
-                    for n, r in rt.user_metrics.items()}
-    try:
-        return rt._rpc("user_metrics_dump")
-    except Exception:
-        return um.local_store()
-
-
-def _hist_stats(rec: Optional[dict]) -> Optional[dict]:
-    """Aggregate one histogram record across its label sets into
-    {count, mean, p50, p95, p99}."""
-    if not rec:
-        return None
-    buckets: dict[str, float] = {}
-    total_sum = 0.0
-    for key, val in rec["series"].items():
-        le = next((v for k, v in key if k == "le"), None)
-        if le is not None:
-            buckets[le] = buckets.get(le, 0.0) + val
-        elif any(k == "__sum__" for k, _ in key):
-            total_sum += val
-    count = buckets.get("+Inf", 0.0)
-    if count <= 0:
-        return None
-    p50, p95, p99 = histogram_quantiles(buckets, count, (0.5, 0.95, 0.99))
-    return {"count": count, "mean": total_sum / count,
-            "p50": p50, "p95": p95, "p99": p99}
+# the store merge + histogram fold are shared with rl.podracer's
+# summary; the canonical implementations live in util/metrics.py
+_collect_store = _um_collect_store
+_hist_stats = _um_histogram_stats
 
 
 def _counter_total(rec: Optional[dict]) -> float:

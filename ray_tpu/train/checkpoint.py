@@ -241,6 +241,38 @@ class CheckpointManager:
         self._prune()
         return managed
 
+    def scan_existing(self) -> int:
+        """Rebuild ``history`` from ``checkpoint_*`` directories already
+        present in managed storage — the kill-and-resume path: a
+        restarted driver pointed at the same ``storage_dir`` picks up
+        ``latest`` and continues instead of starting over (TorchTitan's
+        checkpointer does the same dir scan on boot). Metrics come back
+        from each checkpoint's metadata (empty when absent); ``_seq``
+        continues past the highest index so new registrations never
+        reuse a directory name. Returns how many were found."""
+        found: list[tuple[int, Checkpoint]] = []
+        for p in fsutil.list_dirs(self._fs, self._fs_dir):
+            name = p.rstrip("/").rsplit("/", 1)[-1]
+            if not name.startswith("checkpoint_"):
+                continue
+            try:
+                seq = int(name.split("_", 1)[1])
+            except ValueError:
+                continue
+            found.append((seq, Checkpoint(fsutil.join(self.dir, name),
+                                          filesystem=self._filesystem)))
+        for seq, ckpt in sorted(found, key=lambda sc: sc[0]):
+            try:
+                meta = ckpt.metadata()
+            except Exception:
+                # a crash mid-write can truncate metadata.json; the
+                # checkpoint still lists (its restore path decides
+                # whether the STATE loads — see PodracerTrainer resume)
+                meta = {}
+            self.history.append((ckpt, meta))
+            self._seq = max(self._seq, seq + 1)
+        return len(found)
+
     @property
     def latest(self) -> Optional[Checkpoint]:
         return self.history[-1][0] if self.history else None

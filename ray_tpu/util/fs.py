@@ -123,6 +123,14 @@ def list_files(fs_, path: str) -> list[str]:
                   if i.type == pafs.FileType.File)
 
 
+def list_dirs(fs_, path: str) -> list[str]:
+    """Immediate subdirectories of a directory (sorted full paths)."""
+    from pyarrow import fs as pafs
+    sel = pafs.FileSelector(path, recursive=False, allow_not_found=True)
+    return sorted(i.path for i in fs_.get_file_info(sel)
+                  if i.type == pafs.FileType.Directory)
+
+
 def _is_glob(s: str) -> bool:
     return any(c in s for c in "*?[")
 

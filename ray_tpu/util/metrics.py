@@ -335,6 +335,50 @@ def histogram_quantiles(buckets: dict, total: float,
     return out
 
 
+def collect_store() -> dict:
+    """The merged user-metric store: head tables on the head driver, the
+    user_metrics_dump RPC from a remote driver/worker, this process's
+    registry when no runtime exists (bench / unit tests). The shared
+    entry point behind serve.metrics_summary() and
+    rl.podracer.metrics_summary()."""
+    from ..core import runtime as rt_mod
+    flush()   # ship this process's deltas first
+    rt = rt_mod.get_runtime_if_exists()
+    if rt is None:
+        return local_store()
+    if isinstance(rt, rt_mod.Runtime):
+        with rt.lock:
+            return {n: {"kind": r["kind"], "desc": r["desc"],
+                        "series": dict(r["series"])}
+                    for n, r in rt.user_metrics.items()}
+    try:
+        return rt._rpc("user_metrics_dump")
+    except Exception:
+        return local_store()
+
+
+def histogram_stats(rec: Optional[dict]) -> Optional[dict]:
+    """Fold one head-store histogram record (cumulative le buckets +
+    __sum__ rows, summed across label sets) into
+    {count, mean, p50, p95, p99}; None when absent/empty."""
+    if not rec:
+        return None
+    buckets: dict[str, float] = {}
+    total_sum = 0.0
+    for key, val in rec["series"].items():
+        le = next((v for k, v in key if k == "le"), None)
+        if le is not None:
+            buckets[le] = buckets.get(le, 0.0) + val
+        elif any(k == "__sum__" for k, _ in key):
+            total_sum += val
+    count = buckets.get("+Inf", 0.0)
+    if count <= 0:
+        return None
+    p50, p95, p99 = histogram_quantiles(buckets, count, (0.5, 0.95, 0.99))
+    return {"count": count, "mean": total_sum / count,
+            "p50": p50, "p95": p95, "p99": p99}
+
+
 def _esc_label(v: str) -> str:
     return str(v).replace("\\", "\\\\").replace("\"", "\\\"") \
         .replace("\n", "\\n")

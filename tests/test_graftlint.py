@@ -426,6 +426,36 @@ def test_gl007_suppression():
     assert lint(src, rules={"GL007"}) == []
 
 
+def test_gl007_rl_namespace_allowed():
+    """The rl workload's telemetry namespace is first-class: rtpu_rl_*
+    passes, while a lookalike (rtpu_rlx_) or a bare rl_ prefix still
+    fails — the allowlist is exact namespaces, not a prefix match."""
+    src = """
+        from ray_tpu.util.metrics import Counter, Histogram, cached_metric
+
+        OK1 = Counter("rtpu_rl_env_steps_total", tag_keys=("arch",))
+        OK2 = Histogram("rtpu_rl_fragment_wait_seconds",
+                        boundaries=(0.1, 1.0))
+
+        def ok_cached():
+            return cached_metric(Counter, "rtpu_rl_fragments_total")
+    """
+    assert lint(src, rules={"GL007"}) == []
+
+
+def test_gl007_rl_namespace_lookalikes_rejected():
+    src = """
+        from ray_tpu.util.metrics import Counter, cached_metric
+
+        BAD1 = Counter("rtpu_rlx_env_steps_total")
+        BAD2 = cached_metric(Counter, "rl_env_steps_total")
+        BAD3 = Counter("rtpu_rl_BadCase_total")
+    """
+    found = lint(src, rules={"GL007"})
+    assert len(found) == 3
+    assert all("does not match" in f.message for f in found)
+
+
 # ------------------------------------------------------------------ #
 # GL008 swallowed exceptions
 # ------------------------------------------------------------------ #

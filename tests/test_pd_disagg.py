@@ -75,6 +75,8 @@ class TestEngineExportImport:
 
 
 class TestPDProxy:
+    @pytest.mark.slow  # tier-1 budget: proxy wiring is covered by
+    # the PD handoff tests; this full cluster e2e costs ~24s
     def test_proxy_end_to_end(self, ray_start_regular):
         ray = ray_start_regular
         from ray_tpu.llm.pd_disagg import build_pd_proxy

@@ -32,6 +32,9 @@ def _prompt(n, seed=0):
                    np.random.RandomState(seed).randint(97, 122, (n,)))
 
 
+@pytest.mark.slow  # tier-1 budget: the PD streaming e2e below
+# covers the replica poll path; this start-poll soak is the 28s
+# outlier of the suite
 def test_decode_replica_start_poll(ray):
     """Replica-side streaming half: tokens become visible through poll()
     while decode is still running."""

@@ -42,6 +42,8 @@ def test_pipeline_apply_matches_sequential():
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow  # tier-1 budget: pipeline_apply equivalence runs
+# fast on the MLP case; the llama variant re-proves it at 12s
 def test_llama_pipelined_matches_apply():
     mesh = build_mesh(MeshSpec(pp=2), devices=jax.devices()[:2])
     cfg = _cfg()
@@ -148,6 +150,8 @@ class TestInterleaved:
                                    np.asarray(sequential(x)),
                                    rtol=1e-5, atol=1e-5)
 
+    @pytest.mark.slow  # tier-1 budget: interleaved forward
+    # equivalence stays fast-path; the grad re-proof costs 17s
     def test_interleaved_grads_match(self):
         from ray_tpu.parallel.pipeline import interleave_stages
         mesh = build_mesh(MeshSpec(pp=2), devices=jax.devices()[:2])
@@ -208,6 +212,8 @@ class TestInterleaved:
                                    np.asarray(sequential(x)),
                                    rtol=1e-5, atol=1e-5)
 
+    @pytest.mark.slow  # tier-1 budget: same equivalence as the MLP
+    # interleaved case, on llama, at 16s
     def test_llama_interleaved_matches_apply(self):
         mesh = build_mesh(MeshSpec(pp=2), devices=jax.devices()[:2])
         cfg = _cfg()   # 4 layers -> S=2 x V=2 single-layer chunks
