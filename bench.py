@@ -100,6 +100,39 @@ def _probe_accelerator(timeout_s: float = 90.0) -> bool:
         return False
 
 
+def trace_arg(argv) -> "str | None":
+    """Shared --trace <out.json> parsing for the bench CLIs."""
+    if "--trace" in argv:
+        i = argv.index("--trace")
+        if i + 1 < len(argv):
+            return argv[i + 1]
+    return None
+
+
+def flight_report(trace_out, trace_t0) -> None:
+    """Shared bench --trace tail: export the flight recording of the
+    measured section (cluster-stitched when a runtime is up, local ring
+    otherwise) and print the wait/dispatch breakdown JSON line next to
+    the throughput numbers. No-op unless --trace was given; never fails
+    the bench."""
+    if not trace_out:
+        return
+    try:
+        from ray_tpu.core import flight
+        from ray_tpu.core import runtime as rt_mod
+        rt = rt_mod.get_runtime_if_exists()
+        rep = flight.capture_report(rt, trace_t0, trace_out)
+        print(json.dumps({
+            "metric": "flight_trace",
+            "out": trace_out,
+            "events": rep["events"],
+            "wait_s": rep["wait_s"],
+            "counts": rep["counts"],
+        }))
+    except Exception as e:  # noqa: BLE001 — tracing must not fail a bench
+        print(json.dumps({"metric": "flight_trace", "error": str(e)[:200]}))
+
+
 def repin_jax_platforms():
     """Honor JAX_PLATFORMS after import: the axon sitecustomize
     overrides the jax config (not the env var) at import time, so CPU

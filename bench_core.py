@@ -24,7 +24,7 @@ def timed(n, fn):
     return n / (time.perf_counter() - t0)
 
 
-def main(quick: bool = False):
+def main(quick: bool = False, trace_out: str | None = None):
     import ray_tpu as ray
 
     # size the pool to the machine: on few-core hosts extra workers just
@@ -65,6 +65,10 @@ def main(quick: bool = False):
     # most of the core from any timed section (wall 3x cpu measured)
     ray.get([nop.remote() for _ in range(20)], timeout=120)
     time.sleep(0.5 if quick else 3.0)
+
+    # --trace: flight-record the measured section (everything after the
+    # warmup) and report the wait/dispatch breakdown with the numbers
+    trace_t0 = time.monotonic_ns() if trace_out else None
 
     # single client tasks sync
     def tasks_sync():
@@ -136,6 +140,7 @@ def main(quick: bool = False):
     results["compiled_dag_roundtrip"] = (dag_rate, uncompiled_rate)
 
     if quick:
+        _flight_report(trace_out, trace_t0)
         ray.shutdown()
         _report(results)
         return
@@ -214,6 +219,7 @@ def main(quick: bool = False):
         timed(1, bcast) * 256 / 1024, 1.0)
     col.destroy_collective_group("bench")
 
+    _flight_report(trace_out, trace_t0)
     ray.shutdown()
 
     _report(results)
@@ -255,6 +261,15 @@ def main(quick: bool = False):
                           "error": str(e)[:200]}))
 
 
+def _flight_report(trace_out, trace_t0):
+    """--trace out.json: export the measured section's flight recording
+    and print the wait/dispatch breakdown (shared bench.flight_report)."""
+    if not trace_out:
+        return  # keep the default path free of bench.py's jax import
+    from bench import flight_report
+    flight_report(trace_out, trace_t0)
+
+
 # metrics whose vs_baseline is NOT a vs-reference ratio (self-relative
 # speedup, or a tracking scenario with no reference analog): reported,
 # but excluded from the worst-ratio gate line
@@ -284,4 +299,10 @@ def _report(results):
 
 if __name__ == "__main__":
     import sys
-    main(quick="--quick" in sys.argv[1:])
+    argv = sys.argv[1:]
+    out = None
+    if "--trace" in argv:
+        # lazy: importing bench pulls jax; only pay it when tracing
+        from bench import trace_arg
+        out = trace_arg(argv)
+    main(quick="--quick" in argv, trace_out=out)

@@ -21,6 +21,10 @@ Prints one JSON line per metric ({"metric", "value", "unit",
 
 ``--quick``: fewer/shorter reps; same line format (wired into the test
 suite as a slow-marked smoke so the bench itself can't rot).
+
+``--trace out.json``: flight-record the measured cluster section
+(core/flight.py — fragment seal/wake, credit waits, weight pub/fetch)
+and print a wait/dispatch breakdown line; opens in Perfetto.
 """
 import json
 import os
@@ -94,6 +98,7 @@ def main(quick: bool = False):
     rcfg.override(worker_prestart=scale_n)
     ray.init(num_cpus=float(max(os.cpu_count() or 2, scale_n + 1)),
              object_store_memory=512 << 20)
+    trace_t0 = time.monotonic_ns()
 
     # ---- scaling: 1 vs N runners on the latency-bound env -------------- #
     ones, ns = [], []
@@ -136,6 +141,8 @@ def main(quick: bool = False):
                  f"medians of {reps} interleaved reps)"),
         "vs_baseline": round(mc / max(ma, 1e-9), 3),
     }))
+    from bench import flight_report, trace_arg
+    flight_report(trace_arg(sys.argv), trace_t0)
     ray.shutdown()
 
     # ---- anakin: fused jitted env+update on the host mesh -------------- #

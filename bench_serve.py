@@ -17,6 +17,10 @@ telemetry the engines recorded via ray_tpu.util.metrics during the burst.
 requests sharing one long system prompt with varied tails, caching on vs
 off; reports hit rate, prompt tokens saved, and the TTFT delta the cache
 buys (paged_engine.py enable_prefix_caching).
+
+``--trace out.json``: flight-record the measured section (core/flight.py)
+and print a wait/dispatch breakdown JSON line next to the numbers; the
+trace file opens in Perfetto/chrome://tracing.
 """
 import json
 import sys
@@ -87,6 +91,7 @@ def main():
                for i in range(n_requests)]
     sp = SamplingParams(max_tokens=max_tokens)
 
+    trace_t0 = time.monotonic_ns()
     t0 = time.perf_counter()
     reqs = [eng.submit(p, sp) for p in prompts]
     while not all(r.done for r in reqs):
@@ -110,6 +115,9 @@ def main():
         from ray_tpu.serve.metrics import metrics_summary
         print(json.dumps({"metric": "serve_metrics_summary",
                           "value": metrics_summary()}, default=str))
+
+    from bench import flight_report, trace_arg
+    flight_report(trace_arg(sys.argv), trace_t0)
 
     _pd_interference(model, cfg, rng, max_tokens, prompt_lens, on_tpu)
 
@@ -180,9 +188,12 @@ def _shared_prefix():
         outs = [list(r.out_ids) for r in reqs]
         return ttfts[len(ttfts) // 2], wall, eng.pool_stats(), outs
 
+    trace_t0 = time.monotonic_ns()
     p50_on, wall_on, st, outs_on = run(True)
     p50_off, wall_off, _, outs_off = run(False)
     assert outs_on == outs_off, "prefix caching changed greedy outputs"
+    from bench import flight_report, trace_arg
+    flight_report(trace_arg(sys.argv), trace_t0)
     print(json.dumps({
         "metric": "serve_prefix_cache_ttft_p50",
         "value": round(p50_on, 4),
@@ -236,6 +247,7 @@ def _decode_plan():
             outs.append("".join(c["choices"][0]["text"] for c in gen))
         return outs
 
+    trace_t0 = time.monotonic_ns()
     outs_on = run_mode(True)
     outs_off = run_mode(False)
     assert outs_on == outs_off, \
@@ -260,6 +272,8 @@ def _decode_plan():
         "vs_baseline": (None if not chan_rate or poll_rate is None
                         else round(poll_rate / chan_rate, 3)),
     }))
+    from bench import flight_report, trace_arg
+    flight_report(trace_arg(sys.argv), trace_t0)
     serve.shutdown()
     ray_tpu.shutdown()
 

@@ -348,11 +348,21 @@ def cmd_memory(args) -> int:
 def cmd_timeline(args) -> int:
     ray, rt, _ = _client(args.address)
     try:
-        events = rt.timeline()
+        if args.flight:
+            # cluster-stitched flight-recorder trace: every process's
+            # event ring on one clock, channel seal->wake flow arrows
+            # included. state.timeline owns the remote-vs-local
+            # dispatch — one path to keep in sync with the RPC.
+            from . import state as state_mod
+            events = state_mod.timeline(flight=True)
+            n = len(events.get("traceEvents", []))
+        else:
+            events = rt.timeline()
+            n = len(events)
         with open(args.out, "w") as f:
             json.dump(events, f)
-        print(f"wrote {len(events)} events to {args.out} "
-              f"(open in chrome://tracing or Perfetto)")
+        print(f"wrote {n} events to {args.out} "
+              f"(open in chrome://tracing or https://ui.perfetto.dev)")
         return 0
     finally:
         ray.shutdown()
@@ -433,6 +443,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("timeline", help="dump chrome trace")
     sp.add_argument("--out", default="timeline.json")
     sp.add_argument("--address", default=None)
+    sp.add_argument("--flight", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="include the cluster-stitched flight-recorder "
+                         "rings (--no-flight = span events only)")
     sp.set_defaults(fn=cmd_timeline)
     return p
 

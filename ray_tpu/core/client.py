@@ -23,6 +23,7 @@ from multiprocessing.connection import Client
 from .object_store import SharedObjectStore, SpillStore
 from .protocol import PROTOCOL_VERSION, ProtocolMismatchError
 from .worker import WorkerRuntime
+from . import flight
 from . import runtime as rt_mod
 
 
@@ -86,6 +87,7 @@ class DriverRuntime(WorkerRuntime):
 
     def __init__(self, store, conn, wid, spill=None, address_arg=None):
         super().__init__(store, conn, wid, spill)
+        flight.set_proc_name("driver:" + wid)
         self.disconnected = threading.Event()
         self._address_arg = address_arg
         self._closing = False
@@ -147,15 +149,23 @@ class DriverRuntime(WorkerRuntime):
 
     def _conn_loop(self):
         # Workers drain dispatches here; a driver receives "exit" (head
-        # shutting down), rpc replies (handled by WorkerRuntime paths), or
-        # EOF (head died -> try to reconnect).
+        # shutting down), flight_pull (cluster flight-recorder
+        # collection — the driver's ring holds the handle-side serve
+        # events, and an unanswered pull would stall every collection
+        # for its full timeout), rpc replies (handled by WorkerRuntime
+        # paths), or EOF (head died -> try to reconnect).
         while True:
             try:
                 while True:
                     msg = self.conn.recv()
-                    if isinstance(msg, dict) and msg.get("t") == "exit":
+                    if not isinstance(msg, dict):
+                        continue
+                    t = msg.get("t")
+                    if t == "exit":
                         self.disconnected.set()
                         return
+                    if t == "flight_pull":
+                        self.send_async(flight.pull_reply(msg))
             except (EOFError, OSError, TypeError):
                 # TypeError: the conn's fd was torn down mid-recv by
                 # interpreter shutdown (read(None, ...)); same as EOF

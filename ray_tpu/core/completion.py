@@ -25,6 +25,7 @@ import time
 from typing import Any, Callable, Optional
 
 from .ids import ObjectID
+from . import flight
 
 # wait-slice length: only the re-check cadence for spill hits and
 # recovery nudges — a seal (or the doorbell) wakes the thread instantly
@@ -149,9 +150,11 @@ class CompletionMux:
                 except Exception:
                     return  # store closed mid-delete: tearing down
             now = time.monotonic()
+            n_fired = 0
             for oid, sealed in zip(oids, flags[1:]):
                 if sealed or (self._spill is not None
                               and self._spill.contains(oid)):
+                    n_fired += 1
                     self._fire(oid)
                     continue
                 with self._lock:
@@ -166,6 +169,8 @@ class CompletionMux:
                         self._rt._mux_nudge(oid)
                     except Exception:
                         pass  # recovery is best-effort; the slice retries
+            if n_fired:
+                flight.evt(flight.MUX_WAKE, n_fired, len(oids))
 
 
 # -- waiter plumbing (used by ObjectRef.__await__ / .future()) ------------

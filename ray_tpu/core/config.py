@@ -244,6 +244,15 @@ _FLAGS = [
          "Prometheus /metrics port (0 = ephemeral)"),
     Flag("event_export_enabled", False,
          "write task/actor events to session_dir/events.jsonl"),
+    Flag("flight_recorder", True,
+         "always-on per-process flight recorder (core/flight.py): "
+         "sub-microsecond struct-packed event ring instrumenting the "
+         "zero-dispatch fast paths; off = evt() is a no-op (the "
+         "overhead A/B knob)"),
+    Flag("flight_ring_slots", 16384,
+         "flight-recorder ring capacity in events (rounded up to a "
+         "power of two; 44 bytes/event — the default is ~720 KiB per "
+         "process, overwritten oldest-first with a drop counter)"),
 ]
 
 cfg = Config(_FLAGS)

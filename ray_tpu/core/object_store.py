@@ -27,6 +27,7 @@ import numpy as np
 
 from .ids import ObjectID
 from .native.build import ensure_built
+from . import flight
 
 _FLAG_NORMAL = 0
 _FLAG_EXCEPTION = 1
@@ -373,11 +374,13 @@ class SharedObjectStore:
             raise ObjectStoreFullError(
                 f"object store full ({self.bytes_in_use()}/{self.capacity()} "
                 f"bytes in use) while allocating {size} bytes")
+        flight.evt(flight.OBJ_CREATE, flight.lo48(oid), size)
         return self._view[off:off + size]
 
     def seal(self, oid: ObjectID) -> None:
         if self._lib.os_seal(self._handle(), oid.binary()) != 0:
             raise RuntimeError(f"seal failed for {oid}")
+        flight.evt(flight.OBJ_SEAL, flight.lo48(oid))
 
     def get_raw(self, oid: ObjectID, timeout_ms: int = -1) -> Optional[memoryview]:
         """Pin + return the payload view, or None on timeout. Caller must
@@ -420,9 +423,19 @@ class SharedObjectStore:
         n = len(oids)
         if n == 0:
             return []
+        if timeout_ms == 0:
+            # non-blocking bulk contains: no flight record — depth probes
+            # and sealed_now() polls would flood the ring with non-events
+            if n > self._WAIT_CHUNK:
+                return self._wait_sealed_chunked(oids, min_count, 0)
+            return self._wait_sealed_call(oids, min_count, 0)
+        flight.evt(flight.WAIT_BEGIN, n, min_count)
         if n > self._WAIT_CHUNK:
-            return self._wait_sealed_chunked(oids, min_count, timeout_ms)
-        return self._wait_sealed_call(oids, min_count, timeout_ms)
+            out = self._wait_sealed_chunked(oids, min_count, timeout_ms)
+        else:
+            out = self._wait_sealed_call(oids, min_count, timeout_ms)
+        flight.evt(flight.WAIT_END, sum(out))
+        return out
 
     def _wait_sealed_chunked(self, oids, min_count: int,
                              timeout_ms: int) -> list[bool]:

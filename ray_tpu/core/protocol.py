@@ -59,7 +59,14 @@ from __future__ import annotations
 #     stop-wake semantics), and serve's handle_request_streaming grew a
 #     `chan` argument whose dict reply an old-build handle would treat
 #     as a stream id. Same-build clusters only, as ever.
-PROTOCOL_VERSION = 4
+# v5: flight-recorder collection frames (core/flight.py): the head may
+#     send "flight_pull" {nonce, stats_only} to any worker, which
+#     answers "flight_ring" {nonce, snap} carrying its event-ring
+#     snapshot + (mono_ns, wall_ns) clock pair for offset estimation.
+#     An old-build worker would drop flight_pull on the floor and the
+#     head would wait out its collection timeout per pull — reject at
+#     the handshake instead.
+PROTOCOL_VERSION = 5
 
 # Bump on any incompatible change to the sqlite snapshot contents.
 # v2: named-actor keys are namespace-qualified ("ns/name"); v1 snapshots

@@ -39,6 +39,7 @@ from typing import Any, Optional
 import cloudpickle
 
 from .. import exceptions as exc
+from . import flight
 from .ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID
 from .object_store import GetTimeoutError as StoreTimeout
 from .object_store import ObjectStoreFullError, SharedObjectStore, SpillStore
@@ -463,6 +464,11 @@ class Runtime:
         # serializes queue drains so specs admit in FIFO order even when
         # cancel() drains concurrently with the pump
         self._submitq_drain_lock = threading.Lock()
+        # flight-recorder cluster collection: nonce -> {"snap"}
+        # answered by the flight_ring handler as worker replies land
+        self._flight_pulls: dict[bytes, dict] = {}
+        self._flight_evt = threading.Event()
+        flight.set_proc_name("head")
         self._sched_evt = threading.Event()
         threading.Thread(target=self._sched_pump_loop, daemon=True,
                          name="rtpu-sched-pump").start()
@@ -843,6 +849,7 @@ class Runtime:
         they request collapse into ONE at the end — whose per-worker task
         dispatches ride one batched frame each (_send_buf). A bad message
         must not poison the rest, same contract as the recv loop."""
+        flight.evt(flight.BATCH_RECV, len(msgs))
         with self.lock:
             opened = self._send_buf is None
             if opened:
@@ -878,6 +885,29 @@ class Runtime:
             self._on_task_done(wid, msg)
         elif t == "trace_span":
             self.record_trace_span(msg["span"])
+        elif t == "flight_ring":
+            # A worker's answer to flight_pull. The monotonic-clock
+            # offset is estimated through the WALL clock as a bridge:
+            # the snapshot samples (mono, wall) together, we sample our
+            # own pair at receipt, and offset = (their mono - their
+            # wall) - (our mono - our wall). Unlike the request/reply
+            # midpoint this is immune to transport latency (an 8ms
+            # queueing delay on a loaded box would otherwise skew the
+            # midpoint by 4ms and reorder same-host seal->wake edges);
+            # it is exact whenever wall clocks agree — always on one
+            # host, NTP-close across hosts. Sub-millisecond residue is
+            # clamped to zero so shared-clock processes stitch exactly.
+            rec = self._flight_pulls.get(msg["nonce"])
+            if rec is not None:
+                snap = msg["snap"]
+                mono, wall = time.monotonic_ns(), time.time_ns()
+                off = ((snap.get("mono_ns", 0) - snap.get("wall_ns", 0))
+                       - (mono - wall))
+                if abs(off) < 1_000_000:
+                    off = 0
+                snap["offset_ns"] = off
+                rec["snap"] = snap
+                self._flight_evt.set()
         elif t == "actor_ready":
             self._on_actor_ready(wid, msg)
         elif t == "submit":
@@ -1088,7 +1118,8 @@ class Runtime:
     _RPC_METHODS = ("get_actor_by_name", "cluster_resources",
                     "available_resources", "node_table", "pg_wait",
                     "create_placement_group_rpc", "remove_placement_group_rpc",
-                    "timeline", "state_list", "state_summary",
+                    "timeline", "flight_timeline", "flight_stats",
+                    "state_list", "state_summary",
                     "memory_summary", "autoscaler_status",
                     "user_metrics_dump", "pubsub_poll",
                     "kv_put", "kv_get", "kv_del", "kv_keys", "locate",
@@ -1833,6 +1864,11 @@ class Runtime:
         return refs
 
     def _record_task_locked(self, spec, state: str, **extra):
+        # every transition hits the flight ring, even ones whose state-
+        # API record was FIFO-evicted — the recorder is the always-on
+        # view of task flow, the records dict is the bounded query view
+        flight.evt(flight.TASK_STATE, flight.lo48(spec.task_id),
+                   flight.TASK_STATES.get(state, -1))
         rec = self.task_records.get(spec.task_id)
         if rec is None:
             if state != "PENDING":
@@ -2051,6 +2087,13 @@ class Runtime:
     def _schedule_pass_locked(self):
         if self.pending.buckets and not self._dispatch_possible_locked():
             return
+        flight.evt(flight.SCHED_BEGIN)
+        try:
+            self._schedule_pass_body_locked()
+        finally:
+            flight.evt(flight.SCHED_END)
+
+    def _schedule_pass_body_locked(self):
         for key in list(self.pending.buckets):
             dq = self.pending.buckets.get(key)
             if not dq:
@@ -3203,7 +3246,7 @@ class Runtime:
                             self._recover_lost_spill(oid)
                         continue
                     except exc.RayTaskError as e:
-                        raise e.as_instanceof_cause() from None
+                        raise e.as_instanceof_cause() from e
                 if self._fetch_remote(oid):
                     continue  # pulled into the local store; next get hits
                 with self.lock:
@@ -3211,7 +3254,7 @@ class Runtime:
                     self._schedule_locked()
                 continue
             except exc.RayTaskError as e:
-                raise e.as_instanceof_cause() from None
+                raise e.as_instanceof_cause() from e
             return value
 
     def wait(self, refs, num_returns=1, timeout: float | None = None,
@@ -3361,6 +3404,88 @@ class Runtime:
     def timeline(self) -> list[dict]:
         with self.lock:
             return list(self.events)
+
+    # ------------------------------------------------------------------ #
+    # flight recorder (core/flight.py) cluster collection
+    # ------------------------------------------------------------------ #
+
+    def flight_collect(self, timeout_s: float = 3.0,
+                       stats_only: bool = False) -> list[dict]:
+        """Pull every live worker's flight-recorder ring (or just its
+        stats) over the control plane, plus this process's own. Each
+        remote snapshot carries ``offset_ns`` — its monotonic clock
+        minus ours, estimated through the wall-clock bridge (see the
+        flight_ring handler) and clamped to 0 for same-host clocks — so
+        export_chrome can stitch all tracks onto the head clock.
+        Dead/unresponsive workers are skipped at the deadline;
+        collection never blocks the scheduler lock."""
+        local = flight.snapshot(stats_only) or flight.stats()
+        local["offset_ns"] = 0
+        snaps = [local]
+        with self.lock:
+            targets = [w for w in self.workers.values()
+                       if w.conn is not None and w.state != "dead"]
+        pulls = {}
+        for w in targets:
+            nonce = os.urandom(12)
+            rec = {"snap": None}
+            self._flight_pulls[nonce] = rec
+            pulls[nonce] = rec
+            if not w.send({"t": "flight_pull", "nonce": nonce,
+                           "stats_only": stats_only}):
+                self._flight_pulls.pop(nonce, None)
+                pulls.pop(nonce, None)
+        deadline = time.monotonic() + timeout_s
+        try:
+            while any(r["snap"] is None for r in pulls.values()):
+                remain = deadline - time.monotonic()
+                if remain <= 0:
+                    break
+                self._flight_evt.wait(timeout=min(0.1, remain))
+                self._flight_evt.clear()
+        finally:
+            for nonce in pulls:
+                self._flight_pulls.pop(nonce, None)
+        snaps.extend(r["snap"] for r in pulls.values()
+                     if r["snap"] is not None)
+        return snaps
+
+    def flight_stats(self) -> list[dict]:
+        """Per-process recorder health (events recorded/dropped, channel
+        endpoint counters) for state.summary(). stats_only pulls are
+        tiny frames answered straight from each recv loop; the short
+        deadline bounds how long a summary poll can stall on one
+        backlogged worker (it is skipped, not waited out)."""
+        out = []
+        for snap in self.flight_collect(timeout_s=0.5, stats_only=True):
+            cnt = snap.get("counters", {})
+            out.append({
+                "proc": snap.get("proc", ""), "pid": snap.get("pid"),
+                "recorded": snap.get("recorded", 0),
+                "dropped": snap.get("dropped", 0),
+                "bad": snap.get("bad", 0),
+                "chan_open": cnt.get("chan_open", 0),
+                "chan_closed": cnt.get("chan_closed", 0),
+            })
+        return out
+
+    def flight_timeline(self, since_ns: int = 0) -> dict:
+        """Cluster-stitched Chrome-trace/Perfetto object: every
+        process's flight ring on one clock, plus the span-tracing
+        timeline events merged in (state.timeline(flight=True)).
+        Span events are wall-clock stamped — rebase them onto the head
+        monotonic microseconds the flight events use, so both layers
+        land on one Perfetto timeline."""
+        trace = flight.export_chrome(self.flight_collect(),
+                                     since_ns=since_ns)
+        delta_us = (time.monotonic_ns() / 1000.0
+                    - time.time_ns() / 1000.0)
+        for ev in self.timeline():
+            ev = dict(ev)
+            ev["ts"] = float(ev.get("ts", 0.0)) + delta_us
+            if ev["ts"] * 1000.0 >= since_ns:
+                trace["traceEvents"].append(ev)
+        return trace
 
     # ------------------------------------------------------------------ #
     # shutdown

@@ -41,6 +41,7 @@ from .object_store import ObjectStoreFullError as StoreFull
 from .object_store import SharedObjectStore, SpillStore
 from .ref import ObjectRef
 from .task_spec import ActorSpec, TaskSpec
+from . import flight
 from . import runtime as rt_mod
 
 
@@ -262,6 +263,7 @@ class WorkerRuntime:
             try:
                 self.conn.send(msgs[0] if len(msgs) == 1
                                else {"t": "batch", "msgs": msgs})
+                flight.evt(flight.CTRL_FLUSH, len(msgs))
             except (OSError, EOFError, KeyboardInterrupt, SystemExit):
                 # transport failure (or an interrupt that may have landed
                 # mid-write): put the unsent messages back at the FRONT,
@@ -526,8 +528,9 @@ class WorkerRuntime:
                     except OSError:
                         pass  # freed between contains and load; keep waiting
                     except exc.RayTaskError as e:
-                        raise e.as_instanceof_cause() from None
+                        raise e.as_instanceof_cause() from e
                 if first:
+                    flight.evt(flight.OBJ_MISS, flight.lo48(oid))
                     on_wait()
                     self.send({"t": "ensure", "oids": [oid.binary()]})
                     first = False
@@ -536,7 +539,7 @@ class WorkerRuntime:
                 self._try_fetch(oid)
                 continue
             except exc.RayTaskError as e:
-                raise e.as_instanceof_cause() from None
+                raise e.as_instanceof_cause() from e
 
     def _try_fetch(self, oid: ObjectID) -> bool:
         """Pull a missing object from a holder node into the local store
@@ -764,6 +767,7 @@ class WorkerLoop:
         addr = os.environ["RTPU_HEAD_ADDR"]
         authkey = bytes.fromhex(os.environ["RTPU_AUTHKEY"])
         self.wid = os.environ["RTPU_WORKER_ID"]
+        flight.set_proc_name("worker:" + self.wid)
         self.store = SharedObjectStore(store_path)
         spill_dir = os.environ.get("RTPU_SPILL_DIR")
         spill = SpillStore(spill_dir) if spill_dir else None
@@ -858,6 +862,7 @@ class WorkerLoop:
         self._current_task_id = spec.task_id
         self.rt.current_task_name = spec.name
         t0 = time.time()
+        flight.evt(flight.EXEC_BEGIN, flight.lo48(spec.task_id))
         span_rec = None
         ns_tok = _ACTIVE_NS.set(getattr(spec, "namespace", None))
         try:
@@ -895,6 +900,7 @@ class WorkerLoop:
         finally:
             self._current_task_id = None
             _ACTIVE_NS.reset(ns_tok)
+        flight.evt(flight.EXEC_END, flight.lo48(spec.task_id), int(ok))
         self.rt._did_block = False
         done_msg = {"t": "done", "task_id": spec.task_id, "ok": ok,
                     "err": err, "retryable": retryable, "name": spec.name,
@@ -966,6 +972,7 @@ class WorkerLoop:
 
     def _run_actor_task(self, spec: TaskSpec):
         t0 = time.time()
+        flight.evt(flight.EXEC_BEGIN, flight.lo48(spec.task_id))
         span_rec = None
         try:
             group = getattr(spec, "concurrency_group", None)
@@ -1042,6 +1049,7 @@ class WorkerLoop:
                     self.store.put(oid, werr, is_exception=True)
                 except Exception:
                     pass  # store full/closing; done msg carries err
+        flight.evt(flight.EXEC_END, flight.lo48(spec.task_id), int(ok))
         done_msg = {"t": "done", "task_id": spec.task_id, "ok": ok,
                     "err": err, "retryable": False, "name": spec.name,
                     "dur": time.time() - t0}
@@ -1158,6 +1166,12 @@ class WorkerLoop:
                 threading.Thread(
                     target=self._serve_device_get, args=(msg,),
                     daemon=True).start()
+            elif t == "flight_pull":
+                # head pulling this process's flight-recorder ring; the
+                # snapshot samples (mono_ns, wall_ns) together for the
+                # head's wall-clock-bridge offset estimate, and is a
+                # buffer copy — cheap enough for this loop
+                self.rt.send_async(flight.pull_reply(msg))
             elif t == "cancel":
                 self._cancel_current(msg["task_id"])
             elif t == "steal":

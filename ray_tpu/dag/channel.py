@@ -45,6 +45,7 @@ import time
 from typing import Any, Optional
 
 from ..core.ids import ObjectID
+from ..core import flight
 
 # how long one futex park lasts before the waiter re-checks its deadline
 # and (optionally) its liveness callback; a seal/stop wakes it instantly
@@ -82,6 +83,14 @@ def write_slot(store, base: bytes, seq: int, value: Any = None,
     lands in the remote store behind it (cross-store edge); `frame` is an
     optional pre-built _FramedValue shared across fan-out targets."""
     oid = slot_oid(base, seq)
+    # the producer half of the per-message seal->wake flow edge: the
+    # consumer's CHAN_WAKE carries the same (chan48, seq) pair, which is
+    # what lets the exporter draw the cross-process arrow. Recorded
+    # BEFORE the physical seal: the consumer wakes the instant the seal
+    # lands, so stamping afterwards would let a descheduled producer
+    # record its seal LATER than the wake that consumed it — the edge
+    # must stay ordered on a shared clock
+    flight.evt(flight.CHAN_SEAL, flight.lo48(base), seq)
     if push_addr is not None:
         from ..core.object_store import _FramedValue
         from ..core.object_transfer import push_object
@@ -136,6 +145,7 @@ def read_slot(store, base: bytes, seq: int, stop_oid: ObjectID,
         except GetTimeoutError:
             if on_idle is not None:
                 on_idle()
+    flight.evt(flight.CHAN_WAKE, flight.lo48(base), seq)
     store.delete(oid)
     if ack_base is not None:
         send_ack(store, ack_base, seq, ack_push_addr)
@@ -146,6 +156,7 @@ def send_ack(store, ack_base: bytes, seq: int,
              push_addr: Optional[str] = None) -> None:
     """Seal the 1-byte ack for `seq` into the producer's store."""
     oid = slot_oid(ack_base, seq)
+    flight.evt(flight.CHAN_ACK, flight.lo48(ack_base), seq)
     if push_addr is not None:
         from ..core.object_transfer import push_object
         push_object(push_addr, oid, value=True)
@@ -163,27 +174,33 @@ def await_ack(store, ack_base: bytes, seq: int, stop_oid: ObjectID,
     from ..core.object_store import GetTimeoutError
     oid = slot_oid(ack_base, seq)
     deadline = None if timeout_s is None else time.monotonic() + timeout_s
-    while True:
-        slice_ms = _WAIT_SLICE_MS
-        if deadline is not None:
-            remain = deadline - time.monotonic()
-            if remain <= 0:
-                raise GetTimeoutError(
-                    f"timed out waiting for channel ack {seq}")
-            slice_ms = max(1, min(slice_ms, int(remain * 1000)))
-        acked, stopped = store.wait_sealed([oid, stop_oid], 1, slice_ms)
-        if acked:
-            store.delete(oid)
-            return
-        if stopped:
-            raise ChannelClosed("channel stop flag sealed")
-        if on_idle is not None:
-            on_idle()
+    flight.evt(flight.CREDIT_BEGIN, flight.lo48(ack_base), seq)
+    try:
+        while True:
+            slice_ms = _WAIT_SLICE_MS
+            if deadline is not None:
+                remain = deadline - time.monotonic()
+                if remain <= 0:
+                    raise GetTimeoutError(
+                        f"timed out waiting for channel ack {seq}")
+                slice_ms = max(1, min(slice_ms, int(remain * 1000)))
+            acked, stopped = store.wait_sealed([oid, stop_oid], 1,
+                                               slice_ms)
+            if acked:
+                store.delete(oid)
+                return
+            if stopped:
+                raise ChannelClosed("channel stop flag sealed")
+            if on_idle is not None:
+                on_idle()
+    finally:
+        flight.evt(flight.CREDIT_END, flight.lo48(ack_base))
 
 
 def signal_stop(store, stop_oid: ObjectID) -> None:
     """Seal the stop flag locally (idempotent): every parked channel wait
     in this store wakes and raises ChannelClosed."""
+    flight.evt(flight.CHAN_STOP, flight.lo48(stop_oid))
     try:
         store.put(stop_oid, True)
     except FileExistsError:
@@ -233,6 +250,8 @@ class MultiRingReader:
                                else [None] * len(self.bases))
         self.seqs = [0] * len(self.bases)
         self._rr = 0  # next producer index favoured by the rotation
+        self._fl_open = True
+        flight.chan_opened(len(self.bases))
 
     def _slots(self) -> list[ObjectID]:
         return [slot_oid(b, s) for b, s in zip(self.bases, self.seqs)]
@@ -289,6 +308,7 @@ class MultiRingReader:
         oid = slot_oid(self.bases[idx], seq)
         val = self.store.get(oid, timeout_ms=5000,
                              zero_copy=self.zero_copy)
+        flight.evt(flight.CHAN_WAKE, flight.lo48(self.bases[idx]), seq)
         self.store.delete(oid)
         send_ack(self.store, self.ack_bases[idx], seq,
                  self.ack_push_addrs[idx])
@@ -301,6 +321,9 @@ class MultiRingReader:
         ack windows around every cursor, in case a producer already
         exited and will never observe the stop."""
         signal_stop(self.store, self.stop)
+        if self._fl_open:
+            self._fl_open = False
+            flight.chan_closed(len(self.bases))
         for base, ack_base, seq in zip(self.bases, self.ack_bases,
                                        self.seqs):
             drain_stale_slots(self.store, [base, ack_base],
@@ -352,6 +375,13 @@ class RingReader:
         self.ack_push_addr = ack_push_addr
         self.zero_copy = zero_copy
         self.seq = 0
+        self._fl_open = True
+        flight.chan_opened()
+
+    def _fl_close(self) -> None:
+        if self._fl_open:
+            self._fl_open = False
+            flight.chan_closed()
 
     def read(self, timeout_s: Optional[float] = None, on_idle=None) -> Any:
         val = read_slot(self.store, self.base, self.seq, self.stop,
@@ -367,6 +397,7 @@ class RingReader:
         reader sealed would otherwise leak one store entry each, every
         stream. Local-store readers only (pushed acks live in the
         producer's store, which sweeps on its own exit)."""
+        self._fl_close()
         if self.ack_push_addr is None:
             drain_stale_slots(self.store, [self.ack_base],
                               self.seq - self.ring - 1, self.seq)
@@ -377,6 +408,7 @@ class RingReader:
         window; also sweep the slots/acks around OUR cursor in case the
         producer already exited normally and will never observe the
         stop."""
+        self._fl_close()
         signal_stop(self.store, self.stop)
         drain_stale_slots(self.store, [self.base, self.ack_base],
                           self.seq - self.ring - 1, self.seq + self.ring)

@@ -27,6 +27,7 @@ from collections import deque
 from typing import Any, Optional
 
 from ..core.ids import ObjectID
+from ..core import flight
 from . import channel as ch
 from .nodes import ClassMethodNode, DAGNode, InputNode
 
@@ -121,6 +122,7 @@ def _dag_actor_loop_sealed(instance, plan: list, stop_hex: str, ring: int):
     store = rt.store
     stop_oid = ObjectID(bytes.fromhex(stop_hex))
     seq = 0
+    from ..core import flight as _fl
     try:
         while True:
             local: dict[int, Any] = {}
@@ -134,7 +136,9 @@ def _dag_actor_loop_sealed(instance, plan: list, stop_hex: str, ring: int):
                     else:  # chan: the edge's data base
                         args.append(_ch.read_slot(store, val, seq,
                                                   stop_oid))
+                _fl.evt(_fl.DAG_STEP_BEGIN, step["idx"], seq)
                 out = getattr(instance, step["method"])(*args)
+                _fl.evt(_fl.DAG_STEP_END, step["idx"], seq)
                 local[step["idx"]] = out
                 outs = step["out_chans"]
                 if not outs:
@@ -323,6 +327,13 @@ class CompiledDAG:
             for base, c in self.input_chans]
         self._push_addrs = sorted({addr for addr in actor_addr.values()
                                    if addr is not None})
+        # channel-endpoint accounting for state.summary(): every edge
+        # (inputs + cross-actor + the driver-facing output) is one live
+        # channel until teardown
+        self._n_chans = len(self.input_chans) + sum(
+            len(step["out_chans"]) for plan in plans.values()
+            for step in plan)
+        flight.chan_opened(self._n_chans)
 
         # ---- install loops -------------------------------------------- #
         self._loop_refs = []
@@ -361,6 +372,7 @@ class CompiledDAG:
             self._outstanding.popleft().get()
         seq = self._seq
         self._seq += 1
+        flight.evt(flight.DAG_EXEC, seq)
         if self.sealed:
             ref = self._execute_sealed(seq, value)
         else:
@@ -406,6 +418,7 @@ class CompiledDAG:
         if self._torn_down:
             return
         self._torn_down = True
+        flight.chan_closed(self._n_chans)
         ch.signal_stop(self.store, self.stop_oid)
         # own-store actors wait on their LOCAL stores for the flag
         from ..core.object_transfer import push_object

@@ -165,6 +165,11 @@ class PagedInferenceEngine(_EngineBase):
         self._spec_gain = float(cfg.spec_tokens + 1)
         self._spec_cooldown = 0
         self._spec_cooldown_len = 8    # doubles per failed probe, to 256
+        # step profiler (util/profiling.py): compile-vs-execute wall
+        # split per program family; feeds profile_summary()'s MFU when
+        # estimate_flops() has run
+        from ..util.profiling import StepProfiler
+        self.profiler = StepProfiler("paged_engine")
 
     @staticmethod
     def _sampling_mode(reqs) -> tuple:
@@ -294,6 +299,7 @@ class PagedInferenceEngine(_EngineBase):
             rb = 1
             while "prefill" in families:
                 rb = min(rb, cfg.prefill_rows)
+                tw = _time.perf_counter()
                 toks, _lps, self.caches = self._prefill_rows_fn(rb, mode)(
                     self.params, self.caches,
                     np.zeros((rb, c), np.int32),
@@ -302,17 +308,24 @@ class PagedInferenceEngine(_EngineBase):
                     key, ctr, np.zeros((rb,), np.float32),
                     np.zeros((rb,), np.int32))
                 np.asarray(toks)
+                # book as compile (and mark the key warm) so the first
+                # REAL dispatch after warmup counts as execute time
+                self.profiler.record_compile(
+                    _time.perf_counter() - tw, "prefill", (rb, mode))
                 if rb >= cfg.prefill_rows:
                     break
                 rb <<= 1
             for w in (sorted({1, cfg.decode_window})
                       if "decode" in families else ()):
+                tw = _time.perf_counter()
                 out, _lps, self.caches = self._decode_window_fn(w, mode)(
                     self.params, self.caches, np.zeros((bs,), np.int32),
                     np.zeros((bs, maxp), np.int32),
                     np.zeros((bs,), np.int32), key, ctr,
                     np.zeros((bs,), np.float32), np.zeros((bs,), np.int32))
                 np.asarray(out)
+                self.profiler.record_compile(
+                    _time.perf_counter() - tw, "decode", (w, mode))
         if cfg.spec_tokens > 0 and "verify" in families:
             s1, rb = cfg.spec_tokens + 1, 1
             while True:
@@ -617,14 +630,15 @@ class PagedInferenceEngine(_EngineBase):
             sps[i], tls[i] = pos, n
             temps[i] = req.params.temperature
             topks[i] = req.params.top_k
-        toks, lps, self.caches = self._prefill_rows_fn(
-            rb, self._sampling_mode([q for q, _, _ in rows]))(
-            self.params, self.caches, chunks, bts, sps, tls,
-            self._rng_base, np.int32(self._rng_ctr), temps, topks)
+        mode = self._sampling_mode([q for q, _, _ in rows])
+        with self.profiler.step("prefill", (rb, mode)):
+            toks, lps, self.caches = self._prefill_rows_fn(rb, mode)(
+                self.params, self.caches, chunks, bts, sps, tls,
+                self._rng_base, np.int32(self._rng_ctr), temps, topks)
+            toks = np.asarray(toks)     # block: the step must measure
+            lps = None if lps is None else np.asarray(lps)
         self._rng_ctr += 1
         self.stats["prefill_dispatches"] += 1
-        toks = np.asarray(toks)
-        lps = None if lps is None else np.asarray(lps)
         if self._prefix_on:
             page = cfg.page_size
             for req, pos, n in rows:
@@ -818,14 +832,15 @@ class PagedInferenceEngine(_EngineBase):
             temps[slot] = req.params.temperature
             topks[slot] = req.params.top_k
             bt[slot] = self._block_tables[slot]
-        out, lps, self.caches = self._decode_window_fn(
-            w, self._sampling_mode(self._active.values()))(
-            self.params, self.caches, tokens, bt, lengths,
-            self._rng_base, np.int32(self._rng_ctr), temps, topks)
+        mode = self._sampling_mode(self._active.values())
+        with self.profiler.step("decode", (w, mode)):
+            out, lps, self.caches = self._decode_window_fn(w, mode)(
+                self.params, self.caches, tokens, bt, lengths,
+                self._rng_base, np.int32(self._rng_ctr), temps, topks)
+            out = np.asarray(out)           # [bs, w]; block to measure
+            lps = None if lps is None else np.asarray(lps)
         self._rng_ctr += 1
         self.stats["decode_dispatches"] += 1
-        out = np.asarray(out)               # [bs, w]
-        lps = None if lps is None else np.asarray(lps)
         for slot in list(self._active):
             req = self._active[slot]
             for j in range(w):
@@ -1022,6 +1037,51 @@ class PagedInferenceEngine(_EngineBase):
         return fn
 
     # -- stats -------------------------------------------------------------
+
+    def estimate_flops(self) -> dict:
+        """FLOPs per dispatch for the hot program families via XLA
+        cost_analysis (one extra out-of-band compile per family — run
+        once, after warmup, not per step). Feeds profile_summary()'s
+        MFU; returns {family: flops} for the families estimated."""
+        from ..util.profiling import compiled_flops
+        cfg = self.cfg
+        bs, maxp = cfg.max_batch_size, cfg.max_pages_per_seq
+        key, ctr = self._rng_base, np.int32(0)
+        mode = (False, False, False)
+        out = {}
+        w = cfg.decode_window
+        fl = compiled_flops(
+            self._decode_window_fn(w, mode),
+            self.params, self.caches, np.zeros((bs,), np.int32),
+            np.zeros((bs, maxp), np.int32), np.zeros((bs,), np.int32),
+            key, ctr, np.zeros((bs,), np.float32),
+            np.zeros((bs,), np.int32))
+        if fl:
+            out["decode"] = fl
+            # keyed to the full-window greedy program: dispatches at
+            # smaller windows / other sampling modes are NOT credited
+            # this cost (MFU must understate, never inflate)
+            self.profiler.attach_flops("decode", fl, key=(w, mode))
+        r = cfg.prefill_rows
+        fl = compiled_flops(
+            self._prefill_rows_fn(r, mode),
+            self.params, self.caches,
+            np.zeros((r, cfg.chunk_size), np.int32),
+            np.zeros((r, maxp), np.int32), np.zeros((r,), np.int32),
+            np.zeros((r,), np.int32), key, ctr,
+            np.zeros((r,), np.float32), np.zeros((r,), np.int32))
+        if fl:
+            out["prefill"] = fl
+            self.profiler.attach_flops("prefill", fl, key=(r, mode))
+        return out
+
+    def profile_summary(self) -> dict:
+        """Step-profiler view (util/profiling.py): compile/execute wall
+        split, per-step wall, and MFU when estimate_flops() has run."""
+        return {**self.profiler.summary(), "dispatches": {
+            "prefill": self.stats["prefill_dispatches"],
+            "decode": self.stats["decode_dispatches"],
+            "spec": self.stats["spec_dispatches"]}}
 
     def pool_stats(self) -> dict:
         hits = self.stats["prefix_hits"]

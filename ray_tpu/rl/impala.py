@@ -126,10 +126,29 @@ class ImpalaLearner:
             "dones": jnp.asarray(sample["dones"], jnp.float32),
             "bootstrap_value": jnp.asarray(sample["last_value"]),
         }
+        # shapes only for flops_estimate(): lower() needs abstract
+        # shapes, and keeping the live arrays would pin a whole rollout
+        # batch in device memory for the learner's lifetime; shapes are
+        # static per run, so derive them once, not per SGD update
+        if getattr(self, "_last_batch_shapes", None) is None:
+            import jax
+            self._last_batch_shapes = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), batch)
         self.params, self.opt_state, loss, (pg, vf, ent) = self._update(
             self.params, self.opt_state, batch)
         return {"loss": float(loss), "pg_loss": float(pg),
                 "vf_loss": float(vf), "entropy": float(ent)}
+
+    def flops_estimate(self):
+        """FLOPs of one V-trace update at the last batch's shapes via
+        XLA cost_analysis (one extra out-of-band compile); None before
+        the first update or when XLA won't say."""
+        shapes = getattr(self, "_last_batch_shapes", None)
+        if shapes is None:
+            return None
+        from ..util.profiling import compiled_flops
+        return compiled_flops(self._update, self.params,
+                              self.opt_state, shapes)
 
 
 class IMPALA(AlgorithmBase):

@@ -210,6 +210,15 @@ class AnakinTrainer:
             **{f"learner/{k}": v for k, v in metrics.items()},
         }
 
+    def flops_estimate(self):
+        """FLOPs of one fused iteration via XLA cost_analysis on the
+        compiled program (one extra out-of-band compile; the MFU input
+        for PodracerTrainer(profile=True) and the ROADMAP TPU goal)."""
+        from ...util.profiling import compiled_flops
+        return compiled_flops(self._run, self.params, self.opt_state,
+                              self._env_state, self._obs, self._keys,
+                              self._ep_ret)
+
     # -- checkpoint ------------------------------------------------------ #
 
     def save_state(self) -> dict:

@@ -33,6 +33,7 @@ import os
 import time
 from typing import Any, Optional
 
+from ...core import flight as _fl
 from ...core.ids import ObjectID
 from ...dag.channel import (ChannelClosed, MultiRingReader, RingWriter,
                             drain_stale_slots)
@@ -93,6 +94,7 @@ class RolloutQueue:
         env-runner actor raises promptly instead of hanging the learner."""
         t0 = time.perf_counter()
         idx, val = self._reader.read_any(timeout_s, on_idle)
+        _fl.evt(_fl.FRAG_GET, idx)
         try:
             tm.fragment_wait().observe(time.perf_counter() - t0,
                                        tags={"transport": "chan"})
@@ -152,6 +154,7 @@ class RolloutProducer:
     def write(self, fragment: Any,
               timeout_s: Optional[float] = None) -> None:
         """Seal the next fragment (raises ChannelClosed on teardown)."""
+        _fl.evt(_fl.FRAG_PUT, self.index, self._writer.seq)
         self._writer.write(fragment, timeout_s)
 
     def closed(self) -> bool:

@@ -31,6 +31,7 @@ from typing import Any, Callable, Optional
 
 import numpy as np
 
+from ...core import flight as _fl
 from ..env_runner import EnvRunner
 from ..impala import ImpalaConfig, ImpalaLearner
 from ..module import MLPConfig
@@ -80,6 +81,7 @@ class WeightBroadcast:
 
     def publish(self, params: Any) -> int:
         v = self.version + 1
+        _fl.evt(_fl.WEIGHT_PUB, v)
         self.store.put(_slot(self.base, v), (v, time.time(), params))
         if v == 0:
             try:
@@ -175,7 +177,16 @@ class WeightSubscriber:
                 f"weight slot {v} holds a {type(got).__name__}, not the "
                 f"(version, ts, params) triple: {got!r}"[:300])
         ver, ts, params = got
+        if isinstance(params, (str, bytes)) or not isinstance(ver, int):
+            # the one corrupted shape the triple check can't see: a
+            # str/bytes params leaf surfaces later as an opaque
+            # TypeError inside the jitted policy (params["pi"] on a
+            # str) — fail here, naming the slot and payload instead
+            raise RuntimeError(
+                f"weight slot {v} payload corrupt: version "
+                f"{ver!r}, params {type(params).__name__}"[:300])
         self.version, self._ts, self._params = ver, ts, params
+        _fl.evt(_fl.WEIGHT_FETCH, ver)
         return True
 
     def current(self):
@@ -228,7 +239,9 @@ class SebulbaEnvRunner(EnvRunner):
                 if producer.closed():
                     break
                 params, version, ts = weights.current()
+                _fl.evt(_fl.SAMPLE_BEGIN, index)
                 sample = self.sample(params)
+                _fl.evt(_fl.SAMPLE_END, index, frags)
                 sample["param_version"] = version
                 sample["param_ts"] = ts
                 sample["runner"] = index
@@ -462,6 +475,13 @@ class SebulbaTrainer:
         return (self._weights.version
                 if self.config.transport == "chan"
                 else self._actor_version)
+
+    def flops_estimate(self):
+        """FLOPs of one iteration = learner-update FLOPs x fragments
+        consumed per train() (rollout compute runs in the env-runner
+        actors and is latency-, not FLOP-, bound)."""
+        fl = self.learner.flops_estimate()
+        return fl * self._frags_per_iter if fl else None
 
     def evaluate(self, num_episodes: int = 5) -> dict:
         """Greedy evaluation in the DRIVER process (a channel runner is

@@ -30,7 +30,7 @@ class PodracerTrainer:
                  checkpoint_every: int = 10,
                  num_to_keep: Optional[int] = 2,
                  score_attribute: Optional[str] = None,
-                 resume: bool = True):
+                 resume: bool = True, profile: bool = False):
         if isinstance(config, SebulbaConfig):
             self.arch = "sebulba"
             self._inner = SebulbaTrainer(config)
@@ -43,6 +43,13 @@ class PodracerTrainer:
                 f"{type(config).__name__}")
         self.config = config
         self.checkpoint_every = max(1, checkpoint_every)
+        # step profiler (util/profiling.py): compile-vs-execute split of
+        # the training step, always on (two clock reads per train());
+        # profile=True additionally estimates the update program's FLOPs
+        # on the first iteration so summary()/results carry an MFU
+        from ...util.profiling import StepProfiler
+        self.profiler = StepProfiler(f"podracer-{self.arch}")
+        self._profile_flops = profile
         self._last_saved = -1   # iteration of the newest checkpoint
         self._manager = None
         if storage_dir:
@@ -67,12 +74,36 @@ class PodracerTrainer:
         return self._inner.iteration
 
     def train(self) -> dict:
-        """One inner iteration + the periodic checkpoint."""
-        result = self._inner.train()
+        """One inner iteration + the periodic checkpoint. The step
+        profiler wraps the whole iteration (the first one, which jit-
+        compiles the update/fused program, books as compile time); its
+        rolling summary rides the result under ``profile/``."""
+        with self.profiler.step("train"):
+            result = self._inner.train()
+        if self._profile_flops:
+            # at most ONE out-of-band compile, even when the estimate
+            # comes back unknown — retrying every train() would serialize
+            # an XLA compile into each iteration
+            self._profile_flops = False
+            self.profiler.attach_flops("train",
+                                       self._inner_flops_estimate())
         if self._manager is not None and \
                 self._inner.iteration % self.checkpoint_every == 0:
             self.save(result)
+        prof = self.profiler.summary()
+        result["profile/step_wall_s"] = prof["step_wall_s"]
+        result["profile/compile_s"] = prof["compile_s"]
+        if prof["mfu"] is not None:
+            result["profile/mfu"] = prof["mfu"]
         return result
+
+    def _inner_flops_estimate(self):
+        """FLOPs of one training step via XLA cost_analysis on the
+        inner trainer's jitted program (one extra compile, once)."""
+        try:
+            return self._inner.flops_estimate()
+        except Exception:
+            return None  # profiling must never fail training
 
     def fit(self, num_iterations: int,
             target_return: Optional[float] = None) -> dict:

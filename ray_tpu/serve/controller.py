@@ -17,6 +17,7 @@ import math
 import time
 from typing import Any, Optional
 
+from ..core import flight as _fl
 from .api import AutoscalingConfig, DeploymentSpec
 
 
@@ -99,8 +100,10 @@ class ReplicaActor:
         import time
         self._ongoing += 1
         self._total += 1
+        req = self._total
         t0 = time.perf_counter()
         outcome = "ok"
+        _fl.evt(_fl.SRV_REQ_BEGIN, req)
         try:
             return await self._invoke(method, args, kwargs, context)
         except BaseException:
@@ -108,6 +111,7 @@ class ReplicaActor:
             raise
         finally:
             self._ongoing -= 1
+            _fl.evt(_fl.SRV_REQ_END, req, int(outcome == "ok"))
             self._observe(context, t0, outcome)
 
     # -- streaming responses (reference: replica.py handles generator
@@ -121,7 +125,9 @@ class ReplicaActor:
         import time
         self._ongoing += 1
         self._total += 1
+        req = self._total
         t0 = time.perf_counter()
+        _fl.evt(_fl.SRV_REQ_BEGIN, req)
         try:
             out = await self._invoke(method, args, kwargs, context)
             if not hasattr(out, "__anext__") and \
@@ -131,10 +137,12 @@ class ReplicaActor:
                     f"{type(out).__name__}, not a generator")
         except BaseException:
             self._ongoing -= 1
+            _fl.evt(_fl.SRV_REQ_END, req, 0)
             self._observe(context, t0, "error")
             raise
         # latency here covers the call that produced the generator; the
         # drain is accounted at the proxy's e2e histogram
+        _fl.evt(_fl.SRV_REQ_END, req, 1)
         self._observe(context, t0, "ok")
         self._stream_seq += 1
         sid = self._stream_seq
@@ -178,6 +186,7 @@ class ReplicaActor:
             # items are counted by the CONSUMING handle (symmetric with
             # the poll transport) — no replica-side inc, or the series
             # would double
+            _fl.evt(_fl.SRV_DRAIN_BEGIN, sid)
             try:
                 while True:
                     if writer.closed():
@@ -201,6 +210,7 @@ class ReplicaActor:
                 import traceback
                 traceback.print_exc()
             finally:
+                _fl.evt(_fl.SRV_DRAIN_END, sid, writer.seq)
                 try:
                     # cancelled streams leave the stop flag and a ring
                     # window of unread slots behind: sweep them

@@ -25,6 +25,7 @@ from collections import deque
 from typing import Any, Optional
 
 from ..core.config import cfg as _cfg
+from ..core import flight as _fl
 
 # affinity yields to load: the preferred replica is skipped when it has
 # this many more in-flight requests (on this handle) than the lightest
@@ -429,6 +430,7 @@ class DeploymentHandle:
         idx = self._pick(replicas, self._affinity_key(args, kwargs))
         replica = replicas[idx]
         self._inflight[idx] = self._inflight.get(idx, 0) + 1
+        _fl.evt(_fl.SRV_DISPATCH, idx, int(self._stream))
 
         def done(i=idx):
             self._inflight[i] = max(0, self._inflight.get(i, 1) - 1)
@@ -466,7 +468,9 @@ class DeploymentHandle:
             if isinstance(resp, dict) and resp.get("chan") is not None:
                 # static decode plan engaged: items arrive over the ring
                 # channel, no per-chunk actor calls
+                _fl.evt(_fl.SRV_STREAM_START, int(resp["chan"]), 1)
                 return ChannelResponseGenerator(replica, chan, done, tags)
+            _fl.evt(_fl.SRV_STREAM_START, int(resp), 0)
             return DeploymentResponseGenerator(replica, resp, done, tags)
 
         def retry():

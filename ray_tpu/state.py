@@ -165,7 +165,10 @@ def autoscaler_status() -> dict:
 
 
 def summary() -> dict:
-    """Cluster summary (reference: `ray summary tasks` + cluster status)."""
+    """Cluster summary (reference: `ray summary tasks` + cluster status).
+    Includes flight-recorder health per process (events recorded vs
+    dropped — a silently saturated ring shows up here) and the live
+    channel-endpoint count across the cluster."""
     remote = _remote()
     if remote is not None:
         return remote._rpc("state_summary")
@@ -174,7 +177,7 @@ def summary() -> dict:
         by_state: dict[str, int] = {}
         for r in rt.task_records.values():
             by_state[r["state"]] = by_state.get(r["state"], 0) + 1
-        return {
+        out = {
             "tasks": dict(rt.counters),
             "tasks_by_state": by_state,
             "actors": len(rt.actors),
@@ -192,6 +195,17 @@ def summary() -> dict:
                 "evictions": rt.store.evictions(),
             },
         }
+    # flight collection pulls worker rings over the control plane and
+    # must never run under the head lock (worker replies need it free)
+    procs = rt.flight_stats()
+    out["flight"] = {
+        "per_process": procs,
+        "events_recorded": sum(p["recorded"] for p in procs),
+        "events_dropped": sum(p["dropped"] for p in procs),
+    }
+    out["active_channels"] = sum(
+        p["chan_open"] - p["chan_closed"] for p in procs)
+    return out
 
 
 def memory_summary(limit: int = 1000) -> dict:
@@ -352,6 +366,19 @@ def stop_metrics_server() -> None:
         _server = None
 
 
-def timeline() -> list[dict]:
-    """Chrome-trace events (reference: ray.timeline)."""
-    return _head().timeline()
+def timeline(flight: bool = False):
+    """Chrome-trace events (reference: ray.timeline).
+
+    ``flight=False`` keeps the classic span-tracing event list.
+    ``flight=True`` returns the full flight-recorder view: every
+    process's event ring pulled over the control plane, clock-offset
+    stitched onto the head's monotonic clock, with span events merged
+    in — a ``{"traceEvents": [...]}`` object Perfetto/chrome://tracing
+    loads directly, showing producer-seal -> consumer-wake flow arrows
+    on every channel message."""
+    if not flight:
+        return _head().timeline()
+    remote = _remote()
+    if remote is not None:
+        return remote._rpc("flight_timeline")
+    return _head().flight_timeline()

@@ -1,0 +1,215 @@
+"""JAX step profiling: compile-vs-execute wall split, FLOPs -> MFU.
+
+The measurement layer the ROADMAP's TPU goals (MFU closure, TTFT) report
+through, so the numbers come from the framework rather than ad-hoc bench
+scripts (the Gemma-on-TPU comparison papers only trust MFU/TTFT claims
+whose methodology ships with the system). Three pieces:
+
+- :class:`StepProfiler` — per-step wall-clock accounting with the
+  compile/execute split. jit functions compile on FIRST call per static
+  key (shape bucket, sampling mode), so the profiler attributes the
+  first observation of each key to compile time and the rest to execute
+  time; callers that know better (paged_engine.warmup) record compiles
+  explicitly. Every step also lands in the flight recorder
+  (STEP_BEGIN/STEP_END), so step cadence shows up on the cluster
+  timeline next to the channel/dispatch events.
+- FLOPs estimation — ``compiled_flops(fn, *args)`` lowers+compiles a
+  jitted function out of band and reads XLA's ``cost_analysis()``;
+  :func:`mfu` divides by wall time and the device's peak. Peak FLOPs
+  come from a device-kind table (TPU generations; CPU/unknown -> None,
+  MFU then reports None rather than a made-up number).
+- Optional ``jax.profiler`` capture — :func:`trace` wraps a block in a
+  TensorBoard-loadable trace when a directory is given, and is a no-op
+  otherwise, so call sites can leave the hook in place unconditionally.
+
+Profilers are cheap enough to leave attached (two perf_counter reads and
+two flight events per step); FLOPs estimation triggers an extra XLA
+compile, so it runs only when explicitly requested.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Optional
+
+from ..core import flight
+
+# bf16 peak FLOP/s per chip by device_kind substring (public spec
+# sheets); looked up longest-match-first so "TPU v5p" beats "TPU v5"
+_PEAK_FLOPS = (
+    ("TPU v6e", 918e12),
+    ("TPU v6", 918e12),
+    ("TPU v5p", 459e12),
+    ("TPU v5e", 197e12),
+    ("TPU v5", 197e12),
+    ("TPU v4", 275e12),
+    ("TPU v3", 123e12),
+    ("TPU v2", 45e12),
+)
+
+# StepProfiler kind codes for the flight ring (exported by name)
+STEP_KINDS = {"prefill": 0, "decode": 1, "verify": 2, "update": 3,
+              "train": 4, "other": 5}
+
+
+def device_peak_flops(device=None) -> Optional[float]:
+    """Per-device peak bf16 FLOP/s, or None when unknown (CPU, new TPU
+    generations not in the table): MFU must be honest, not guessed."""
+    try:
+        import jax
+        device = device or jax.devices()[0]
+        kind = getattr(device, "device_kind", "") or ""
+    except Exception:
+        return None  # no jax / no devices: peak unknown, MFU stays None
+    for prefix, peak in _PEAK_FLOPS:
+        if prefix.lower() in kind.lower():
+            return peak
+    return None
+
+
+def _flops_of(compiled) -> Optional[float]:
+    """Pull the 'flops' entry out of a compiled executable's
+    cost_analysis(), tolerating the per-version shapes jax has used
+    (dict, list-of-dicts per computation)."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None  # backend without cost analysis: FLOPs unknown
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    val = ca.get("flops")
+    return float(val) if val else None
+
+
+def compiled_flops(fn, *args, **kwargs) -> Optional[float]:
+    """FLOPs per invocation of a jit-wrapped ``fn`` at these arg shapes,
+    via an out-of-band lower+compile (costs one extra XLA compile — call
+    once, cache the result). None when fn isn't jitted or XLA won't
+    say."""
+    try:
+        lowered = fn.lower(*args, **kwargs)
+        return _flops_of(lowered.compile())
+    except Exception:
+        return None  # not a jit fn / lowering failed: FLOPs unknown
+
+
+def mfu(flops_per_step: Optional[float], step_seconds: float,
+        n_devices: int = 1, peak: Optional[float] = None) -> Optional[float]:
+    """Model FLOPs utilization for one step, or None when either the
+    FLOPs or the device peak is unknown."""
+    peak = peak if peak is not None else device_peak_flops()
+    if not flops_per_step or not peak or step_seconds <= 0:
+        return None
+    return flops_per_step / (step_seconds * peak * max(1, n_devices))
+
+
+class StepProfiler:
+    """Wall-clock accounting for a family of jitted steps.
+
+    ``with prof.step("decode"):`` times one step; the first step seen
+    for a (kind, key) pair is booked as compile time (jit compiles on
+    first call per static key), later ones as execute time.
+    ``record_compile`` books an explicitly measured compile (warmup
+    paths). ``attach_flops`` stores a FLOPs-per-step estimate so
+    ``summary()`` can report MFU.
+    """
+
+    def __init__(self, name: str = "step", n_devices: int = 1):
+        self.name = name
+        self.n_devices = max(1, n_devices)
+        self.compile_s = 0.0
+        self.execute_s = 0.0
+        self.compiles = 0
+        self.steps = 0
+        self.flops_per_step: dict[str, float] = {}
+        self.steps_by_kind: dict[str, int] = {}
+        self._steps_by_tag: dict[tuple, int] = {}
+        self._flops_by_tag: dict[tuple, float] = {}
+        self._seen: set = set()
+        self._peak = device_peak_flops()
+
+    @contextlib.contextmanager
+    def step(self, kind: str = "other", key: Any = None):
+        code = STEP_KINDS.get(kind, STEP_KINDS["other"])
+        flight.evt(flight.STEP_BEGIN, code)
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            flight.evt(flight.STEP_END, code)
+            tag = (kind, key)
+            if tag not in self._seen:
+                # first call at this static key: XLA compiled inside it
+                self._seen.add(tag)
+                self.compile_s += dt
+                self.compiles += 1
+            else:
+                self.execute_s += dt
+                self.steps += 1
+                self.steps_by_kind[kind] = \
+                    self.steps_by_kind.get(kind, 0) + 1
+                self._steps_by_tag[tag] = \
+                    self._steps_by_tag.get(tag, 0) + 1
+
+    def record_compile(self, seconds: float, kind: str = "other",
+                       key: Any = None) -> None:
+        """Book an explicitly measured compile (e.g. warmup) and mark
+        its key warm so the next timed step counts as execute."""
+        self.compile_s += seconds
+        self.compiles += 1
+        self._seen.add((kind, key))
+
+    def attach_flops(self, kind: str, flops: Optional[float],
+                     key: Any = None) -> None:
+        """Record a FLOPs-per-step estimate for steps of ``(kind, key)``.
+        The key must be the SAME static key those steps time under: a
+        jitted program's cost is a function of its static shapes, so an
+        estimate taken at one shape must not be credited to dispatches
+        at another (an 8-row prefill estimate applied to 1-row steps
+        would inflate MFU ~8x). Steps at unestimated keys contribute
+        wall but no FLOPs — MFU understates, never overstates."""
+        if flops:
+            self.flops_per_step[kind] = float(flops)
+            self._flops_by_tag[(kind, key)] = float(flops)
+
+    def summary(self) -> dict:
+        per_step = (self.execute_s / self.steps) if self.steps else None
+        # MFU over the whole execute window: flops actually performed
+        # (per-(kind, static-key) flops x matching executed steps) over
+        # total execute wall — NOT sum-of-all-kind flops over the
+        # mixed-kind average step, and NOT full-shape estimates credited
+        # to smaller-shape dispatches; either would inflate. Steps at
+        # unestimated tags contribute wall but no flops, so a partial
+        # estimate UNDERstates MFU (honest direction).
+        done_flops = sum(
+            f * self._steps_by_tag.get(tag, 0)
+            for tag, f in self._flops_by_tag.items()) or None
+        return {
+            "name": self.name,
+            "compile_s": round(self.compile_s, 6),
+            "execute_s": round(self.execute_s, 6),
+            "compiles": self.compiles,
+            "steps": self.steps,
+            "steps_by_kind": dict(self.steps_by_kind) or None,
+            "step_wall_s": per_step,
+            "flops_per_step": self.flops_per_step or None,
+            "peak_flops": self._peak,
+            "mfu": (mfu(done_flops, self.execute_s, self.n_devices,
+                        self._peak)
+                    if self.execute_s else None),
+        }
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str]):
+    """``jax.profiler`` capture around a block when ``log_dir`` is set;
+    a no-op otherwise (leave the hook unconditional at call sites)."""
+    if not log_dir:
+        yield
+        return
+    import jax
+    with jax.profiler.trace(log_dir):
+        yield

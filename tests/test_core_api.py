@@ -63,6 +63,31 @@ def test_error_propagation(ray_start_regular):
         ray.get(boom.remote())
 
 
+def test_error_carries_remote_traceback(ray_start_regular):
+    # the cause chain must surface the REMOTE frames: `raise
+    # as_instanceof_cause() from e` keeps the RayTaskError (which
+    # formats the remote traceback) as __cause__ — a `from None` here
+    # once reduced a 1-in-13 Podracer flake to an undiagnosable
+    # one-line TypeError for two PRs
+    import traceback
+    ray = ray_start_regular
+
+    @ray.remote(max_retries=0)
+    def boom():
+        raise ValueError("kaboom with context")
+
+    try:
+        ray.get(boom.remote())
+    except ValueError as err:
+        tb = "".join(traceback.format_exception(
+            type(err), err, err.__traceback__))
+    else:
+        pytest.fail("remote ValueError was swallowed, not raised")
+    assert "in boom" in tb, tb          # the remote frame
+    assert "kaboom with context" in tb
+    assert "direct cause" in tb, tb     # chained, not suppressed
+
+
 def test_error_propagates_through_deps(ray_start_regular):
     ray = ray_start_regular
 

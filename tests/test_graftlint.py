@@ -577,6 +577,55 @@ def test_gl009_suppression():
 
 
 # ------------------------------------------------------------------ #
+# GL010 eager formatting at flight-recorder emit sites
+# ------------------------------------------------------------------ #
+
+def test_gl010_flags_formatting_args():
+    src = """
+        from ray_tpu.core import flight
+
+        def emit(oid, n):
+            flight.evt(flight.OBJ_SEAL, f"oid={oid}")
+            flight.evt(flight.OBJ_SEAL, "%s" % oid)
+            flight.evt(flight.OBJ_SEAL, "{}".format(oid))
+            flight.evt(flight.OBJ_SEAL, str(oid))
+            flight.evt(flight.OBJ_SEAL, {"n": n})
+            flight.evt(flight.OBJ_SEAL, "literal")
+    """
+    found = lint(src, rules={"GL010"})
+    assert len(found) == 6
+    kinds = " ".join(f.message for f in found)
+    for frag in ("f-string", "%-formatting", ".format() call",
+                 "str() call", "container literal", "string constant"):
+        assert frag in kinds, frag
+
+
+def test_gl010_negatives():
+    # plain ints, event-code attributes, lo48 compression and arithmetic
+    # are the intended emit shape; f-strings in OTHER calls are not ours
+    src = """
+        from ray_tpu.core import flight
+
+        def emit(oid, n, log):
+            flight.evt(flight.OBJ_SEAL, flight.lo48(oid), n)
+            flight.evt(21, n & 0xFFFF, n + 1)
+            log.info(f"sealed {oid}")
+            d = {"n": n}
+    """
+    assert lint(src, rules={"GL010"}) == []
+
+
+def test_gl010_suppression():
+    src = """
+        from ray_tpu.core import flight
+
+        def emit(tag):
+            flight.evt(1, str(tag))  # graftlint: disable=GL010
+    """
+    assert lint(src, rules={"GL010"}) == []
+
+
+# ------------------------------------------------------------------ #
 # engine: baseline mechanics + CLI
 # ------------------------------------------------------------------ #
 

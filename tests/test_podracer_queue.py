@@ -158,6 +158,31 @@ def test_weight_broadcast_subscriber_skips_to_newest(ray_start_regular):
     wb.sweep()
 
 
+def test_weight_subscriber_rejects_corrupt_payload(ray_start_regular):
+    """A corrupted weight slot fails LOUDLY at the subscriber, naming
+    the slot — not as an opaque TypeError later inside the jitted
+    policy (the shape the 1-in-13 sigkill-driver flake presented as)."""
+    import pytest
+    from ray_tpu.rl.podracer import RolloutQueueSpec
+    from ray_tpu.rl.podracer.sebulba import (WeightBroadcast,
+                                             WeightSubscriber, _slot,
+                                             _boot_oid)
+    store = _store(ray_start_regular)
+    spec = RolloutQueueSpec.create(1)
+    wb = WeightBroadcast(store)
+    # forge version 0 by hand: right shape class, corrupt params leaf
+    store.put(_slot(wb.base, 0), (0, time.time(), "abc"))
+    store.put(_boot_oid(wb.base), True)
+    sub = WeightSubscriber(store, wb.base, spec.stop_oid())
+    with pytest.raises(RuntimeError, match="weight slot 0 payload"):
+        sub.current()
+    # and a non-triple payload still hits the PR 6 shape guard
+    store.put(_slot(wb.base, 1), "xyz")
+    sub2 = WeightSubscriber(store, wb.base, spec.stop_oid())
+    with pytest.raises(RuntimeError, match="not the"):
+        sub2.current()
+
+
 def test_weight_subscriber_stop_aware_before_first_publish(
         ray_start_regular):
     """Teardown before the first weight publish must unblock a waiting

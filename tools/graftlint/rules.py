@@ -528,6 +528,7 @@ FRAME_MODULES = (
     "ray_tpu/core/client.py",
     "ray_tpu/core/runtime.py",
     "ray_tpu/core/node_agent.py",
+    "ray_tpu/core/flight.py",       # pull_reply builds the flight_ring frame
     "ray_tpu/util/metrics.py",
     "ray_tpu/util/tracing.py",
     "ray_tpu/util/chaos.py",
@@ -864,6 +865,67 @@ def check_seal_polling(ctx: FileContext) -> Iterable[Finding]:
                         f"sleep({v:g}) between contains() probes polls "
                         f"for a seal; use wait_sealed (futex wakes on "
                         f"seal) instead of a sleep-probe loop"))
+    return findings
+
+
+# --------------------------------------------------------------------- #
+# GL010 — eager formatting/allocation at flight-recorder emit sites
+# --------------------------------------------------------------------- #
+# Motivation: flight.evt() is budgeted at well under a microsecond so it
+# can stay ALWAYS-ON inside the zero-dispatch fast paths (core/flight.py
+# docstring). Python evaluates arguments BEFORE the call, so an f-string,
+# %-format, .format(), str()/repr() or a dict/list/set literal in evt's
+# argument list pays allocation + formatting on every emit even though
+# the recorder only stores fixed-width ints — exactly the cost the
+# struct-packed ring exists to avoid. Codes resolve to names at export
+# time; object ids compress through flight.lo48 (bytes slicing, no
+# string rendering).
+
+_GL010_STR_BUILDERS = ("str", "repr", "bytes", "hex", "format")
+
+
+def _gl010_bad_arg(arg: ast.AST) -> Optional[str]:
+    if isinstance(arg, ast.JoinedStr):
+        return "f-string"
+    if isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Mod) and (
+            isinstance(arg.left, ast.Constant)
+            and isinstance(arg.left.value, str)):
+        return "%-formatting"
+    if isinstance(arg, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                        ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+        return "container literal"
+    if isinstance(arg, ast.Call):
+        if isinstance(arg.func, ast.Attribute) and \
+                arg.func.attr == "format":
+            return ".format() call"
+        if isinstance(arg.func, ast.Name) and \
+                arg.func.id in _GL010_STR_BUILDERS:
+            return f"{arg.func.id}() call"
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return "string constant (the ring stores ints; add a code)"
+    return None
+
+
+@file_rule("GL010")
+def check_flight_emit_cost(ctx: FileContext) -> Iterable[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None)
+        if name != "evt":
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            why = _gl010_bad_arg(arg)
+            if why:
+                findings.append(Finding(
+                    "GL010", ctx.relpath, arg.lineno, arg.col_offset,
+                    f"{why} evaluated on the flight-recorder hot path; "
+                    f"evt() args must be plain ints (codes + "
+                    f"flight.lo48 ids) — formatting belongs at export "
+                    f"time"))
     return findings
 
 
