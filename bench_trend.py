@@ -12,6 +12,14 @@ vs_baseline against the round-5 pin.
 
 Run directly (it re-execs itself with the CPU-mesh env):
     python bench_trend.py
+
+`python bench_trend.py --history [--dir D] [--out trend.json]` folds the
+accumulated per-round bench artifacts (BENCH_r0N.json, BENCHCORE_r0N.json,
+BENCH_TPU_*.json, MULTICHIP_r0N.json, ...) into ONE round-over-round
+trend table — markdown to stdout, the structured JSON to --out — so a
+regression is visible at a glance instead of requiring hand-diffing N
+files of three different shapes (single-object, single-object-with-
+parsed, and JSON-lines metric records).
 """
 import json
 import os
@@ -159,6 +167,131 @@ def measure() -> float:
     return _run_child("1", "_trend_tokens_per_sec", extra)
 
 
+# --------------------------------------------------------------------- #
+# --history: fold per-round bench artifacts into one trend table
+# --------------------------------------------------------------------- #
+
+import glob as _glob
+import re as _re
+
+_ROUND_RE = _re.compile(r"_r(\d+)")
+
+
+def _metric_records(path: str):
+    """Yield {"metric", "value", "vs_baseline"} records from one bench
+    artifact, tolerating all four accumulated shapes: a JSON-lines file
+    of metric records (BENCHCORE r05 / BENCH_TPU), a wrapper object with
+    a "metrics" list (BENCHCORE r04), a single object carrying a
+    "parsed" metric record (BENCH_r0N driver wrapper), and a single
+    status object with no metric at all (MULTICHIP dryruns — reported as
+    an ok/rc pseudo-metric so tunnel regressions still show)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        obj = json.loads(text)
+    except json.JSONDecodeError:
+        obj = None
+    if isinstance(obj, dict):
+        if isinstance(obj.get("metrics"), list):
+            for rec in obj["metrics"]:
+                if isinstance(rec, dict) and "metric" in rec:
+                    yield rec
+        elif isinstance(obj.get("parsed"), dict) \
+                and "metric" in obj["parsed"]:
+            yield obj["parsed"]
+        elif "metric" in obj:
+            yield obj
+        elif "rc" in obj:
+            name = os.path.basename(path).split("_r")[0].lower()
+            yield {"metric": f"{name}_ok",
+                   "value": 1.0 if obj.get("rc") == 0 else 0.0,
+                   "vs_baseline": None}
+        return
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            yield rec
+
+
+def build_history(directory: str) -> dict:
+    """Scan `directory` for BENCH*_r*.json / MULTICHIP*_r*.json round
+    artifacts and fold them into {"rounds": [..], "metrics": {name:
+    {round: {"value", "vs_baseline"}}}}. Later files for the same
+    (metric, round) win (e.g. an *_interim refresh)."""
+    paths = sorted(_glob.glob(os.path.join(directory, "BENCH*_r*.json"))
+                   + _glob.glob(os.path.join(directory,
+                                             "MULTICHIP*_r*.json")))
+    metrics: dict = {}
+    rounds: set = set()
+    for path in paths:
+        m = _ROUND_RE.search(os.path.basename(path))
+        if m is None:
+            continue
+        rnd = int(m.group(1))
+        for rec in _metric_records(path):
+            rounds.add(rnd)
+            metrics.setdefault(rec["metric"], {})[rnd] = {
+                "value": rec.get("value"),
+                "vs_baseline": rec.get("vs_baseline"),
+            }
+    return {"rounds": sorted(rounds), "metrics": metrics,
+            "files": len(paths)}
+
+
+def _fmt_cell(cell) -> str:
+    if cell is None:
+        return ""
+    v, vb = cell.get("value"), cell.get("vs_baseline")
+    if v is None:
+        return "err"
+    s = f"{v:.4g}" if isinstance(v, (int, float)) else str(v)
+    if isinstance(vb, (int, float)):
+        s += f" ({vb:.2f}x)"
+    return s
+
+
+def history_markdown(hist: dict) -> str:
+    """Render build_history() output as one markdown table: metrics x
+    rounds, cells `value (vs_baseline x)`."""
+    rounds = hist["rounds"]
+    lines = ["| metric | " + " | ".join(f"r{r:02d}" for r in rounds)
+             + " |",
+             "|---" * (len(rounds) + 1) + "|"]
+    for name in sorted(hist["metrics"]):
+        cells = [_fmt_cell(hist["metrics"][name].get(r)) for r in rounds]
+        lines.append(f"| {name} | " + " | ".join(cells) + " |")
+    return "\n".join(lines)
+
+
+def history_main(argv) -> int:
+    import argparse
+    p = argparse.ArgumentParser(prog="bench_trend.py --history")
+    p.add_argument("--history", action="store_true")
+    p.add_argument("--dir", default=os.path.dirname(
+        os.path.abspath(__file__)))
+    p.add_argument("--out", default=None,
+                   help="also write the structured JSON here")
+    args = p.parse_args(argv)
+    hist = build_history(args.dir)
+    if not hist["metrics"]:
+        print(f"no BENCH*_r*.json artifacts under {args.dir}")
+        return 1
+    print(history_markdown(hist))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(hist, f, indent=1)
+        print(f"\nwrote {args.out} ({hist['files']} files, "
+              f"{len(hist['metrics'])} metrics, "
+              f"rounds {hist['rounds']})")
+    return 0
+
+
 def main():
     tps = measure()
     base = BASELINE_TOKENS_PER_SEC or _PIN_FILE_DEFAULT
@@ -176,5 +309,7 @@ if __name__ == "__main__":
         _child_serve()
     elif kind:
         _child()
+    elif "--history" in sys.argv[1:]:
+        sys.exit(history_main(sys.argv[1:]))
     else:
         main()

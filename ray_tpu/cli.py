@@ -15,6 +15,8 @@ Commands:
     job submit [--working-dir DIR] [--env K=V ...] [--follow] -- CMD...
     job list | job status ID | job logs ID [--follow] | job stop ID
     state tasks|actors|nodes|objects|jobs  (state API, ray list analog)
+    stack [--all]   (live thread stacks cluster-wide, ray stack analog)
+    doctor          (summary + stuck tasks + deadlocks + stacks + memory)
     timeline --out FILE
 """
 from __future__ import annotations
@@ -345,6 +347,59 @@ def cmd_memory(args) -> int:
         ray.shutdown()
 
 
+def cmd_stack(args) -> int:
+    """`ray stack` analog: live thread stacks of every process in the
+    cluster (head, workers, drivers), annotated with the task each
+    thread runs and the object/channel a parked thread waits on. The
+    default view hides idle bookkeeping threads; --all shows every
+    thread."""
+    ray, rt, _ = _client(args.address)
+    try:
+        from . import state as state_mod
+        from .core import stacks as stacks_mod
+        report = state_mod.stack_report()
+        print(stacks_mod.format_report(report, show_all=args.all))
+        return 0
+    finally:
+        ray.shutdown()
+
+
+def cmd_doctor(args) -> int:
+    """One-shot stall diagnosis: cluster summary + hang report (stuck
+    tasks with attached stacks, wait-graph deadlocks, watchdog health)
+    + live stacks + memory pressure, in that order — the first page of
+    every "why is my job hung" investigation."""
+    ray, rt, _ = _client(args.address)
+    try:
+        from . import state as state_mod
+        from .core import stacks as stacks_mod
+        s = state_mod.summary()
+        print("== cluster ==")
+        print(f"nodes {s['nodes_alive']} | workers {s['workers']} | "
+              f"actors {s['actors']} | pending tasks {s['pending_tasks']}")
+        print(f"tasks by state: {s['tasks_by_state']}")
+        st = s["object_store"]
+        print(f"object store: {st['bytes_in_use']:,}/{st['capacity']:,} "
+              f"bytes in {st['num_objects']} objects "
+              f"({st['evictions']} evictions)")
+        print("\n== hangs ==")
+        hangs = state_mod.hang_report()
+        print(stacks_mod.format_hangs(hangs))
+        print("\n== stacks ==")
+        # reuse the snapshots the hang diagnosis already collected: one
+        # cluster-wide pull serves both sections
+        print(stacks_mod.format_report(hangs, show_all=False))
+        print("== memory ==")
+        m = state_mod.memory_summary(limit=10)
+        print(f"{m['num_objects_tracked']} objects tracked, "
+              f"{m['num_transfer_pins']} transfer pins, "
+              f"{m['num_task_arg_refs']} task-arg refs")
+        # non-zero exit when something is wrong, so scripts can gate on it
+        return 1 if (hangs["stuck_tasks"] or hangs["deadlocks"]) else 0
+    finally:
+        ray.shutdown()
+
+
 def cmd_timeline(args) -> int:
     ray, rt, _ = _client(args.address)
     try:
@@ -439,6 +494,18 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--limit", type=int, default=200)
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_memory)
+
+    sp = sub.add_parser("stack", help="live thread stacks of every "
+                                      "process (`ray stack` analog)")
+    sp.add_argument("--all", action="store_true",
+                    help="include idle bookkeeping threads")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_stack)
+
+    sp = sub.add_parser("doctor", help="one-shot stall diagnosis: "
+                                       "summary + hangs + stacks + memory")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_doctor)
 
     sp = sub.add_parser("timeline", help="dump chrome trace")
     sp.add_argument("--out", default="timeline.json")

@@ -24,6 +24,7 @@ from .object_store import SharedObjectStore, SpillStore
 from .protocol import PROTOCOL_VERSION, ProtocolMismatchError
 from .worker import WorkerRuntime
 from . import flight
+from . import stacks
 from . import runtime as rt_mod
 
 
@@ -152,8 +153,11 @@ class DriverRuntime(WorkerRuntime):
         # shutting down), flight_pull (cluster flight-recorder
         # collection — the driver's ring holds the handle-side serve
         # events, and an unanswered pull would stall every collection
-        # for its full timeout), rpc replies (handled by WorkerRuntime
-        # paths), or EOF (head died -> try to reconnect).
+        # for its full timeout), stack_dump (stall-doctor live-stack
+        # collection — the driver's threads hold the handle-side serve
+        # waits, and its own wedged gets are half the hang picture),
+        # rpc replies (handled by WorkerRuntime paths), or EOF (head
+        # died -> try to reconnect).
         while True:
             try:
                 while True:
@@ -166,6 +170,8 @@ class DriverRuntime(WorkerRuntime):
                         return
                     if t == "flight_pull":
                         self.send_async(flight.pull_reply(msg))
+                    elif t == "stack_dump":
+                        self.send_async(stacks.dump_reply(msg))
             except (EOFError, OSError, TypeError):
                 # TypeError: the conn's fd was torn down mid-recv by
                 # interpreter shutdown (read(None, ...)); same as EOF

@@ -253,6 +253,23 @@ _FLAGS = [
          "flight-recorder ring capacity in events (rounded up to a "
          "power of two; 44 bytes/event — the default is ~720 KiB per "
          "process, overwritten oldest-first with a drop counter)"),
+    Flag("stall_watchdog", True,
+         "stuck-task watchdog on the head (core/stacks.py stall "
+         "doctor): per-task-name runtime EWMAs flag tasks RUNNING "
+         "beyond stuck_task_multiple x typical (with an absolute "
+         "floor), auto-attach the owning worker's live stack to the "
+         "task record, and emit rtpu_core_stuck_tasks metrics + a "
+         "task_stuck flight event"),
+    Flag("stall_watchdog_period_s", 2.0,
+         "watchdog scan period (one pass over the bounded RUNNING "
+         "task records; a scan does no control-plane traffic unless "
+         "it flags something)"),
+    Flag("stuck_task_multiple", 10.0,
+         "a task is suspect once its runtime exceeds this multiple of "
+         "its task-name EWMA (never below stuck_task_floor_s)"),
+    Flag("stuck_task_floor_s", 30.0,
+         "absolute minimum runtime before the watchdog may flag a "
+         "task — also the threshold for task names with no history"),
 ]
 
 cfg = Config(_FLAGS)

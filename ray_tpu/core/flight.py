@@ -82,6 +82,8 @@ TASK_STATE = 1        # i  (task48, state_code)
 SCHED_BEGIN = 2       # B  ()
 SCHED_END = 3         # E  ()
 BATCH_RECV = 4        # i  (n_msgs,)
+TASK_STUCK = 5        # i  (task48, over_ms)  stall-doctor watchdog flag
+DEADLOCK = 6          # i  (n_parties,)       wait-graph cycle reported
 
 # worker
 EXEC_BEGIN = 10       # B  (task48,)
@@ -139,6 +141,8 @@ CODES: dict[int, tuple] = {
     SCHED_BEGIN: ("sched_pass", "sched", "B", None, ()),
     SCHED_END: ("sched_pass", "sched", "E", None, ()),
     BATCH_RECV: ("batch_recv", "ctrl", "i", None, ("n",)),
+    TASK_STUCK: ("task_stuck", "task", "i", None, ("task", "over_ms")),
+    DEADLOCK: ("deadlock", "task", "i", None, ("parties",)),
     EXEC_BEGIN: ("task_exec", "task", "B", None, ("task",)),
     EXEC_END: ("task_exec", "task", "E", None, ("task", "ok")),
     CTRL_FLUSH: ("ctrl_flush", "ctrl", "i", None, ("n",)),

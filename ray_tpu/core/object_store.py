@@ -28,6 +28,7 @@ import numpy as np
 from .ids import ObjectID
 from .native.build import ensure_built
 from . import flight
+from . import stacks
 
 _FLAG_NORMAL = 0
 _FLAG_EXCEPTION = 1
@@ -389,8 +390,29 @@ class SharedObjectStore:
         size = ctypes.c_uint64()
         if timeout_ms < 0:
             timeout_ms = 2**31  # ~24 days; effectively infinite
-        rc = self._lib.os_get(self._handle(), oid.binary(), timeout_ms,
+        # non-blocking first: the hot path (object already sealed — every
+        # get of a computed result) pays NO beacon traffic and the same
+        # single native call as before
+        rc = self._lib.os_get(self._handle(), oid.binary(), 0,
                               ctypes.byref(off), ctypes.byref(size))
+        if rc != 0 and timeout_ms != 0:
+            # about to actually park: arm the wait beacon (stacks.py) so
+            # a live stack dump shows WHAT the native futex wait is
+            # waiting for. `armed` keeps a more specific outer beacon
+            # (channel credit waits) from being overwritten.
+            b = stacks.beacon()
+            armed = not b[0]
+            if armed:
+                ob = oid.binary()
+                stacks.set_wait(b, stacks.WAIT_GET, flight.lo48(ob),
+                                tag=stacks.wait_tag(ob))
+            try:
+                rc = self._lib.os_get(self._handle(), oid.binary(),
+                                      timeout_ms, ctypes.byref(off),
+                                      ctypes.byref(size))
+            finally:
+                if armed:
+                    stacks.clear_wait(b)
         if rc != 0:
             return None
         return self._view[off.value:off.value + size.value]
@@ -430,10 +452,25 @@ class SharedObjectStore:
                 return self._wait_sealed_chunked(oids, min_count, 0)
             return self._wait_sealed_call(oids, min_count, 0)
         flight.evt(flight.WAIT_BEGIN, n, min_count)
-        if n > self._WAIT_CHUNK:
-            out = self._wait_sealed_chunked(oids, min_count, timeout_ms)
-        else:
-            out = self._wait_sealed_call(oids, min_count, timeout_ms)
+        # wait beacon: this thread is about to park on these ids — a live
+        # stack dump (stacks.py) names the first one + the count. An
+        # already-armed beacon (an outer channel-credit wait driving this
+        # wait_sealed) wins; we only arm/clear when we armed.
+        b = stacks.beacon()
+        armed = not b[0]
+        if armed:
+            ob = oids[0].binary()
+            stacks.set_wait(b, stacks.WAIT_OBJ, flight.lo48(ob), n,
+                            tag=stacks.wait_tag(ob))
+        try:
+            if n > self._WAIT_CHUNK:
+                out = self._wait_sealed_chunked(oids, min_count,
+                                                timeout_ms)
+            else:
+                out = self._wait_sealed_call(oids, min_count, timeout_ms)
+        finally:
+            if armed:
+                stacks.clear_wait(b)
         flight.evt(flight.WAIT_END, sum(out))
         return out
 
@@ -563,9 +600,24 @@ class SharedObjectStore:
         size = ctypes.c_uint64()
         if timeout_ms < 0:
             timeout_ms = 2**31  # ~24 days; effectively infinite
-        rc = self._lib.os_chan_get(self._handle(), oid.binary(),
-                                   stop_oid.binary(), timeout_ms,
-                                   ctypes.byref(off), ctypes.byref(size))
+        # channel-wait beacon: lo48 of a slot oid equals lo48 of its
+        # channel base (slot ids share the base's first 12 bytes), so the
+        # stack report and the wait-graph fold resolve this directly
+        # against the producer endpoint tables
+        b = stacks.beacon()
+        armed = not b[0]
+        if armed:
+            ob = oid.binary()
+            stacks.set_wait(b, stacks.WAIT_CHAN, flight.lo48(ob),
+                            tag=stacks.wait_tag(ob))
+        try:
+            rc = self._lib.os_chan_get(self._handle(), oid.binary(),
+                                       stop_oid.binary(), timeout_ms,
+                                       ctypes.byref(off),
+                                       ctypes.byref(size))
+        finally:
+            if armed:
+                stacks.clear_wait(b)
         if rc == -3:
             raise ChannelStopped(f"stop flag sealed while waiting for {oid}")
         if rc != 0:

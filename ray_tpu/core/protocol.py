@@ -66,7 +66,16 @@ from __future__ import annotations
 #     An old-build worker would drop flight_pull on the floor and the
 #     head would wait out its collection timeout per pull — reject at
 #     the handshake instead.
-PROTOCOL_VERSION = 5
+# v6: stall-doctor live-stack collection frames (core/stacks.py): the
+#     head may send "stack_dump" {nonce, no_stacks} to any worker OR
+#     driver, answered with "stack_reply" {nonce, snap} carrying every
+#     thread's frames plus wait-beacon/task annotations. Like
+#     flight_pull, the reply is built on the per-connection recv
+#     threads, so a dump succeeds while the target's executor threads
+#     are wedged; an old-build peer would drop the frame and stall
+#     every stack/hang report for its full collection timeout — reject
+#     at the handshake instead.
+PROTOCOL_VERSION = 6
 
 # Bump on any incompatible change to the sqlite snapshot contents.
 # v2: named-actor keys are namespace-qualified ("ns/name"); v1 snapshots

@@ -40,6 +40,7 @@ import cloudpickle
 
 from .. import exceptions as exc
 from . import flight
+from . import stacks
 from .ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID
 from .object_store import GetTimeoutError as StoreTimeout
 from .object_store import ObjectStoreFullError, SharedObjectStore, SpillStore
@@ -468,6 +469,19 @@ class Runtime:
         # answered by the flight_ring handler as worker replies land
         self._flight_pulls: dict[bytes, dict] = {}
         self._flight_evt = threading.Event()
+        # live-stack cluster collection (stall doctor, core/stacks.py):
+        # same nonce protocol over the new stack_dump/stack_reply frames
+        self._stack_pulls: dict[bytes, dict] = {}
+        self._stack_evt = threading.Event()
+        # stuck-task watchdog: per-task-name runtime EWMAs (updated on
+        # every successful done) + scan/flag health counters; cycle keys
+        # already reported (one DEADLOCK flight event per incident, not
+        # per hang_report poll)
+        self._seen_cycles: set = set()  # guarded by: self.lock
+        self._task_ewma: dict[str, float] = {}  # guarded by: self.lock
+        self._watchdog = {"enabled": bool(cfg.stall_watchdog), "scans": 0,
+                          "flagged_total": 0, "stuck_running": 0,
+                          "last_scan": 0.0}
         flight.set_proc_name("head")
         self._sched_evt = threading.Event()
         threading.Thread(target=self._sched_pump_loop, daemon=True,
@@ -567,6 +581,8 @@ class Runtime:
                          name="rtpu-healthcheck").start()
         threading.Thread(target=self._pipeline_rebalance_loop, daemon=True,
                          name="rtpu-rebalance").start()
+        threading.Thread(target=self._stall_watchdog_loop, daemon=True,
+                         name="rtpu-stall-watchdog").start()
 
         # cross-node data plane: serve this node's store to pullers
         # (object_manager.h:119 Push/Pull analog; object_transfer.py)
@@ -908,6 +924,14 @@ class Runtime:
                 snap["offset_ns"] = off
                 rec["snap"] = snap
                 self._flight_evt.set()
+        elif t == "stack_reply":
+            # a worker's/driver's answer to stack_dump (stall doctor);
+            # wait-beacon durations are already relative in the snapshot,
+            # so no clock stitching is needed here
+            rec = self._stack_pulls.get(msg["nonce"])
+            if rec is not None:
+                rec["snap"] = msg["snap"]
+                self._stack_evt.set()
         elif t == "actor_ready":
             self._on_actor_ready(wid, msg)
         elif t == "submit":
@@ -1119,6 +1143,7 @@ class Runtime:
                     "available_resources", "node_table", "pg_wait",
                     "create_placement_group_rpc", "remove_placement_group_rpc",
                     "timeline", "flight_timeline", "flight_stats",
+                    "stack_report", "hang_report",
                     "state_list", "state_summary",
                     "memory_summary", "autoscaler_status",
                     "user_metrics_dump", "pubsub_poll",
@@ -1882,6 +1907,15 @@ class Runtime:
             while len(self.task_records) > self.task_records_max:
                 self.task_records.popitem(last=False)
         rec["state"] = state
+        if state in ("RUNNING", "RETRYING") and rec.get("stuck"):
+            # a fresh attempt starts clean: without this, a retried task
+            # is falsely listed stuck the moment it re-enters RUNNING
+            # (stale flag + stale stack from the previous attempt), and
+            # a retry that genuinely wedges later could never be
+            # re-flagged with a fresh stack
+            for k in ("stuck", "stuck_at", "threshold_s", "ewma_s",
+                      "stack"):
+                rec.pop(k, None)
         rec.update(extra)
         if self._event_file is not None:
             try:
@@ -2497,6 +2531,18 @@ class Runtime:
             if spec is not None and spec.task_id == task_id:
                 if msg["ok"]:
                     self.counters["tasks_finished"] += 1
+                    # per-task-name runtime EWMA: the stuck-task
+                    # watchdog's notion of "typical" (bounded dict —
+                    # oldest name evicted, matching task_records FIFO)
+                    dur = msg.get("dur")
+                    if isinstance(dur, (int, float)):
+                        prev = self._task_ewma.get(spec.name)
+                        self._task_ewma[spec.name] = (
+                            dur if prev is None
+                            else 0.8 * prev + 0.2 * dur)
+                        if len(self._task_ewma) > 4096:
+                            self._task_ewma.pop(
+                                next(iter(self._task_ewma)))
                     self._record_task_locked(spec, "FINISHED",
                                              finished_at=time.time(),
                                              duration_s=msg.get("dur"))
@@ -3409,6 +3455,45 @@ class Runtime:
     # flight recorder (core/flight.py) cluster collection
     # ------------------------------------------------------------------ #
 
+    def _pull_from_peers(self, make_msg, pulls: dict,
+                         evt: threading.Event, timeout_s: float,
+                         wids: Optional[list] = None):
+        """Shared nonce-pull machinery behind flight_collect and
+        stack_collect: register a nonce per connected worker/driver in
+        `pulls` (the dict the matching reply handler fills), send
+        ``make_msg(nonce)`` to each, and wait out the deadline on
+        `evt`. Returns ({nonce: {"snap"}}, {nonce: wid}) for the peers
+        that were actually sent to; late repliers are dropped at
+        cleanup. Never waits under the scheduler lock."""
+        with self.lock:
+            targets = [w for w in self.workers.values()
+                       if w.conn is not None and w.state != "dead"
+                       and (wids is None or w.wid in wids)]
+        mine: dict[bytes, dict] = {}
+        names: dict[bytes, str] = {}
+        for w in targets:
+            nonce = os.urandom(12)
+            rec = {"snap": None}
+            pulls[nonce] = rec
+            mine[nonce] = rec
+            names[nonce] = w.wid
+            if not w.send(make_msg(nonce)):
+                pulls.pop(nonce, None)
+                mine.pop(nonce, None)
+                names.pop(nonce, None)
+        deadline = time.monotonic() + timeout_s
+        try:
+            while any(r["snap"] is None for r in mine.values()):
+                remain = deadline - time.monotonic()
+                if remain <= 0:
+                    break
+                evt.wait(timeout=min(0.1, remain))
+                evt.clear()
+        finally:
+            for nonce in mine:
+                pulls.pop(nonce, None)
+        return mine, names
+
     def flight_collect(self, timeout_s: float = 3.0,
                        stats_only: bool = False) -> list[dict]:
         """Pull every live worker's flight-recorder ring (or just its
@@ -3422,30 +3507,10 @@ class Runtime:
         local = flight.snapshot(stats_only) or flight.stats()
         local["offset_ns"] = 0
         snaps = [local]
-        with self.lock:
-            targets = [w for w in self.workers.values()
-                       if w.conn is not None and w.state != "dead"]
-        pulls = {}
-        for w in targets:
-            nonce = os.urandom(12)
-            rec = {"snap": None}
-            self._flight_pulls[nonce] = rec
-            pulls[nonce] = rec
-            if not w.send({"t": "flight_pull", "nonce": nonce,
-                           "stats_only": stats_only}):
-                self._flight_pulls.pop(nonce, None)
-                pulls.pop(nonce, None)
-        deadline = time.monotonic() + timeout_s
-        try:
-            while any(r["snap"] is None for r in pulls.values()):
-                remain = deadline - time.monotonic()
-                if remain <= 0:
-                    break
-                self._flight_evt.wait(timeout=min(0.1, remain))
-                self._flight_evt.clear()
-        finally:
-            for nonce in pulls:
-                self._flight_pulls.pop(nonce, None)
+        pulls, _ = self._pull_from_peers(
+            lambda nonce: {"t": "flight_pull", "nonce": nonce,
+                           "stats_only": stats_only},
+            self._flight_pulls, self._flight_evt, timeout_s)
         snaps.extend(r["snap"] for r in pulls.values()
                      if r["snap"] is not None)
         return snaps
@@ -3486,6 +3551,340 @@ class Runtime:
             if ev["ts"] * 1000.0 >= since_ns:
                 trace["traceEvents"].append(ev)
         return trace
+
+    # ------------------------------------------------------------------ #
+    # stall doctor (core/stacks.py): live stacks, stuck-task watchdog,
+    # wait-graph deadlock detection
+    # ------------------------------------------------------------------ #
+
+    def stack_collect(self, timeout_s: float = 3.0,
+                      wids: Optional[list] = None,
+                      include_stacks: bool = True,
+                      include_local: bool = True):
+        """Pull live thread stacks (+ wait-beacon/task annotations) from
+        every connected worker AND driver over the control plane, plus
+        this process's own. Replies are built on each peer's recv thread
+        (the flight_pull precedent), so a dump succeeds even when the
+        target's executor threads are wedged — which is exactly when it
+        is needed. Returns (snapshots, unresponsive_wids); dead or
+        backlogged peers are skipped at the deadline, never waited out
+        under the scheduler lock."""
+        snaps = [stacks.capture(include_stacks)] if include_local else []
+        pulls, names = self._pull_from_peers(
+            lambda nonce: {"t": "stack_dump", "nonce": nonce,
+                           "no_stacks": not include_stacks},
+            self._stack_pulls, self._stack_evt, timeout_s, wids=wids)
+        unresponsive = [names[n] for n, r in pulls.items()
+                        if r["snap"] is None]
+        for nonce, r in pulls.items():
+            if r["snap"] is not None:
+                r["snap"]["wid"] = names[nonce]
+                snaps.append(r["snap"])
+        return snaps, unresponsive
+
+    def _stall_maps_locked(self):
+        """Resolution tables for snapshot annotation + the wait-graph
+        fold: task lo48 -> its state record, and PENDING-object lo48 ->
+        the lo48 of the task whose lineage produces it."""
+        task_by48 = {}
+        for tid_key, rec in self.task_records.items():
+            task_by48[flight.lo48(tid_key)] = rec
+        obj_task48 = {}
+        obj_hex48 = {}
+        for oid, e in self.directory.items():
+            if e.state == PENDING:
+                obj_hex48[flight.lo48(oid)] = oid.hex()
+                if e.lineage is not None:
+                    obj_task48[flight.lo48(oid)] = \
+                        flight.lo48(e.lineage.task_id)
+        return task_by48, obj_task48, obj_hex48
+
+    @staticmethod
+    def _fold_producers(snaps: list) -> dict:
+        """Channel-base lo48 -> (pid, tid) across every collected
+        process's endpoint table."""
+        producers = {}
+        for s in snaps:
+            for b48, tid in (s.get("chan_producers") or {}).items():
+                producers[int(b48)] = (s["pid"], int(tid))
+        return producers
+
+    def _annotate_snaps(self, snaps: list, maps=None) -> None:
+        """Resolve each thread's task48/wait id48 against what the head
+        knows: task names, PENDING objects and their producing tasks,
+        channel producer endpoints across every collected process.
+        `maps` lets hang_report share one _stall_maps_locked build (and
+        one lock hold) with cycle detection."""
+        if maps is None:
+            with self.lock:
+                maps = self._stall_maps_locked()
+        task_by48, obj_task48, obj_hex48 = maps
+        producers = self._fold_producers(snaps)
+        proc_of = {}
+        for s in snaps:
+            proc_of[s["pid"]] = s.get("proc") or f"pid-{s['pid']}"
+        for s in snaps:
+            for t in s.get("threads", ()):
+                t48 = t.get("task48")
+                if t48:
+                    rec = task_by48.get(t48)
+                    if rec is not None:
+                        t["task"] = (f"{rec.get('name')} "
+                                     f"[{rec.get('task_id', '')[:12]}]")
+                w = t.get("wait")
+                if not w:
+                    continue
+                id48 = w.get("id48", 0)
+                tgt = producers.get(id48)
+                if tgt is not None:
+                    w["target"] = (f"channel 0x{id48:012x} (producer: "
+                                   f"{proc_of.get(tgt[0], tgt[0])} "
+                                   f"thread {tgt[1]})")
+                    continue
+                prod48 = obj_task48.get(id48)
+                if prod48 is not None:
+                    rec = task_by48.get(prod48)
+                    if rec is not None:
+                        w["target"] = (
+                            f"object {obj_hex48.get(id48, '')[:12]} <- "
+                            f"task {rec.get('name')} "
+                            f"({rec.get('state')} on "
+                            f"{rec.get('worker', '?')})")
+                        continue
+                if id48 in obj_hex48:
+                    w["target"] = f"object {obj_hex48[id48][:12]}"
+
+    def stack_report(self, timeout_s: float = 3.0,
+                     wids: Optional[list] = None,
+                     include_stacks: bool = True) -> dict:
+        """Cluster-wide annotated live-stack report
+        (state.stack_report() / `cli stack` / GET /api/stacks)."""
+        snaps, unresponsive = self.stack_collect(
+            timeout_s=timeout_s, wids=wids,
+            include_stacks=include_stacks)
+        self._annotate_snaps(snaps)
+        return {"procs": snaps, "unresponsive": unresponsive,
+                "collected_at": time.time()}
+
+    def _detect_wait_cycles(self, snaps: list,
+                            min_wait_s: float = 1.0,
+                            maps=None) -> list[dict]:
+        """Fold wait beacons + channel endpoint tables + the object
+        directory into a waiter->producer graph and return its cycles.
+        Nodes are (pid, tid) threads; each waiting thread has at most
+        one outgoing edge (what it waits on resolves to at most one
+        producing thread), so cycle detection is one pass over a
+        functional graph.
+
+        Only waits parked at least ``min_wait_s`` become edges: the
+        snapshots are not simultaneous (each peer captures when its
+        recv loop reaches the dump, up to the collection timeout
+        apart), so millisecond-transient waits on a healthy
+        backpressured pipeline could otherwise pair up into a phantom
+        cycle. A real deadlock is sustained by definition and crosses
+        any such floor."""
+        producers = self._fold_producers(snaps)
+        threads = {(s["pid"], t["tid"]): (s, t)
+                   for s in snaps for t in s.get("threads", ())}
+        task_thread = {t["task48"]: (s["pid"], t["tid"])
+                       for s in snaps for t in s.get("threads", ())
+                       if t.get("task48")}
+        if maps is None:
+            with self.lock:
+                maps = self._stall_maps_locked()
+        _, obj_task48, _ = maps
+        edges = {}
+        for key, (s, t) in threads.items():
+            w = t.get("wait")
+            if not w or w.get("for_s", 0.0) < min_wait_s:
+                continue
+            id48 = w.get("id48", 0)
+            tgt = producers.get(id48)
+            if tgt is None:
+                prod48 = obj_task48.get(id48)
+                if prod48 is not None:
+                    tgt = task_thread.get(prod48)
+            if tgt is not None and tgt in threads and tgt != key:
+                edges[key] = tgt
+        done: set = set()
+        cycles = []
+        for start in list(edges):
+            if start in done:
+                continue
+            path, seen_at = [], {}
+            node = start
+            while node in edges and node not in done \
+                    and node not in seen_at:
+                seen_at[node] = len(path)
+                path.append(node)
+                node = edges[node]
+            if node in seen_at:
+                cyc = path[seen_at[node]:]
+                parties = []
+                for pid, tid in cyc:
+                    s, t = threads[(pid, tid)]
+                    w = t.get("wait", {})
+                    parties.append({
+                        "proc": s.get("proc") or f"pid-{pid}",
+                        "pid": pid, "tid": tid,
+                        "thread_name": t.get("name"),
+                        "task": t.get("task"),
+                        "wait_kind": w.get("kind"),
+                        "target": w.get("target")
+                        or f"0x{w.get('id48', 0):012x}",
+                    })
+                cycles.append({"parties": parties})
+            done.update(path)
+        return cycles
+
+    def hang_report(self, timeout_s: float = 3.0,
+                    min_wait_s: float = 1.0) -> dict:
+        """One-shot hang diagnosis (state.hang_report() / `cli doctor`):
+        watchdog-flagged stuck tasks (with their attached worker
+        stacks), suspected wait-graph deadlocks naming every party, and
+        watchdog health. The annotated stack snapshots the diagnosis
+        was computed from ride along as ``procs`` so consumers (`cli
+        doctor`) render them without a second cluster-wide pull."""
+        snaps, unresponsive = self.stack_collect(timeout_s=timeout_s)
+        with self.lock:
+            maps = self._stall_maps_locked()
+        # one maps build + lock hold serves annotation AND the cycle fold
+        self._annotate_snaps(snaps, maps=maps)
+        cycles = self._detect_wait_cycles(snaps, min_wait_s=min_wait_s,
+                                          maps=maps)
+        report = {"procs": snaps, "unresponsive": unresponsive,
+                  "collected_at": time.time()}
+        now = time.time()
+        with self.lock:
+            # one DEADLOCK event per incident: a poller (dashboard
+            # auto-refresh, a doctor loop) re-observing the same
+            # sustained cycle must not inflate the flight ring. A key is
+            # forgotten (so a recurrence re-reports) only when a FULL
+            # collection no longer shows it — a cycle merely invisible
+            # because one party missed the reply deadline must not be
+            # re-announced when it reappears.
+            keys = [frozenset((p["pid"], p["tid"])
+                    for p in cyc["parties"]) for cyc in cycles]
+            for key, cyc in zip(keys, cycles):
+                if key not in self._seen_cycles:
+                    flight.evt(flight.DEADLOCK, len(cyc["parties"]))
+            if not unresponsive:
+                self._seen_cycles &= set(keys)
+            self._seen_cycles |= set(keys)
+        with self.lock:
+            stuck = []
+            for rec in self.task_records.values():
+                if rec.get("stuck") and rec.get("state") == "RUNNING":
+                    r = dict(rec)
+                    r["running_s"] = now - rec.get("started_at", now)
+                    stuck.append(r)
+            wd = dict(self._watchdog)
+        return {"stuck_tasks": stuck, "deadlocks": cycles,
+                "watchdog": wd, "procs": report["procs"],
+                "unresponsive": report["unresponsive"],
+                "collected_at": report["collected_at"]}
+
+    def watchdog_health(self) -> dict:
+        with self.lock:
+            return dict(self._watchdog)
+
+    def _stall_watchdog_loop(self):
+        from .config import cfg
+        if not cfg.stall_watchdog:
+            return
+        period = max(0.1, cfg.stall_watchdog_period_s)
+        while not self._shutdown:
+            time.sleep(period)
+            if self._shutdown:
+                return
+            try:
+                self._stall_watchdog_scan()
+            except Exception:
+                pass  # diagnosis must never take down the head; the
+                # next scan retries with fresh state
+
+    def _stall_watchdog_scan(self):
+        """One watchdog pass: flag RUNNING tasks past their per-name
+        threshold (EWMA multiple, floored), attach the owning worker's
+        live stack to the task record, emit the task_stuck flight event
+        and rtpu_core_stuck_tasks metrics. A scan that flags nothing
+        does no control-plane traffic at all."""
+        from .config import cfg
+        from ..util.metrics import Counter, Gauge, cached_metric
+        now = time.time()
+        floor = cfg.stuck_task_floor_s
+        mult = cfg.stuck_task_multiple
+        newly = []
+        n_stuck = 0
+        with self.lock:
+            self._watchdog["scans"] += 1
+            self._watchdog["last_scan"] = now
+            for rec in self.task_records.values():
+                if rec.get("state") != "RUNNING":
+                    continue
+                t0 = rec.get("started_at")
+                if t0 is None:
+                    continue
+                running = now - t0
+                ewma = self._task_ewma.get(rec.get("name"))
+                thr = max(floor, mult * ewma) if ewma is not None \
+                    else floor
+                if running < thr:
+                    continue
+                n_stuck += 1
+                if not rec.get("stuck"):
+                    rec["stuck"] = True
+                    rec["stuck_at"] = now
+                    rec["threshold_s"] = thr
+                    rec["ewma_s"] = ewma
+                    # live record ref kept: the stack attaches to it
+                    # below without re-searching under the lock
+                    newly.append((rec, running, thr))
+            self._watchdog["stuck_running"] = n_stuck
+            if newly:
+                self._watchdog["flagged_total"] += len(newly)
+        cached_metric(Gauge, "rtpu_core_stuck_tasks",
+                      "tasks currently RUNNING past their stuck "
+                      "threshold").set(float(n_stuck))
+        if not newly:
+            return
+        cached_metric(Counter, "rtpu_core_stuck_tasks_total",
+                      "tasks flagged stuck by the stall watchdog"
+                      ).inc(float(len(newly)))
+        # ONE stack pull per distinct owning worker, not per task: a
+        # node wedging a whole batch at once must not serialize N
+        # 2s-deadline pulls (stalling further scans exactly when timely
+        # diagnosis matters) or spam an unresponsive worker
+        by_wid: dict[str, list] = {}
+        for rec, running, thr in newly:
+            try:
+                t48 = flight.lo48(bytes.fromhex(rec.get("task_id", "")))
+            except ValueError:
+                t48 = 0
+            flight.evt(flight.TASK_STUCK, t48,
+                       int(max(0.0, running - thr) * 1000))
+            wid = rec.get("worker")
+            if wid:
+                by_wid.setdefault(wid, []).append((rec, t48))
+        for wid, recs in by_wid.items():
+            snaps, _ = self.stack_collect(timeout_s=2.0, wids=[wid],
+                                          include_local=False)
+            if not snaps:
+                continue
+            self._annotate_snaps(snaps)
+            threads = snaps[0].get("threads", [])
+            busy = [t for t in threads
+                    if t.get("task48") or t.get("wait")]
+            with self.lock:
+                for rec, t48 in recs:
+                    if not rec.get("stuck"):
+                        # the attempt failed and a retry re-entered
+                        # RUNNING while we collected: the fresh attempt
+                        # must not inherit the wedged one's stack
+                        continue
+                    hit = [t for t in threads
+                           if t48 and t.get("task48") == t48]
+                    rec["stack"] = hit or busy or threads
 
     # ------------------------------------------------------------------ #
     # shutdown

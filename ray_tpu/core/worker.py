@@ -42,6 +42,7 @@ from .object_store import SharedObjectStore, SpillStore
 from .ref import ObjectRef
 from .task_spec import ActorSpec, TaskSpec
 from . import flight
+from . import stacks
 from . import runtime as rt_mod
 
 
@@ -863,6 +864,9 @@ class WorkerLoop:
         self.rt.current_task_name = spec.name
         t0 = time.time()
         flight.evt(flight.EXEC_BEGIN, flight.lo48(spec.task_id))
+        # live-stack annotation: this thread is running this task (the
+        # head's stack/hang reports resolve the lo48 back to the record)
+        stacks.set_task(flight.lo48(spec.task_id))
         span_rec = None
         ns_tok = _ACTIVE_NS.set(getattr(spec, "namespace", None))
         try:
@@ -899,6 +903,7 @@ class WorkerLoop:
                         pass  # store full/closing; done msg carries err
         finally:
             self._current_task_id = None
+            stacks.set_task(0)
             _ACTIVE_NS.reset(ns_tok)
         flight.evt(flight.EXEC_END, flight.lo48(spec.task_id), int(ok))
         self.rt._did_block = False
@@ -973,6 +978,7 @@ class WorkerLoop:
     def _run_actor_task(self, spec: TaskSpec):
         t0 = time.time()
         flight.evt(flight.EXEC_BEGIN, flight.lo48(spec.task_id))
+        stacks.set_task(flight.lo48(spec.task_id))
         span_rec = None
         try:
             group = getattr(spec, "concurrency_group", None)
@@ -1049,6 +1055,7 @@ class WorkerLoop:
                     self.store.put(oid, werr, is_exception=True)
                 except Exception:
                     pass  # store full/closing; done msg carries err
+        stacks.set_task(0)
         flight.evt(flight.EXEC_END, flight.lo48(spec.task_id), int(ok))
         done_msg = {"t": "done", "task_id": spec.task_id, "ok": ok,
                     "err": err, "retryable": False, "name": spec.name,
@@ -1172,6 +1179,12 @@ class WorkerLoop:
                 # head's wall-clock-bridge offset estimate, and is a
                 # buffer copy — cheap enough for this loop
                 self.rt.send_async(flight.pull_reply(msg))
+            elif t == "stack_dump":
+                # head pulling live thread stacks (stall doctor). Handled
+                # HERE, on the recv thread, exactly like flight_pull: the
+                # dump must succeed even when every executor thread is
+                # wedged — that is the whole point of the feature
+                self.rt.send_async(stacks.dump_reply(msg))
             elif t == "cancel":
                 self._cancel_current(msg["task_id"])
             elif t == "steal":

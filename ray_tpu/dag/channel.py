@@ -46,6 +46,7 @@ from typing import Any, Optional
 
 from ..core.ids import ObjectID
 from ..core import flight
+from ..core import stacks
 
 # how long one futex park lasts before the waiter re-checks its deadline
 # and (optionally) its liveness callback; a seal/stop wakes it instantly
@@ -90,7 +91,12 @@ def write_slot(store, base: bytes, seq: int, value: Any = None,
     # lands, so stamping afterwards would let a descheduled producer
     # record its seal LATER than the wake that consumed it — the edge
     # must stay ordered on a shared clock
-    flight.evt(flight.CHAN_SEAL, flight.lo48(base), seq)
+    b48 = flight.lo48(base)
+    flight.evt(flight.CHAN_SEAL, b48, seq)
+    # producer endpoint registration (one dict store): the wait-graph
+    # deadlock fold resolves "thread X parked on channel C" to THIS
+    # thread through it (stacks.py)
+    stacks.note_producer(b48)
     if push_addr is not None:
         from ..core.object_store import _FramedValue
         from ..core.object_transfer import push_object
@@ -156,7 +162,11 @@ def send_ack(store, ack_base: bytes, seq: int,
              push_addr: Optional[str] = None) -> None:
     """Seal the 1-byte ack for `seq` into the producer's store."""
     oid = slot_oid(ack_base, seq)
-    flight.evt(flight.CHAN_ACK, flight.lo48(ack_base), seq)
+    a48 = flight.lo48(ack_base)
+    flight.evt(flight.CHAN_ACK, a48, seq)
+    # the CONSUMER produces acks: a producer parked in an ack wait
+    # resolves to this thread in the wait-graph fold
+    stacks.note_producer(a48)
     if push_addr is not None:
         from ..core.object_transfer import push_object
         push_object(push_addr, oid, value=True)
@@ -174,7 +184,16 @@ def await_ack(store, ack_base: bytes, seq: int, stop_oid: ObjectID,
     from ..core.object_store import GetTimeoutError
     oid = slot_oid(ack_base, seq)
     deadline = None if timeout_s is None else time.monotonic() + timeout_s
-    flight.evt(flight.CREDIT_BEGIN, flight.lo48(ack_base), seq)
+    a48 = flight.lo48(ack_base)
+    flight.evt(flight.CREDIT_BEGIN, a48, seq)
+    # credit-wait beacon spanning the whole retirement wait: the inner
+    # wait_sealed slices see it armed and leave it in place, so a stack
+    # dump reports "channel_credit on <ack chan>" instead of a generic
+    # object wait per slice
+    bcn = stacks.beacon()
+    armed = not bcn[0]
+    if armed:
+        stacks.set_wait(bcn, stacks.WAIT_ACK, a48, tag=seq)
     try:
         while True:
             slice_ms = _WAIT_SLICE_MS
@@ -194,7 +213,9 @@ def await_ack(store, ack_base: bytes, seq: int, stop_oid: ObjectID,
             if on_idle is not None:
                 on_idle()
     finally:
-        flight.evt(flight.CREDIT_END, flight.lo48(ack_base))
+        if armed:
+            stacks.clear_wait(bcn)
+        flight.evt(flight.CREDIT_END, a48)
 
 
 def signal_stop(store, stop_oid: ObjectID) -> None:
@@ -252,6 +273,8 @@ class MultiRingReader:
         self._rr = 0  # next producer index favoured by the rotation
         self._fl_open = True
         flight.chan_opened(len(self.bases))
+        for ab in self.ack_bases:
+            stacks.note_producer(flight.lo48(ab))  # this end seals acks
 
     def _slots(self) -> list[ObjectID]:
         return [slot_oid(b, s) for b, s in zip(self.bases, self.seqs)]
@@ -345,6 +368,11 @@ class RingWriter:
         self.ring = max(1, ring)
         self.push_addr = push_addr
         self.seq = 0
+        # seed the endpoint table at construction: a deadlocked channel
+        # that never got its first write still resolves to this thread
+        # in the wait-graph fold (overwritten by the actual writing
+        # thread on the first write_slot)
+        stacks.note_producer(flight.lo48(self.base))
 
     def closed(self) -> bool:
         return self.store.contains(self.stop)
@@ -377,6 +405,7 @@ class RingReader:
         self.seq = 0
         self._fl_open = True
         flight.chan_opened()
+        stacks.note_producer(flight.lo48(self.ack_base))  # acks originate here
 
     def _fl_close(self) -> None:
         if self._fl_open:

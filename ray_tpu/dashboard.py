@@ -16,6 +16,8 @@ separate process tree, no node agents, no build step.
     # GET /api/summary | /api/nodes | /api/actors | /api/tasks
     #     /api/objects | /api/workers | /api/jobs | /api/config
     #     /api/serve   | /api/serve_metrics | /api/logs
+    #     /api/stacks  | /api/hangs   (stall doctor: live stacks + hang
+    #                                  diagnosis, see core/stacks.py)
     # GET /api/task/{id}   -> full task record + its timeline events
     # GET /api/actor/{id}  -> full actor record + per-call queues
     # GET /api/log?file=worker-X.log&tail=N -> log tail (session dir only)
@@ -220,6 +222,14 @@ def start_dashboard(port: int = 0, host: str = "127.0.0.1") -> int:
             elif kind == "timeline":
                 loop = asyncio.get_event_loop()
                 out = await loop.run_in_executor(None, rt.timeline)
+            elif kind == "stacks":
+                # cluster-wide live-stack pull (stall doctor): control-
+                # plane round trips, keep it off the dashboard loop
+                loop = asyncio.get_event_loop()
+                out = await loop.run_in_executor(None, rt.stack_report)
+            elif kind == "hangs":
+                loop = asyncio.get_event_loop()
+                out = await loop.run_in_executor(None, rt.hang_report)
             elif kind in ("tasks", "actors", "objects", "nodes", "workers"):
                 fn = getattr(state_api, f"list_{kind}")
                 out = fn(limit) if kind in ("tasks", "actors",

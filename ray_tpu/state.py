@@ -205,7 +205,35 @@ def summary() -> dict:
     }
     out["active_channels"] = sum(
         p["chan_open"] - p["chan_closed"] for p in procs)
+    # stall-doctor watchdog health (scan counters only — a summary poll
+    # must never trigger a cluster-wide stack collection)
+    out["watchdog"] = rt.watchdog_health()
     return out
+
+
+def stack_report(timeout_s: float = 3.0) -> dict:
+    """Cluster-wide live thread stacks (reference: `ray stack`), pulled
+    over the control plane from every worker and driver and annotated
+    with what the head knows: the task each thread is executing, the
+    object/channel a parked thread is waiting on (wait beacons) and who
+    produces it. Works while executor threads are wedged — replies come
+    from each peer's recv thread."""
+    remote = _remote()
+    if remote is not None:
+        return remote._rpc("stack_report", timeout_s)
+    return _head().stack_report(timeout_s=timeout_s)
+
+
+def hang_report(timeout_s: float = 3.0) -> dict:
+    """One-shot hang diagnosis: watchdog-flagged stuck tasks (with the
+    owning worker's stack attached), suspected wait-graph deadlocks
+    naming the tasks/channels/threads in each cycle, and watchdog
+    health. The stall doctor's `cli doctor` and GET /api/hangs read
+    exactly this."""
+    remote = _remote()
+    if remote is not None:
+        return remote._rpc("hang_report", timeout_s)
+    return _head().hang_report(timeout_s=timeout_s)
 
 
 def memory_summary(limit: int = 1000) -> dict:

@@ -1,0 +1,79 @@
+"""bench_trend.py --history smoke: the round-over-round trend fold
+tolerates every accumulated artifact shape and renders one table."""
+import importlib.util
+import json
+import os
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_bench_trend():
+    spec = importlib.util.spec_from_file_location(
+        "bench_trend", os.path.join(REPO_ROOT, "bench_trend.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_history_folds_all_artifact_shapes(tmp_path):
+    bt = _load_bench_trend()
+    # shape 1: JSON-lines metric records (BENCHCORE style)
+    (tmp_path / "BENCHCORE_r01.json").write_text(
+        '{"metric": "tasks_sync", "value": 100.0, "vs_baseline": 1.0}\n'
+        '{"metric": "tasks_async", "value": 50.0, "vs_baseline": 0.5}\n')
+    (tmp_path / "BENCHCORE_r02.json").write_text(
+        '{"metric": "tasks_sync", "value": 200.0, "vs_baseline": 2.0}\n')
+    # shape 1b: wrapper object with a metrics list (BENCHCORE r04 style)
+    (tmp_path / "BENCHWRAP_r01.json").write_text(json.dumps(
+        {"round": 1, "metrics": [
+            {"metric": "wrapped", "value": 7.0, "vs_baseline": 1.0}]}))
+    # shape 2: driver wrapper with a parsed record (BENCH_rN style)
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+        {"n": 2, "rc": 0, "parsed": {"metric": "mfu", "value": 0.4,
+                                     "vs_baseline": 1.1}}))
+    # shape 3: status-only object (MULTICHIP style) -> ok pseudo-metric
+    (tmp_path / "MULTICHIP_r01.json").write_text(
+        json.dumps({"rc": 1, "ok": False, "tail": "boom"}))
+    (tmp_path / "MULTICHIP_r02.json").write_text(
+        json.dumps({"rc": 0, "ok": True}))
+    # interim refresh for the same round wins over the earlier file
+    (tmp_path / "BENCHCORE_r02_interim.json").write_text(
+        '{"metric": "tasks_sync", "value": 250.0, "vs_baseline": 2.5}\n')
+    # junk that must not break the fold
+    (tmp_path / "BENCH_r03.json").write_text("not json at all {{{")
+
+    hist = bt.build_history(str(tmp_path))
+    assert hist["rounds"] == [1, 2]
+    m = hist["metrics"]
+    assert m["tasks_sync"][1]["value"] == 100.0
+    assert m["tasks_sync"][2]["value"] == 250.0   # interim wins
+    assert m["tasks_async"][1]["vs_baseline"] == 0.5
+    assert m["mfu"][2]["value"] == 0.4
+    assert m["wrapped"][1]["value"] == 7.0
+    assert m["multichip_ok"][1]["value"] == 0.0
+    assert m["multichip_ok"][2]["value"] == 1.0
+
+    table = bt.history_markdown(hist)
+    assert "| metric | r01 | r02 |" in table
+    assert "| tasks_sync | 100 (1.00x) | 250 (2.50x) |" in table
+
+    # CLI entry writes the structured JSON too
+    out = tmp_path / "trend.json"
+    rc = bt.history_main(["--history", "--dir", str(tmp_path),
+                          "--out", str(out)])
+    assert rc == 0
+    assert json.loads(out.read_text())["metrics"]["mfu"]["2"][
+        "value"] == 0.4
+
+
+def test_history_on_real_repo_artifacts():
+    """The accumulated BENCH*_r0*.json in the repo root fold without
+    errors and surface the core microbench series."""
+    bt = _load_bench_trend()
+    hist = bt.build_history(REPO_ROOT)
+    assert hist["files"] >= 5
+    # both core-bench rounds present: r05 is JSON-lines, r04 is the
+    # metrics-list wrapper — a missing round defeats the whole point
+    assert 4 in hist["metrics"]["single_client_tasks_async"]
+    assert 5 in hist["metrics"]["single_client_tasks_async"]
+    assert bt.history_markdown(hist).count("\n") >= 3

@@ -279,6 +279,7 @@ def test_bulk_get_error_before_hanging_ref(ray_start_regular):
     assert time.monotonic() - t0 < 20  # did not wait out the hanging ref
 
 
+@pytest.mark.slow  # 14s equivalence re-proof; the batching-ON path is exercised by the whole suite
 def test_batching_on_off_results_identical(shutdown_only):
     ray = shutdown_only
     from ray_tpu.core.config import cfg

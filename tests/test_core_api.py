@@ -123,8 +123,19 @@ def test_wait(ray_start_regular):
         return t
 
     refs = [slow.remote(0.05), slow.remote(5.0)]
+    # De-flaked: the old form asserted the 0.05s task finished inside a
+    # 3s wait timeout — a pure wall-clock margin that loses under host
+    # load (scheduling latency on a saturated single-CPU box can exceed
+    # seconds). Gate on the MEASURED completion instead: once the fast
+    # task is known finished (unbounded get), a wait must return it as
+    # ready without consuming its timeout on it.
+    assert ray.get(refs[0]) == 0.05
+    t0 = time.monotonic()
     ready, pending = ray.wait(refs, num_returns=1, timeout=3.0)
     assert len(ready) == 1 and len(pending) == 1
+    assert ready[0] == refs[0] and pending[0] == refs[1]
+    # an already-complete ref never burns the whole timeout
+    assert time.monotonic() - t0 < 3.0
     assert ray.get(ready[0]) == 0.05
 
 

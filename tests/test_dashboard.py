@@ -138,5 +138,52 @@ def test_dashboard_drilldowns_and_logs(dash):
     # traversal is rejected
     status, body = _get(port, "/api/log?file=../../etc/passwd")
     assert status == 404
+
     status, _ = _get(port, "/api/task/deadbeef")
     assert status == 404
+
+
+def test_log_tail_rejects_path_traversal(dash):
+    """The log endpoint serves ONLY basenames inside this session's dir
+    (the docstring's promise): a real .log file planted OUTSIDE the
+    session dir must be unreachable under every traversal spelling,
+    while an in-session log still serves."""
+    import os
+    import tempfile
+    import urllib.parse
+    from ray_tpu.core import runtime as rt_mod
+    ray, port = dash
+    rt = rt_mod.get_runtime_if_exists()
+
+    # plant a secret .log one level above the session dir — the target a
+    # naive join(session_dir, "../secret-XYZ.log") would leak
+    secret = "dash-traversal-secret-content"
+    fd, outside = tempfile.mkstemp(
+        suffix=".log", dir=os.path.dirname(rt.session_dir.rstrip("/")))
+    with os.fdopen(fd, "w") as f:
+        f.write(secret + "\n")
+    try:
+        name = os.path.basename(outside)
+        attempts = [
+            f"../{name}",
+            f"..%2F{name}",                      # pre-encoded slash
+            urllib.parse.quote(f"../{name}"),     # fully encoded
+            outside,                              # absolute path
+            f"foo/../../{name}",
+        ]
+        for attempt in attempts:
+            status, body = _get(port, f"/api/log?file={attempt}")
+            assert status == 404, (attempt, status)
+            assert secret not in body, f"leaked via {attempt!r}"
+        # sanity: an in-session log is still served (the defense is
+        # scoping, not a broken endpoint)
+        with open(os.path.join(rt.session_dir, "inside.log"), "w") as f:
+            f.write("inside-ok\n")
+        status, body = _get(port, "/api/log?file=inside.log")
+        assert status == 200 and "inside-ok" in body
+        # non-.log session files are refused too (cluster.json holds the
+        # authkey — the other thing scoping protects)
+        status, body = _get(port, "/api/log?file=cluster.json")
+        assert status == 404 and "authkey" not in body
+    finally:
+        os.unlink(outside)

@@ -26,6 +26,7 @@ def test_inner_join(ray):
                for r in out)
 
 
+@pytest.mark.slow  # 5s; join machinery stays covered by test_inner_join + test_join_with_blocks
 def test_left_and_outer_join(ray):
     data = _data()
     left = data.from_items([{"id": i, "a": i} for i in range(4)])
@@ -37,6 +38,7 @@ def test_left_and_outer_join(ray):
     assert [r["id"] for r in oj] == [0, 1, 2, 3, 4, 5]
 
 
+@pytest.mark.slow  # 5s; join machinery stays covered by test_inner_join + test_join_with_blocks
 def test_multi_key_join(ray):
     data = _data()
     left = data.from_items(
@@ -103,6 +105,7 @@ def test_actor_pool_then_block_ops_fuse(ray):
     assert rows == [2 * v for v in range(1000, 1020, 2)]
 
 
+@pytest.mark.slow  # 3.5s dtype variant of the joins kept in tier-1
 def test_join_mixed_key_dtypes(ray):
     """int32 vs int64 key columns must co-partition equal values."""
     import pandas as pd

@@ -51,6 +51,7 @@ def test_public_kv_api(ray_start_regular):
     assert ray.kv_del("cfg/lr") is True
 
 
+@pytest.mark.slow  # 20s; restart-path coverage stays via test_head_restart.py's driver-survives-restart (tier-1)
 def test_head_restart_restores_state():
     """Named actor + PG + job table survive a head restart (GCS FT)."""
     script1 = textwrap.dedent("""

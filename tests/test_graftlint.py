@@ -383,6 +383,43 @@ def test_gl006_real_tree_is_in_parity():
     assert run_lint(["ray_tpu"], rules={"GL006"}) == []
 
 
+def test_gl006_stack_frames_pinned_at_v6():
+    """The stall-doctor collection frames are part of the pinned wire
+    vocabulary, and the manifest version matches the code."""
+    import json as _json
+    from tools.graftlint.rules import FRAMES_MANIFEST
+    from ray_tpu.core.protocol import PROTOCOL_VERSION
+    with open(FRAMES_MANIFEST) as f:
+        manifest = _json.load(f)
+    assert manifest["protocol_version"] == PROTOCOL_VERSION == 6
+    assert "stack_dump" in manifest["frames"]
+    assert "stack_reply" in manifest["frames"]
+
+
+def test_gl006_catches_renamed_stack_dump_frame(tmp_path):
+    """Renaming the head's stack_dump send (without touching the worker
+    and driver handlers) must produce BOTH findings: the new name is
+    sent-but-unhandled, the old handlers go dead."""
+    import shutil
+    from tools.graftlint.rules import FRAME_MODULES
+    for rel in FRAME_MODULES + ("ray_tpu/core/protocol.py",):
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(f"{REPO_ROOT}/{rel}", dst)
+    rp = tmp_path / "ray_tpu/core/runtime.py"
+    src = rp.read_text()
+    assert '{"t": "stack_dump", "nonce": nonce,' in src
+    rp.write_text(src.replace('{"t": "stack_dump", "nonce": nonce,',
+                              '{"t": "stack_dump_zz9", "nonce": nonce,'))
+    found = run_lint([str(tmp_path / "ray_tpu")], root=str(tmp_path),
+                     rules={"GL006"})
+    msgs = [f.message for f in found]
+    assert any('"stack_dump_zz9" is sent but no peer handles it' in m
+               for m in msgs)
+    assert any('"stack_dump" has a handler but no sender' in m
+               for m in msgs)
+
+
 # ------------------------------------------------------------------ #
 # GL007 metric conventions
 # ------------------------------------------------------------------ #

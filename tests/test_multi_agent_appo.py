@@ -101,6 +101,7 @@ def test_multi_agent_ppo_two_policies_converge(ray4):
         algo.stop()
 
 
+@pytest.mark.slow  # 8s variant; multi-agent routing stays via test_multi_agent_rejects_unknown_policy, convergence suites run under -m slow
 def test_multi_agent_shared_policy(ray4):
     """All agents mapped onto one shared policy still learn."""
     cfg = (MultiAgentPPOConfig()

@@ -168,6 +168,7 @@ def test_propose_draft_prompt_lookup():
     assert P(np.asarray([7], np.int32), 2, 4) == []         # too short
 
 
+@pytest.mark.slow  # 18s parity re-proof; spec decode stays covered by the repetitive-text win + prefix-cache composition tests
 def test_spec_decode_exact_greedy_parity():
     """Speculation must reproduce exact greedy output, token for token,
     while emitting more than one token per dispatch once the generation

@@ -87,6 +87,7 @@ def test_pipelined_training_step_matches_gradients():
                                    rtol=5e-3, atol=5e-4)
 
 
+@pytest.mark.slow  # 4s composition re-proof; pp correctness and dp each stay proven separately
 def test_pipeline_composes_with_dp():
     """pp x dp mesh: batch sharded over dp, stages over pp."""
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -179,6 +180,7 @@ class TestInterleaved:
         np.testing.assert_allclose(np.asarray(gp["w"]), np.asarray(gs["w"]),
                                    rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.slow  # 14s; interleaved equivalence stays via test_interleaved_matches_sequential (tier-1)
     def test_interleaved_v1_is_gpipe(self):
         """num_chunks=1 must reproduce the plain GPipe result exactly."""
         mesh = build_mesh(MeshSpec(pp=2), devices=jax.devices()[:2])

@@ -40,6 +40,7 @@ def test_nested_blocking_no_deadlock(ray2):
                        timeout=120) == [3] * 6
 
 
+@pytest.mark.slow  # 13s; nested-blocking deadlock stays covered by test_nested_blocking_no_deadlock, zero-cpu blocked-flag by test_zero_cpu_tasks_oversubscribe
 def test_zero_cpu_nested_blocking_no_deadlock(ray2):
     """Zero-resource tasks hold nothing, but blocking must STILL steal
     their pipelined successors (regression: the blocked handler used to

@@ -59,6 +59,7 @@ def test_shared_system_prompt_zero_recompute():
     assert off.pool_stats()["prefix_tokens_saved"] == 0
 
 
+@pytest.mark.slow  # 6s; warm-prefix reuse stays proven by shared-system-prompt + multi-turn tests (tier-1)
 def test_warm_cache_across_sequential_requests():
     """A retired request's pages serve the next request's admission-time
     longest-prefix match (the multi-turn / repeated-system-prompt path)."""
@@ -186,6 +187,7 @@ def test_multi_turn_reuses_generated_pages():
     assert a["token_ids"] == b["token_ids"]
 
 
+@pytest.mark.slow  # 8s composition re-proof; spec decode and prefix cache each stay covered separately
 def test_spec_decode_composes_with_prefix_cache():
     """Speculative decoding on a warm prefix cache still reproduces exact
     greedy output."""

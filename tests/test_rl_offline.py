@@ -86,6 +86,7 @@ def test_cql_learns_from_mixed_data(ray):
         algo.stop()
 
 
+@pytest.mark.slow  # 7s; checkpoint roundtrip mechanics stay covered by podracer resume + train save/restore
 def test_cql_checkpoint_roundtrip(ray):
     ds = collect_transitions(ENV, 600, policy=_expert, seed=4)
     algo = (CQLConfig().environment(ENV)

@@ -19,6 +19,7 @@ def test_generate_variants_grid_and_random():
     assert all(0 <= v["b"] <= 1 and v["c"] == "fixed" for v in variants)
 
 
+@pytest.mark.slow  # 4.4s; Tuner driving stays via test_stop_criteria_iterations, variant expansion via test_generate_variants_grid_and_random
 def test_tuner_grid_best_result(ray, tmp_path):
     from ray_tpu import tune
     from ray_tpu.train.config import RunConfig

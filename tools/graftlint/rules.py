@@ -529,6 +529,7 @@ FRAME_MODULES = (
     "ray_tpu/core/runtime.py",
     "ray_tpu/core/node_agent.py",
     "ray_tpu/core/flight.py",       # pull_reply builds the flight_ring frame
+    "ray_tpu/core/stacks.py",       # dump_reply builds the stack_reply frame
     "ray_tpu/util/metrics.py",
     "ray_tpu/util/tracing.py",
     "ray_tpu/util/chaos.py",
