@@ -73,6 +73,19 @@ class PagedEngineConfig:
     # verify is one model-step of compute vs w serial steps. 0 disables.
     spec_tokens: int = 0
     spec_ngram: int = 2
+    # block-table page bucketing: every dispatch slices its block tables
+    # to the smallest power-of-two page bucket (floor 4, clamped to
+    # max_pages_per_seq) that covers the live pages PLUS the dispatch's
+    # write window, so both the plain-JAX fallback's prefix gather and
+    # the ragged kernel's page grid scale with TRUE sequence length
+    # instead of pool capacity. Each bucket is one more static program
+    # per family (same trick as the prefill-row buckets; warmup compiles
+    # the whole ladder), so "auto" engages it only when
+    # max_pages_per_seq >= 48 — short tables don't amortize the extra
+    # programs' compiles (measured: a 40-page table loses more to the
+    # extra XLA compiles than the narrower gathers win back on CI-scale
+    # models). "on"/"off" force it.
+    page_buckets: str = "auto"
     # automatic prefix caching (vLLM-style block-hash reuse): retired
     # requests park their full KV pages in a content-addressed LRU pool
     # instead of freeing them; a later request whose prompt shares a
@@ -89,6 +102,8 @@ class PagedEngineConfig:
             raise ValueError("chunk_size must be a multiple of page_size")
         if self.prefill_rows < 1 or self.decode_window < 1:
             raise ValueError("prefill_rows and decode_window must be >= 1")
+        if self.page_buckets not in ("auto", "on", "off"):
+            raise ValueError("page_buckets must be 'auto', 'on' or 'off'")
 
     @property
     def max_seq_len(self) -> int:
@@ -140,12 +155,18 @@ class PagedInferenceEngine(_EngineBase):
         self._rng_ctr = 0
         self._lock = threading.Lock()
         self._interpret = interpret
-        # jitted programs, keyed by (static unroll factor, sampling mode):
-        # unroll = decode window / prefill row count; mode = the
-        # (any_sampled, any_topk) pair so all-greedy batches compile
-        # without the categorical and no-top-k batches without the sort.
-        # Cache pytrees are donated through every one so XLA updates
-        # pages in place.
+        # block-table width bucketing (cfg.page_buckets): "auto" engages
+        # only when the table is long enough that gathering max_pages on
+        # every dispatch dominates (threshold 48 pages)
+        self._bucketing = cfg.page_buckets == "on" or (
+            cfg.page_buckets == "auto" and cfg.max_pages_per_seq >= 48)
+        # jitted programs, keyed by (static unroll factor, sampling mode,
+        # block-table page bucket): unroll = decode window / prefill row
+        # count; mode = the (any_sampled, any_topk) pair so all-greedy
+        # batches compile without the categorical and no-top-k batches
+        # without the sort; the page bucket (_page_bucket) is the table
+        # width the dispatch was sliced to. Cache pytrees are donated
+        # through every one so XLA updates pages in place.
         self._decode_win_fns: dict[tuple, Any] = {}
         self._prefill_rows_fns: dict[tuple, Any] = {}
         self._verify_fns: dict[tuple, Any] = {}
@@ -183,11 +204,47 @@ class PagedInferenceEngine(_EngineBase):
         want_logp = any(r.params.logprobs for r in reqs)
         return any_sampled, any_topk, want_logp
 
-    def _decode_window_fn(self, w: int, mode: tuple):
+    # -- block-table page buckets -----------------------------------------
+
+    _PAGE_BUCKET_FLOOR = 4
+
+    def _page_bucket(self, need_pages: int) -> int:
+        """Block-table width for a dispatch that must address
+        ``need_pages`` logical pages (live prefix + every position the
+        dispatch writes — a write past the width would CLAMP into the
+        last column and clobber a live page instead of routing to the
+        zero/sink entries beyond a row's allocation). Power-of-two,
+        floored at 4 (tiny programs don't amortize their compile),
+        clamped to max_pages_per_seq; the full width when bucketing is
+        off, so every dispatch shape matches the unbucketed engine."""
+        maxp = self.cfg.max_pages_per_seq
+        if not self._bucketing:
+            return maxp
+        need = max(int(need_pages), 1)
+        return min(maxp, max(self._PAGE_BUCKET_FLOOR,
+                             1 << (need - 1).bit_length()))
+
+    def _page_bucket_ladder(self) -> list[int]:
+        """Every width _page_bucket can return (ascending) — what warmup
+        must compile for the no-mid-burst-compiles contract to hold."""
+        maxp = self.cfg.max_pages_per_seq
+        if not self._bucketing:
+            return [maxp]
+        out = []
+        b = self._PAGE_BUCKET_FLOOR
+        while b < maxp:
+            out.append(b)
+            b <<= 1
+        out.append(maxp)
+        return out
+
+    def _decode_window_fn(self, w: int, mode: tuple, pages: int):
         """One dispatch = w decode steps for every slot: lax.scan unrolls
         decode+sample, feeding each step's sampled tokens straight back in
-        on-device. Only the [B, w] token block crosses back to the host."""
-        fn = self._decode_win_fns.get((w, mode))
+        on-device. Only the [B, w] token block crosses back to the host.
+        ``pages`` is the block-table width this program was built for
+        (_page_bucket): part of the static key, like w and the mode."""
+        fn = self._decode_win_fns.get((w, mode, pages))
         if fn is None:
             mc, page = self.cfg.model, self.cfg.page_size
             interpret = self._interpret
@@ -216,20 +273,24 @@ class PagedInferenceEngine(_EngineBase):
                 return ys.T, None, c
 
             fn = jax.jit(run, donate_argnums=(1,))
-            self._decode_win_fns[(w, mode)] = fn
+            self._decode_win_fns[(w, mode, pages)] = fn
         return fn
 
-    def _prefill_rows_fn(self, r: int, mode: tuple):
+    def _prefill_rows_fn(self, r: int, mode: tuple, pages: int):
         """One dispatch = r prefill chunk-rows + in-jit sampling of each
-        row's last-token logits (used only for prompt-completing rows)."""
-        fn = self._prefill_rows_fns.get((r, mode))
+        row's last-token logits (used only for prompt-completing rows).
+        ``pages`` = block-table width (static key, see
+        _decode_window_fn)."""
+        fn = self._prefill_rows_fns.get((r, mode, pages))
         if fn is None:
             mc, page = self.cfg.model, self.cfg.page_size
+            interpret = self._interpret
             any_sampled, any_topk, want_logp = mode
 
             def run(p, c, chunks, bts, sps, tls, key, ctr, temps, top_ks):
                 last, c = llama.prefill_paged_rows(
-                    p, chunks, c, bts, sps, tls, mc, page_size=page)
+                    p, chunks, c, bts, sps, tls, mc, page_size=page,
+                    interpret=interpret)
                 toks, lps = sample_logits_batch(
                     last, jax.random.fold_in(key, ctr), temps, top_ks,
                     any_sampled=any_sampled, any_topk=any_topk,
@@ -237,21 +298,25 @@ class PagedInferenceEngine(_EngineBase):
                 return toks, lps, c
 
             fn = jax.jit(run, donate_argnums=(1,))
-            self._prefill_rows_fns[(r, mode)] = fn
+            self._prefill_rows_fns[(r, mode, pages)] = fn
         return fn
 
-    def _verify_fn(self, r: int, s1: int, want_logp: bool = False):
+    def _verify_fn(self, r: int, s1: int, pages: int,
+                   want_logp: bool = False):
         """One dispatch = verify r rows of s1 = 1+drafts tokens; returns
         the model's greedy next token AT each fed position [r, s1] (and
         its log-probability when the batch asked for logprobs — a
-        static key, like the sampling modes)."""
-        fn = self._verify_fns.get((r, s1, want_logp))
+        static key, like the sampling modes and the ``pages``
+        block-table width)."""
+        fn = self._verify_fns.get((r, s1, pages, want_logp))
         if fn is None:
             mc, page = self.cfg.model, self.cfg.page_size
+            interpret = self._interpret
 
             def run(p, c, toks, bts, starts):
                 logits, c = llama.verify_paged_rows(
-                    p, toks, c, bts, starts, mc, page_size=page)
+                    p, toks, c, bts, starts, mc, page_size=page,
+                    interpret=interpret)
                 y = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 if not want_logp:
                     return y, None, c
@@ -261,7 +326,7 @@ class PagedInferenceEngine(_EngineBase):
                 return y, lp, c
 
             fn = jax.jit(run, donate_argnums=(1,))
-            self._verify_fns[(r, s1, want_logp)] = fn
+            self._verify_fns[(r, s1, pages, want_logp)] = fn
         return fn
 
     # -- public API --------------------------------------------------------
@@ -280,63 +345,81 @@ class PagedInferenceEngine(_EngineBase):
 
         Families: prefill rows over the power-of-two buckets, decode
         windows {1, decode_window}, and — when speculation is on — the
-        verify-row buckets. ``families`` narrows the set for replicas
-        that only ever run one side (a P/D prefill replica never
-        decodes; a decode replica never prefills — compiling the other
-        side would double deploy-time for nothing). Dummy dispatches
-        carry zero block tables and zero true_lens, so every write
-        routes to sink page 0 and no visible engine state is touched;
-        the donated caches round-trip through each program.
+        verify-row buckets; every family crossed with the block-table
+        page-bucket ladder when cfg.page_buckets engages (a dispatch's
+        table width is a static program key exactly like its row
+        count). ``families`` narrows the set for replicas that only
+        ever run one side (a P/D prefill replica never decodes; a
+        decode replica never prefills — compiling the other side would
+        double deploy-time for nothing). Dummy dispatches carry zero
+        block tables and zero true_lens, so every write routes to sink
+        page 0 and no visible engine state is touched; the donated
+        caches round-trip through each program.
         """
         import time as _time
         t0 = _time.perf_counter()
         cfg = self.cfg
-        bs, maxp, c = (cfg.max_batch_size, cfg.max_pages_per_seq,
-                       cfg.chunk_size)
+        bs, c = cfg.max_batch_size, cfg.chunk_size
         key, ctr = self._rng_base, np.int32(0)
         modes = [tuple(m) + (False,) * (3 - len(m)) for m in sample_modes]
+        buckets = self._page_bucket_ladder()
         for mode in modes:
-            rb = 1
-            while "prefill" in families:
-                rb = min(rb, cfg.prefill_rows)
-                tw = _time.perf_counter()
-                toks, _lps, self.caches = self._prefill_rows_fn(rb, mode)(
-                    self.params, self.caches,
-                    np.zeros((rb, c), np.int32),
-                    np.zeros((rb, maxp), np.int32),
-                    np.zeros((rb,), np.int32), np.zeros((rb,), np.int32),
-                    key, ctr, np.zeros((rb,), np.float32),
-                    np.zeros((rb,), np.int32))
-                np.asarray(toks)
-                # book as compile (and mark the key warm) so the first
-                # REAL dispatch after warmup counts as execute time
-                self.profiler.record_compile(
-                    _time.perf_counter() - tw, "prefill", (rb, mode))
-                if rb >= cfg.prefill_rows:
-                    break
-                rb <<= 1
-            for w in (sorted({1, cfg.decode_window})
-                      if "decode" in families else ()):
-                tw = _time.perf_counter()
-                out, _lps, self.caches = self._decode_window_fn(w, mode)(
-                    self.params, self.caches, np.zeros((bs,), np.int32),
-                    np.zeros((bs, maxp), np.int32),
-                    np.zeros((bs,), np.int32), key, ctr,
-                    np.zeros((bs,), np.float32), np.zeros((bs,), np.int32))
-                np.asarray(out)
-                self.profiler.record_compile(
-                    _time.perf_counter() - tw, "decode", (w, mode))
+            for maxp in (buckets if "prefill" in families else ()):
+                rb = 1
+                while True:
+                    rb = min(rb, cfg.prefill_rows)
+                    tw = _time.perf_counter()
+                    toks, _lps, self.caches = self._prefill_rows_fn(
+                        rb, mode, maxp)(
+                        self.params, self.caches,
+                        np.zeros((rb, c), np.int32),
+                        np.zeros((rb, maxp), np.int32),
+                        np.zeros((rb,), np.int32), np.zeros((rb,), np.int32),
+                        key, ctr, np.zeros((rb,), np.float32),
+                        np.zeros((rb,), np.int32))
+                    np.asarray(toks)
+                    # book as compile (and mark the key warm) so the first
+                    # REAL dispatch after warmup counts as execute time
+                    self.profiler.record_compile(
+                        _time.perf_counter() - tw, "prefill",
+                        (rb, mode, maxp))
+                    if rb >= cfg.prefill_rows:
+                        break
+                    rb <<= 1
+            for maxp in (buckets if "decode" in families else ()):
+                for w in sorted({1, cfg.decode_window}):
+                    tw = _time.perf_counter()
+                    out, _lps, self.caches = self._decode_window_fn(
+                        w, mode, maxp)(
+                        self.params, self.caches, np.zeros((bs,), np.int32),
+                        np.zeros((bs, maxp), np.int32),
+                        np.zeros((bs,), np.int32), key, ctr,
+                        np.zeros((bs,), np.float32),
+                        np.zeros((bs,), np.int32))
+                    np.asarray(out)
+                    self.profiler.record_compile(
+                        _time.perf_counter() - tw, "decode", (w, mode, maxp))
         if cfg.spec_tokens > 0 and "verify" in families:
-            s1, rb = cfg.spec_tokens + 1, 1
-            while True:
-                rb = min(rb, bs)
-                y, _ylp, self.caches = self._verify_fn(rb, s1)(
-                    self.params, self.caches, np.zeros((rb, s1), np.int32),
-                    np.zeros((rb, maxp), np.int32), np.zeros((rb,), np.int32))
-                np.asarray(y)
-                if rb >= bs:
-                    break
-                rb <<= 1
+            s1 = cfg.spec_tokens + 1
+            for maxp in buckets:
+                rb = 1
+                while True:
+                    rb = min(rb, bs)
+                    tw = _time.perf_counter()
+                    y, _ylp, self.caches = self._verify_fn(rb, s1, maxp)(
+                        self.params, self.caches,
+                        np.zeros((rb, s1), np.int32),
+                        np.zeros((rb, maxp), np.int32),
+                        np.zeros((rb,), np.int32))
+                    np.asarray(y)
+                    # mark warm like prefill/decode: the first REAL spec
+                    # dispatch must book as execute, not compile
+                    self.profiler.record_compile(
+                        _time.perf_counter() - tw, "verify",
+                        (rb, s1, maxp, False))
+                    if rb >= bs:
+                        break
+                    rb <<= 1
         return _time.perf_counter() - t0
 
     def has_work(self) -> bool:
@@ -593,7 +676,7 @@ class PagedInferenceEngine(_EngineBase):
         if not self._prefilling:
             return
         cfg = self.cfg
-        c, maxp = cfg.chunk_size, cfg.max_pages_per_seq
+        c = cfg.chunk_size
         # pack up to prefill_rows chunk-rows, queue order; a request with
         # several remaining chunks occupies consecutive rows (the scan
         # carries caches, so later rows see earlier rows' page writes)
@@ -618,21 +701,26 @@ class PagedInferenceEngine(_EngineBase):
         # requests' worth of latency on a remote-attached accelerator.
         r = len(rows)
         rb = min(1 << max(r - 1, 0).bit_length(), cfg.prefill_rows)
+        # block-table width bucket: widest logical page any row reads or
+        # writes this dispatch (prefix + chunk = pos + n tokens)
+        pg = cfg.page_size
+        W = self._page_bucket(max(
+            (pos + n + pg - 1) // pg for _, pos, n in rows))
         chunks = np.zeros((rb, c), np.int32)
-        bts = np.zeros((rb, maxp), np.int32)
+        bts = np.zeros((rb, W), np.int32)
         sps = np.zeros((rb,), np.int32)
         tls = np.zeros((rb,), np.int32)
         temps = np.zeros((rb,), np.float32)
         topks = np.zeros((rb,), np.int32)
         for i, (req, pos, n) in enumerate(rows):
             chunks[i, :n] = req.prompt_ids[pos:pos + n]
-            bts[i] = self._block_tables[req.slot]
+            bts[i] = self._block_tables[req.slot][:W]
             sps[i], tls[i] = pos, n
             temps[i] = req.params.temperature
             topks[i] = req.params.top_k
         mode = self._sampling_mode([q for q, _, _ in rows])
-        with self.profiler.step("prefill", (rb, mode)):
-            toks, lps, self.caches = self._prefill_rows_fn(rb, mode)(
+        with self.profiler.step("prefill", (rb, mode, W)):
+            toks, lps, self.caches = self._prefill_rows_fn(rb, mode, W)(
                 self.params, self.caches, chunks, bts, sps, tls,
                 self._rng_base, np.int32(self._rng_ctr), temps, topks)
             toks = np.asarray(toks)     # block: the step must measure
@@ -736,8 +824,13 @@ class PagedInferenceEngine(_EngineBase):
         # pad rows write only to sink page 0 and are discarded
         r, s1 = len(slots), s + 1
         rb = min(1 << max(r - 1, 0).bit_length(), cfg.max_batch_size)
+        # table-width bucket: every row writes positions start..start+s1-1,
+        # so the width must cover their pages (beyond-allocation writes
+        # then hit the row's zero entries = sink page, never a clamp)
+        W = self._page_bucket(max(
+            (self._lengths[sl] + s1 - 1) // page + 1 for sl in slots))
         toks = np.zeros((rb, s1), np.int32)
-        bts = np.zeros((rb, cfg.max_pages_per_seq), np.int32)
+        bts = np.zeros((rb, W), np.int32)
         starts = np.zeros((rb,), np.int32)
         allow: dict[int, int] = {}
         for i, slot in enumerate(slots):
@@ -745,13 +838,14 @@ class PagedInferenceEngine(_EngineBase):
             allow[slot] = self._reserve(req, s1)
             toks[i, 0] = req.out_ids[-1]
             toks[i, 1:1 + len(drafts[slot])] = drafts[slot]
-            bts[i] = self._block_tables[slot]
+            bts[i] = self._block_tables[slot][:W]
             starts[i] = self._lengths[slot]
         want_lp = any(self._active[sl].params.logprobs for sl in slots)
-        y, ylp, self.caches = self._verify_fn(rb, s1, want_lp)(
-            self.params, self.caches, toks, bts, starts)
-        y = np.asarray(y)                                   # [r, s1]
-        ylp = None if ylp is None else np.asarray(ylp)
+        with self.profiler.step("verify", (rb, s1, W, want_lp)):
+            y, ylp, self.caches = self._verify_fn(rb, s1, W, want_lp)(
+                self.params, self.caches, toks, bts, starts)
+            y = np.asarray(y)               # [r, s1]; block: measure
+            ylp = None if ylp is None else np.asarray(ylp)
         self.stats["spec_dispatches"] += 1
         emitted = 0
         for i, slot in enumerate(slots):
@@ -816,6 +910,11 @@ class PagedInferenceEngine(_EngineBase):
         # full window only when no prompt is waiting: a pending prefill
         # gets interleaved every step, keeping TTFT low under bursts
         w = 1 if not quiet else cfg.decode_window
+        # table-width bucket: the window writes positions len..len+w-1
+        # per slot, so the width covers every such page (beyond-allocation
+        # writes then hit zero entries = sink page, never a clamp)
+        W = self._page_bucket(max(
+            (self._lengths[sl] + w - 1) // page + 1 for sl in self._active))
         tokens = np.zeros((bs,), np.int32)
         lengths = np.zeros((bs,), np.int32)
         temps = np.zeros((bs,), np.float32)
@@ -823,7 +922,7 @@ class PagedInferenceEngine(_EngineBase):
         # slots not decoding this step get a zeroed block-table row: their
         # dummy writes go to sink page 0 instead of a live (possibly
         # reused) page
-        bt = np.zeros_like(self._block_tables)
+        bt = np.zeros((bs, W), np.int32)
         allow: dict[int, int] = {}          # valid tokens per slot this window
         for slot, req in self._active.items():
             allow[slot] = self._reserve(req, w)
@@ -831,10 +930,10 @@ class PagedInferenceEngine(_EngineBase):
             lengths[slot] = self._lengths[slot]
             temps[slot] = req.params.temperature
             topks[slot] = req.params.top_k
-            bt[slot] = self._block_tables[slot]
+            bt[slot] = self._block_tables[slot][:W]
         mode = self._sampling_mode(self._active.values())
-        with self.profiler.step("decode", (w, mode)):
-            out, lps, self.caches = self._decode_window_fn(w, mode)(
+        with self.profiler.step("decode", (w, mode, W)):
+            out, lps, self.caches = self._decode_window_fn(w, mode, W)(
                 self.params, self.caches, tokens, bt, lengths,
                 self._rng_base, np.int32(self._rng_ctr), temps, topks)
             out = np.asarray(out)           # [bs, w]; block to measure
@@ -1039,41 +1138,64 @@ class PagedInferenceEngine(_EngineBase):
     # -- stats -------------------------------------------------------------
 
     def estimate_flops(self) -> dict:
-        """FLOPs per dispatch for the hot program families via XLA
-        cost_analysis (one extra out-of-band compile per family — run
-        once, after warmup, not per step). Feeds profile_summary()'s
-        MFU; returns {family: flops} for the families estimated."""
+        """FLOPs per dispatch for the program families via XLA
+        cost_analysis (one extra out-of-band compile per estimated
+        program — run once, after traffic or warmup, not per step).
+
+        Length-aware: estimates are taken PER static program key —
+        (rows/window, sampling mode, block-table page bucket) — for
+        every key the profiler has executed steps under, so a dispatch
+        that ran at a short page bucket is credited its true
+        bucket-proportional attention FLOPs instead of a
+        max_pages-sized estimate (which would leave short-sequence
+        steps uncredited and profile_summary() MFU understating).
+        Before any traffic, falls back to the full-width greedy decode
+        and prefill programs. Returns {family: {key: flops}}."""
         from ..util.profiling import compiled_flops
         cfg = self.cfg
         bs, maxp = cfg.max_batch_size, cfg.max_pages_per_seq
-        key, ctr = self._rng_base, np.int32(0)
         mode = (False, False, False)
-        out = {}
-        w = cfg.decode_window
-        fl = compiled_flops(
-            self._decode_window_fn(w, mode),
-            self.params, self.caches, np.zeros((bs,), np.int32),
-            np.zeros((bs, maxp), np.int32), np.zeros((bs,), np.int32),
-            key, ctr, np.zeros((bs,), np.float32),
-            np.zeros((bs,), np.int32))
-        if fl:
-            out["decode"] = fl
-            # keyed to the full-window greedy program: dispatches at
-            # smaller windows / other sampling modes are NOT credited
-            # this cost (MFU must understate, never inflate)
-            self.profiler.attach_flops("decode", fl, key=(w, mode))
-        r = cfg.prefill_rows
-        fl = compiled_flops(
-            self._prefill_rows_fn(r, mode),
-            self.params, self.caches,
-            np.zeros((r, cfg.chunk_size), np.int32),
-            np.zeros((r, maxp), np.int32), np.zeros((r,), np.int32),
-            np.zeros((r,), np.int32), key, ctr,
-            np.zeros((r,), np.float32), np.zeros((r,), np.int32))
-        if fl:
-            out["prefill"] = fl
-            self.profiler.attach_flops("prefill", fl, key=(r, mode))
+        tags = [t for t in self.profiler.executed_tags()
+                if t[0] in ("prefill", "decode", "verify")]
+        if not tags:
+            tags = [("decode", (cfg.decode_window, mode, maxp)),
+                    ("prefill", (cfg.prefill_rows, mode, maxp))]
+        out: dict[str, dict] = {}
+        for kind, k in tags:
+            fl = compiled_flops(*self._dispatch_for_key(kind, k))
+            if fl:
+                out.setdefault(kind, {})[k] = fl
+                # credited only to steps at this EXACT static key:
+                # dispatches at other shapes/modes stay uncredited
+                # (MFU must understate, never inflate)
+                self.profiler.attach_flops(kind, fl, key=k)
         return out
+
+    def _dispatch_for_key(self, kind: str, key: tuple):
+        """(fn, *dummy_args) reproducing the static shapes of the
+        program behind a profiler step tag — used by estimate_flops to
+        cost exactly the programs that dispatched."""
+        cfg = self.cfg
+        bs, c = cfg.max_batch_size, cfg.chunk_size
+        rkey, ctr = self._rng_base, np.int32(0)
+        if kind == "decode":
+            w, mode, W = key
+            return (self._decode_window_fn(w, mode, W),
+                    self.params, self.caches, np.zeros((bs,), np.int32),
+                    np.zeros((bs, W), np.int32), np.zeros((bs,), np.int32),
+                    rkey, ctr, np.zeros((bs,), np.float32),
+                    np.zeros((bs,), np.int32))
+        if kind == "prefill":
+            rb, mode, W = key
+            return (self._prefill_rows_fn(rb, mode, W),
+                    self.params, self.caches, np.zeros((rb, c), np.int32),
+                    np.zeros((rb, W), np.int32), np.zeros((rb,), np.int32),
+                    np.zeros((rb,), np.int32), rkey, ctr,
+                    np.zeros((rb,), np.float32), np.zeros((rb,), np.int32))
+        rb, s1, W, want_lp = key                      # verify
+        return (self._verify_fn(rb, s1, W, want_lp),
+                self.params, self.caches, np.zeros((rb, s1), np.int32),
+                np.zeros((rb, W), np.int32), np.zeros((rb,), np.int32))
 
     def profile_summary(self) -> dict:
         """Step-profiler view (util/profiling.py): compile/execute wall

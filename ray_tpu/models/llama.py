@@ -579,9 +579,8 @@ def decode_paged(params: dict, tokens: jax.Array, caches: list[dict],
     the output — their token writes land in page block_tables[b, 0] slot 0
     and are overwritten on real use.
     """
-    from ..ops.paged_attention import (
-        paged_decode_attention, paged_decode_reference,
-    )
+    from ..ops.paged_attention import paged_decode_reference
+    from ..ops.ragged_paged_attention import ragged_decode_attention
 
     b = tokens.shape[0]
     rows = jnp.arange(b)
@@ -589,6 +588,10 @@ def decode_paged(params: dict, tokens: jax.Array, caches: list[dict],
     cos, sin = rope_freqs(cfg, lengths[:, None])
     page_ids = block_tables[rows, lengths // page_size]    # [B]
     offsets = lengths % page_size                          # [B]
+    # hoisted: the platform probe + partial are trace-time constants, so
+    # selecting per layer just re-evaluated them n_layers times per step
+    attend = (functools.partial(ragged_decode_attention, interpret=interpret)
+              if (interpret or _on_tpu()) else paged_decode_reference)
 
     new_caches = []
     for layer in range(cfg.n_layers):
@@ -600,9 +603,6 @@ def decode_paged(params: dict, tokens: jax.Array, caches: list[dict],
             k[:, 0].astype(cache["k"].dtype))
         v_pages = cache["v"].at[page_ids, offsets].set(
             v[:, 0].astype(cache["v"].dtype))
-        attend = paged_decode_reference if not (
-            interpret or _on_tpu()) else functools.partial(
-                paged_decode_attention, interpret=interpret)
         attn = attend(q[:, 0], k_pages, v_pages, block_tables,
                       lengths + 1)                         # [B, H, D]
         x = x + attn.reshape(b, 1, -1) @ p["wo"]
@@ -618,7 +618,8 @@ def decode_paged(params: dict, tokens: jax.Array, caches: list[dict],
 def prefill_paged_chunk(params: dict, chunk: jax.Array, caches: list[dict],
                         block_table_row: jax.Array, start_pos: jax.Array,
                         cfg: LlamaConfig, *, page_size: int,
-                        true_chunk_len: jax.Array | None = None):
+                        true_chunk_len: jax.Array | None = None,
+                        interpret: bool = False):
     """Prefill ONE page-aligned chunk of one sequence.
 
     chunk [1, C] (C a multiple of page_size, right-padded with zeros);
@@ -629,6 +630,15 @@ def prefill_paged_chunk(params: dict, chunk: jax.Array, caches: list[dict],
     (logits [C, V], updated caches) — caller picks the logit at the
     prompt's true last position.
 
+    Attention dispatch: the chunk's K/V is scattered into its pages
+    FIRST, so attention always reads pages only (prefix + causal window
+    in one predicate). On TPU (or under ``interpret``) that is the
+    ragged Pallas kernel (ops/ragged_paged_attention.py) with HBM
+    traffic tracking the row's live page count; elsewhere it is the
+    kernel's own jnp oracle (ragged_paged_reference), whose gather cost
+    scales with the block-table row WIDTH — which the engine buckets to
+    the live page count (power-of-two page buckets) at long tables.
+
     Pages past the chunk's real tokens (pad pages of the final chunk, or
     logical pages beyond the block table) are written to page 0 — the
     reserved sink page no sequence owns — so a short final chunk can never
@@ -637,14 +647,17 @@ def prefill_paged_chunk(params: dict, chunk: jax.Array, caches: list[dict],
     Chunked prefill exists so admission never stalls decode: the engine
     interleaves one bounded chunk per step (vLLM's chunked-prefill role).
     """
+    from ..ops.ragged_paged_attention import (
+        ragged_paged_attention, ragged_paged_reference,
+    )
+
     c = chunk.shape[1]
     n_chunk_pages = c // page_size
     max_pages = block_table_row.shape[0]
-    prefix_len = max_pages * page_size                    # static gather size
     positions = start_pos + jnp.arange(c)[None, :]        # [1, C]
     cos, sin = rope_freqs(cfg, positions)
-    groups = cfg.n_heads // cfg.n_kv_heads
     scale = cfg.head_dim ** -0.5
+    use_kernel = interpret or _on_tpu()
     if true_chunk_len is None:
         true_chunk_len = jnp.int32(c)
     # gather (not dynamic_slice: it clamps at the row end and would silently
@@ -663,44 +676,35 @@ def prefill_paged_chunk(params: dict, chunk: jax.Array, caches: list[dict],
         h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
         q, k, v = _qkv(h, p, cfg, cos, sin)               # [1,C,H/KVH,D]
 
-        # gathered prefix (static size; masked beyond start_pos)
-        pk = cache["k"][block_table_row].reshape(
-            1, prefix_len, cfg.n_kv_heads, cfg.head_dim)
-        pv = cache["v"][block_table_row].reshape(
-            1, prefix_len, cfg.n_kv_heads, cfg.head_dim)
-        kk = jnp.concatenate([pk, k.astype(pk.dtype)], axis=1)
-        vv = jnp.concatenate([pv, v.astype(pv.dtype)], axis=1)
-        if groups > 1:
-            kk = jnp.repeat(kk, groups, axis=2)
-            vv = jnp.repeat(vv, groups, axis=2)
-        s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                       kk.astype(jnp.float32)) * scale
-        k_pos = jnp.concatenate(
-            [jnp.arange(prefix_len),
-             start_pos + jnp.arange(c)])                  # [K]
-        prefix_valid = jnp.concatenate(
-            [jnp.arange(prefix_len) < start_pos,
-             jnp.ones((c,), bool)])
-        mask = (k_pos[None, :] <= positions[0][:, None]) & \
-            prefix_valid[None, :]                         # [C, K]
-        s = jnp.where(mask[None, None], s, -1e30)
-        w = jax.nn.softmax(s, axis=-1)
-        attn = jnp.einsum("bhqk,bkhd->bqhd", w,
-                          vv.astype(jnp.float32)).astype(cfg.dtype)
-        x = x + attn.reshape(1, c, -1) @ p["wo"]
-        x, _ = _mlp_block(x, p, cfg)
-
         # write the chunk's K/V into its (page-aligned) pages
         k_w = k[0].reshape(n_chunk_pages, page_size,
                            cfg.n_kv_heads, cfg.head_dim)
         v_w = v[0].reshape(n_chunk_pages, page_size,
                            cfg.n_kv_heads, cfg.head_dim)
-        new_caches.append({
-            "k": cache["k"].at[chunk_page_ids].set(
-                k_w.astype(cache["k"].dtype)),
-            "v": cache["v"].at[chunk_page_ids].set(
-                v_w.astype(cache["v"].dtype)),
-        })
+        k_pages = cache["k"].at[chunk_page_ids].set(
+            k_w.astype(cache["k"].dtype))
+        v_pages = cache["v"].at[chunk_page_ids].set(
+            v_w.astype(cache["v"].dtype))
+
+        # the scatter above already placed the window's K/V, so both
+        # paths attend pages only (prefix + causal window in one
+        # predicate); the jnp oracle IS the fallback — one copy of the
+        # gather/mask/grouped-GQA math to keep in sync with the kernel.
+        # Real queries (q < true_chunk_len) read only real pages; pad
+        # queries read sink-routed garbage the caller discards.
+        starts1 = jnp.reshape(start_pos, (1,)).astype(jnp.int32)
+        qlens1 = jnp.reshape(true_chunk_len, (1,)).astype(jnp.int32)
+        if use_kernel:
+            attn = ragged_paged_attention(
+                q, k_pages, v_pages, block_table_row[None], starts1,
+                qlens1, scale=scale, interpret=interpret).astype(cfg.dtype)
+        else:
+            attn = ragged_paged_reference(
+                q, k_pages, v_pages, block_table_row[None], starts1,
+                qlens1, scale=scale).astype(cfg.dtype)
+        x = x + attn.reshape(1, c, -1) @ p["wo"]
+        x, _ = _mlp_block(x, p, cfg)
+        new_caches.append({"k": k_pages, "v": v_pages})
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"],
@@ -711,7 +715,7 @@ def prefill_paged_chunk(params: dict, chunk: jax.Array, caches: list[dict],
 def prefill_paged_rows(params: dict, chunks: jax.Array, caches: list[dict],
                        bt_rows: jax.Array, start_pos: jax.Array,
                        true_lens: jax.Array, cfg: LlamaConfig, *,
-                       page_size: int):
+                       page_size: int, interpret: bool = False):
     """Prefill up to R chunk-rows in ONE compiled program.
 
     chunks [R, C] (each row one page-aligned chunk, right-padded);
@@ -733,7 +737,7 @@ def prefill_paged_rows(params: dict, chunks: jax.Array, caches: list[dict],
         chunk, bt, sp, tl = row
         logits, carry = prefill_paged_chunk(
             params, chunk[None, :], carry, bt, sp, cfg,
-            page_size=page_size, true_chunk_len=tl)
+            page_size=page_size, true_chunk_len=tl, interpret=interpret)
         last = logits[jnp.clip(tl - 1, 0, c - 1)]
         return carry, last
 
@@ -744,7 +748,8 @@ def prefill_paged_rows(params: dict, chunks: jax.Array, caches: list[dict],
 
 def verify_paged_rows(params: dict, tokens: jax.Array, caches: list[dict],
                       bt_rows: jax.Array, starts: jax.Array,
-                      cfg: LlamaConfig, *, page_size: int):
+                      cfg: LlamaConfig, *, page_size: int,
+                      interpret: bool = False):
     """Speculative-verification forward (the scorer role of vLLM-style
     speculative decoding in the reference's serving engine): for each of
     R rows feed S1 = 1 + n_draft tokens at positions
@@ -752,6 +757,10 @@ def verify_paged_rows(params: dict, tokens: jax.Array, caches: list[dict],
     K/V in place, and return logits [R, S1, V] for every fed position —
     the engine accepts the longest draft prefix the model agrees with,
     so one dispatch can emit up to S1 tokens.
+
+    Attention dispatch mirrors prefill_paged_chunk: the ragged paged
+    kernel on TPU / under ``interpret`` (the K/V scatter already happens
+    before attention here), the plain-jnp gather as fallback/oracle.
 
     Position p's K/V lands in page bt_rows[r, p // page_size] at slot
     p % page_size; positions past the block table route to sink page 0
@@ -763,11 +772,14 @@ def verify_paged_rows(params: dict, tokens: jax.Array, caches: list[dict],
     Rows run under one lax.scan carrying the caches (same shape
     discipline as prefill_paged_rows; R and S1 are static).
     """
+    from ..ops.ragged_paged_attention import (
+        ragged_paged_attention, ragged_paged_reference,
+    )
+
     maxp = bt_rows.shape[1]
-    prefix_len = maxp * page_size
     s1 = tokens.shape[1]
-    groups = cfg.n_heads // cfg.n_kv_heads
     scale = cfg.head_dim ** -0.5
+    use_kernel = interpret or _on_tpu()
 
     def body(carry, row):
         toks, bt, start = row
@@ -788,23 +800,25 @@ def verify_paged_rows(params: dict, tokens: jax.Array, caches: list[dict],
                 k[0].astype(cache["k"].dtype))
             v_pages = cache["v"].at[page_ids, offsets].set(
                 v[0].astype(cache["v"].dtype))
-            # the gather happens AFTER the scatter, so the window's own
-            # K/V is already in place: no separate in-window concat path
-            kk = k_pages[bt].reshape(1, prefix_len, cfg.n_kv_heads,
-                                     cfg.head_dim)
-            vv = v_pages[bt].reshape(1, prefix_len, cfg.n_kv_heads,
-                                     cfg.head_dim)
-            if groups > 1:
-                kk = jnp.repeat(kk, groups, axis=2)
-                vv = jnp.repeat(vv, groups, axis=2)
-            s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                           kk.astype(jnp.float32)) * scale
-            k_pos = jnp.arange(prefix_len)
-            mask = k_pos[None, :] <= positions[:, None]    # causal+self
-            s = jnp.where(mask[None, None], s, -1e30)
-            w = jax.nn.softmax(s, axis=-1)
-            attn = jnp.einsum("bhqk,bkhd->bqhd", w,
-                              vv.astype(jnp.float32)).astype(cfg.dtype)
+            if use_kernel:
+                # the scatter above already placed the window's K/V, so
+                # the ragged kernel attends pages only
+                attn = ragged_paged_attention(
+                    q, k_pages, v_pages, bt[None],
+                    jnp.reshape(start, (1,)).astype(jnp.int32),
+                    jnp.full((1,), s1, jnp.int32),
+                    scale=scale, interpret=interpret).astype(cfg.dtype)
+            else:
+                # the gather happens AFTER the scatter, so the window's
+                # own K/V is already in place — exactly the ragged
+                # oracle's contract, so the fallback IS the oracle (one
+                # copy of the gather/mask/grouped-GQA math to keep in
+                # sync with the kernel)
+                attn = ragged_paged_reference(
+                    q, k_pages, v_pages, bt[None],
+                    jnp.reshape(start, (1,)).astype(jnp.int32),
+                    jnp.full((1,), s1, jnp.int32),
+                    scale=scale).astype(cfg.dtype)
             x = x + attn.reshape(1, s1, -1) @ p["wo"]
             x, _ = _mlp_block(x, p, cfg)
             new_caches.append({"k": k_pages, "v": v_pages})

@@ -16,6 +16,12 @@ skipped (`pl.when`), and the tail page is masked by position.
 Reference role (not design): vLLM's paged attention under
 llm/_internal/serve/deployments/llm/vllm/vllm_engine.py:180 — the
 reference orchestrates it, the kernel itself is ours.
+
+This decode-specialized kernel is the ancestor of the GENERAL family in
+ops/ragged_paged_attention.py (variable query windows: prefill chunks,
+verify windows, decode as q_len=1), which the serving dispatch now
+routes through; it stays as the q_len=1 equivalence baseline and the
+home of the decode jnp oracle.
 """
 from __future__ import annotations
 
@@ -138,7 +144,12 @@ def paged_decode_attention(q, k_pages, v_pages, block_table, lengths,
 
 def paged_decode_reference(q, k_pages, v_pages, block_table, lengths,
                            scale: float | None = None):
-    """Numerical oracle (jnp gather). Same contract as the kernel."""
+    """Numerical oracle (jnp gather). Same contract as the kernel.
+
+    GQA runs as a grouped einsum against the ungathered-head K/V
+    (q reshaped [B, KVH, G, D]) — the head axes line up by construction
+    (query head h attends kv head h // G), so no O(groups) jnp.repeat
+    materialization of the gathered cache is ever built."""
     b, h, d = q.shape
     p_total, page_size, kvh, _ = k_pages.shape
     groups = h // kvh
@@ -148,13 +159,11 @@ def paged_decode_reference(q, k_pages, v_pages, block_table, lengths,
     # gather each sequence's pages -> [B, max_pages*page, KVH, D]
     k = k_pages[block_table].reshape(b, max_pages * page_size, kvh, d)
     v = v_pages[block_table].reshape(b, max_pages * page_size, kvh, d)
-    if groups > 1:
-        k = jnp.repeat(k, groups, axis=2)
-        v = jnp.repeat(v, groups, axis=2)
-    s = jnp.einsum("bhd,bkhd->bhk", q.astype(jnp.float32),
+    qg = q.reshape(b, kvh, groups, d).astype(jnp.float32)
+    s = jnp.einsum("bhgd,bkhd->bhgk", qg,
                    k.astype(jnp.float32)) * scale
     pos = jnp.arange(max_pages * page_size)[None, :]
-    s = jnp.where(pos[:, None] < lengths[:, None, None], s, NEG_INF)
+    s = jnp.where((pos < lengths[:, None])[:, None, None], s, NEG_INF)
     w = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhk,bkhd->bhd", w,
-                      v.astype(jnp.float32)).astype(q.dtype)
+    return jnp.einsum("bhgk,bkhd->bhgd", w,
+                      v.astype(jnp.float32)).reshape(b, h, d).astype(q.dtype)

@@ -162,6 +162,13 @@ class StepProfiler:
         self.compiles += 1
         self._seen.add((kind, key))
 
+    def executed_tags(self) -> list:
+        """(kind, key) tags with at least one EXECUTED step (compiles
+        excluded) — what a length-aware FLOPs estimator should cost:
+        estimating only dispatched shapes keeps the out-of-band compile
+        count at the number of programs actually used."""
+        return sorted(self._steps_by_tag, key=repr)
+
     def attach_flops(self, kind: str, flops: Optional[float],
                      key: Any = None) -> None:
         """Record a FLOPs-per-step estimate for steps of ``(kind, key)``.
@@ -170,9 +177,16 @@ class StepProfiler:
         estimate taken at one shape must not be credited to dispatches
         at another (an 8-row prefill estimate applied to 1-row steps
         would inflate MFU ~8x). Steps at unestimated keys contribute
-        wall but no FLOPs — MFU understates, never overstates."""
+        wall but no FLOPs — MFU understates, never overstates.
+
+        ``summary()['flops_per_step'][kind]`` keeps the LARGEST estimate
+        attached for the kind (the widest program) as the representative
+        per-step cost — with several keys per kind (page buckets) the
+        last-attached key would otherwise win arbitrarily; MFU always
+        uses the exact per-tag estimates regardless."""
         if flops:
-            self.flops_per_step[kind] = float(flops)
+            self.flops_per_step[kind] = max(
+                float(flops), self.flops_per_step.get(kind, 0.0))
             self._flops_by_tag[(kind, key)] = float(flops)
 
     def summary(self) -> dict:

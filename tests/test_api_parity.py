@@ -118,6 +118,7 @@ def test_in_task_namespace_resolution(ray):
         rt.namespace = old
 
 
+@pytest.mark.slow  # 8s tier-1 rebalance: max_pending_calls admission/backpressure semantics stay covered by test_max_pending_calls_backpressure above; this adds only the errors-count-as-settled prune rule
 def test_max_pending_calls_prunes_failed_results(ray):
     """Errored calls are not in flight: a handle whose every call raised
     must admit new calls (FAILED counts as settled in the prune —

@@ -121,6 +121,7 @@ def test_driver_participates(ray):
     col.destroy_collective_group("g2")
 
 
+@pytest.mark.slow  # 12s tier-1 rebalance: collective op correctness stays covered by test_collective_group_ops (all ops, inline path) and the store-backed transport by test_bulk_broadcast_crosses_own_store_node; this re-proves every op on the store-backed path
 def test_store_backed_bulk_ops(ray):
     """Payloads above collective_inline_bytes move store-to-store: the
     rendezvous actor sees only ObjectRefs (near-zero payload bytes), and

@@ -1,0 +1,324 @@
+"""Ragged paged attention: kernel-vs-oracle parity (interpret mode on
+CPU — tier-1 exercises the REAL Pallas kernel, not just the fallback),
+decode-as-q_len=1 equivalence with the original decode kernel, sink-page
+safety, and the paged-engine end-to-end contracts: kernel-on vs
+plain-JAX fallback within fp accumulation tolerance, block-table page
+bucketing changing nothing but the gather width, and the bucketed
+warmup ladder keeping the no-mid-burst-compiles contract."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.llm import SamplingParams
+from ray_tpu.llm.paged_engine import PagedEngineConfig, PagedInferenceEngine
+from ray_tpu.models import llama
+from ray_tpu.ops.ragged_paged_attention import (
+    ragged_decode_attention, ragged_paged_attention, ragged_paged_reference,
+)
+
+
+def _pools(rng, P, page, kvh, d):
+    k = jnp.asarray(rng.randn(P, page, kvh, d), jnp.float32)
+    v = jnp.asarray(rng.randn(P, page, kvh, d), jnp.float32)
+    return k, v
+
+
+def _assert_rows_close(got, ref, q_lens, atol=2e-5):
+    """Compare only the live query positions; pad rows/positions are
+    contractually garbage."""
+    for r in range(got.shape[0]):
+        n = int(q_lens[r])
+        if n:
+            np.testing.assert_allclose(
+                np.asarray(got)[r, :n], np.asarray(ref)[r, :n], atol=atol,
+                err_msg=f"row {r}")
+
+
+@pytest.mark.parametrize("groups,page", [(1, 8), (2, 8), (4, 8), (2, 16)])
+def test_kernel_matches_oracle_ragged_rows(groups, page):
+    """Parity sweep over GQA ratios {1,2,4} and both page sizes (the
+    full cross product adds interpreter wall without new code paths —
+    page size is orthogonal to the GQA loop, so one 16-page case
+    suffices) with genuinely ragged rows: a from-zero prefill window, a
+    mid-sequence verify window whose start is NOT page-aligned, a
+    tail-partial page, and an empty padding row."""
+    rng = np.random.RandomState(0)
+    kvh, d, P, maxp = 2, 32, 24, 6
+    h = kvh * groups
+    q = jnp.asarray(rng.randn(4, 8, h, d), jnp.float32)
+    kp, vp = _pools(rng, P, page, kvh, d)
+    bt = jnp.asarray(rng.randint(1, P, (4, maxp)), jnp.int32)
+    starts = jnp.asarray([0, 13, 2 * page + 3, 0], jnp.int32)
+    q_lens = jnp.asarray([8, 5, 3, 0], jnp.int32)
+    ref = ragged_paged_reference(q, kp, vp, bt, starts, q_lens)
+    got = ragged_paged_attention(q, kp, vp, bt, starts, q_lens,
+                                 interpret=True)
+    _assert_rows_close(got, ref, q_lens)
+    assert np.isfinite(np.asarray(got)).all()
+
+
+def test_kernel_skips_pages_beyond_live_count():
+    """Sink-page-0 safety: block-table entries at/beyond a row's live
+    page count point at a POISONED page; `pl.when` + the clamped index
+    map must never let it contribute (the engine zeroes those entries —
+    they alias the sink page every idle write lands in)."""
+    rng = np.random.RandomState(1)
+    page, kvh, d, P, maxp = 8, 2, 32, 16, 8
+    q = jnp.asarray(rng.randn(2, 4, 4, d), jnp.float32)
+    kp, vp = _pools(rng, P, page, kvh, d)
+    kp = kp.at[0].set(1e9)
+    vp = vp.at[0].set(1e9)
+    starts = jnp.asarray([3, 9], jnp.int32)
+    q_lens = jnp.asarray([4, 2], jnp.int32)
+    bt = rng.randint(1, P, (2, maxp)).astype(np.int32)
+    live = -(-(np.asarray(starts) + np.asarray(q_lens)) // page)
+    for r in range(2):
+        bt[r, live[r]:] = 0            # beyond-live -> poisoned sink
+    bt = jnp.asarray(bt)
+    ref = ragged_paged_reference(q, kp, vp, bt, starts, q_lens)
+    got = ragged_paged_attention(q, kp, vp, bt, starts, q_lens,
+                                 interpret=True)
+    _assert_rows_close(got, ref, q_lens)
+    assert np.isfinite(np.asarray(got)).all()
+    assert np.abs(np.asarray(got)).max() < 1e3   # poison never attended
+
+
+def test_decode_is_qlen1_of_ragged_kernel():
+    """Decode equivalence: the ragged kernel at q_len=1 must match BOTH
+    the original specialized decode kernel and the jnp decode oracle on
+    the same contract (lengths INCLUDE the current step's token)."""
+    from ray_tpu.ops.paged_attention import (
+        paged_decode_attention, paged_decode_reference,
+    )
+    rng = np.random.RandomState(2)
+    page, kvh, d, P, maxp = 16, 4, 64, 12, 4
+    q = jnp.asarray(rng.randn(3, 8, d), jnp.float32)
+    kp, vp = _pools(rng, P, page, kvh, d)
+    bt = jnp.asarray(rng.randint(0, P, (3, maxp)), jnp.int32)
+    lengths = jnp.asarray([5, 33, 64], jnp.int32)
+    old = paged_decode_attention(q, kp, vp, bt, lengths, interpret=True)
+    ref = paged_decode_reference(q, kp, vp, bt, lengths)
+    new = ragged_decode_attention(q, kp, vp, bt, lengths, interpret=True)
+    np.testing.assert_allclose(np.asarray(new), np.asarray(old), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(new), np.asarray(ref), atol=2e-5)
+
+
+def test_prefill_and_verify_kernel_vs_fallback():
+    """models/llama.py dispatch parity: prefill_paged_chunk (incl. a
+    ragged tail chunk) and verify_paged_rows produce matching logits and
+    IDENTICAL page writes whether attention runs in the ragged kernel
+    (interpret) or the plain-jnp fallback."""
+    cfg = llama.llama_tiny(vocab_size=64, n_heads=4, n_kv_heads=2, dim=32,
+                           n_layers=2, mlp_dim=64, max_seq_len=128)
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    page, maxp, P = 8, 6, 12
+    caches = llama.init_paged_cache(cfg, P, page)
+    rng = np.random.RandomState(1)
+    bt = np.zeros((maxp,), np.int32)
+    bt[:4] = [1, 2, 3, 4]
+    btj = jnp.asarray(bt)
+
+    chunk0 = jnp.asarray(rng.randint(1, 60, (1, 16)), jnp.int32)
+    lg_fb, c_fb = llama.prefill_paged_chunk(
+        params, chunk0, caches, btj, jnp.int32(0), cfg, page_size=page)
+    lg_k, c_k = llama.prefill_paged_chunk(
+        params, chunk0, caches, btj, jnp.int32(0), cfg, page_size=page,
+        interpret=True)
+    np.testing.assert_allclose(np.asarray(lg_fb), np.asarray(lg_k),
+                               rtol=2e-5, atol=2e-5)
+    # layer 0 K/V is computed BEFORE any attention so its pages match
+    # bitwise; deeper layers inherit the attention impl's fp differences
+    np.testing.assert_array_equal(np.asarray(c_fb[0]["k"]),
+                                  np.asarray(c_k[0]["k"]))
+    for a, b in zip(c_fb, c_k):
+        np.testing.assert_allclose(np.asarray(a["k"]), np.asarray(b["k"]),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(a["v"]), np.asarray(b["v"]),
+                                   rtol=2e-5, atol=2e-5)
+
+    # ragged tail: 11 of 16 tokens real; pad-page writes route to sink
+    chunk1 = jnp.asarray(rng.randint(1, 60, (1, 16)), jnp.int32)
+    lg_fb2, c_fb2 = llama.prefill_paged_chunk(
+        params, chunk1, c_fb, btj, jnp.int32(16), cfg, page_size=page,
+        true_chunk_len=jnp.int32(11))
+    lg_k2, c_k2 = llama.prefill_paged_chunk(
+        params, chunk1, c_k, btj, jnp.int32(16), cfg, page_size=page,
+        true_chunk_len=jnp.int32(11), interpret=True)
+    np.testing.assert_allclose(np.asarray(lg_fb2)[:11],
+                               np.asarray(lg_k2)[:11],
+                               rtol=2e-5, atol=2e-5)
+
+    # verify window: starts mid-page, two rows
+    toks = jnp.asarray(rng.randint(1, 60, (2, 4)), jnp.int32)
+    bt2 = np.zeros((2, maxp), np.int32)
+    bt2[0, :4] = [1, 2, 3, 4]
+    bt2[1, :2] = [5, 6]
+    starts = jnp.asarray([27, 5], jnp.int32)
+    lv_fb, _ = llama.verify_paged_rows(
+        params, toks, c_fb2, jnp.asarray(bt2), starts, cfg, page_size=page)
+    lv_k, _ = llama.verify_paged_rows(
+        params, toks, c_k2, jnp.asarray(bt2), starts, cfg, page_size=page,
+        interpret=True)
+    np.testing.assert_allclose(np.asarray(lv_fb), np.asarray(lv_k),
+                               rtol=2e-5, atol=2e-5)
+
+
+TINY = llama.llama_tiny(vocab_size=258, max_seq_len=512)
+
+
+def _mk_engine(**kw):
+    d = dict(model=TINY, max_batch_size=2, page_size=8, num_pages=256,
+             max_pages_per_seq=40, chunk_size=16, decode_window=1,
+             page_buckets="on")
+    d.update(kw)
+    return PagedInferenceEngine(PagedEngineConfig(**d), rng_seed=0)
+
+
+def test_engine_kernel_vs_fallback_end_to_end():
+    """Kernel-on (interpret) and plain-JAX-fallback engines agree on
+    greedy tokens AND chosen-token logprobs within fp32-accumulation
+    tolerance across chunked prefill + windowed decode."""
+    mk = lambda interp: PagedInferenceEngine(PagedEngineConfig(
+        model=llama.llama_tiny(vocab_size=258, max_seq_len=128,
+                               n_layers=2, dim=32, n_heads=4, n_kv_heads=2,
+                               mlp_dim=64),
+        max_batch_size=2, page_size=8, num_pages=64, max_pages_per_seq=8,
+        chunk_size=16, decode_window=1), rng_seed=0, interpret=interp)
+    kern, fall = mk(True), mk(False)
+    kern.params = fall.params
+    rng = np.random.RandomState(7)
+    prompts = [list(rng.randint(1, 250, (n,))) for n in (5, 21)]
+    sp = SamplingParams(max_tokens=4, logprobs=True)
+    a = kern.generate(prompts, sp)
+    b = fall.generate(prompts, sp)
+    for x, y in zip(a, b):
+        assert x["token_ids"] == y["token_ids"]
+        np.testing.assert_allclose(x["logprobs"], y["logprobs"],
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_engine_page_bucketing_changes_nothing_but_width():
+    """Bucketed vs forced-off engines: identical tokens and logprobs,
+    and the bucketed one actually dispatched at narrower block tables
+    than the full width. ("auto" engages only at max_pages_per_seq >=
+    48 — the production default of 64 qualifies — so this 40-page
+    config opts in with "on".)"""
+    on, off = _mk_engine(), _mk_engine(page_buckets="off")
+    assert on._bucketing and not off._bucketing
+    assert not _mk_engine(page_buckets="auto")._bucketing   # 40 < 48
+    rng = np.random.RandomState(5)
+    prompts = [list(rng.randint(1, 250, (n,))) for n in (9, 27)]
+    # greedy/no-logprobs: logprob parity across dispatch paths is
+    # test_engine_kernel_vs_fallback_end_to_end's job — asking for it
+    # here would double every family's compiled-program count
+    sp = SamplingParams(max_tokens=4)
+    a = on.generate(prompts, sp)
+    b = off.generate(prompts, sp)
+    for x, y in zip(a, b):
+        assert x["token_ids"] == y["token_ids"]
+    widths_on = {k[2] for k in on._decode_win_fns} | \
+        {k[2] for k in on._prefill_rows_fns}
+    assert widths_on and max(widths_on) < 40, widths_on
+    assert {k[2] for k in off._decode_win_fns} == {40}
+    # absolute correctness at a bucketed width: greedy == full forward
+    ids = list(prompts[1])
+    want = []
+    for _ in range(4):
+        logits = llama.apply(on.params, np.asarray([ids], np.int32), TINY)
+        nxt = int(np.argmax(np.asarray(logits[0, -1])))
+        want.append(nxt)
+        ids.append(nxt)
+    assert a[1]["token_ids"] == want
+
+    # length-aware estimate_flops: costs the EXECUTED program keys
+    # (page bucket included), so short-bucket dispatches are credited
+    # their own FLOPs — and attach targets exactly those tags
+    out = on.estimate_flops()
+    assert out, "no flops estimated"
+    for kind, per_key in out.items():
+        for key, fl in per_key.items():
+            assert fl > 0
+            assert (kind, key) in on.profiler._flops_by_tag
+            if kind == "decode":
+                _w, _mode, W = key
+                assert W in on._page_bucket_ladder()
+
+
+@pytest.mark.slow  # ~20s: ladder warmup compiles prefill+decode x 4 buckets
+def test_bucketed_warmup_covers_every_bucket_program():
+    """With bucketing engaged, warmup() compiles the whole page-bucket
+    ladder, and a burst spanning several buckets triggers ZERO new
+    program keys (the no-mid-burst-compiles contract of
+    test_warmup_covers_every_burst_program, extended to buckets)."""
+    cfg = PagedEngineConfig(
+        model=llama.llama_tiny(vocab_size=258, max_seq_len=256),
+        max_batch_size=2, page_size=8, num_pages=256,
+        max_pages_per_seq=32, chunk_size=16, prefill_rows=1,
+        decode_window=1, page_buckets="on")
+    eng = PagedInferenceEngine(cfg, rng_seed=0)
+    assert eng._bucketing
+    assert eng._page_bucket_ladder() == [4, 8, 16, 32]
+    eng.warmup()
+    families = (eng._prefill_rows_fns, eng._decode_win_fns)
+    warmed = tuple(set(d) for d in families)
+    assert {k[2] for k in eng._prefill_rows_fns} == {4, 8, 16, 32}
+    rng = np.random.RandomState(3)
+    prompts = [list(rng.randint(1, 250, (n,))) for n in (5, 120)]
+    out = eng.generate(prompts, SamplingParams(max_tokens=40))
+    assert all(r["token_ids"] for r in out)
+    for d, before in zip(families, warmed):
+        assert set(d) == before, (set(d) - before, "compiled mid-burst")
+
+
+@pytest.mark.slow  # subprocess bench smoke, ~60s
+def test_bench_kernels_quick_smoke():
+    """bench_kernels --quick must complete and report sane values: the
+    bucketed fallback dispatch never slower than 2x the full-width one
+    (it does strictly less gather work; 2x guards only against
+    collapse, not noise), all wall numbers positive."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    p = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench_kernels.py"), "--quick"],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=420)
+    assert p.returncode == 0, p.stdout + p.stderr
+    rows = [json.loads(line) for line in p.stdout.splitlines()
+            if line.startswith("{")]
+    by_name = {r["metric"]: r for r in rows}
+    for family in ("prefill", "verify", "decode"):
+        full = by_name[f"kernel_{family}_full_ms"]["value"]
+        bucket = by_name[f"kernel_{family}_bucket_ms"]["value"]
+        assert full > 0 and bucket > 0
+        assert bucket < 2 * full, (family, full, bucket)
+    assert by_name["kernel_prefill_ttft_ratio"]["value"] > 0
+
+
+def test_spec_verify_dispatches_bucketed():
+    """The bucketed speculative-verify path actually DISPATCHES: a
+    solo self-similar greedy prompt drives _spec_step through sliced
+    block tables (the verify W arithmetic covers start..start+s1-1
+    writes), reproducing exact greedy output and warming only ladder
+    widths."""
+    model = llama.llama_tiny(vocab_size=258, max_seq_len=256)
+    mk = lambda buckets: PagedInferenceEngine(PagedEngineConfig(
+        model=model, max_batch_size=2, page_size=8, num_pages=96,
+        max_pages_per_seq=24, chunk_size=16, decode_window=4,
+        spec_tokens=8, page_buckets=buckets), rng_seed=0)
+    on, off = mk("on"), mk("off")
+    on.params = off.params
+    prompt = [7, 8, 9] * 5
+    sp = SamplingParams(max_tokens=48)
+    a = off.generate([prompt], sp)[0]
+    b = on.generate([prompt], sp)[0]
+    assert a["token_ids"] == b["token_ids"]
+    assert on.stats["spec_dispatches"] > 0, on.stats
+    widths = {k[2] for k in on._verify_fns}
+    assert widths and widths <= set(on._page_bucket_ladder()), widths
+    assert max(widths) < 24, widths       # verify ran on SLICED tables
