@@ -130,6 +130,12 @@ _FLAGS = [
          "store fill fraction above which sealed objects spill to disk"),
     Flag("min_spilling_size", 1 << 20,
          "don't spill objects smaller than this (bytes)"),
+    Flag("put_copy_threads", 0,
+         "threads for the large-piece memmove on the put path (0 = auto: "
+         "4 when pieces exceed the parallel threshold; 1 = always copy "
+         "single-threaded). ctypes.memmove releases the GIL, so slicing "
+         "one multi-hundred-MiB copy across threads tracks memory "
+         "bandwidth instead of one core's share of it"),
     Flag("tracing_enabled", False,
          "propagate (trace_id, span_id) context through task submission "
          "and record per-task spans in the timeline (util/tracing.py)"),

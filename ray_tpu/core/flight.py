@@ -129,6 +129,14 @@ WEIGHT_FETCH = 63     # i  (version,)
 SAMPLE_BEGIN = 64     # B  (producer_idx,)
 SAMPLE_END = 65       # E  (producer_idx, frags)
 
+# data streaming pipelines (data/streaming) — per-block seal/consume
+# flow arrows ride the CHAN_SEAL/CHAN_WAKE records the channel layer
+# already emits (chan48:seq flow ids); these add the stage spans and
+# block-index annotations the timeline groups a pipeline by
+DATA_STAGE_BEGIN = 80  # B  (stage_idx, worker_idx)
+DATA_STAGE_END = 81    # E  (stage_idx, blocks)
+DATA_BLOCK = 82        # i  (stage_idx, block_idx)
+
 # jax step profiling (util/profiling.py)
 STEP_BEGIN = 70       # B  (kind,)
 STEP_END = 71         # E  (kind,)
@@ -176,6 +184,11 @@ CODES: dict[int, tuple] = {
     SAMPLE_BEGIN: ("rollout_sample", "rl", "B", None, ("producer",)),
     SAMPLE_END: ("rollout_sample", "rl", "E", None,
                  ("producer", "frags")),
+    DATA_STAGE_BEGIN: ("data_stage", "data", "B", None,
+                       ("stage", "worker")),
+    DATA_STAGE_END: ("data_stage", "data", "E", None,
+                     ("stage", "blocks")),
+    DATA_BLOCK: ("data_block", "data", "i", None, ("stage", "idx")),
     STEP_BEGIN: ("jax_step", "jax", "B", None, ("kind",)),
     STEP_END: ("jax_step", "jax", "E", None, ("kind",)),
     JIT_COMPILE_BEGIN: ("jit_compile", "jax", "B", None, ("key",)),

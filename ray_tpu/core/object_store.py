@@ -20,6 +20,7 @@ import os
 import pickle
 import struct
 import sys
+import threading
 from typing import Any, Optional
 
 import cloudpickle
@@ -39,6 +40,62 @@ _HEADER = struct.Struct("<BxxxIQ")  # flags, n_bufs, pickle_len
 # _FramedValue.write_into (see comment there); smaller ones stay on the
 # simpler slice-assignment path.
 _MEMMOVE_MIN = 256 * 1024
+
+# Pieces at least this large copy on a small thread pool: ctypes.memmove
+# releases the GIL, so slicing one multi-hundred-MiB memmove across
+# threads tracks the machine's memory bandwidth instead of one core's
+# share of it (the put-bandwidth path — bench_core
+# single_client_put_gigabytes profiles as ~97% this copy).
+_PARALLEL_MIN = 32 * 1024 * 1024
+_COPY_THREADS_AUTO = 4
+_COPY_THREADS_MAX = 16
+_copy_pool = None          # guarded by: _copy_pool_lock
+_copy_pool_width = 0       # guarded by: _copy_pool_lock
+_copy_pool_pid = 0         # guarded by: _copy_pool_lock
+_copy_pool_lock = threading.Lock()
+
+
+def _get_copy_pool(threads: int):
+    """The per-process copy pool, built/regrown under a lock. Fork
+    safety: a child inheriting the parent's pool object has no live
+    worker threads, so a pid change forces a rebuild."""
+    global _copy_pool, _copy_pool_width, _copy_pool_pid
+    with _copy_pool_lock:
+        if _copy_pool is None or _copy_pool_pid != os.getpid() \
+                or _copy_pool_width < threads:
+            import concurrent.futures as cf
+            # on regrow the OLD pool is simply dropped, never
+            # shutdown(): a concurrent put may have grabbed it before
+            # this lock and still needs to submit; its idle threads
+            # retire when the executor is garbage-collected after that
+            # last user drains
+            _copy_pool = cf.ThreadPoolExecutor(
+                max_workers=threads, thread_name_prefix="rtpu-copy")
+            _copy_pool_width = threads
+            _copy_pool_pid = os.getpid()
+        return _copy_pool
+
+
+def _copy_parallel(dst: int, src, n: int) -> None:
+    """memmove(dst, src, n), sliced across the copy pool for large n.
+    `src` is an int address or a bytes object."""
+    from .config import cfg
+    threads = min(cfg.put_copy_threads or _COPY_THREADS_AUTO,
+                  _COPY_THREADS_MAX)
+    if n < _PARALLEL_MIN or threads <= 1:
+        ctypes.memmove(dst, src, n)
+        return
+    if isinstance(src, bytes):
+        # zero-copy readonly view; keeps `src` alive across the workers
+        src_arr = np.frombuffer(src, np.uint8)
+        src = src_arr.ctypes.data
+    pool = _get_copy_pool(threads)
+    step = -(-n // threads)  # ceil
+    futs = [pool.submit(ctypes.memmove, dst + off, src + off,
+                        min(step, n - off))
+            for off in range(0, n, step)]
+    for f in futs:
+        f.result()
 
 
 class ObjectStoreFullError(MemoryError):
@@ -149,7 +206,7 @@ class _FramedValue:
                         ctypes.c_char.from_buffer(buf))
                 src = piece if isinstance(piece, bytes) else \
                     np.frombuffer(piece, np.uint8).ctypes.data
-                ctypes.memmove(dst_addr + pos, src, n)
+                _copy_parallel(dst_addr + pos, src, n)
             else:
                 buf[pos:pos + n] = piece
             pos += n

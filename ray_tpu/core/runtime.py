@@ -408,6 +408,17 @@ class Runtime:
         self.workers: dict[str, WorkerInfo] = {}  # guarded by: self.lock
         self.actors: dict[ActorID, ActorInfo] = {}
         self.named_actors: dict[str, ActorID] = {}
+        # dead actors' ready oids that died UNOBSERVED (no ref held, so
+        # no error object was stored — storing one per dead actor leaks
+        # forever); a late __ray_ready__ ref materializes the error from
+        # here. guarded by: self.lock
+        self._ready_failed: dict[ObjectID, str] = {}
+        # ALIVE actors' ready oids (init completed): nothing is sealed
+        # under a ready oid up front — one object per actor that nobody
+        # reads would leak — so a __ray_ready__ ref materializes the
+        # "ok" payload lazily at ref-add, and its refcount frees it.
+        # guarded by: self.lock
+        self._ready_ok: set[ObjectID] = set()
         self.pgs: dict[PlacementGroupID, PlacementGroupState] = {}
         self.pending = _PendingQueues()  # guarded by: self.lock
         self._sweeping_failed_deps = False
@@ -1667,6 +1678,35 @@ class Runtime:
     def _ref_add_locked(self, oid: ObjectID, holder: str,
                         from_transfer: bool):
         self.interest.setdefault(oid, set()).add(holder)
+        if oid in self._ready_ok and not self.store.contains(oid):
+            # an ALIVE actor's ready oid gains an observer: seal the
+            # "ok" payload now (nothing is stored up front — see
+            # _ready_ok) so ray.get(h.__ray_ready__()) resolves; this
+            # ref's refcount frees it, and a later re-observation
+            # re-materializes
+            try:
+                self.store.put(oid, True)
+                if oid not in self.directory:
+                    self.directory[oid] = DirEntry(READY)
+            except Exception:
+                pass  # store full: get() falls back to ensure/locate
+        # not popped: the entry persists (one small dict slot per dead
+        # actor) so every FUTURE ref — including one deserialized after
+        # the first observer's error object was freed — re-materializes
+        brief = self._ready_failed.get(oid)
+        if brief is not None and not self.store.contains(oid):
+            # a dead actor's payload-less ready oid gains its first
+            # observer: materialize the death error now so get() raises
+            # it instead of spinning on a missing object; this ref's
+            # refcount frees it like any task result. (Scoped to ready
+            # oids via the registry — a generic FAILED entry may hold
+            # its real, differently-typed error on a remote store.)
+            self._store_error(oid, exc.ActorDiedError(brief))
+            if oid not in self.directory:
+                # the original entry may have been freed by an earlier
+                # ready ref's drop; without one, _maybe_free could
+                # never reclaim the error object we just stored
+                self.directory[oid] = DirEntry(FAILED)
         if from_transfer:
             # clamp at 0: deserializations of refs embedded in STORED
             # objects carry no pin (containment edges protect those), and
@@ -2687,9 +2727,21 @@ class Runtime:
                     "actor_id": a.spec.actor_id.hex(), "state": "alive",
                     "name": a.spec.name})
                 if a.spec.ready_oid is not None:
-                    e = self.directory.get(a.spec.ready_oid)
+                    ro = a.spec.ready_oid
+                    e = self.directory.get(ro)
                     if e is not None:
                         e.state = READY
+                    self._ready_ok.add(ro)
+                    if ro in self.interest and \
+                            not self.store.contains(ro):
+                        # a __ray_ready__ waiter parked BEFORE init
+                        # finished: seal its payload now (later
+                        # observers materialize at ref-add)
+                        try:
+                            self.store.put(ro, True)
+                        except Exception:
+                            pass  # store full: waiter falls back to
+                            # the ensure/locate path
                 while a.queue:
                     self._route_actor_task_locked(a.queue.popleft())
             else:
@@ -2798,10 +2850,33 @@ class Runtime:
         if a.spec.named and self.named_actors.get(a.spec.named) == a.spec.actor_id:
             del self.named_actors[a.spec.named]
         if a.spec.ready_oid is not None:
-            self._store_error(a.spec.ready_oid, err)
-            e = self.directory.get(a.spec.ready_oid)
-            if e is not None:
-                e.state = FAILED
+            ro = a.spec.ready_oid
+            self._ready_ok.discard(ro)
+            e = self.directory.get(ro)
+            if ro in self.interest or self.xfer_pins.get(ro, 0) > 0:
+                # a live __ray_ready__ ref reads the real error; its
+                # refcount frees the object like any task result. The
+                # registry entry stays regardless: once the holder drops
+                # and the object is freed, a LATER ref (a ready ref
+                # deserialized from an old pickled handle) still needs
+                # the error re-materialized
+                self._store_error(ro, err)
+                if e is not None:
+                    e.state = FAILED
+                self._ready_failed[ro] = str(err)[:200]
+            else:
+                # nobody holds a ready ref: a stored error would leak
+                # one store object per dead actor forever (ready oids
+                # never enter refcounting). Seal-less FAILED keeps a
+                # still-present entry loud for dependency scans; the
+                # registry lets a late __ray_ready__ ref materialize
+                # the real error at ref-add time — including when the
+                # entry was already freed by an earlier ready ref's
+                # drop (e is None here).
+                if e is not None:
+                    e.state = FAILED
+                    e.error_brief = str(err)[:200]
+                self._ready_failed[ro] = str(err)[:200]
             self._sweep_failed_deps_locked()
         for spec in list(a.queue) + list(a.running.values()):
             self._handle_failed_task_locked(spec, err, retryable=False)

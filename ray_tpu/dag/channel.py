@@ -62,6 +62,47 @@ def slot_oid(base: bytes, seq: int) -> ObjectID:
     return ObjectID(base[:12] + struct.pack("<I", seq & 0xFFFFFFFF))
 
 
+# Sequence number reserved for the end-of-stream marker. Writers allocate
+# seqs from 0 upward and a channel never lives long enough to reach it, so
+# the id can't collide with a data slot.
+EOS_SEQ = 0xFFFFFFFF
+
+
+def eos_oid(base: bytes) -> ObjectID:
+    """The end-of-stream marker id for a channel. Unlike a sentinel
+    message, sealing it needs NO ring credit — a producer can always end
+    a stream even when every data slot is un-acked (the data.streaming
+    fan-out writers depend on that: EOS for an idle consumer must not
+    wait on that consumer's credit)."""
+    return slot_oid(base, EOS_SEQ)
+
+
+def seal_eos(store, base: bytes, count: int,
+             push_addr: Optional[str] = None) -> None:
+    """Seal the end-of-stream marker carrying the final message count.
+    Consumers treat a ring as exhausted once ``eos`` is sealed AND their
+    cursor reached ``count``."""
+    oid = eos_oid(base)
+    flight.evt(flight.CHAN_SEAL, flight.lo48(base), EOS_SEQ)
+    if push_addr is not None:
+        from ..core.object_transfer import push_object
+        push_object(push_addr, oid, value=int(count))
+        return
+    try:
+        store.put(oid, int(count))
+    except FileExistsError:
+        pass  # idempotent (teardown retry)
+
+
+def read_eos(store, base: bytes) -> Optional[int]:
+    """Non-blocking: the final message count if EOS sealed, else None."""
+    from ..core.object_store import GetTimeoutError
+    try:
+        return int(store.get(eos_oid(base), timeout_ms=0))
+    except GetTimeoutError:
+        return None
+
+
 def ack_base_for(base: bytes) -> bytes:
     """The ack-channel id base paired with a data base (derived, so only
     the data base needs plumbing through plans and channel specs)."""
@@ -228,15 +269,22 @@ def signal_stop(store, stop_oid: ObjectID) -> None:
         pass  # already stopped
 
 
-def drain_stale_slots(store, bases: list[bytes], lo: int, hi: int) -> None:
+def drain_stale_slots(store, bases: list[bytes], lo: int, hi: int,
+                      eos: bool = False) -> None:
     """Best-effort teardown sweep: delete any [lo, hi) slots still in the
     local store for the given bases. The ack handshake bounds live slots
     to the last ring positions, so callers pass a window, not the full
-    history."""
+    history. With ``eos``, each base's end-of-stream marker is swept
+    too (streams torn down before the consumer observed it)."""
     for base in bases:
         for seq in range(max(0, lo), hi):
             try:
                 store.delete(slot_oid(base, seq))
+            except Exception:
+                return  # store closing; slots die with it
+        if eos:
+            try:
+                store.delete(eos_oid(base))
             except Exception:
                 return  # store closing; slots die with it
 
@@ -377,6 +425,28 @@ class RingWriter:
     def closed(self) -> bool:
         return self.store.contains(self.stop)
 
+    def credit_ready(self) -> bool:
+        """Non-blocking: would the next write() proceed without parking
+        in a credit wait? True while the ring has free positions or the
+        retiring ack is already sealed. Fan-out writers use this to pick
+        a consumer with capacity (and to count backpressure stalls)
+        before committing to a blocking write."""
+        n = self.seq
+        if n < self.ring:
+            return True
+        ack = slot_oid(self.ack_base, n - self.ring)
+        return self.store.wait_sealed([ack], 0, 0)[0]
+
+    def pending_ack_oid(self) -> Optional[ObjectID]:
+        """The ack object the next write() would park on (None when the
+        ring still has free positions). Lets a fan-out writer build ONE
+        multi-oid wait across every full consumer ring instead of
+        committing to a single consumer's credit."""
+        n = self.seq
+        if n < self.ring:
+            return None
+        return slot_oid(self.ack_base, n - self.ring)
+
     def write(self, value: Any, timeout_s: Optional[float] = None) -> None:
         n = self.seq
         if n >= self.ring:
@@ -385,6 +455,43 @@ class RingWriter:
         write_slot(self.store, self.base, n, value,
                    push_addr=self.push_addr)
         self.seq = n + 1
+
+    def finish(self, timeout_s: Optional[float] = None) -> None:
+        """End the stream cleanly: seal EOS (carrying the final count —
+        needs no ring credit), retire every still-outstanding ring
+        position by consuming the consumer's trailing acks, then wait
+        for the consumer's EOS ack and delete the marker. The producer
+        owns every object it created, so after finish() the channel
+        holds ZERO store objects — the store-returns-to-baseline
+        teardown contract. (Deleting the marker without the EOS ack
+        would strand a consumer that had not observed it yet: it would
+        park on a data slot that never comes.) Raises ChannelClosed if
+        the pipeline stop flag seals while draining.
+
+        Same-store channels with an EOS-aware consumer only (the
+        data.streaming BlockReceiver): a plain RingReader never acks
+        EOS_SEQ, and on a cross-store edge the marker lives in the
+        remote store where the local delete could not reach it."""
+        if self.push_addr is not None:
+            raise NotImplementedError(
+                "RingWriter.finish() is same-store only: the EOS "
+                "marker and its ack live in the remote store on a "
+                "push edge")
+        seal_eos(self.store, self.base, self.seq, self.push_addr)
+        self.drain_trailing(timeout_s)
+
+    def drain_trailing(self, timeout_s: Optional[float] = None) -> None:
+        """The retirement half of finish(): consume the trailing data
+        acks and the EOS ack, then delete the marker. Split out so
+        fan-out writers can seal EOS on EVERY ring before parking on
+        any single consumer's acks (data/streaming BlockSender)."""
+        for seq in range(max(0, self.seq - self.ring), self.seq):
+            await_ack(self.store, self.ack_base, seq, self.stop, timeout_s)
+        await_ack(self.store, self.ack_base, EOS_SEQ, self.stop, timeout_s)
+        try:
+            self.store.delete(eos_oid(self.base))
+        except Exception:
+            pass  # store closing; the marker dies with it
 
 
 class RingReader:

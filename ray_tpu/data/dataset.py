@@ -256,6 +256,50 @@ class Dataset:
             return self._cached
         return Executor(self._ctx).execute_streaming(self._plan)
 
+    def _streaming_pipeline_factory(self):
+        """A () -> StreamingPipeline factory when the channel-based
+        streaming executor (data/streaming) should drive consumption,
+        else None (task executor). "auto" engages whenever the plan is
+        streamable and a cluster with a shared shm store is up —
+        results are bit-identical either way, only the dispatch bill
+        differs."""
+        mode = getattr(self._ctx, "streaming_executor", "off")
+        if mode == "off" or self._cached is not None:
+            return None
+        from ..core import runtime as rt_mod
+        rt = rt_mod.get_runtime_if_exists()
+        if getattr(rt, "store", None) is None:
+            if mode == "force":
+                raise RuntimeError(
+                    "streaming_executor='force' needs an initialized "
+                    "cluster with a shared shm object store")
+            return None
+        from .streaming.executor import (StreamingPipeline, compile_plan,
+                                         worker_budget)
+        drafts = compile_plan(self._plan, self._ctx)
+        if drafts is None:
+            if mode == "force":
+                raise RuntimeError(
+                    "streaming_executor='force': this plan has nothing "
+                    "to stream (bare materialized blocks)")
+            return None
+        if mode != "force" and len(drafts) > worker_budget():
+            # more stages than the worker pool can run concurrently
+            # (a many-way zip tree on a tiny cluster): the pipeline
+            # could never schedule every run_loop — use tasks instead
+            return None
+        ctx = self._ctx
+        return lambda **kw: StreamingPipeline(drafts, ctx, **kw)
+
+    def _stream_feed(self):
+        """What iteration consumers drink from: the channel pipeline
+        when streaming engages, else (ref, meta) pairs."""
+        make = self._streaming_pipeline_factory()
+        if make is not None:
+            from .streaming.executor import PipelineFeed
+            return PipelineFeed(make)
+        return self._stream_pairs()
+
     def materialize(self) -> "Dataset":
         pairs = self._execute()
         out = Dataset(InputData(pairs), self._ctx)
@@ -417,7 +461,7 @@ class Dataset:
 
     def take(self, n: int = 20) -> list[dict]:
         out: list[dict] = []
-        for blk in iter_blocks(self._stream_pairs()):
+        for blk in DataIterator(self._stream_feed()).iter_blocks():
             for row in B.to_rows(blk):
                 out.append(row)
                 if len(out) >= n:
@@ -435,7 +479,7 @@ class Dataset:
     # -- iteration --------------------------------------------------------
 
     def iter_rows(self) -> Iterator[dict]:
-        for blk in iter_blocks(self._stream_pairs()):
+        for blk in DataIterator(self._stream_feed()).iter_blocks():
             yield from B.to_rows(blk)
 
     def iter_batches(self, *, batch_size: Optional[int] = 256,
@@ -443,7 +487,7 @@ class Dataset:
                      drop_last: bool = False,
                      local_shuffle_buffer_size: Optional[int] = None,
                      local_shuffle_seed: Optional[int] = None) -> Iterator:
-        return DataIterator(self._stream_pairs()).iter_batches(
+        return DataIterator(self._stream_feed()).iter_batches(
             batch_size=batch_size, batch_format=batch_format,
             drop_last=drop_last,
             local_shuffle_buffer_size=local_shuffle_buffer_size,
@@ -451,23 +495,34 @@ class Dataset:
 
     def iter_jax_batches(self, *, batch_size: int = 256,
                          drop_last: bool = True, sharding=None) -> Iterator:
-        return DataIterator(self._stream_pairs()).iter_jax_batches(
+        return DataIterator(self._stream_feed()).iter_jax_batches(
             batch_size=batch_size, drop_last=drop_last, sharding=sharding)
 
     def iter_torch_batches(self, *, batch_size: Optional[int] = 256,
                            drop_last: bool = False) -> Iterator:
-        return DataIterator(self._stream_pairs()).iter_torch_batches(
+        return DataIterator(self._stream_feed()).iter_torch_batches(
             batch_size=batch_size, drop_last=drop_last)
 
     def streaming_split(self, n: int) -> list["DataIterator"]:
         """n iterators sharing ONE streaming execution, one per Train
         worker (reference: dataset.py:1731 + the output-splitter operator).
-        A coordinator actor owns the bounded-window execution; each shard
-        claims the next finished block through it (work-stealing split), so
-        shards are picklable to workers and no one waits for the whole
-        dataset to materialize."""
+        Work-stealing split either way: with
+        ``ctx.split_transport="actor"`` (default) a coordinator actor
+        hands out finished blocks one dispatch at a time; with "chan"
+        the streaming pipeline's sink edge fans out over n sealed-ring
+        consumer slots — zero dispatches per block, blocks flow to
+        whichever shard is consuming (consume shards concurrently for
+        balanced cuts). Shards are picklable to workers either way and
+        no one waits for the whole dataset to materialize."""
         if self._cached is not None:
             return [DataIterator(self._cached[i::n]) for i in range(n)]
+        if getattr(self._ctx, "split_transport", "actor") == "chan":
+            make = self._streaming_pipeline_factory()
+            if make is not None:
+                from .streaming.executor import ChannelShardFeed
+                pipe = make(consumers=n, split=True).start()
+                return [DataIterator(ChannelShardFeed(
+                    pipe.sink_edge, i, pipeline=pipe)) for i in range(n)]
         import ray_tpu as ray
         Coord = ray.remote(_SplitCoordinator)
         coord = Coord.remote(self._plan, self._ctx, n)
@@ -566,8 +621,9 @@ class _ActorFeed:
 
 
 class DataIterator:
-    """Streams batches from block pairs — a materialized list or a live
-    streaming-executor generator (reference: data/iterator.py DataIterator;
+    """Streams batches from block pairs — a materialized list, a live
+    task-executor generator, or a channel-pipeline feed exposing
+    ``iter_blocks()`` (reference: data/iterator.py DataIterator;
     iter_torch_batches -> iter_jax_batches)."""
 
     def __init__(self, pairs):
@@ -586,9 +642,15 @@ class DataIterator:
         return self._pairs
 
     def count(self) -> int:
+        if hasattr(self._pairs, "count_rows"):
+            return self._pairs.count_rows()
+        if hasattr(self._pairs, "iter_blocks"):
+            return sum(b.num_rows for b in self._pairs.iter_blocks())
         return sum(m.rows for _, m in self._as_list())
 
     def iter_blocks(self) -> Iterator[B.Block]:
+        if hasattr(self._pairs, "iter_blocks"):
+            return self._pairs.iter_blocks()
         return iter_blocks(self._pairs)
 
     def iter_rows(self) -> Iterator[dict]:

@@ -187,6 +187,16 @@ def _slice_task(block, start, end):
     return out, BlockMeta(B.num_rows(out), B.size_bytes(out))
 
 
+def zip_blocks(lb, rb):
+    """Column-concat of two row-aligned blocks (right side wins on
+    column-name collision) — THE zip merge, shared by this executor and
+    the streaming zip stage so the two paths can never drift."""
+    import pyarrow as pa
+    cols = {**{n: lb.column(n) for n in lb.column_names},
+            **{n: rb.column(n) for n in rb.column_names}}
+    return pa.table(cols)
+
+
 def _hash_partition_multi(block, keys, n_out):
     """Hash-partition on one or more key columns (joins, multi-key ops)."""
     if B.num_rows(block) == 0:
@@ -415,6 +425,8 @@ class Executor:
         of streaming_executor_state.py:646 select_operator_to_run)."""
         from collections import deque
 
+        from .streaming import telemetry as tm
+
         ray = _ray()
         if window is _DEFAULT:
             window = max(1, self.ctx.max_tasks_in_flight)
@@ -434,6 +446,10 @@ class Executor:
                     exhausted = True
                     break
                 pending.append(thunk())
+                # the dispatch-economy counter the streaming executor's
+                # A/B reads: the task path pays one control dispatch
+                # per block by construction
+                tm.note_dispatches(1.0, "task")
             self.max_in_flight_seen = max(self.max_in_flight_seen,
                                           len(pending))
             if not pending:
@@ -441,7 +457,9 @@ class Executor:
             # head-of-line: deliver strictly in plan order (later tasks
             # keep running in the window meanwhile)
             block_ref, meta_ref = pending.popleft()
-            yield block_ref, ray.get(meta_ref)
+            meta = ray.get(meta_ref)
+            tm.note_blocks(1.0, "task")
+            yield block_ref, meta
 
     def _resolve(self, pairs) -> list[tuple[Any, BlockMeta]]:
         ray = _ray()
@@ -587,10 +605,7 @@ class Executor:
             return B.concat(list(blocks))
 
         def _zip_all(lb, rb):
-            import pyarrow as pa
-            cols = {**{n: lb.column(n) for n in lb.column_names},
-                    **{n: rb.column(n) for n in rb.column_names}}
-            tbl = pa.table(cols)
+            tbl = zip_blocks(lb, rb)
             return tbl, BlockMeta(B.num_rows(tbl), B.size_bytes(tbl))
 
         cat = ray.remote(_fetch_concat)

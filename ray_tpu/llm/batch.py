@@ -154,19 +154,41 @@ def _engine_batch(engine, sampling, prompt_column, output_column,
     return result
 
 
-class _EngineActor:
-    """Stateful pool member: builds its engine once, generates per batch
-    (reference: vllm_engine_stage.py — one vLLM engine per stage actor)."""
+class LLMPredictor:
+    """Stateful pool member for ``Dataset.map_batches(LLMPredictor,
+    concurrency=N, fn_constructor_args=(engine_cfg, sampling))``: builds
+    its engine ONCE per pool actor (model init + XLA compiles paid once),
+    then generates per batch (reference: vllm_engine_stage.py — one vLLM
+    engine per stage actor).
 
-    def __init__(self, engine_cfg, sampling, prompt_column, output_column):
+    The offline batch-inference workhorse. Under the streaming executor
+    (data/streaming, the default), the pool becomes a stage of
+    long-lived workers fed over sealed channels: each predictor owns a
+    deterministic stripe of the block sequence (worker ``w`` processes
+    idxs ``w mod W`` in order — what keeps the pipeline deadlock-free
+    and results bit-identical), streaming through its engine with no
+    per-block task dispatches — at document scale the control-plane
+    bill drops from one dispatch per block to one ``run_loop`` call per
+    predictor for the whole run (rtpu_data_* counters prove it)."""
+
+    def __init__(self, engine_cfg=None, sampling=None,
+                 prompt_column: str = "prompt",
+                 output_column: str = "generated_text"):
+        if engine_cfg is None:
+            engine_cfg = _default_engine_cfg(ProcessorConfig())
         self.engine = InferenceEngine(engine_cfg)
-        self.sampling = sampling
+        self.sampling = sampling if sampling is not None \
+            else SamplingParams()
         self.pc = prompt_column
         self.oc = output_column
 
     def __call__(self, batch: dict) -> dict:
         return _engine_batch(self.engine, self.sampling, self.pc,
                              self.oc, batch)
+
+
+#: backwards-compat alias (pre-streaming name)
+_EngineActor = LLMPredictor
 
 
 class EngineStage(Stage):
@@ -185,7 +207,7 @@ class EngineStage(Stage):
         engine_cfg = _default_engine_cfg(cfg)
         if cfg.concurrency is not None:
             return ds.map_batches(
-                _EngineActor, concurrency=cfg.concurrency,
+                LLMPredictor, concurrency=cfg.concurrency,
                 fn_constructor_args=(engine_cfg, cfg.sampling,
                                      cfg.prompt_column,
                                      cfg.output_column))

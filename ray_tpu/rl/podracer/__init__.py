@@ -31,10 +31,12 @@ _EXPORTS = {
     "AnakinConfig": "anakin", "AnakinTrainer": "anakin",
     "JaxCartPole": "jax_env",
     "PodracerTrainer": "trainer",
+    "ReplayIngestor": "replay", "ReplayIngestConfig": "replay",
+    "train_dqn_offline": "replay",
     "metrics_summary": "telemetry",
 }
 _MODULES = ("queue", "sebulba", "anakin", "jax_env", "telemetry",
-            "trainer")
+            "trainer", "replay")
 
 __all__ = list(_EXPORTS) + list(_MODULES)
 

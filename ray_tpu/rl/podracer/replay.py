@@ -1,0 +1,114 @@
+"""data.streaming → ReplayBuffer ingestion: offline transitions feed
+podracer DQN/SAC.
+
+The missing half of the Podracer data plane (the PR-6 remainder):
+Sebulba streams FRESH rollouts through sealed channels; this adapter
+streams STORED transitions — offline RL corpora, logged production
+trajectories, d4rl-style datasets — through the same substrate. A
+``Dataset`` of transition rows rides the streaming executor
+(data/streaming: stage actors on sealed rings, ~zero control dispatches
+per block, credit-bounded memory) straight into a ``ReplayBuffer``,
+so replay ingestion at dataset scale costs a handful of actor calls
+total instead of one per block, and a learner can start sampling while
+ingestion is still streaming the tail.
+
+Works with both buffer families: ``rl.ReplayBuffer`` (discrete actions
+— DQN) and ``rl.sac.ContinuousReplayBuffer`` (action vectors — SAC)
+share ``add_batch``.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class ReplayIngestConfig:
+    """Column mapping from transition rows to ReplayBuffer.add_batch."""
+
+    obs_column: str = "obs"
+    action_column: str = "action"
+    reward_column: str = "reward"
+    next_obs_column: str = "next_obs"
+    done_column: str = "done"
+    gamma_column: Optional[str] = None   # per-row effective discount
+    batch_size: int = 1024               # rows per add_batch call
+
+
+class ReplayIngestor:
+    """Streams a Dataset of transitions into a ReplayBuffer.
+
+    ``ingest()`` consumes ``ds.iter_batches`` — under the default
+    streaming executor that is a channel pipeline (read/decode stages
+    stream shm-to-shm into this process), under the task executor a
+    bounded-window task stream; either way the buffer fills in
+    plan order, batch by batch."""
+
+    def __init__(self, buffer: Any,
+                 config: Optional[ReplayIngestConfig] = None):
+        self.buffer = buffer
+        self.config = config or ReplayIngestConfig()
+
+    def ingest(self, ds, limit: Optional[int] = None) -> int:
+        """Feed transitions from ``ds`` into the buffer; returns rows
+        ingested. ``limit`` stops early (tears the stream down cleanly —
+        the pipeline sweeps itself, the PR 5/6 contract)."""
+        cfg = self.config
+        total = 0
+        it = ds.iter_batches(batch_size=cfg.batch_size,
+                             batch_format="numpy")
+        for batch in it:
+            obs = np.asarray(batch[cfg.obs_column], np.float32)
+            nxt = np.asarray(batch[cfg.next_obs_column], np.float32)
+            act = np.asarray(batch[cfg.action_column])
+            rew = np.asarray(batch[cfg.reward_column], np.float32)
+            done = np.asarray(batch[cfg.done_column], np.float32)
+            gam = None
+            if cfg.gamma_column is not None:
+                gam = np.asarray(batch[cfg.gamma_column], np.float32)
+            if limit is not None and total + len(act) > limit:
+                take = limit - total
+                obs, nxt, act = obs[:take], nxt[:take], act[:take]
+                rew, done = rew[:take], done[:take]
+                gam = gam[:take] if gam is not None else None
+            self.buffer.add_batch(obs, act, rew, nxt, done, gammas=gam)
+            total += len(act)
+            try:
+                from . import telemetry as tm
+                tm.replay_ingested().inc(float(len(act)))
+            except Exception:
+                pass  # telemetry must never fail the data plane
+            if limit is not None and total >= limit:
+                it.close()   # generator close -> pipeline teardown
+                break
+        return total
+
+
+def train_dqn_offline(ds, *, obs_dim: int, num_actions: int,
+                      dqn_config=None, ingest: Optional[
+                          ReplayIngestConfig] = None,
+                      iterations: int = 10, hidden: tuple = (64, 64),
+                      seed: int = 0) -> dict:
+    """Offline DQN on a transition Dataset: stream the dataset into a
+    ReplayBuffer via the streaming executor, then run ``iterations``
+    learner updates (no environment in the loop — the offline-RL shape).
+    Returns the last update's stats plus ingestion counts."""
+    from ..dqn import DQNConfig, DQNLearner, ReplayBuffer
+    from ..module import MLPConfig
+    cfg = dqn_config or DQNConfig()
+    icfg = ingest or ReplayIngestConfig()
+    buf = ReplayBuffer(cfg.buffer_size, obs_dim, gamma=cfg.gamma)
+    n = ReplayIngestor(buf, icfg).ingest(ds)
+    if n == 0:
+        raise ValueError("empty transition dataset")
+    learner = DQNLearner(
+        MLPConfig(obs_dim=obs_dim, num_actions=num_actions,
+                  hidden=tuple(hidden)), cfg, seed=seed)
+    rng = np.random.default_rng(seed)
+    stats: dict = {}
+    for _ in range(max(1, iterations)):
+        stats = learner.update_from_buffer(buf, rng)
+    return {"transitions_ingested": n, "buffer_size": buf.size,
+            "iterations": max(1, iterations), **stats}

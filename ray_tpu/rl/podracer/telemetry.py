@@ -101,6 +101,12 @@ def checkpoints() -> Counter:
                    "trainer checkpoint events", tag_keys=("kind",))
 
 
+def replay_ingested() -> Counter:
+    return _metric(Counter, "rtpu_rl_replay_ingested_total",
+                   "transitions streamed from datasets into replay "
+                   "buffers (replay.py ingestion adapter)")
+
+
 # --------------------------------------------------------------------- #
 # summary
 # --------------------------------------------------------------------- #

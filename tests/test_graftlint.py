@@ -493,6 +493,35 @@ def test_gl007_rl_namespace_lookalikes_rejected():
     assert all("does not match" in f.message for f in found)
 
 
+def test_gl007_data_namespace_allowed():
+    """The streaming data plane's rtpu_data_* namespace is first-class
+    (data/streaming/telemetry.py's dispatch-economy counters)."""
+    src = """
+        from ray_tpu.util.metrics import Counter, Gauge, cached_metric
+
+        OK1 = Counter("rtpu_data_blocks_total", tag_keys=("path",))
+        OK2 = Gauge("rtpu_data_queue_depth")
+
+        def ok_cached():
+            return cached_metric(Counter,
+                                 "rtpu_data_backpressure_waits_total")
+    """
+    assert lint(src, rules={"GL007"}) == []
+
+
+def test_gl007_data_namespace_lookalikes_rejected():
+    src = """
+        from ray_tpu.util.metrics import Counter, cached_metric
+
+        BAD1 = Counter("rtpu_dataset_blocks_total")
+        BAD2 = cached_metric(Counter, "data_blocks_total")
+        BAD3 = Counter("rtpu_data_Blocks_total")
+    """
+    found = lint(src, rules={"GL007"})
+    assert len(found) == 3
+    assert all("does not match" in f.message for f in found)
+
+
 # ------------------------------------------------------------------ #
 # GL008 swallowed exceptions
 # ------------------------------------------------------------------ #

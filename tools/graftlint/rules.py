@@ -688,14 +688,14 @@ def update_frames_manifest(ctxs: dict[str, FileContext]) -> dict:
 # GL007 — metric naming + once-only registration
 # --------------------------------------------------------------------- #
 # Motivation: the head merges every process's series by NAME; names
-# outside the rtpu_(core|llm|serve|rl)_ namespaces silently fall off the
-# dashboards and the metrics_summary() aggregations. Constructing a
-# Metric per call re-validates against the registry on a hot path —
-# construct at module scope or through cached_metric (llm/telemetry.py's
-# pattern).
+# outside the rtpu_(core|llm|serve|rl|data)_ namespaces silently fall
+# off the dashboards and the metrics_summary() aggregations.
+# Constructing a Metric per call re-validates against the registry on a
+# hot path — construct at module scope or through cached_metric
+# (llm/telemetry.py's pattern).
 
 _METRIC_CTORS = ("Counter", "Gauge", "Histogram")
-_METRIC_NAME_RE = re.compile(r"^rtpu_(core|llm|serve|rl)_[a-z0-9_]+$")
+_METRIC_NAME_RE = re.compile(r"^rtpu_(core|llm|serve|rl|data)_[a-z0-9_]+$")
 _GL007_EXEMPT_FILES = ("ray_tpu/util/metrics.py",)
 
 
@@ -746,7 +746,7 @@ def check_metric_conventions(ctx: FileContext) -> Iterable[Finding]:
                 findings.append(Finding(
                     "GL007", ctx.relpath, node.lineno, node.col_offset,
                     f'metric name "{name}" does not match '
-                    f"rtpu_(core|llm|serve|rl)_[a-z0-9_]+"))
+                    f"rtpu_(core|llm|serve|rl|data)_[a-z0-9_]+"))
         if fn in _METRIC_CTORS and id(node) in in_func:
             findings.append(Finding(
                 "GL007", ctx.relpath, node.lineno, node.col_offset,
