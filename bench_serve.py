@@ -35,6 +35,8 @@ def main():
         return _shared_prefix()
     if "--decode-plan" in sys.argv:
         return _decode_plan()
+    if "--soak" in sys.argv:
+        return _soak()
     from bench import _probe_accelerator, repin_jax_platforms
     repin_jax_platforms()
     from ray_tpu.llm import SamplingParams
@@ -276,6 +278,161 @@ def _decode_plan():
     flight_report(trace_arg(sys.argv), trace_t0)
     serve.shutdown()
     ray_tpu.shutdown()
+
+
+def _soak():
+    """Front-door soak (serve/frontdoor/): a REAL serve deployment —
+    2 LLM replicas behind 2 controller-managed proxies with SLO-aware
+    admission — slammed with thousands of concurrent HTTP connections.
+    CPU-only by design: the gates under test (zero 500s, sheds are
+    429-with-Retry-After ONLY, bounded p99 for admitted traffic,
+    cross-replica prefix-directory hits bit-identical to cold prefill)
+    are data-plane properties, not device speed. Prints ONE JSON line;
+    vs_baseline = 1.0 iff every gate holds.
+
+    Flags: ``--connections N`` (default 2500), ``--quick`` (400)."""
+    import asyncio
+    import os
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.core.config import cfg as rcfg
+    from ray_tpu.llm import SamplingParams
+    from ray_tpu.llm.paged_engine import (PagedEngineConfig,
+                                          PagedInferenceEngine)
+    from ray_tpu.llm.serving import LLMConfig, build_llm_deployment
+    from ray_tpu.models import llama
+
+    conns = 400 if "--quick" in sys.argv else 2500
+    if "--connections" in sys.argv:
+        conns = int(sys.argv[sys.argv.index("--connections") + 1])
+
+    rcfg.override(worker_prestart=2)
+    ray_tpu.init(num_cpus=2, object_store_memory=512 << 20)
+    ecfg = PagedEngineConfig(
+        model=llama.llama_tiny(vocab_size=258, max_seq_len=256),
+        max_batch_size=8, page_size=8, num_pages=256,
+        max_pages_per_seq=24, chunk_size=16)
+    app = build_llm_deployment(
+        LLMConfig(model_id="tiny", engine=ecfg, num_replicas=2,
+                  max_ongoing_requests=16, warmup=False))
+    serve.run(app, name="default", http_port=18511, num_proxies=2)
+
+    ports = sorted(p["port"] for p in serve.status()["proxies"])
+    assert len(ports) >= 2, "soak requires >= 2 proxies"
+
+    system = ("You are a helpful, precise assistant. Use short answers "
+              "and cite nothing. ") * 2
+    rng = np.random.RandomState(0)
+    fixed_prompt = system + "What is 2+2?"
+
+    # prime: a small warm wave serves the shared system prefix on one
+    # replica and lets it publish to the prefix directory (production
+    # steady state) — the storm's spillover traffic on the OTHER
+    # replica then admission-matches via cross-replica import
+    import json as _json
+    import urllib.request
+    for _ in range(2):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{ports[0]}/default", method="POST",
+            data=_json.dumps({"prompt": fixed_prompt, "max_tokens": 4,
+                              "temperature": 0.0}).encode(),
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=120).read()
+    time.sleep(1.0)     # > cfg.serve_prefix_publish_s
+
+    trace_t0 = time.monotonic_ns()
+
+    async def run_load():
+        import aiohttp
+        out = []
+        sem = asyncio.Semaphore(conns)          # all in flight at once
+
+        async def one(session, i):
+            port = ports[i % len(ports)]
+            prompt = (fixed_prompt if i % 7 == 0 else
+                      system + f"Question {rng.randint(1e6)}?")
+            t0 = time.perf_counter()
+            try:
+                async with sem, session.post(
+                        f"http://127.0.0.1:{port}/default",
+                        json={"prompt": prompt, "max_tokens": 4,
+                              "temperature": 0.0},
+                        timeout=aiohttp.ClientTimeout(total=120)) as r:
+                    body = await r.json()
+                    out.append((r.status, time.perf_counter() - t0,
+                                r.headers.get("Retry-After"),
+                                body if i % 7 == 0 else None))
+            except Exception as e:  # noqa: BLE001 — a gate failure
+                out.append(("exc:" + type(e).__name__,
+                            time.perf_counter() - t0, None, None))
+
+        connector = aiohttp.TCPConnector(limit=0)
+        async with aiohttp.ClientSession(connector=connector) as s:
+            await asyncio.gather(*(one(s, i) for i in range(conns)))
+        return out
+
+    t0 = time.perf_counter()
+    results = asyncio.new_event_loop().run_until_complete(run_load())
+    wall = time.perf_counter() - t0
+
+    statuses = [r[0] for r in results]
+    n200 = statuses.count(200)
+    n429 = statuses.count(429)
+    n_other = len(statuses) - n200 - n429
+    bare_500s = sum(1 for s in statuses if s == 500)
+    shed_clean = all(ra is not None for s, _t, ra, _b in results
+                     if s == 429)
+    admitted_lat = sorted(t for s, t, _ra, _b in results if s == 200)
+    p99 = admitted_lat[int(len(admitted_lat) * 0.99)] if admitted_lat \
+        else None
+    p50 = admitted_lat[len(admitted_lat) // 2] if admitted_lat else None
+
+    # cross-replica prefix directory: counter-verified hits, and the
+    # served text for the fixed prompt is BIT-IDENTICAL to a cold
+    # local prefill (same config, same seed, greedy)
+    time.sleep(3.0)     # worker metric flush cadence
+    ms = serve.metrics_summary()
+    pd = ms.get("prefix_directory") or {}
+    dir_hits = pd.get("hits", 0)
+    served_texts = {b["choices"][0]["text"] for s, _t, _ra, b in results
+                    if s == 200 and b}
+    cold = PagedInferenceEngine(ecfg, rng_seed=0)
+    cold_out = cold.generate([cold.tokenizer.encode(fixed_prompt)],
+                             SamplingParams(max_tokens=4))[0]
+    bit_identical = served_texts == {cold_out["text"]} if served_texts \
+        else False
+
+    gates = {
+        "zero_500s": bare_500s == 0 and n_other == 0,
+        "sheds_are_429_with_retry_after": shed_clean,
+        "admitted_p99_bounded": p99 is not None and p99 < 60.0,
+        "prefix_directory_hits": dir_hits > 0,
+        "bit_identical_to_cold_prefill": bit_identical,
+    }
+    print(json.dumps({
+        "metric": "serve_soak_admitted_p99",
+        "value": None if p99 is None else round(p99, 4),
+        "unit": (f"s e2e over {conns} concurrent conns x 2 proxies "
+                 f"(p50={None if p50 is None else round(p50, 4)}s, "
+                 f"{n200} ok / {n429} shed / {n_other} other in "
+                 f"{wall:.1f}s, dir_hits={dir_hits:.0f}, "
+                 f"imported_pages="
+                 f"{pd.get('imported_pages', 0):.0f}, "
+                 f"gates={gates})"),
+        "vs_baseline": 1.0 if all(gates.values()) else 0.0,
+    }))
+    print(json.dumps({"metric": "serve_soak_admission",
+                      "value": ms.get("admission"),
+                      "unit": "admitted/shed counters + queue waits"},
+                     default=str))
+    from bench import flight_report, trace_arg
+    flight_report(trace_arg(sys.argv), trace_t0)
+    serve.shutdown()
+    ray_tpu.shutdown()
+    raise SystemExit(0 if all(gates.values()) else 1)
 
 
 def _pd_interference(model, cfg, rng, max_tokens, prompt_lens, on_tpu):

@@ -290,9 +290,16 @@ def cmd_serve(args) -> int:
     cloudpickle.register_pickle_by_value(mod)
     app = getattr(mod, attr)
     serve_api.run(app, name=args.name, route_prefix=args.route_prefix,
-                  http_port=args.http_port)
+                  http_port=args.http_port, num_proxies=args.proxies)
+    from .core.config import cfg as _cfg
+    # same default resolution run() applies, so the banner matches the
+    # ports actually listening
+    n = max(1, args.proxies if args.proxies is not None
+            else _cfg.serve_num_proxies)
+    ports = f"{args.http_port}" if n == 1 else \
+        f"{args.http_port}..{args.http_port + n - 1}"
     print(f"serving {args.target!r} as app {args.name!r} on "
-          f"http://127.0.0.1:{args.http_port} (Ctrl-C to stop)")
+          f"http://127.0.0.1:{{{ports}}} ({n} proxies, Ctrl-C to stop)")
     try:
         while True:
             time.sleep(1)
@@ -480,6 +487,9 @@ def build_parser() -> argparse.ArgumentParser:
     sr.add_argument("--name", default="default")
     sr.add_argument("--route-prefix", default="/")
     sr.add_argument("--http-port", type=int, default=8000)
+    sr.add_argument("--proxies", type=int, default=None,
+                    help="HTTP proxy actors to run (ports http-port.."
+                         "http-port+N-1; default cfg.serve_num_proxies)")
     sr.add_argument("--address", default=None)
     sr.set_defaults(fn=cmd_serve)
 

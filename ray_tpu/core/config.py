@@ -245,6 +245,42 @@ _FLAGS = [
     Flag("serve_stream_ring", 64,
          "in-flight item bound of the static decode plan's ring channel "
          "(producer blocks once this far ahead of the consumer)"),
+    # ---- serve front door (serve/frontdoor/) ------------------------- #
+    Flag("serve_num_proxies", 1,
+         "HTTP proxies the controller keeps alive per application "
+         "deploy (ports http_port..http_port+n-1); each is a "
+         "controller-managed actor, replaced on death like a replica"),
+    Flag("serve_admission_control", True,
+         "SLO-aware admission at the proxies: per-deployment budgets "
+         "derived from live replica capacity (replicas x "
+         "max_ongoing_requests, split across proxies); past the budget "
+         "requests queue with bounded depth and deadline, then shed as "
+         "HTTP 429 + Retry-After instead of timing out as 500s"),
+    Flag("serve_admission_queue_depth", 64,
+         "per-proxy, per-deployment bound on requests parked waiting "
+         "for an admission slot; arrivals past it shed immediately"),
+    Flag("serve_admission_timeout_s", 2.0,
+         "admission-queue deadline (the TTFT SLO contribution the "
+         "queue may add): a request predicted or measured to wait "
+         "longer sheds with a Retry-After estimate instead of queueing"),
+    Flag("serve_prefix_directory", True,
+         "cluster-wide prefix-cache directory: paged-engine replicas "
+         "publish chained page hashes to the head (core/directory.py), "
+         "and admission-match prefixes warmed on ANY replica by "
+         "importing the KV pages from the owner over the object store"),
+    Flag("serve_prefix_publish_s", 0.25,
+         "how often a replica's engine loop drains newly published / "
+         "evicted page hashes to the prefix directory (one async frame "
+         "per drain with anything to report)"),
+    Flag("serve_prefix_import_timeout_s", 10.0,
+         "deadline for fetching a warmed prefix's KV pages from the "
+         "owning replica; on timeout/death the entries are dropped "
+         "from the directory and the request prefills cold (stale "
+         "entries are hints, never correctness)"),
+    Flag("dir_max_entries", 65536,
+         "per-directory entry cap of the head's shared directory "
+         "service (FIFO eviction; bounds head memory no matter how "
+         "many pages the fleet publishes)"),
     # ---- observability ----------------------------------------------- #
     Flag("metrics_export_port", 0,
          "Prometheus /metrics port (0 = ephemeral)"),

@@ -55,30 +55,42 @@ _copy_pool_pid = 0         # guarded by: _copy_pool_lock
 _copy_pool_lock = threading.Lock()
 
 
-def _get_copy_pool(threads: int):
-    """The per-process copy pool, built/regrown under a lock. Fork
-    safety: a child inheriting the parent's pool object has no live
-    worker threads, so a pid change forces a rebuild."""
+def _ensure_copy_pool_locked(threads: int):
+    """The per-process copy pool, built/regrown. CALLER HOLDS
+    _copy_pool_lock — and every submit happens under the same lock
+    (_copy_parallel), which is what makes the regrow swap safe: once
+    this function replaces the pool, no racing put can still be between
+    "fetched the old pool" and "submitted to it", so the old pool can
+    be drained with shutdown(wait=False) immediately — queued slices
+    finish, its threads then retire, nothing is left to GC timing.
+    Fork safety: a child inheriting the parent's pool object has no
+    live worker threads, so a pid change forces a rebuild (the ghost
+    pool is NOT shutdown — its internal lock state is whatever the
+    parent froze at fork time)."""
     global _copy_pool, _copy_pool_width, _copy_pool_pid
-    with _copy_pool_lock:
-        if _copy_pool is None or _copy_pool_pid != os.getpid() \
-                or _copy_pool_width < threads:
-            import concurrent.futures as cf
-            # on regrow the OLD pool is simply dropped, never
-            # shutdown(): a concurrent put may have grabbed it before
-            # this lock and still needs to submit; its idle threads
-            # retire when the executor is garbage-collected after that
-            # last user drains
-            _copy_pool = cf.ThreadPoolExecutor(
-                max_workers=threads, thread_name_prefix="rtpu-copy")
-            _copy_pool_width = threads
-            _copy_pool_pid = os.getpid()
-        return _copy_pool
+    if _copy_pool is None or _copy_pool_pid != os.getpid() \
+            or _copy_pool_width < threads:
+        import concurrent.futures as cf
+        old, old_pid = _copy_pool, _copy_pool_pid
+        _copy_pool = cf.ThreadPoolExecutor(
+            max_workers=threads, thread_name_prefix="rtpu-copy")
+        _copy_pool_width = threads
+        _copy_pool_pid = os.getpid()
+        if old is not None and old_pid == os.getpid():
+            # drain, don't drop: in-flight futures complete, idle
+            # threads exit once the queue empties (wait=False: never
+            # block a put on another put's copies)
+            old.shutdown(wait=False)
+    return _copy_pool
 
 
 def _copy_parallel(dst: int, src, n: int) -> None:
     """memmove(dst, src, n), sliced across the copy pool for large n.
-    `src` is an int address or a bytes object."""
+    `src` is an int address or a bytes object. Slices are SUBMITTED
+    under _copy_pool_lock (cheap queue puts) so a concurrent regrow
+    (cfg.put_copy_threads raised mid-run) can never shut the pool down
+    between our fetch and our submit; the actual copying — and the
+    wait for it — happens outside the lock on the pool threads."""
     from .config import cfg
     threads = min(cfg.put_copy_threads or _COPY_THREADS_AUTO,
                   _COPY_THREADS_MAX)
@@ -89,11 +101,12 @@ def _copy_parallel(dst: int, src, n: int) -> None:
         # zero-copy readonly view; keeps `src` alive across the workers
         src_arr = np.frombuffer(src, np.uint8)
         src = src_arr.ctypes.data
-    pool = _get_copy_pool(threads)
     step = -(-n // threads)  # ceil
-    futs = [pool.submit(ctypes.memmove, dst + off, src + off,
-                        min(step, n - off))
-            for off in range(0, n, step)]
+    with _copy_pool_lock:
+        pool = _ensure_copy_pool_locked(threads)
+        futs = [pool.submit(ctypes.memmove, dst + off, src + off,
+                            min(step, n - off))
+                for off in range(0, n, step)]
     for f in futs:
         f.result()
 

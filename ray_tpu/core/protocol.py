@@ -75,7 +75,16 @@ from __future__ import annotations
 #     are wedged; an old-build peer would drop the frame and stall
 #     every stack/hang report for its full collection timeout — reject
 #     at the handshake instead.
-PROTOCOL_VERSION = 6
+# v7: shared-directory frames (core/directory.py): any peer may send
+#     "dir_update" {d, put, drop} (async merge into a head-side named
+#     hint map, owner-stamped and swept on disconnect) and "dir_query"
+#     {d, keys, reply_oid} (answered inline on the head recv thread via
+#     the rpc_reply plumbing). The serve front door rides these for its
+#     shared proxy route table and the cluster-wide prefix-cache
+#     directory; an old-build head would drop both frames and every
+#     proxy route refresh / prefix lookup would wait out its timeout —
+#     reject at the handshake instead.
+PROTOCOL_VERSION = 7
 
 # Bump on any incompatible change to the sqlite snapshot contents.
 # v2: named-actor keys are namespace-qualified ("ns/name"); v1 snapshots

@@ -41,6 +41,7 @@ import cloudpickle
 from .. import exceptions as exc
 from . import flight
 from . import stacks
+from .directory import DirectoryService
 from .ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID
 from .object_store import GetTimeoutError as StoreTimeout
 from .object_store import ObjectStoreFullError, SharedObjectStore, SpillStore
@@ -493,6 +494,16 @@ class Runtime:
         self._watchdog = {"enabled": bool(cfg.stall_watchdog), "scans": 0,
                           "flagged_total": 0, "stuck_running": 0,
                           "last_scan": 0.0}
+        # cluster-shared directory service (core/directory.py, protocol
+        # v7): named hint maps behind dir_update/dir_query frames. NOT
+        # self.directory — that is the object directory below.
+        self.dirs = DirectoryService()
+        # store-path rpc replies in flight per peer: a reply written to
+        # the shared store has NO directory entry (the peer reads and
+        # deletes it directly), so a peer killed between sending the
+        # rpc and reading the reply would leak the object forever —
+        # _on_worker_death reclaims these. Pruned lazily on write.
+        self._rpc_reply_pins: dict[str, set] = {}  # guarded by: self.lock
         flight.set_proc_name("head")
         self._sched_evt = threading.Event()
         threading.Thread(target=self._sched_pump_loop, daemon=True,
@@ -1068,6 +1079,22 @@ class Runtime:
             # must keep draining the worker's other messages. A shared pool
             # replaces the former thread-per-rpc spawn (hot-path cost).
             self._rpc_pool.submit(self._handle_worker_rpc, msg, wid)
+        elif t == "dir_update":
+            # shared-directory merge (core/directory.py): cheap dict ops,
+            # handled inline; publishes are owner-stamped with the sending
+            # connection so _on_worker_death can sweep them
+            self.dirs.merge(msg["d"], msg.get("put"), msg.get("drop"),
+                            owner=wid)
+        elif t == "dir_query":
+            # answered INLINE on this recv thread (not the rpc pool): a
+            # pure dict read under the directory's own short lock, and
+            # admission-time prefix lookups sit on the serve hot path
+            try:
+                payload = ("ok", self.dirs.lookup(msg["d"],
+                                                  msg.get("keys")))
+            except Exception as e:  # noqa: BLE001 — reply with any failure
+                payload = ("err", e)
+            self._reply_rpc(wid, ObjectID(msg["reply_oid"]), payload)
         elif t == "rpc_abandon":
             # Worker timed out waiting for a reply. Mark abandoned FIRST,
             # then reclaim if already written — this order closes the race
@@ -1322,43 +1349,52 @@ class Runtime:
             n = self.nodes.get(w.node_id)
             return n is not None and n.own_store
 
-    def _handle_worker_rpc(self, msg: dict, wid: str | None = None):
-        oid = ObjectID(msg["reply_oid"])
-        via_conn = self._reply_via_conn(wid)
-
-        def reply(payload):
-            if via_conn:
-                with self.lock:
-                    w = self.workers.get(wid)
-                if w is not None:
-                    # outside the lock: w.send pickles + writes the pipe
-                    # under its own per-worker send_lock
-                    w.send({"t": "rpc_reply", "reply_oid": oid.binary(),
-                            "payload": payload})
-            else:
-                self.store.put(oid, payload)
-        try:
-            m = msg["m"]
-            if m not in self._RPC_METHODS:
-                raise ValueError(f"unknown rpc {m!r}")
-            result = getattr(self, m)(*msg.get("args", ()))
-            reply(("ok", result))
-        except BaseException as e:  # noqa: BLE001 — reply with any failure
-            try:
-                reply(("err", e))
-            except BaseException:  # unpicklable exception/result
-                reply(("err", RuntimeError(
-                    f"rpc {msg.get('m')} failed with unpicklable error: "
-                    f"{type(e).__name__}: {e!r}")))
-        if via_conn:
+    def _reply_rpc(self, wid: str | None, oid: ObjectID, payload) -> None:
+        """Deliver one rpc-style reply: over the control connection for
+        own-store peers, into the shared store otherwise (with the
+        abandon-race reclaim). Shared by the rpc pool and the inline
+        dir_query handler."""
+        if self._reply_via_conn(wid):
+            with self.lock:
+                w = self.workers.get(wid)
+            if w is not None:
+                # outside the lock: w.send pickles + writes the pipe
+                # under its own per-worker send_lock
+                w.send({"t": "rpc_reply", "reply_oid": oid.binary(),
+                        "payload": payload})
             return
+        self.store.put(oid, payload)
         # No directory entry: the worker polls the store directly and deletes
         # the reply once read. If the worker already gave up, reclaim now.
         with self.lock:
             abandoned = oid in self._abandoned_rpcs
             self._abandoned_rpcs.discard(oid)
+            if not abandoned and wid is not None:
+                pend = self._rpc_reply_pins.setdefault(wid, set())
+                # lazy prune: replies the peer already consumed (and
+                # deleted) fall out here, keeping the set at the number
+                # of genuinely in-flight replies
+                pend.difference_update(
+                    [o for o in pend if not self.store.contains(o)])
+                pend.add(oid)
         if abandoned:
             self.store.delete(oid)
+
+    def _handle_worker_rpc(self, msg: dict, wid: str | None = None):
+        oid = ObjectID(msg["reply_oid"])
+        try:
+            m = msg["m"]
+            if m not in self._RPC_METHODS:
+                raise ValueError(f"unknown rpc {m!r}")
+            result = getattr(self, m)(*msg.get("args", ()))
+            self._reply_rpc(wid, oid, ("ok", result))
+        except BaseException as e:  # noqa: BLE001 — reply with any failure
+            try:
+                self._reply_rpc(wid, oid, ("err", e))
+            except BaseException:  # unpicklable exception/result
+                self._reply_rpc(wid, oid, ("err", RuntimeError(
+                    f"rpc {msg.get('m')} failed with unpicklable error: "
+                    f"{type(e).__name__}: {e!r}")))
 
     # job-table RPCs (gcs_job_manager.h:52 / job_manager.py:60 analog)
     def job_submit(self, entrypoint, env=None, working_dir_zip=None,
@@ -1499,6 +1535,14 @@ class Runtime:
             # and its refcount interest (it will never send ref_drop)
             for oid in [o for o, s in self.interest.items() if wid in s]:
                 self._ref_drop_locked(oid, wid)
+            # store-path rpc replies it will never read (a peer killed
+            # between sending an rpc/dir_query and consuming the reply)
+            for oid in self._rpc_reply_pins.pop(wid, ()):
+                try:
+                    if self.store.contains(oid):
+                        self.store.delete(oid)
+                except Exception:
+                    pass  # store closing; the reply dies with it
             node = self.nodes.get(w.node_id)
             if node:
                 node.workers.discard(wid)
@@ -1533,6 +1577,13 @@ class Runtime:
                 self._on_actor_worker_death_locked(w.actor_id, wid)
             self._schedule_locked()
             self.cv.notify_all()
+        # outside self.lock (own short lock): a dead publisher's shared-
+        # directory hints are swept so stale entries die with the worker
+        # instead of lingering until every reader validates them
+        try:
+            self.dirs.sweep_owner(wid)
+        except Exception:
+            pass  # hint cleanup must never block reaping
         try:
             w.proc.wait(timeout=1)
         except Exception:

@@ -663,9 +663,18 @@ class WorkerRuntime:
     # _handle_worker_rpc) ---------------------------------------------------
 
     def _rpc(self, method: str, *args, timeout: float = 30.0):
+        return self._rpc_frame({"t": "rpc", "m": method, "args": args},
+                               method, timeout=timeout)
+
+    def _rpc_frame(self, msg: dict, label: str, timeout: float = 30.0):
+        """Send a request frame that the head answers through the rpc
+        reply plumbing (a ("ok"/"err", payload) tuple at reply_oid —
+        Runtime._reply_rpc), and wait for the reply. `msg` is any frame
+        dict the head answers this way ("rpc" itself, "dir_query");
+        the reply_oid is stamped here."""
         reply = ObjectID.from_random()
-        self.send({"t": "rpc", "m": method, "args": args,
-                   "reply_oid": reply.binary()})
+        msg = {**msg, "reply_oid": reply.binary()}
+        self.send(msg)
         deadline = time.monotonic() + timeout
         rb = reply.binary()
         while True:
@@ -680,7 +689,7 @@ class WorkerRuntime:
                 if time.monotonic() > deadline:
                     self._rpc_abandoned.add(rb)
                     raise exc.GetTimeoutError(
-                        f"head rpc {method} timed out") from None
+                        f"head rpc {label} timed out") from None
                 continue
             # event-driven: the reply's seal wakes this futex wait
             # immediately (was: a 100ms store.get poll slice per pass);
@@ -692,7 +701,7 @@ class WorkerRuntime:
                 self.send({"t": "rpc_abandon",
                            "reply_oid": reply.binary()})
                 raise exc.GetTimeoutError(
-                    f"head rpc {method} timed out") from None
+                    f"head rpc {label} timed out") from None
             sealed = self.store.wait_sealed(
                 [reply], 1, min(1000, remain_ms))[0]
             if sealed:

@@ -135,7 +135,10 @@ async function refresh() {
     for (const [app, dep] of Object.entries(sv.applications || {}))
       for (const [name, d] of Object.entries(dep.deployments || {}))
         rows.push([app, name, {pill: d.status || 'RUNNING'},
-                   `${d.num_replicas_running ?? d.replicas ?? ''}`]);
+                   `${d.running_replicas ?? d.num_replicas_running ?? d.replicas ?? ''}`]);
+    for (const p of (sv.proxies || []))
+      rows.push(['(front door)', `proxy-${p.index}`, {pill: 'RUNNING'},
+                 `:${p.port}`]);
     fill('serve', ['app', 'deployment', 'status', 'replicas'], rows);
   } catch (e) { fill('serve', ['(serve not running)'], []); }
   try {

@@ -150,6 +150,14 @@ class PagedInferenceEngine(_EngineBase):
         self._hash_to_page: dict[bytes, int] = {}
         self._page_to_hash: dict[int, bytes] = {}
         self._cached_lru: "OrderedDict[int, None]" = OrderedDict()
+        # cluster prefix-directory delta tracking (serve/frontdoor):
+        # hashes registered/unregistered since the last drain. Appended
+        # only when track_page_publish is on (the serving layer enables
+        # it), and only ever touched from the stepping thread — the
+        # drain contract (drain_directory_delta) keeps it lock-free.
+        self.track_page_publish = False
+        self._dir_new: list[bytes] = []
+        self._dir_dropped: list[bytes] = []
         self._next_rid = 0
         self._rng_base = jax.random.PRNGKey(rng_seed ^ 0x5EED)
         self._rng_ctr = 0
@@ -179,7 +187,10 @@ class PagedInferenceEngine(_EngineBase):
                       # pressure, and prompt tokens whose prefill was
                       # skipped entirely
                       "prefix_hits": 0, "prefix_misses": 0,
-                      "prefix_evictions": 0, "prefix_tokens_saved": 0}
+                      "prefix_evictions": 0, "prefix_tokens_saved": 0,
+                      # pages seeded from ANOTHER replica's cache via the
+                      # cluster prefix directory (import_prefix)
+                      "prefix_imported_pages": 0}
         # speculation controller: EMA of tokens-per-slot-per-spec-dispatch
         # (starts optimistic), plus a cooldown of windowed dispatches
         # before re-probing once the EMA drops below the window
@@ -450,6 +461,13 @@ class PagedInferenceEngine(_EngineBase):
         h = self._page_to_hash.pop(pid, None)
         if h is not None and self._hash_to_page.get(h) == pid:
             del self._hash_to_page[h]
+            if self.track_page_publish:
+                self._dir_dropped.append(h)
+                if len(self._dir_dropped) > 4 * self.cfg.num_pages:
+                    # publisher not draining (no directory attached):
+                    # drop the log — un-dropped stale entries are hints
+                    # the importer validates anyway
+                    del self._dir_dropped[:]
 
     def _incref(self, pid: int):
         """Pin a page for a request; a cached (refcount-0) page leaves
@@ -609,6 +627,13 @@ class PagedInferenceEngine(_EngineBase):
             return      # already published, or duplicate content elsewhere
         self._page_to_hash[pid] = h
         self._hash_to_page[h] = pid
+        if self.track_page_publish:
+            self._dir_new.append(h)
+            if len(self._dir_new) > 4 * self.cfg.num_pages:
+                # publisher not draining: the delta log is redundant
+                # with the index itself — compress to a full resync so
+                # an undrained engine's memory stays bounded
+                self._dir_new = list(self._hash_to_page)
 
     def _register_request_pages(self, req: _Request):
         """Publish req's full, KV-materialized pages into the content
@@ -1134,6 +1159,132 @@ class PagedInferenceEngine(_EngineBase):
                          donate_argnums=(0,))
             self._import_fn_cached = fn
         return fn
+
+    # -- cluster prefix-cache directory hooks (serve/frontdoor/prefix.py;
+    # cross-replica page import extends the import_prefill contract:
+    # same chained content hashes, same _import_fn scatter, but the
+    # imported pages seed the CACHE — refcount 0, LRU-parked — instead
+    # of a decode-ready request) ------------------------------------------
+
+    def hash_prompt(self, prompt) -> list[bytes]:
+        """Chained hashes of the prompt's admission-reusable pages: the
+        whole full pages inside the chunk-aligned _reuse_limit, exactly
+        the run _match_prefix can admit from cache. Pure computation —
+        no lock, no state."""
+        ids = (self.tokenizer.encode(prompt) if isinstance(prompt, str)
+               else list(prompt))
+        c = self.cfg.chunk_size
+        limit = ((len(ids) - 1) // c) * c
+        if limit <= 0:
+            return []
+        return self._hash_chain(ids[:limit])
+
+    def cached_prefix_len(self, hashes) -> int:
+        """How many of `hashes` (a chain run) this engine's cache already
+        covers, walking from the head until the first miss."""
+        with self._lock:
+            n = 0
+            for h in hashes:
+                if h not in self._hash_to_page:
+                    break
+                n += 1
+            return n
+
+    def export_prefix(self, hashes) -> Optional[dict]:
+        """Gather the cached pages for a chain run of hashes to host
+        arrays — the cross-replica analog of _export_kv_locked, keyed by
+        content instead of by request. Returns the longest covered
+        prefix run (None when even the first page is gone: entries in
+        the cluster directory are hints and this engine may have evicted
+        since publishing). CALLER must serialize against the stepping
+        thread (serving.py's step lock): dispatches donate self.caches,
+        so a concurrent step would invalidate the buffers mid-gather."""
+        with self._lock:
+            pids: list[int] = []
+            for h in hashes:
+                pid = self._hash_to_page.get(h)
+                if pid is None:
+                    break
+                pids.append(pid)
+            if not pids:
+                return None
+            idx = jnp.asarray(np.asarray(pids, np.int32))
+            pages = [{"k": np.asarray(layer["k"][idx]),
+                      "v": np.asarray(layer["v"][idx])}
+                     for layer in self.caches]
+            return {"page_size": self.cfg.page_size,
+                    "page_hashes": list(hashes[:len(pids)]),
+                    "pages": pages}
+
+    def import_prefix(self, payload: Optional[dict],
+                      reserve_pages: Optional[int] = None) -> int:
+        """Seed this engine's prefix cache with another replica's
+        exported pages: allocate, scatter (donated, in place), register
+        under the payload's chain hashes, and park refcount-0 in the
+        cached LRU — the next _match_prefix/_try_reuse admits them like
+        locally computed pages. Imports stop once the pool would drop
+        below `reserve_pages` allocatable pages (default one page per
+        slot) so a warm import can never starve active requests.
+        Returns pages imported. CALLER must serialize against the
+        stepping thread (same contract as export_prefix/import_prefill:
+        _import_fn donates the cache pools)."""
+        if payload is None or not self._prefix_on:
+            return 0
+        if payload["page_size"] != self.cfg.page_size:
+            raise ValueError(
+                f"page_size mismatch: payload {payload['page_size']} vs "
+                f"engine {self.cfg.page_size}")
+        with self._lock:
+            if reserve_pages is None:
+                reserve_pages = self.cfg.max_batch_size
+            hashes = payload["page_hashes"]
+            take_idx: list[int] = []
+            take_pids: list[int] = []
+            budget = self._pages_avail() - int(reserve_pages)
+            for i, h in enumerate(hashes):
+                if h in self._hash_to_page:
+                    continue    # already cached locally (either source)
+                if budget <= 0:
+                    break
+                pid = self._pop_free_page()
+                self._page_refs[pid] = 0
+                take_idx.append(i)
+                take_pids.append(pid)
+                budget -= 1
+            if not take_pids:
+                return 0
+            idx = jnp.asarray(np.asarray(take_pids, np.int32))
+            sel = np.asarray(take_idx)
+            for li, layer in enumerate(self.caches):
+                layer["k"] = self._import_fn(
+                    layer["k"], idx,
+                    jnp.asarray(payload["pages"][li]["k"][sel]))
+                layer["v"] = self._import_fn(
+                    layer["v"], idx,
+                    jnp.asarray(payload["pages"][li]["v"][sel]))
+            for i, pid in zip(take_idx, take_pids):
+                self._register_page(pid, hashes[i])
+                self._cached_lru[pid] = None
+            self.stats["prefix_imported_pages"] += len(take_pids)
+            return len(take_pids)
+
+    def drain_directory_delta(self) -> tuple:
+        """-> (new_hashes, dropped_hashes) accumulated since the last
+        drain, filtered against current cache state so a
+        publish-then-evict (or evict-then-republish) nets out to the
+        truth. Only meaningful with track_page_publish on; must be
+        called serialized with stepping (the serving layer's engine
+        loop), which is also what bounds the lists."""
+        if not self._dir_new and not self._dir_dropped:
+            return (), ()
+        new, self._dir_new = self._dir_new, []
+        dropped, self._dir_dropped = self._dir_dropped, []
+        with self._lock:
+            new = [h for h in dict.fromkeys(new)
+                   if h in self._hash_to_page]
+            dropped = [h for h in dict.fromkeys(dropped)
+                       if h not in self._hash_to_page]
+        return new, dropped
 
     # -- stats -------------------------------------------------------------
 

@@ -78,6 +78,23 @@ class LLMServer:
         self._wake = threading.Event()
         self._stop = False
         self._error: Optional[BaseException] = None
+        # serializes engine stepping against cross-replica page
+        # import/export (the dispatches donate engine.caches, so a
+        # concurrent scatter/gather would read deleted buffers — same
+        # contract as pd_disagg's _steplock around import_prefill)
+        self._steplock = threading.Lock()
+        # cluster prefix directory (serve/frontdoor/prefix.py): base
+        # paged engine only — LoRA-merged engines produce different KV
+        # for the same tokens and must stay out of the shared-by-model
+        # directory. The controller injects this replica's own handle
+        # via set_replica_handle; publishing starts then.
+        self._prefix_dir = None
+        from ..core.config import cfg as rcfg
+        if rcfg.serve_prefix_directory and \
+                getattr(self.engine, "_prefix_on", False):
+            from ..serve.frontdoor.prefix import PrefixDirectoryClient
+            self._prefix_dir = PrefixDirectoryClient(cfg.model_id)
+            self.engine.track_page_publish = True
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -145,8 +162,14 @@ class LLMServer:
                 worked = False
                 for eng in self._engines():
                     if eng.has_work():
-                        eng.step()
+                        with self._steplock:
+                            eng.step()
                         worked = True
+                if self._prefix_dir is not None:
+                    # drain newly published/evicted page hashes to the
+                    # cluster directory (rate-limited inside; this IS
+                    # the stepping thread, per the drain contract)
+                    self._prefix_dir.maybe_publish(self.engine)
                 if not worked:
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
@@ -171,6 +194,16 @@ class LLMServer:
             logprobs=int(request.get("logprobs") or 0),
         )
         eng = self._engine_for(request)
+        # tokenize ONCE: the prefix-directory lookup and submit share
+        # the ids (a second encode of a long system prompt would tax
+        # exactly the workloads the directory accelerates)
+        prompt = (eng.tokenizer.encode(prompt)
+                  if isinstance(prompt, str) else list(prompt))
+        if self._prefix_dir is not None and eng is self.engine:
+            # cluster prefix directory: admission-match a prefix warmed
+            # on ANY replica by importing its KV pages before submit —
+            # best effort, a miss/failure just means a cold prefill
+            self._prefix_dir.maybe_import(eng, self._steplock, prompt)
         if sp.logprobs and not hasattr(eng, "_prefill_rows_fns"):
             # dense InferenceEngine never fills out_logps: refuse loudly
             # instead of returning a well-formed response missing the
@@ -263,6 +296,23 @@ class LLMServer:
         yield {"object": "text_completion.chunk", "model": self.model_id,
                "choices": [{"text": tail, "index": 0,
                             "finish_reason": out["finish_reason"]}]}
+
+    def set_replica_handle(self, handle) -> None:
+        """Controller-injected handle to THIS replica's actor: the value
+        every prefix-directory entry carries, so peer replicas can call
+        export_prefix on the owner."""
+        if self._prefix_dir is not None:
+            self._prefix_dir.set_replica_handle(handle)
+
+    def export_prefix(self, hashes):
+        """Serve a peer replica's cross-replica prefix import: gather
+        the cached KV pages for `hashes` (a chain run) to host arrays.
+        None when nothing is cached any more — the caller treats the
+        directory entry as stale and prefills cold."""
+        if not getattr(self.engine, "_prefix_on", False):
+            return None
+        with self._steplock:
+            return self.engine.export_prefix(list(hashes))
 
     def loaded_loras(self) -> list:
         return list(self._lora_engines)

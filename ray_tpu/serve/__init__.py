@@ -7,8 +7,10 @@ proxy.py:709, autoscaling autoscaling_policy.py:12, public api serve/api.py).
 
 Shape here: a singleton ServeController actor reconciles declarative
 deployment specs into replica actors; DeploymentHandles route requests with
-power-of-two-choices over per-handle in-flight counts; an aiohttp proxy
-actor exposes HTTP; queue-based autoscaling adds/removes replicas between
+power-of-two-choices over per-handle in-flight counts; a controller-managed
+FLEET of aiohttp proxy actors exposes HTTP behind a shared route table with
+SLO-aware admission control and a cluster-wide prefix-cache directory
+(serve/frontdoor/); queue-based autoscaling adds/removes replicas between
 min/max. LLM serving (serve.llm analog) lives in ray_tpu.llm on top of this.
 
     @serve.deployment(num_replicas=2)

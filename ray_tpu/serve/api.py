@@ -159,6 +159,7 @@ def _controller(create: bool = True):
 def run(app: Application, *, name: str = "default",
         route_prefix: Optional[str] = "/", blocking: bool = False,
         http_port: Optional[int] = None,
+        num_proxies: Optional[int] = None,
         local_testing_mode: bool = False,
         _local_testing_mode: bool = False) -> DeploymentHandle:
     """Deploy an application; returns the ingress handle
@@ -166,7 +167,12 @@ def run(app: Application, *, name: str = "default",
     whole application runs in-process with no cluster — unit-test speed
     for composition/async/streaming logic (reference:
     serve/_private/local_testing_mode.py; also accepted under the
-    reference's ``_local_testing_mode`` spelling)."""
+    reference's ``_local_testing_mode`` spelling).
+
+    ``num_proxies`` (default cfg.serve_num_proxies) scales the HTTP
+    front door: the controller keeps N proxy actors alive on ports
+    http_port..http_port+N-1, each applying SLO-aware admission control
+    from the shared route table (serve/frontdoor/)."""
     import cloudpickle
     from ..core.usage import record_library_usage
     record_library_usage("serve")
@@ -182,7 +188,8 @@ def run(app: Application, *, name: str = "default",
     ctrl = _controller()
     specs_blob = cloudpickle.dumps(
         (app.specs(), app.ingress.spec.name, route_prefix))
-    ray.get(ctrl.deploy_application.remote(name, specs_blob, http_port))
+    ray.get(ctrl.deploy_application.remote(name, specs_blob, http_port,
+                                           num_proxies))
     handle = DeploymentHandle(app.ingress.spec.name, name, ctrl)
     if blocking:  # pragma: no cover - interactive use
         import time

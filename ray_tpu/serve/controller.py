@@ -305,6 +305,15 @@ class ReplicaActor:
             if asyncio.iscoroutine(res):
                 await res
 
+    async def set_self(self, handle):
+        """Inject this replica's OWN actor handle (the controller calls
+        this right after creation, passing the handle back in). The
+        prefix-directory client publishes it as the owner of every page
+        hash this replica registers (llm/serving.py
+        set_replica_handle)."""
+        if hasattr(self._callable, "set_replica_handle"):
+            self._callable.set_replica_handle(handle)
+
     async def health_check(self) -> bool:
         if hasattr(self._callable, "check_health"):
             self._callable.check_health()
@@ -344,16 +353,25 @@ class ServeController:
         self._ingress: dict[str, str] = {}
         # app -> URL route prefix (reference: route_prefix in serve.run)
         self._routes: dict[str, str] = {}
-        self._proxy = None
+        # proxy fleet (serve/frontdoor): [{"actor", "port", "index"}],
+        # controller-managed like replicas — dead proxies are replaced
+        # on their port by the reconcile loop
+        self._proxies: list[dict] = []
+        self._http_port = None
         self._reconcile_task = None
         self._shutdown = False
         self._version_counter = itertools.count(1)
         self._ticks = 0
+        # last published route-table snapshot (minus the version field):
+        # republished through frontdoor/routetable.py whenever topology
+        # drifts from it
+        self._pub_state = None
 
     # -- deploy ------------------------------------------------------------
 
     async def deploy_application(self, app_name: str, specs_blob: bytes,
-                                 http_port: Optional[int] = None) -> None:
+                                 http_port: Optional[int] = None,
+                                 num_proxies: Optional[int] = None) -> None:
         import cloudpickle
         specs, ingress, route_prefix = cloudpickle.loads(specs_blob)
         if app_name in self._apps:  # redeploy: tear down the old replicas
@@ -383,7 +401,8 @@ class ServeController:
         for st in states.values():
             await self._scale_to_target(st)
         if http_port is not None:
-            await self._ensure_proxy(http_port)
+            await self._ensure_proxies(http_port, num_proxies)
+        self._publish_routes()
         if self._reconcile_task is None:
             self._reconcile_task = asyncio.get_event_loop().create_task(
                 self._reconcile_loop())
@@ -423,6 +442,12 @@ class ServeController:
         if st.spec.user_config is not None:
             # configured BEFORE the replica enters routing (async-aware)
             await actor.reconfigure.remote(st.spec.user_config)
+        # hand the replica its own handle (prefix-directory ownership);
+        # fire-and-forget: replicas without the hook ignore it
+        try:
+            actor.set_self.remote(actor)
+        except Exception:
+            pass  # replica already dying; reconcile replaces it
         st.replicas.append(actor)
         st.bump()
 
@@ -511,13 +536,21 @@ class ServeController:
         """{route_prefix: app} for the proxy's longest-prefix matching."""
         return {v: k for k, v in self._routes.items()}
 
+    async def get_proxies(self) -> list:
+        """The live proxy fleet with actor handles (ops/chaos tooling)."""
+        return [{"actor": p["actor"], "port": p["port"],
+                 "index": p["index"]} for p in self._proxies]
+
     async def get_ingress(self, app: str) -> str:
         if app not in self._ingress:
             raise ValueError(f"no application {app!r}")
         return self._ingress[app]
 
     async def status(self) -> dict:
-        out: dict = {"applications": {}}
+        out: dict = {"applications": {},
+                     "proxies": [{"index": p["index"], "port": p["port"]}
+                                 for p in self._proxies],
+                     "http_port": self._http_port}
         for app, states in self._apps.items():
             out["applications"][app] = {
                 "ingress": self._ingress.get(app),
@@ -535,6 +568,7 @@ class ServeController:
         import ray_tpu
         states = self._apps.pop(app, None)
         self._ingress.pop(app, None)
+        self._publish_routes()
         if not states:
             return
         for st in states.values():
@@ -558,12 +592,13 @@ class ServeController:
         self._shutdown = True
         for app in list(self._apps):
             await self.delete_application(app)
-        if self._proxy is not None:
-            import ray_tpu
+        import ray_tpu
+        for rec in self._proxies:
             try:
-                ray_tpu.kill(self._proxy)
+                ray_tpu.kill(rec["actor"])
             except Exception:
                 pass  # already dead
+        self._proxies.clear()
 
     # -- reconcile + autoscaling ------------------------------------------
 
@@ -613,6 +648,11 @@ class ServeController:
                     if cfg is not None:
                         self._autoscale(st, cfg, ongoing)
                     await self._scale_to_target(st)
+            if deep and self._proxies:
+                await self._check_proxies()
+            # topology drift (replica counts, proxy replacements) reaches
+            # the shared route table here; no-op when nothing changed
+            self._publish_routes()
 
     def _autoscale(self, st: _DeploymentState, cfg: AutoscalingConfig,
                    total_ongoing: int):
@@ -646,13 +686,94 @@ class ServeController:
     def _last(st: _DeploymentState, which: str) -> float:
         return st._last_scale_up if which == "up" else st._last_scale_down
 
-    # -- HTTP proxy --------------------------------------------------------
+    # -- HTTP proxy fleet (serve/frontdoor) -------------------------------
 
-    async def _ensure_proxy(self, port: int):
-        if self._proxy is not None:
-            return
+    async def _spawn_proxy(self, port: int, index: int) -> dict:
         import ray_tpu
         from .proxy import ProxyActor
         cls = ray_tpu.remote(ProxyActor)
-        self._proxy = cls.options(max_concurrency=512).remote(port)
-        await self._proxy.start.remote()
+        actor = cls.options(max_concurrency=512).remote(port, index)
+        await actor.start.remote()
+        return {"actor": actor, "port": port, "index": index}
+
+    async def _ensure_proxies(self, port: int,
+                              num_proxies: Optional[int] = None):
+        """Scale the proxy fleet to N actors on ports port..port+N-1
+        (cfg.serve_num_proxies when unspecified). Idempotent; a second
+        app deploy reuses the running fleet. Excess proxies (a deploy
+        shrinking the fleet) drain: killed after the route table stops
+        listing them."""
+        from ..core.config import cfg
+        if num_proxies is None:
+            num_proxies = cfg.serve_num_proxies
+        n = max(1, int(num_proxies))
+        self._http_port = port
+        import ray_tpu
+        while len(self._proxies) > n:
+            victim = self._proxies.pop()
+            self._publish_routes()
+            try:
+                await victim["actor"].stop.remote()
+                ray_tpu.kill(victim["actor"])
+            except Exception:
+                pass  # already dead
+        for i in range(len(self._proxies), n):
+            self._proxies.append(await self._spawn_proxy(port + i, i))
+        self._publish_routes()
+
+    async def _check_proxies(self):
+        """Reconcile tick: replace dead proxies on their port (same
+        controller-managed contract as replicas)."""
+        import ray_tpu
+        for rec in list(self._proxies):
+            try:
+                await rec["actor"].ping.remote()
+            except Exception:
+                try:
+                    ray_tpu.kill(rec["actor"])
+                except Exception:
+                    pass  # already dead
+                try:
+                    fresh = await self._spawn_proxy(rec["port"],
+                                                    rec["index"])
+                except Exception:
+                    # port still lingering in TIME_WAIT or node down:
+                    # retry next tick rather than losing the slot
+                    continue
+                self._proxies[self._proxies.index(rec)] = fresh
+                self._publish_routes()
+        try:
+            from . import metrics as sm
+            sm.proxy_count().set(float(len(self._proxies)))
+        except Exception:
+            pass  # telemetry is best-effort here
+
+    # -- shared route table (frontdoor/routetable.py) ---------------------
+
+    def _publish_routes(self):
+        """Publish the route-table snapshot to the head's shared
+        directory when anything drifted: routes, ingress, per-deployment
+        capacity (replicas x max_ongoing — the admission budgets), or
+        the proxy fleet. One async frame; proxies TTL-refresh from it
+        instead of calling this controller per request."""
+        state = {
+            "routes": {v: k for k, v in self._routes.items()},
+            "ingress": dict(self._ingress),
+            "capacity": {
+                f"{app}/{name}": [len(st.replicas) or st.target,
+                                  st.spec.max_ongoing_requests]
+                for app, states in self._apps.items()
+                for name, st in states.items()},
+            "n_proxies": max(1, len(self._proxies)),
+            "proxies": [{"index": p["index"], "port": p["port"]}
+                        for p in self._proxies],
+        }
+        if state == self._pub_state:
+            return
+        self._pub_state = state
+        try:
+            from .frontdoor import routetable
+            routetable.publish_snapshot(
+                {**state, "v": next(self._version_counter)})
+        except Exception:
+            pass  # no cluster directory (local test): proxies fall back

@@ -1,0 +1,214 @@
+"""SLO-aware admission control + load shedding for the serve proxies.
+
+Each proxy runs one :class:`AdmissionController` on its event loop. Per
+deployment it holds a budget (its share of the fleet's live capacity:
+``replicas x max_ongoing_requests / n_proxies``), a bounded FIFO queue
+for arrivals past the budget, and an EWMA of per-request service time.
+
+Decision tree for an arriving request (``acquire``):
+
+1. a slot is free -> admit immediately;
+2. the queue is full -> shed (``queue_full``);
+3. the *predicted* queue wait — requests ahead divided by the drain
+   rate the EWMA implies — already exceeds the deadline
+   (cfg.serve_admission_timeout_s) -> shed (``slo``): queueing a
+   request that cannot meet its SLO only wastes its socket;
+4. otherwise park; a release hands the slot to the queue head. A
+   request still parked at the deadline sheds (``deadline``).
+
+Sheds raise :class:`ShedError` carrying a Retry-After estimate (the
+predicted time for the backlog to drain, clamped to [1, 60] seconds) —
+the proxy turns it into ``429`` + ``Retry-After``, the gRPC proxy into
+``RESOURCE_EXHAUSTED``. Backpressure therefore reaches the client
+instead of collapsing the replicas, and every admitted request's queue
+wait lands in rtpu_serve_admission_queue_wait_seconds.
+
+Everything here is asyncio single-loop state — no locks; the proxy
+calls it only from its event loop.
+"""
+from __future__ import annotations
+
+import asyncio
+import math
+import time
+from collections import deque
+from typing import Optional
+
+# EWMA smoothing for per-request service seconds; ~20-request memory
+_EWMA_ALPHA = 0.1
+# before any completion is observed, assume requests are this slow —
+# optimistic enough not to shed a cold deployment on its first burst
+_EWMA_SEED_S = 0.05
+
+
+class ShedError(Exception):
+    """Request refused by admission control; carries the retry hint."""
+
+    def __init__(self, reason: str, retry_after_s: int, detail: str = ""):
+        super().__init__(detail or f"admission shed ({reason})")
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+
+
+class _DeploymentGate:
+    def __init__(self, budget: int, queue_depth: int, timeout_s: float):
+        self.budget = max(1, int(budget))
+        self.queue_depth = max(0, int(queue_depth))
+        self.timeout_s = float(timeout_s)
+        self.inflight = 0
+        self._parked: deque = deque()   # FIFO of (future, enqueue_t)
+        self.ewma_s = _EWMA_SEED_S
+
+    def predicted_wait_s(self, ahead: int) -> float:
+        """Seconds until `ahead` queued requests drain: the budget
+        retires ~budget/ewma requests per second."""
+        return ahead * self.ewma_s / self.budget
+
+    def retry_after_s(self) -> int:
+        est = self.predicted_wait_s(len(self._parked) + 1)
+        return max(1, min(60, int(math.ceil(est))))
+
+
+class AdmissionController:
+    """Per-proxy gatekeeper. ``configure`` is idempotent and cheap — the
+    proxy calls it on every route-table refresh so budgets track live
+    replica capacity; gates for deployments that disappear are
+    dropped."""
+
+    def __init__(self, proxy_label: str = "proxy-0"):
+        self._gates: dict[tuple, _DeploymentGate] = {}
+        self._proxy = proxy_label
+
+    # -- configuration ---------------------------------------------------
+
+    def configure(self, app: str, deployment: str, capacity: int,
+                  n_proxies: int = 1,
+                  queue_depth: Optional[int] = None,
+                  timeout_s: Optional[float] = None) -> None:
+        from ...core.config import cfg
+        budget = max(1, int(capacity) // max(1, int(n_proxies)))
+        qd = cfg.serve_admission_queue_depth if queue_depth is None \
+            else queue_depth
+        to = cfg.serve_admission_timeout_s if timeout_s is None \
+            else timeout_s
+        g = self._gates.get((app, deployment))
+        if g is None:
+            self._gates[(app, deployment)] = _DeploymentGate(budget, qd, to)
+        else:
+            g.budget = max(1, int(budget))
+            g.queue_depth = max(0, int(qd))
+            g.timeout_s = float(to)
+
+    def prune(self, live: set) -> None:
+        """Drop gates for (app, deployment) pairs no longer deployed.
+        Parked waiters of a pruned gate shed with a small retry hint —
+        their app was deleted mid-wait."""
+        for key in [k for k in self._gates if k not in live]:
+            g = self._gates.pop(key)
+            for fut, _t in g._parked:
+                if not fut.done():
+                    fut.set_exception(ShedError("deadline", 1,
+                                                "deployment removed"))
+            g._parked.clear()
+
+    def gate_for(self, app: str, deployment: str) -> \
+            Optional[_DeploymentGate]:
+        return self._gates.get((app, deployment))
+
+    # -- the gate --------------------------------------------------------
+
+    async def acquire(self, app: str, deployment: str):
+        """Admit or shed. Returns a zero-arg release callable the caller
+        MUST invoke exactly once when the request finishes (any
+        outcome); raises ShedError to refuse."""
+        g = self._gates.get((app, deployment))
+        if g is None:
+            # unknown deployment (admission unconfigured — e.g. route
+            # snapshot unavailable, or a proxy started standalone in a
+            # test): admit untracked. Must accept the release duration
+            # argument like a real releaser.
+            return lambda *_a: None
+        if g.inflight < g.budget:
+            g.inflight += 1
+            self._count_admit(app, deployment, g, 0.0)
+            return self._releaser(app, deployment, g)
+        if len(g._parked) >= g.queue_depth:
+            self._count_shed(app, deployment, "queue_full", g)
+            raise ShedError("queue_full", g.retry_after_s())
+        if g.predicted_wait_s(len(g._parked) + 1) > g.timeout_s:
+            # SLO-aware refusal: the queue would outlive the deadline
+            self._count_shed(app, deployment, "slo", g)
+            raise ShedError("slo", g.retry_after_s())
+        fut = asyncio.get_event_loop().create_future()
+        t0 = time.perf_counter()
+        g._parked.append((fut, t0))
+        try:
+            await asyncio.wait_for(fut, g.timeout_s)
+        except asyncio.TimeoutError:
+            try:
+                g._parked.remove((fut, t0))
+            except ValueError:
+                pass  # a release popped us concurrently with the timeout
+            self._count_shed(app, deployment, "deadline", g)
+            raise ShedError("deadline", g.retry_after_s()) from None
+        # a releaser handed us its slot (inflight stays counted)
+        self._count_admit(app, deployment, g, time.perf_counter() - t0)
+        return self._releaser(app, deployment, g)
+
+    def _releaser(self, app: str, deployment: str, g: _DeploymentGate):
+        released = False
+
+        def release(duration_s: Optional[float] = None):
+            nonlocal released
+            if released:
+                return
+            released = True
+            if duration_s is not None:
+                g.ewma_s += _EWMA_ALPHA * (duration_s - g.ewma_s)
+            # hand the slot to the queue head; the waiter keeps the
+            # inflight count we hold, so the budget can never leak
+            while g._parked:
+                fut, _t = g._parked.popleft()
+                if not fut.done():
+                    fut.set_result(None)
+                    self._set_inflight(app, deployment, g)
+                    return
+            g.inflight -= 1
+            self._set_inflight(app, deployment, g)
+        return release
+
+    # -- telemetry (never raises) ----------------------------------------
+
+    def _count_admit(self, app, deployment, g, waited_s: float):
+        try:
+            from .. import metrics as sm
+            tags = {"app": app, "deployment": deployment}
+            sm.admission_admitted().inc(1.0, tags=tags)
+            sm.admission_queue_wait().observe(waited_s, tags=tags)
+            self._set_inflight(app, deployment, g)
+        except Exception:
+            pass  # telemetry must never fail a request
+
+    def _count_shed(self, app, deployment, reason, g):
+        try:
+            from .. import metrics as sm
+            sm.admission_shed().inc(1.0, tags={
+                "app": app, "deployment": deployment, "reason": reason})
+        except Exception:
+            pass  # telemetry must never fail a request
+
+    def _set_inflight(self, app, deployment, g):
+        try:
+            from .. import metrics as sm
+            sm.admission_inflight().set(float(g.inflight), tags={
+                "app": app, "deployment": deployment,
+                "proxy": self._proxy})
+        except Exception:
+            pass  # telemetry must never fail a request
+
+    def stats(self) -> dict:
+        return {f"{a}/{d}": {"inflight": g.inflight,
+                             "queued": len(g._parked),
+                             "budget": g.budget,
+                             "ewma_service_s": round(g.ewma_s, 4)}
+                for (a, d), g in self._gates.items()}

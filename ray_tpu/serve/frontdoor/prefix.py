@@ -1,0 +1,169 @@
+"""Cluster-wide prefix-cache directory client (replica side).
+
+PR 2 gave every paged engine a per-replica prefix cache: full prompt
+pages content-addressed by chained hashes, admission-matched so shared
+system prompts prefill once per replica. This module makes those caches
+ONE cluster cache:
+
+- **publish**: the replica's engine loop drains newly registered /
+  evicted page hashes (PagedInferenceEngine.drain_directory_delta) and
+  merges them into the ``serve:prefix:<model>`` shared directory,
+  valued with this replica's own actor handle;
+- **import**: before submitting a prompt, a replica computes the
+  prompt's chain hashes, checks local coverage, and asks the directory
+  about the rest. If another replica warmed a longer run, it calls that
+  replica's ``export_prefix`` (pages gathered to host arrays — the
+  payload rides the object store like any large actor-call result) and
+  seeds its own cache via ``import_prefix``; admission then hits
+  locally as if the pages had been computed here. Greedy decoding over
+  imported pages is bit-identical to a cold prefill — the pages ARE
+  the cold prefill's pages, moved.
+
+Failure model (the consistency rule the README documents): every
+directory entry is a HINT. Owner dead, pages evicted, head gone — the
+importer drops the stale keys (best effort) and the request prefills
+cold. Nothing on this path can corrupt an answer; it can only miss a
+shortcut. Sheds and deaths mid-import surface as a cold prefill, never
+an error.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+
+class PrefixDirectoryClient:
+    """One per LLMServer replica (base engine only — LoRA-merged engines
+    produce different KV for the same tokens, so their pages must never
+    enter the shared-by-model directory)."""
+
+    def __init__(self, model_id: str):
+        self.dir_name = f"serve:prefix:{model_id}"
+        self.model_id = model_id
+        self._self_handle: Any = None
+        self._self_id: Optional[bytes] = None
+        self._last_publish = 0.0
+
+    def set_replica_handle(self, handle) -> None:
+        """The replica's own actor handle (injected by the controller
+        right after creation) — published as every entry's value so
+        importers can call export_prefix on the owner."""
+        self._self_handle = handle
+        self._self_id = getattr(handle, "_actor_id", None)
+
+    # -- publish ---------------------------------------------------------
+
+    def maybe_publish(self, engine) -> int:
+        """Called from the replica's engine loop (the stepping thread —
+        drain_directory_delta's contract): ship accumulated page-hash
+        deltas to the head, rate-limited by cfg.serve_prefix_publish_s.
+        Returns hashes published."""
+        if self._self_handle is None:
+            return 0    # handle not injected yet: nothing to own entries
+        from ...core.config import cfg
+        now = time.monotonic()
+        if now - self._last_publish < cfg.serve_prefix_publish_s:
+            return 0
+        self._last_publish = now
+        new, dropped = engine.drain_directory_delta()
+        if not new and not dropped:
+            return 0
+        from ...core import directory as cdir
+        ok = cdir.update(self.dir_name,
+                         put={h: self._self_handle for h in new},
+                         drop=list(dropped))
+        if ok and new:
+            try:
+                from .. import metrics as sm
+                sm.prefix_directory_publishes().inc(
+                    float(len(new)), tags={"model": self.model_id})
+            except Exception:
+                pass  # telemetry must never fail the engine loop
+        return len(new) if ok else 0
+
+    # -- import ----------------------------------------------------------
+
+    def maybe_import(self, engine, steplock, prompt) -> int:
+        """Admission-time cross-replica import. Returns pages imported
+        (0 on local-hit, no-entry, or any failure — all of which just
+        mean a cold prefill). Called on a request thread; `steplock`
+        serializes the cache scatter against the engine loop (the same
+        contract PD-disagg's import_prefill rides)."""
+        try:
+            hashes = engine.hash_prompt(prompt)
+        except Exception:
+            return 0
+        if not hashes:
+            return 0
+        local = engine.cached_prefix_len(hashes)
+        if local >= len(hashes):
+            return 0    # fully covered locally: not a directory event
+        from ...core import directory as cdir
+        from ...core.config import cfg
+        got = cdir.query(self.dir_name, keys=hashes[local:], timeout=2.0)
+        entries = (got or {}).get("entries") or {}
+        # longest hash the cluster claims to cover, owned by a peer
+        best_i, owner = -1, None
+        for i in range(len(hashes) - 1, local - 1, -1):
+            cand = entries.get(hashes[i])
+            if cand is None:
+                continue
+            if self._self_id is not None and \
+                    getattr(cand, "_actor_id", None) == self._self_id:
+                continue    # our own publication
+            best_i, owner = i, cand
+            break
+        if owner is None:
+            self._count("misses")
+            return 0
+        want = hashes[:best_i + 1]
+        try:
+            import ray_tpu
+            payload = ray_tpu.get(
+                owner.handle_request.remote(
+                    "export_prefix", (want,), {}, None),
+                timeout=cfg.serve_prefix_import_timeout_s)
+        except Exception:
+            # owner dead/slow: drop the stale hints so the next request
+            # doesn't retry a dead replica, then prefill cold
+            cdir.update(self.dir_name,
+                        drop=[h for h in want if h in entries])
+            self._count("stale")
+            return 0
+        if not payload:
+            cdir.update(self.dir_name,
+                        drop=[h for h in want if h in entries])
+            self._count("stale")
+            return 0
+        try:
+            with steplock:
+                n = engine.import_prefix(payload)
+        except Exception:
+            # a matching hint with an incompatible payload (same
+            # model_id, different engine geometry) must cost a cold
+            # prefill, never the request — per the module failure model
+            cdir.update(self.dir_name,
+                        drop=[h for h in want if h in entries])
+            self._count("stale")
+            return 0
+        if n > 0:
+            self._count("hits")
+            try:
+                from .. import metrics as sm
+                sm.prefix_directory_imported_pages().inc(
+                    float(n), tags={"model": self.model_id})
+            except Exception:
+                pass  # telemetry must never fail a request
+        else:
+            self._count("misses")
+        return n
+
+    def _count(self, which: str):
+        try:
+            from .. import metrics as sm
+            fn = {"hits": sm.prefix_directory_hits,
+                  "misses": sm.prefix_directory_misses,
+                  "stale": sm.prefix_directory_stale}[which]
+            fn().inc(1.0, tags={"model": self.model_id})
+        except Exception:
+            pass  # telemetry must never fail a request

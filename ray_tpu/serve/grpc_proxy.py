@@ -40,6 +40,74 @@ class GrpcProxyActor:
         # the 16-thread gRPC executor mutates the cache concurrently
         # (unlike the HTTP proxy, which lives on one event-loop thread)
         self._handles_lock = threading.Lock()
+        # synchronous admission gate (the HTTP fleet's asyncio
+        # controller doesn't fit a thread-pool server): per-app
+        # in-flight counts against the route-table capacity; past
+        # budget + queue depth the request sheds RESOURCE_EXHAUSTED —
+        # the gRPC spelling of the HTTP 429 contract
+        self._inflight: dict[str, int] = {}  # guarded by: self._adm_lock
+        self._adm_lock = threading.Lock()
+        self._snap: Optional[dict] = None
+        self._snap_ts = 0.0
+
+    # -- admission (frontdoor, sync flavor) --------------------------------
+
+    def _budget_for(self, app: str) -> Optional[int]:
+        """App's admission bound from the shared route-table snapshot:
+        the fleet capacity itself (replicas x max_ongoing_requests —
+        replica-side queueing is already inside max_ongoing, and gRPC
+        clients carry deadlines/retries, so unlike the HTTP proxies
+        there is no extra proxy-side queue allowance). None =
+        unconfigured (admit untracked)."""
+        from ..core.config import cfg
+        if not cfg.serve_admission_control:
+            return None
+        import time as _time
+
+        from .frontdoor import routetable
+        if _time.monotonic() - self._snap_ts > 1.0:
+            try:
+                self._snap = routetable.fetch_snapshot()
+            except Exception:
+                self._snap = None  # directory unreachable: admit open
+            self._snap_ts = _time.monotonic()
+        snap = self._snap
+        if not snap:
+            return None
+        ing = snap.get("ingress", {}).get(app)
+        if ing is None:
+            return None
+        cap = routetable.capacity_of(snap, app, ing)
+        if cap <= 0:
+            return None
+        return cap
+
+    def _admit(self, app: str, context) -> bool:
+        """True = admitted (caller must _release); aborts the rpc with
+        RESOURCE_EXHAUSTED when the app is past budget."""
+        import grpc
+        bound = self._budget_for(app)
+        with self._adm_lock:
+            cur = self._inflight.get(app, 0)
+            if bound is not None and cur >= bound:
+                shed = True
+            else:
+                self._inflight[app] = cur + 1
+                shed = False
+        if shed:
+            try:
+                from . import metrics as sm
+                sm.admission_shed().inc(1.0, tags={
+                    "app": app, "deployment": "", "reason": "queue_full"})
+            except Exception:
+                pass  # telemetry must never fail a request
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                          "overloaded; retry_after_s=1")
+        return True
+
+    def _release(self, app: str):
+        with self._adm_lock:
+            self._inflight[app] = max(0, self._inflight.get(app, 1) - 1)
 
     def start(self) -> int:
         import grpc
@@ -105,22 +173,51 @@ class GrpcProxyActor:
         return (app, method, req.get("payload"),
                 req.get("multiplexed_model_id", ""))
 
-    def _call(self, request_bytes: bytes, context) -> bytes:
+    @staticmethod
+    def _typed_abort(context, e) -> None:
+        """Typed statuses for the failure modes a healthy front door
+        still sees (same contract as the HTTP proxy's 503/504): replica
+        death -> UNAVAILABLE (retryable), upstream timeout ->
+        DEADLINE_EXCEEDED; anything else is a real INTERNAL."""
         import grpc
+
+        from ..exceptions import (ActorDiedError, GetTimeoutError,
+                                  WorkerCrashedError)
+        if isinstance(e, (ActorDiedError, WorkerCrashedError)) or (
+                isinstance(e, RuntimeError) and "no replicas" in str(e)):
+            context.abort(grpc.StatusCode.UNAVAILABLE,
+                          f"replica_unavailable: {type(e).__name__}")
+        if isinstance(e, GetTimeoutError):
+            context.abort(grpc.StatusCode.DEADLINE_EXCEEDED,
+                          "upstream_timeout")
+        context.abort(grpc.StatusCode.INTERNAL, repr(e))
+
+    def _call(self, request_bytes: bytes, context) -> bytes:
         try:
             app, method, payload, model_id = self._parse(request_bytes)
+        except Exception as e:  # noqa: BLE001 — bad envelope
+            import grpc
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, repr(e))
+        self._admit(app, context)
+        try:
             h = self._handle_for(app, method, False, model_id)
             resp = (h.remote(payload) if payload is not None
                     else h.remote())
             out = resp.result(timeout_s=300)
             return json.dumps(out, default=str).encode()
         except Exception as e:  # noqa: BLE001 — map to grpc status
-            context.abort(grpc.StatusCode.INTERNAL, repr(e))
+            self._typed_abort(context, e)
+        finally:
+            self._release(app)
 
     def _call_stream(self, request_bytes: bytes, context):
-        import grpc
         try:
             app, method, payload, model_id = self._parse(request_bytes)
+        except Exception as e:  # noqa: BLE001 — bad envelope
+            import grpc
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, repr(e))
+        self._admit(app, context)
+        try:
             h = self._handle_for(app, method, True, model_id)
             gen = (h.remote(payload) if payload is not None
                    else h.remote())
@@ -130,7 +227,9 @@ class GrpcProxyActor:
             finally:
                 gen.cancel()
         except Exception as e:  # noqa: BLE001
-            context.abort(grpc.StatusCode.INTERNAL, repr(e))
+            self._typed_abort(context, e)
+        finally:
+            self._release(app)
 
     def stop(self):
         if self._server is not None:

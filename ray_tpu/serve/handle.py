@@ -54,9 +54,15 @@ class DeploymentResponse:
         try:
             try:
                 return ray_tpu.get(self._ref, timeout=timeout_s)
-            except (ActorDiedError, WorkerCrashedError):
+            except (ActorDiedError, WorkerCrashedError) as e:
                 if self._retry is None:
                     raise
+                # break the exception->traceback->frame cycle NOW: the
+                # traceback's get() frames pin the dead replica's error
+                # ref until a gc pass happens to run, which would hold
+                # the store above baseline long after a chaos kill is
+                # retried successfully
+                e.__traceback__ = None
                 self._ref = self._retry()
                 return ray_tpu.get(self._ref, timeout=timeout_s)
         finally:

@@ -26,6 +26,17 @@ Metric names and label sets:
       (control-plane dispatches serving streams — the static decode
       plan's "dispatches per token -> ~0" headline reads from this)
   rtpu_serve_stream_items_total{app,deployment,transport} counter
+  rtpu_serve_admission_admitted_total{app,deployment}     counter
+  rtpu_serve_admission_shed_total{app,deployment,reason}  counter (shed
+      429s by reason: queue_full | slo | deadline)
+  rtpu_serve_admission_queue_wait_seconds{app,deployment} histogram
+  rtpu_serve_admission_inflight{app,deployment,proxy}     gauge
+  rtpu_serve_proxies                                      gauge
+  rtpu_serve_prefix_directory_hits_total{model}           counter
+  rtpu_serve_prefix_directory_misses_total{model}         counter
+  rtpu_serve_prefix_directory_imported_pages_total{model} counter
+  rtpu_serve_prefix_directory_publishes_total{model}      counter
+  rtpu_serve_prefix_directory_stale_total{model}          counter
 
 ``metrics_summary()`` condenses the merged store into finite p50/p95/p99
 latencies (TTFT, e2e, replica) plus the headline gauges/counters — the
@@ -117,6 +128,78 @@ def stream_items() -> Counter:
     return _metric(Counter, "rtpu_serve_stream_items_total",
                    "items delivered by streaming responses, by transport",
                    tag_keys=("app", "deployment", "transport"))
+
+
+# -- front door: admission control + prefix directory ----------------- #
+
+def admission_admitted() -> Counter:
+    return _metric(Counter, "rtpu_serve_admission_admitted_total",
+                   "requests admitted by the proxy's SLO-aware gate "
+                   "(immediately or after queueing)",
+                   tag_keys=("app", "deployment"))
+
+
+def admission_shed() -> Counter:
+    return _metric(Counter, "rtpu_serve_admission_shed_total",
+                   "requests shed 429+Retry-After instead of queueing "
+                   "past the budget (reason: queue_full | slo | "
+                   "deadline)",
+                   tag_keys=("app", "deployment", "reason"))
+
+
+def admission_queue_wait() -> Histogram:
+    return _metric(Histogram, "rtpu_serve_admission_queue_wait_seconds",
+                   "time admitted requests spent parked in the "
+                   "admission queue before an execution slot freed",
+                   boundaries=_LAT, tag_keys=("app", "deployment"))
+
+
+def admission_inflight() -> Gauge:
+    return _metric(Gauge, "rtpu_serve_admission_inflight",
+                   "requests this proxy currently holds an admission "
+                   "slot for, per deployment",
+                   tag_keys=("app", "deployment", "proxy"))
+
+
+def proxy_count() -> Gauge:
+    return _metric(Gauge, "rtpu_serve_proxies",
+                   "live controller-managed proxy actors")
+
+
+def prefix_directory_hits() -> Counter:
+    return _metric(Counter, "rtpu_serve_prefix_directory_hits_total",
+                   "admission-time prefix lookups that found a warmer "
+                   "replica in the cluster directory and imported its "
+                   "KV pages", tag_keys=("model",))
+
+
+def prefix_directory_misses() -> Counter:
+    return _metric(Counter, "rtpu_serve_prefix_directory_misses_total",
+                   "admission-time prefix lookups the directory could "
+                   "not improve on (no entry, or nothing beyond local "
+                   "coverage)", tag_keys=("model",))
+
+
+def prefix_directory_imported_pages() -> Counter:
+    return _metric(Counter,
+                   "rtpu_serve_prefix_directory_imported_pages_total",
+                   "KV pages imported from other replicas via the "
+                   "prefix directory", tag_keys=("model",))
+
+
+def prefix_directory_publishes() -> Counter:
+    return _metric(Counter,
+                   "rtpu_serve_prefix_directory_publishes_total",
+                   "page hashes this process published to the cluster "
+                   "prefix directory", tag_keys=("model",))
+
+
+def prefix_directory_stale() -> Counter:
+    return _metric(Counter, "rtpu_serve_prefix_directory_stale_total",
+                   "directory hints that failed on use (owner dead or "
+                   "pages evicted) and were dropped; the request "
+                   "prefilled cold — hints, never correctness",
+                   tag_keys=("model",))
 
 
 def batch_size() -> Histogram:
@@ -217,6 +300,32 @@ def metrics_summary() -> dict:
                 rec["dispatches_per_item"] = \
                     rec.get("dispatches", 0.0) / n_items
         out["stream"] = by_transport
+    admitted = _counter_total(
+        store.get("rtpu_serve_admission_admitted_total"))
+    shed = _counter_total(store.get("rtpu_serve_admission_shed_total"))
+    if admitted or shed:
+        qw = _hist_stats(
+            store.get("rtpu_serve_admission_queue_wait_seconds"))
+        out["admission"] = {
+            "admitted": admitted, "shed": shed,
+            "shed_rate": shed / (admitted + shed),
+        }
+        if qw is not None:
+            out["admission"]["queue_wait"] = qw
+    dhits = _counter_total(
+        store.get("rtpu_serve_prefix_directory_hits_total"))
+    dmiss = _counter_total(
+        store.get("rtpu_serve_prefix_directory_misses_total"))
+    if dhits or dmiss:
+        out["prefix_directory"] = {
+            "hits": dhits, "misses": dmiss,
+            "imported_pages": _counter_total(store.get(
+                "rtpu_serve_prefix_directory_imported_pages_total")),
+            "publishes": _counter_total(store.get(
+                "rtpu_serve_prefix_directory_publishes_total")),
+            "stale_dropped": _counter_total(store.get(
+                "rtpu_serve_prefix_directory_stale_total")),
+        }
     out["requests"] = {
         "proxy": _counter_total(
             store.get("rtpu_serve_proxy_requests_total")),

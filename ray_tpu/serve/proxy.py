@@ -1,10 +1,22 @@
-"""HTTP proxy actor (aiohttp).
+"""HTTP proxy actor (aiohttp) — one member of the front-door fleet.
 
 Reference parity: serve/_private/proxy.py:709 HTTPProxy / :1059 ProxyActor —
 uvicorn/Starlette there, aiohttp here (what the image ships). Routes
 `/<app_name>` (and `/` for the default app) to the app's ingress handle:
 JSON bodies become the callable's argument, JSON-able returns become the
 response body.
+
+Front door (serve/frontdoor/): the controller runs N of these behind
+one shared route table (frontdoor/routetable.py — refreshed from the
+head's directory service on a short TTL, controller RPC only as
+fallback), and every request passes the SLO-aware admission gate
+(frontdoor/admission.py) before it touches a handle. Past-budget
+traffic queues bounded-and-deadlined, then sheds as ``429`` +
+``Retry-After``; replica death surfaces as a typed ``503``, a replica
+timeout as ``504`` — a healthy front door returns NO bare 500s under
+overload or chaos. Session/prefix affinity is consistent across the
+fleet for free: handles rendezvous-hash on stable replica actor ids,
+so every proxy maps the same session/prefix to the same replica.
 """
 from __future__ import annotations
 
@@ -22,8 +34,10 @@ _KNOWN_VERBS = frozenset(
 
 
 class ProxyActor:
-    def __init__(self, port: int):
+    def __init__(self, port: int, index: int = 0):
+        from .frontdoor.admission import AdmissionController
         self._port = port
+        self._index = index
         self._runner = None
         # handle cache: a DeploymentHandle per routing variant, NOT per
         # request — each handle runs one long-poll listener thread, so
@@ -33,10 +47,14 @@ class ProxyActor:
         from collections import OrderedDict
         self._handles: "OrderedDict" = OrderedDict()
         self._handles_max = 256
-        # route table cache: refreshed off-loop on a short TTL — a
-        # per-request controller round-trip would block the event loop
+        # shared route table snapshot (frontdoor/routetable.py),
+        # refreshed off-loop on a short TTL; None until the first fetch
+        # (or forever in fallback mode — then per-request controller
+        # calls resolve routing and admission stays unconfigured)
+        self._snap: Optional[dict] = None
         self._routes: dict = {}
         self._routes_ts = 0.0
+        self._admission = AdmissionController(f"proxy-{index}")
 
     def _handle_for(self, ingress, app_name, stream, model_id,
                     method="__call__"):
@@ -67,6 +85,71 @@ class ProxyActor:
         site = web.TCPSite(self._runner, "127.0.0.1", self._port)
         await site.start()
         return self._port
+
+    async def ping(self) -> dict:
+        """Controller liveness probe (frontdoor fleet management); the
+        pid lets chaos tooling SIGKILL a specific proxy."""
+        import os
+        return {"port": self._port, "pid": os.getpid(),
+                "index": self._index}
+
+    # -- shared route table ------------------------------------------------
+
+    async def _refresh_table(self):
+        """TTL-refresh the routing/admission state: ONE dir_query frame
+        for the controller-published snapshot; falls back to controller
+        RPCs (routing only — admission stays open) when the directory
+        is unreachable. Runs off-loop: both paths block."""
+        import time as _time
+        if _time.monotonic() - self._routes_ts <= 1.0:
+            return
+        loop = asyncio.get_event_loop()
+
+        def _fetch():
+            from .frontdoor import routetable
+            snap = routetable.fetch_snapshot()
+            if snap is not None:
+                return snap, snap.get("routes", {})
+            # fallback: a cluster without the directory (local clusters
+            # torn mid-test, head restarting) still routes
+            try:
+                import ray_tpu
+                from .api import CONTROLLER_NAME
+                ctrl0 = ray_tpu.get_actor(CONTROLLER_NAME)
+                return None, ray_tpu.get(ctrl0.get_routes.remote())
+            except Exception:
+                return None, {}
+        snap, routes = await loop.run_in_executor(None, _fetch)
+        self._routes = routes
+        self._routes_ts = _time.monotonic()
+        if snap is not None:
+            self._snap = snap
+            live = set()
+            n = max(1, int(snap.get("n_proxies", 1)))
+            for key, cap in snap.get("capacity", {}).items():
+                app, _, dep = key.partition("/")
+                live.add((app, dep))
+                self._admission.configure(
+                    app, dep, max(int(cap[0]), 1) * max(int(cap[1]), 1),
+                    n_proxies=n)
+            self._admission.prune(live)
+
+    def _resolve_ingress(self, app_name: str) -> Optional[str]:
+        """Ingress deployment for an app: snapshot first, controller
+        RPC fallback. None = unknown app."""
+        if self._snap is not None:
+            ing = self._snap.get("ingress", {}).get(app_name)
+            if ing is not None:
+                return ing
+        import ray_tpu
+        from .api import CONTROLLER_NAME
+        try:
+            ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+            return ray_tpu.get(ctrl.get_ingress.remote(app_name))
+        except ValueError:
+            return None
+
+    # -- request path ------------------------------------------------------
 
     async def _dispatch(self, request):
         """Telemetry shell around _dispatch_inner: mints the request id,
@@ -114,33 +197,34 @@ class ProxyActor:
                 sm.request_latency().observe(
                     _time.perf_counter() - t0,
                     tags={"app": meta["app"], "route": route})
-                if status >= 400 and status != 499:
+                # 499 (client hung up) and 429 (deliberate shed, its own
+                # rtpu_serve_admission_shed_total series) stay out of the
+                # error counter operators alert on
+                if status >= 400 and status not in (429, 499):
                     sm.request_errors().inc(1.0, tags={
                         "app": meta["app"], "route": route,
                         "code": str(status)})
+                if status >= 500:
+                    # the replica-death/timeout paths raise and catch
+                    # through executor threads; the exception->traceback
+                    # ->frame cycles pin the failed call's ObjectRefs
+                    # (and their store error objects) until a gc pass
+                    # happens to run. Errors are rare: collect shortly
+                    # after, so a chaos kill can't hold the store above
+                    # baseline until allocation pressure triggers gc.
+                    import gc
+                    asyncio.get_event_loop().call_later(0.5, gc.collect)
             except Exception:
                 pass  # telemetry must never turn a response into a 500
 
     async def _dispatch_inner(self, request, rid: str, meta: dict):
         from aiohttp import web
-        import ray_tpu
-        from .api import CONTROLLER_NAME
 
         path = request.match_info["tail"].strip("/")
         # route_prefix longest-match first (reference: the proxy's route
         # table); falls back to /<app_name> addressing
         app_name, subpath = None, ""
-        import time as _time
-        loop0 = asyncio.get_event_loop()
-        if _time.monotonic() - self._routes_ts > 1.0:
-            def _fetch_routes():
-                try:
-                    ctrl0 = ray_tpu.get_actor(CONTROLLER_NAME)
-                    return ray_tpu.get(ctrl0.get_routes.remote())
-                except Exception:
-                    return {}
-            self._routes = await loop0.run_in_executor(None, _fetch_routes)
-            self._routes_ts = _time.monotonic()
+        await self._refresh_table()
         routes = self._routes
         full = "/" + path
         for prefix, app in sorted(routes.items(), key=lambda kv:
@@ -163,18 +247,17 @@ class ProxyActor:
             # never expose private/dunder attributes over HTTP
             return web.json_response(
                 {"error": f"no route {subpath!r}"}, status=404)
-        ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
-        try:
-            ingress = ray_tpu.get(ctrl.get_ingress.remote(app_name))
-        except ValueError:
+        loop = asyncio.get_event_loop()
+        ingress = await loop.run_in_executor(
+            None, self._resolve_ingress, app_name)
+        if ingress is None:
             if app_name != "default":
-                try:
-                    ingress = ray_tpu.get(
-                        ctrl.get_ingress.remote("default"))
-                    app_name = "default"
-                except ValueError:
+                ingress = await loop.run_in_executor(
+                    None, self._resolve_ingress, "default")
+                if ingress is None:
                     return web.json_response(
                         {"error": f"no app {app_name!r}"}, status=404)
+                app_name = "default"
             else:
                 return web.json_response(
                     {"error": "no default app"}, status=404)
@@ -186,6 +269,37 @@ class ProxyActor:
         if not meta["route"]:
             meta["route"] = "/" + app_name
 
+        # -- admission gate (frontdoor/admission.py): budget-admit,
+        # bounded-queue, or shed BEFORE any replica work happens --------
+        from ..core.config import cfg as _cfg
+        release = None
+        if _cfg.serve_admission_control:
+            from .frontdoor.admission import ShedError
+            try:
+                release = await self._admission.acquire(app_name, ingress)
+            except ShedError as shed:
+                return web.json_response(
+                    {"error": "overloaded", "reason": shed.reason,
+                     "retry_after_s": shed.retry_after_s},
+                    status=429,
+                    headers={"Retry-After": str(shed.retry_after_s)})
+        import time as _time
+        t_adm = _time.perf_counter()
+        try:
+            return await self._dispatch_admitted(
+                request, rid, meta, app_name, ingress, method)
+        finally:
+            if release is not None:
+                release(_time.perf_counter() - t_adm)
+
+    async def _dispatch_admitted(self, request, rid: str, meta: dict,
+                                 app_name: str, ingress: str,
+                                 method: str):
+        from aiohttp import web
+
+        from ..exceptions import (ActorDiedError, GetTimeoutError,
+                                  WorkerCrashedError)
+
         payload: Optional[dict] = None
         if request.can_read_body:
             try:
@@ -193,6 +307,14 @@ class ProxyActor:
             except Exception:
                 payload = {"body": (await request.read()).decode(
                     errors="replace")}
+
+        # session affinity across the fleet: an explicit session header
+        # becomes the request's affinity key (handle._affinity_key), so
+        # every proxy rendezvous-routes the session to the same replica
+        sid = request.headers.get("serve_session_id", "")
+        if sid and isinstance(payload, dict) and \
+                "session_id" not in payload:
+            payload["session_id"] = sid
 
         # streaming ingress: ?stream=1, Accept: text/event-stream, or an
         # OpenAI-style {"stream": true} body field
@@ -229,7 +351,28 @@ class ProxyActor:
             reset_request_context(token)
 
         loop = asyncio.get_event_loop()
-        out = await loop.run_in_executor(None, lambda: call_ctx.run(call))
+        try:
+            out = await loop.run_in_executor(None,
+                                             lambda: call_ctx.run(call))
+        except (ActorDiedError, WorkerCrashedError) as e:
+            # replica died mid-call and the handle's one retry found no
+            # healthy replacement yet: a TYPED, retryable 503 — the
+            # controller is already replacing the replica
+            return web.json_response(
+                {"error": "replica_unavailable",
+                 "detail": type(e).__name__},
+                status=503, headers={"Retry-After": "1"})
+        except GetTimeoutError:
+            return web.json_response(
+                {"error": "upstream_timeout"}, status=504,
+                headers={"Retry-After": "1"})
+        except RuntimeError as e:
+            if "no replicas" in str(e):
+                return web.json_response(
+                    {"error": "replica_unavailable",
+                     "detail": "no replicas"},
+                    status=503, headers={"Retry-After": "1"})
+            raise
         if want_stream:
             stream = web.StreamResponse()
             stream.headers["Content-Type"] = "text/event-stream"
@@ -237,8 +380,18 @@ class ProxyActor:
             it = iter(out)
             try:
                 while True:
-                    chunk = await loop.run_in_executor(
-                        None, lambda: next(it, _STREAM_END))
+                    try:
+                        chunk = await loop.run_in_executor(
+                            None, lambda: next(it, _STREAM_END))
+                    except (ActorDiedError, WorkerCrashedError,
+                            GetTimeoutError) as e:
+                        # mid-stream replica loss: the status line is
+                        # gone (200 already sent); surface a typed error
+                        # chunk, then end the stream cleanly
+                        await stream.write(json.dumps(
+                            {"error": "replica_unavailable",
+                             "detail": type(e).__name__}).encode())
+                        break
                     if chunk is _STREAM_END:
                         break
                     if not isinstance(chunk, (bytes, str)):

@@ -383,17 +383,20 @@ def test_gl006_real_tree_is_in_parity():
     assert run_lint(["ray_tpu"], rules={"GL006"}) == []
 
 
-def test_gl006_stack_frames_pinned_at_v6():
-    """The stall-doctor collection frames are part of the pinned wire
-    vocabulary, and the manifest version matches the code."""
+def test_gl006_frames_pinned_at_v7():
+    """The stall-doctor and shared-directory frames are part of the
+    pinned wire vocabulary, and the manifest version matches the code."""
     import json as _json
     from tools.graftlint.rules import FRAMES_MANIFEST
     from ray_tpu.core.protocol import PROTOCOL_VERSION
     with open(FRAMES_MANIFEST) as f:
         manifest = _json.load(f)
-    assert manifest["protocol_version"] == PROTOCOL_VERSION == 6
+    assert manifest["protocol_version"] == PROTOCOL_VERSION == 7
     assert "stack_dump" in manifest["frames"]
     assert "stack_reply" in manifest["frames"]
+    # v7: serve front door's route table + prefix directory frames
+    assert "dir_update" in manifest["frames"]
+    assert "dir_query" in manifest["frames"]
 
 
 def test_gl006_catches_renamed_stack_dump_frame(tmp_path):
@@ -417,6 +420,30 @@ def test_gl006_catches_renamed_stack_dump_frame(tmp_path):
     assert any('"stack_dump_zz9" is sent but no peer handles it' in m
                for m in msgs)
     assert any('"stack_dump" has a handler but no sender' in m
+               for m in msgs)
+
+
+def test_gl006_catches_renamed_dir_update_frame(tmp_path):
+    """Renaming the directory client's dir_update send (without touching
+    the head handler) must produce BOTH findings — the v7 frames are
+    held to the same parity contract as every older frame."""
+    import shutil
+    from tools.graftlint.rules import FRAME_MODULES
+    for rel in FRAME_MODULES + ("ray_tpu/core/protocol.py",):
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(f"{REPO_ROOT}/{rel}", dst)
+    dp = tmp_path / "ray_tpu/core/directory.py"
+    src = dp.read_text()
+    assert '{"t": "dir_update", "d": name,' in src
+    dp.write_text(src.replace('{"t": "dir_update", "d": name,',
+                              '{"t": "dir_update_zz9", "d": name,'))
+    found = run_lint([str(tmp_path / "ray_tpu")], root=str(tmp_path),
+                     rules={"GL006"})
+    msgs = [f.message for f in found]
+    assert any('"dir_update_zz9" is sent but no peer handles it' in m
+               for m in msgs)
+    assert any('"dir_update" has a handler but no sender' in m
                for m in msgs)
 
 

@@ -1,0 +1,644 @@
+"""Serve front door (serve/frontdoor/): shared directory service,
+SLO-aware admission control, scaled-out proxies, and the cluster-wide
+prefix-cache directory — plus the chaos variant proving the data plane
+degrades (typed errors, clean sheds) instead of collapsing."""
+import asyncio
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+
+# ------------------------------------------------------------------ #
+# core/directory.py — unit
+# ------------------------------------------------------------------ #
+
+def test_directory_service_unit():
+    from ray_tpu.core.directory import DirectoryService
+    d = DirectoryService(max_entries=4)
+    v1 = d.merge("a", put={"k1": 1, "k2": 2}, owner="w1")
+    got = d.lookup("a")
+    assert got["entries"] == {"k1": 1, "k2": 2} and got["v"] == v1
+    # keyed lookup returns only present keys
+    assert d.lookup("a", keys=["k2", "zz"])["entries"] == {"k2": 2}
+    # drop + re-put bumps the version
+    v2 = d.merge("a", put={"k3": 3}, drop=["k1"], owner="w2")
+    assert v2 > v1
+    assert d.lookup("a")["entries"] == {"k2": 2, "k3": 3}
+    # FIFO cap: oldest-write evicts first; re-put re-arms position
+    d.merge("a", put={"k2": 2.5}, owner="w1")     # k2 now newest
+    d.merge("a", put={"k4": 4, "k5": 5, "k6": 6}, owner="w1")
+    entries = d.lookup("a")["entries"]
+    assert len(entries) == 4
+    assert "k2" in entries and "k3" not in entries
+    assert d.stats()["evictions"] == 1      # k3 (oldest write) evicted
+    # owner sweep drops w1's entries only
+    d.merge("b", put={"x": 1}, owner="w1")
+    swept = d.sweep_owner("w1")
+    assert swept >= 1
+    assert d.lookup("b")["entries"] == {}
+    # a no-op merge doesn't bump the version
+    v = d.lookup("a")["v"]
+    assert d.merge("a", drop=["never-there"]) == v
+
+
+def test_directory_frames_cluster(ray_start_regular):
+    """dir_update/dir_query over protocol-v7 frames: worker publishes,
+    head stamps ownership, worker death sweeps the entries."""
+    import ray_tpu
+    from ray_tpu.core import directory as cdir
+
+    assert cdir.update("t:d1", put={"a": 1})
+    assert cdir.query("t:d1")["entries"] == {"a": 1}
+
+    @ray_tpu.remote
+    class Pub:
+        def pub(self):
+            from ray_tpu.core import directory as cd
+            cd.update("t:d1", put={"b": 2}, drop=["a"])
+            q = None
+            for _ in range(100):
+                q = cd.query("t:d1", keys=["a", "b"])
+                if (q or {}).get("entries") == {"b": 2}:
+                    return q
+                time.sleep(0.05)
+            return q
+
+    a = Pub.remote()
+    q = ray_tpu.get(a.pub.remote())
+    assert q["entries"] == {"b": 2}, q
+    ray_tpu.kill(a)
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        if not cdir.query("t:d1")["entries"]:
+            break
+        time.sleep(0.2)
+    assert cdir.query("t:d1")["entries"] == {}, \
+        "dead publisher's entries were not swept"
+
+
+# ------------------------------------------------------------------ #
+# frontdoor/admission.py — unit (asyncio, no cluster)
+# ------------------------------------------------------------------ #
+
+def _run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def test_admission_budget_queue_and_shed():
+    from ray_tpu.serve.frontdoor.admission import (AdmissionController,
+                                                   ShedError)
+
+    async def body():
+        ac = AdmissionController("proxy-t")
+        ac.configure("app", "dep", capacity=2, n_proxies=1,
+                     queue_depth=2, timeout_s=0.5)
+        r1 = await ac.acquire("app", "dep")
+        r2 = await ac.acquire("app", "dep")     # budget filled
+        # third parks; releasing r1 admits it FIFO
+        acq3 = asyncio.ensure_future(ac.acquire("app", "dep"))
+        await asyncio.sleep(0.05)
+        assert not acq3.done()
+        r1(0.01)
+        r3 = await asyncio.wait_for(acq3, 1.0)
+        # fill the queue (budget still held by r2, r3), then overflow
+        acq4 = asyncio.ensure_future(ac.acquire("app", "dep"))
+        acq5 = asyncio.ensure_future(ac.acquire("app", "dep"))
+        await asyncio.sleep(0.05)
+        with pytest.raises(ShedError) as ei:
+            await ac.acquire("app", "dep")      # queue_full
+        assert ei.value.reason == "queue_full"
+        assert 1 <= ei.value.retry_after_s <= 60
+        # parked requests past the deadline shed as "deadline"
+        with pytest.raises(ShedError) as e4:
+            await asyncio.wait_for(acq4, 5.0)
+        assert e4.value.reason == "deadline"
+        with pytest.raises(ShedError):
+            await asyncio.wait_for(acq5, 5.0)
+        # the budget never leaks: releases return inflight to zero
+        r2(0.01)
+        r3(0.01)
+        g = ac.gate_for("app", "dep")
+        assert g.inflight == 0 and len(g._parked) == 0
+        # double-release is a no-op
+        r3(0.01)
+        assert g.inflight == 0
+        # unconfigured deployment: admit untracked — and the returned
+        # releaser must accept the duration the proxy always passes
+        # (regression: a zero-arg lambda here turned every fallback-mode
+        # response into a 500)
+        r = await ac.acquire("unknown", "dep")
+        r(0.123)
+    _run(body())
+
+
+def test_admission_slo_shed_and_prune():
+    from ray_tpu.serve.frontdoor.admission import (AdmissionController,
+                                                   ShedError)
+
+    async def body():
+        ac = AdmissionController()
+        ac.configure("app", "dep", capacity=1, n_proxies=1,
+                     queue_depth=100, timeout_s=0.2)
+        g = ac.gate_for("app", "dep")
+        g.ewma_s = 1.0      # observed service time >> deadline
+        hold = await ac.acquire("app", "dep")
+        # predicted wait (1 ahead x 1s / budget 1) > 0.2s deadline:
+        # shed immediately as "slo" without burning a queue slot
+        with pytest.raises(ShedError) as ei:
+            await ac.acquire("app", "dep")
+        assert ei.value.reason == "slo"
+        hold(None)
+        # prune sheds parked waiters of removed deployments
+        ac.configure("app2", "dep2", capacity=1, queue_depth=4,
+                     timeout_s=5.0)
+        h2 = await ac.acquire("app2", "dep2")
+        parked = asyncio.ensure_future(ac.acquire("app2", "dep2"))
+        await asyncio.sleep(0.05)
+        ac.prune(live=set())
+        with pytest.raises(ShedError):
+            await asyncio.wait_for(parked, 1.0)
+        del h2
+    _run(body())
+
+
+# ------------------------------------------------------------------ #
+# put-copy pool regrow race (PR 10 leftover)
+# ------------------------------------------------------------------ #
+
+def test_put_copy_pool_regrow_safe():
+    """Growing cfg.put_copy_threads mid-traffic must drain the old pool
+    (shutdown after the swap, under the submit lock) — no slice may be
+    lost and no put may race a dropped executor. Hammers regrows
+    against concurrent parallel copies and verifies bit-equality."""
+    import ctypes
+    import threading
+
+    import numpy as np
+
+    from ray_tpu.core import object_store as osm
+    from ray_tpu.core.config import cfg
+
+    n = osm._PARALLEL_MIN + 12345
+    src = np.random.RandomState(0).randint(
+        0, 256, size=n, dtype=np.uint8).tobytes()
+    old_threads = cfg.put_copy_threads
+    stop = threading.Event()
+    errors = []
+
+    def copier():
+        dst = bytearray(n)
+        dst_addr = ctypes.addressof(
+            (ctypes.c_char * n).from_buffer(dst))
+        try:
+            while not stop.is_set():
+                osm._copy_parallel(dst_addr, src, n)
+                if bytes(dst) != src:
+                    errors.append("copy mismatch")
+                    return
+        except Exception as e:  # noqa: BLE001 — the regression signal
+            errors.append(repr(e))
+
+    def regrower():
+        w = 2
+        while not stop.is_set():
+            cfg.override(put_copy_threads=w)
+            w = 2 if w >= 8 else w + 1
+            time.sleep(0.002)
+
+    try:
+        cfg.override(put_copy_threads=2)
+        threads = [threading.Thread(target=copier) for _ in range(2)]
+        threads.append(threading.Thread(target=regrower))
+        for t in threads:
+            t.start()
+        time.sleep(2.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors, errors
+        # the regrown-away pools are actually shut down (drained), not
+        # left to GC: the live pool is the only one accepting work
+        with osm._copy_pool_lock:
+            pool = osm._ensure_copy_pool_locked(4)
+        assert not pool._shutdown
+    finally:
+        cfg.override(put_copy_threads=old_threads)
+
+
+def test_put_copy_old_pool_drained_on_regrow():
+    """The swap itself: after a regrow the OLD executor is shutdown —
+    a submit to it raises instead of silently landing in a dropped
+    pool (the PR 10 race)."""
+    from ray_tpu.core import object_store as osm
+    from ray_tpu.core.config import cfg
+    old_threads = cfg.put_copy_threads
+    try:
+        with osm._copy_pool_lock:
+            small = osm._ensure_copy_pool_locked(2)
+            w = osm._copy_pool_width           # whatever width it has
+            assert osm._ensure_copy_pool_locked(w) is small  # no regrow
+            grown = osm._ensure_copy_pool_locked(w + 2)
+        assert grown is not small
+        assert small._shutdown, "old pool must be drained on regrow"
+        assert not grown._shutdown
+        with pytest.raises(RuntimeError):
+            small.submit(int, 0)
+        # a narrower re-ask returns the live pool untouched
+        with osm._copy_pool_lock:
+            again = osm._ensure_copy_pool_locked(w)
+        assert again is grown
+    finally:
+        cfg.override(put_copy_threads=old_threads)
+
+
+# ------------------------------------------------------------------ #
+# proxies + admission + prefix directory — e2e on a cluster
+# ------------------------------------------------------------------ #
+
+def _post(port, payload, path="default", timeout=30, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/{path}", method="POST",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    try:
+        r = urllib.request.urlopen(req, timeout=timeout)
+        return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        try:
+            body = json.loads(e.read())
+        except Exception:
+            body = {}
+        return e.code, body, dict(e.headers)
+
+
+@pytest.fixture
+def serve_cluster(ray_start_regular):
+    import ray_tpu.serve as serve
+    yield ray_start_regular
+    serve.shutdown()
+
+
+def test_proxy_fleet_and_shed(serve_cluster):
+    """Two proxies behind the shared route table; overload sheds clean
+    429s with Retry-After while admitted traffic completes."""
+    import threading
+
+    from ray_tpu import serve
+    from ray_tpu.core.config import cfg
+
+    @serve.deployment(num_replicas=1, max_ongoing_requests=2)
+    class Slowish:
+        def __call__(self, payload):
+            time.sleep(float((payload or {}).get("s", 0.01)))
+            return {"ok": True}
+
+    serve.run(Slowish.bind(), name="default", http_port=18431,
+              num_proxies=2)
+    st = serve.status()
+    assert len(st["proxies"]) == 2
+    ports = sorted(p["port"] for p in st["proxies"])
+    assert ports == [18431, 18432]
+    for port in ports:
+        code, body, _h = _post(port, {"s": 0.0})
+        assert code == 200 and body["ok"] is True
+
+    results = []
+    lock = threading.Lock()
+
+    def slam():
+        code, _body, headers = _post(18431, {"s": 0.5}, timeout=45)
+        with lock:
+            results.append((code, headers.get("Retry-After")))
+
+    threads = [threading.Thread(target=slam) for _ in range(12)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    codes = [c for c, _ in results]
+    assert 429 in codes, codes                      # overload shed
+    assert all(c in (200, 429) for c in codes), codes   # and NOTHING else
+    assert all(ra is not None and int(ra) >= 1
+               for c, ra in results if c == 429)
+    # shed traffic is typed in the summary, split from errors
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        ms = serve.metrics_summary()
+        if ms.get("admission", {}).get("shed", 0) > 0:
+            break
+        time.sleep(0.5)
+    assert ms["admission"]["shed"] > 0
+    assert ms["admission"]["admitted"] > 0
+
+
+def test_grpc_shed_resource_exhausted(serve_cluster):
+    """The gRPC front door sheds past fleet capacity with
+    RESOURCE_EXHAUSTED (the 429 contract's gRPC spelling) — and
+    nothing else leaks through as INTERNAL."""
+    import threading
+
+    import grpc
+
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=1, max_ongoing_requests=1)
+    class Slow:
+        def __call__(self, payload):
+            time.sleep(float((payload or {}).get("s", 0.0)))
+            return {"ok": True}
+
+    serve.run(Slow.bind(), name="default", http_port=18471)
+    _h, gport = serve.start_grpc_proxy()
+    ch = grpc.insecure_channel(f"127.0.0.1:{gport}")
+    call = ch.unary_unary("/raytpu.Serve/Call")
+    out = json.loads(call(json.dumps(
+        {"app": "default", "payload": {}}).encode(), timeout=60))
+    assert out["ok"] is True
+    time.sleep(1.5)     # let the proxy's snapshot TTL pick up capacity
+
+    codes = []
+    lock = threading.Lock()
+
+    def slam():
+        try:
+            call(json.dumps({"app": "default",
+                             "payload": {"s": 1.0}}).encode(),
+                 timeout=60)
+            with lock:
+                codes.append("OK")
+        except grpc.RpcError as e:
+            with lock:
+                codes.append(e.code().name)
+
+    threads = [threading.Thread(target=slam) for _ in range(12)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    from collections import Counter
+    c = Counter(codes)
+    assert c.get("RESOURCE_EXHAUSTED", 0) > 0, c
+    assert set(c) <= {"OK", "RESOURCE_EXHAUSTED"}, c
+
+
+def test_prefix_directory_cross_replica(serve_cluster):
+    """The tentpole proof: replica B admission-matches a prefix warmed
+    on replica A via the cluster directory, imports the pages over the
+    objstore, and generates BIT-IDENTICAL output to a cold prefill."""
+    import ray_tpu as rt
+    from ray_tpu import serve
+    from ray_tpu.core import directory as cdir
+    from ray_tpu.llm import SamplingParams
+    from ray_tpu.llm.paged_engine import (PagedEngineConfig,
+                                          PagedInferenceEngine)
+    from ray_tpu.llm.serving import LLMConfig, build_llm_deployment
+    from ray_tpu.models import llama
+
+    ecfg = PagedEngineConfig(
+        model=llama.llama_tiny(vocab_size=258, max_seq_len=256),
+        max_batch_size=4, page_size=8, num_pages=128,
+        max_pages_per_seq=24, chunk_size=16)
+    app = build_llm_deployment(
+        LLMConfig(model_id="tiny", engine=ecfg, num_replicas=2,
+                  warmup=False))
+    serve.run(app, name="llm")
+    ctrl = rt.get_actor("rtpu:serve:controller")
+    _v, replicas = rt.get(ctrl.get_replicas.remote("llm", "llm:tiny"))
+    ra, rb = replicas
+
+    system = "You are a helpful assistant. Answer briefly. " * 2
+    p1 = system + "Q1?"
+    p2 = system + "Q2 something else?"
+    sp = {"max_tokens": 8, "temperature": 0.0}
+
+    out_a = rt.get(ra.handle_request.remote(
+        "completions", ({"prompt": p1, **sp},), {}, None), timeout=180)
+
+    # A's engine loop publishes its page hashes within the publish period
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if cdir.query("serve:prefix:tiny")["entries"]:
+            break
+        time.sleep(0.2)
+    assert cdir.query("serve:prefix:tiny")["entries"], \
+        "replica A never published"
+
+    # B serves a DIFFERENT tail on the same system prefix: the directory
+    # hit imports A's pages instead of prefilling them
+    rt.get(rb.handle_request.remote(
+        "completions", ({"prompt": p2, **sp},), {}, None), timeout=180)
+    deadline = time.monotonic() + 10
+    pd = {}
+    while time.monotonic() < deadline:
+        pd = serve.metrics_summary().get("prefix_directory") or {}
+        if pd.get("hits", 0) > 0:
+            break
+        time.sleep(0.5)
+    assert pd.get("hits", 0) > 0, pd
+    assert pd.get("imported_pages", 0) > 0, pd
+    assert pd.get("publishes", 0) > 0, pd
+
+    # bit-identical: B over imported pages == A == a cold local engine
+    out_b1 = rt.get(rb.handle_request.remote(
+        "completions", ({"prompt": p1, **sp},), {}, None), timeout=180)
+    cold = PagedInferenceEngine(ecfg, rng_seed=0)
+    cold_out = cold.generate([cold.tokenizer.encode(p1)],
+                             SamplingParams(max_tokens=8))[0]
+    assert out_b1["choices"][0]["text"] == cold_out["text"] \
+        == out_a["choices"][0]["text"]
+
+
+def test_engine_export_import_prefix_bitwise():
+    """Engine-level contract: import_prefix registers EXACTLY the
+    exporter's KV bytes, stops at the reserve floor, and tolerates a
+    partial (stale) export."""
+    import numpy as np
+
+    from ray_tpu.llm import SamplingParams
+    from ray_tpu.llm.paged_engine import (PagedEngineConfig,
+                                          PagedInferenceEngine)
+    from ray_tpu.models import llama
+
+    ecfg = PagedEngineConfig(
+        model=llama.llama_tiny(vocab_size=258, max_seq_len=256),
+        max_batch_size=4, page_size=8, num_pages=64,
+        max_pages_per_seq=24, chunk_size=16)
+    a = PagedInferenceEngine(ecfg, rng_seed=0)
+    b = PagedInferenceEngine(ecfg, rng_seed=0)
+    prompt = list(range(1, 70))
+    a.generate([prompt], SamplingParams(max_tokens=4))
+    hashes = a.hash_prompt(prompt)
+    assert hashes and a.cached_prefix_len(hashes) == len(hashes)
+
+    payload = a.export_prefix(hashes)
+    assert payload is not None
+    assert len(payload["page_hashes"]) == len(hashes)
+    n = b.import_prefix(payload)
+    assert n == len(hashes)
+    assert b.cached_prefix_len(hashes) == len(hashes)
+    # the imported pages hold byte-identical KV
+    chk = b.export_prefix(hashes)
+    for la, lb in zip(payload["pages"], chk["pages"]):
+        assert np.array_equal(la["k"], lb["k"])
+        assert np.array_equal(la["v"], lb["v"])
+    # re-import is a no-op (already cached)
+    assert b.import_prefix(payload) == 0
+    # unknown hashes export None (stale directory entry -> cold prefill)
+    assert a.export_prefix([b"\x00" * 16]) is None
+    # and generation over imported pages == cold generation
+    out_b = b.generate([prompt], SamplingParams(max_tokens=4))[0]
+    out_cold = PagedInferenceEngine(ecfg, rng_seed=0).generate(
+        [prompt], SamplingParams(max_tokens=4))[0]
+    assert out_b["token_ids"] == out_cold["token_ids"]
+    assert b.stats["prefix_imported_pages"] == len(hashes)
+
+
+def test_chaos_kill_replica_and_proxy(serve_cluster):
+    """Degradation, not collapse: SIGKILL one replica and one proxy
+    mid-load. Admitted requests finish or surface TYPED errors (zero
+    bare 500s), sheds stay clean 429s, the controller replaces both
+    casualties, doctor comes back clean, and the store drains."""
+    import signal
+    import threading
+
+    import ray_tpu as rt
+    from ray_tpu import serve, state
+
+    import gc
+
+    from ray_tpu.core import runtime as rt_mod
+    head = rt_mod.get_runtime_if_exists()
+
+    def quiesce(budget=10.0):
+        # frees are async (ref-drop messages): wait for a STABLE count
+        deadline = time.monotonic() + budget
+        last, stable_since = head.store.num_objects(), time.monotonic()
+        while time.monotonic() < deadline:
+            gc.collect()
+            n = head.store.num_objects()
+            if n != last:
+                last, stable_since = n, time.monotonic()
+            elif time.monotonic() - stable_since > 1.5:
+                break
+            time.sleep(0.2)
+        return head.store.num_objects()
+
+    base_pre_deploy = quiesce()
+
+    @serve.deployment(num_replicas=2, max_ongoing_requests=4)
+    class Victim:
+        def __call__(self, payload):
+            time.sleep(0.05)
+            return {"pid": os.getpid()}
+
+    serve.run(Victim.bind(), name="default", http_port=18441,
+              num_proxies=2)
+    for port in (18441, 18442):
+        code, body, _h = _post(port, {})
+        assert code == 200
+    base_objects = quiesce()
+
+    results = []
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def load(port):
+        while not stop.is_set():
+            code, body, _h = _post(port, {}, timeout=45)
+            with lock:
+                results.append((code, body))
+
+    threads = [threading.Thread(target=load, args=(p,))
+               for p in (18441, 18442) for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(1.0)
+
+    # SIGKILL one replica (raw kill -9 on its process)...
+    with lock:
+        pids = {b["pid"] for c, b in results
+                if c == 200 and isinstance(b, dict) and "pid" in b}
+    assert pids
+    os.kill(sorted(pids)[0], signal.SIGKILL)
+    # ...and one proxy
+    ctrl = rt.get_actor("rtpu:serve:controller")
+    proxies = rt.get(ctrl.get_proxies.remote())
+    ppid = rt.get(proxies[0]["actor"].ping.remote())["pid"]
+    os.kill(ppid, signal.SIGKILL)
+
+    time.sleep(4.0)     # keep loading through the failure + recovery
+    stop.set()
+    for t in threads:
+        t.join(timeout=60)
+
+    codes = [c for c, _b in results]
+    assert codes.count(200) > 0
+    bad = [c for c in codes if c not in (200, 429, 503, 504)]
+    assert not bad, f"bare/untyped failures: {bad}"
+
+    # recovery: both ports answer again (the dead proxy was respawned
+    # on its port) and the deployment is back at 2 replicas
+    deadline = time.monotonic() + 60
+    ok = False
+    while time.monotonic() < deadline:
+        try:
+            codes2 = [_post(p, {}, timeout=10)[0]
+                      for p in (18441, 18442)]
+            dep = serve.status()["applications"]["default"][
+                "deployments"]["Victim"]
+            if codes2 == [200, 200] and dep["running_replicas"] == 2:
+                ok = True
+                break
+        except Exception:
+            pass
+        time.sleep(0.5)
+    assert ok, "fleet did not recover"
+
+    # doctor clean after recovery (what `cli doctor` gates its exit on)
+    hangs = state.hang_report()
+    assert not hangs["stuck_tasks"] and not hangs["deadlocks"]
+
+    # while serving, the store sits near its post-deploy baseline: the
+    # only extra live objects are in-flight control-plane long-polls
+    # (one parked listen_for_change ref per live handle listener),
+    # which churn on a ~30s period — allow them, catch gross leaks
+    deadline = time.monotonic() + 45
+    while time.monotonic() < deadline:
+        gc.collect()
+        if head.store.num_objects() <= base_objects + 4:
+            break
+        time.sleep(0.5)
+    assert head.store.num_objects() <= base_objects + 4, (
+        head.store.num_objects(), base_objects)
+
+    # ...and teardown drains to the EXACT pre-deploy baseline: the
+    # SIGKILLed replica and proxy leaked nothing reclaimable only by
+    # restart. Drop this test's own handles to the (now dead) actors
+    # first — a live handle to a killed actor legitimately pins its
+    # ActorDiedError ready-object, which is interest, not a leak.
+    del proxies, ctrl
+    serve.shutdown()
+    # +1 tolerance: under the FULL suite, backed-off long-poll listener
+    # threads from earlier tests' (uncollected) handles can retry
+    # against this fresh cluster during the settle window, leaving one
+    # transient ~64-byte control-plane object at the sampled instant —
+    # real front-door leaks (error objects / page payloads per killed
+    # actor) show up as several objects and fail this bound. Standalone
+    # runs settle to the exact baseline.
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        gc.collect()
+        if head.store.num_objects() <= base_pre_deploy + 1:
+            break
+        time.sleep(0.5)
+    assert head.store.num_objects() <= base_pre_deploy + 1, (
+        head.store.num_objects(), base_pre_deploy,
+        state.memory_summary(limit=10))

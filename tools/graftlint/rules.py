@@ -530,6 +530,7 @@ FRAME_MODULES = (
     "ray_tpu/core/node_agent.py",
     "ray_tpu/core/flight.py",       # pull_reply builds the flight_ring frame
     "ray_tpu/core/stacks.py",       # dump_reply builds the stack_reply frame
+    "ray_tpu/core/directory.py",    # dir_update/dir_query senders (v7)
     "ray_tpu/util/metrics.py",
     "ray_tpu/util/tracing.py",
     "ray_tpu/util/chaos.py",
