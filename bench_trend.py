@@ -75,9 +75,23 @@ def _child():
             updates, opt_state2 = opt.update(grads, opt_state, params)
             return optax.apply_updates(params, updates), opt_state2, loss
 
+        # opt_state shardings must be PINNED on both sides: its mu/nu
+        # leaves inherit the param shardings from opt.init, but with
+        # `None` the output placement is left to XLA, which may pick a
+        # different sharding than the donated input — the aliased
+        # buffers then differ in per-device size and the step fails at
+        # dispatch ("Expected aliased input ... to have the same
+        # size"). Scalar leaves (adam's count) come back single-device;
+        # replicate them onto the mesh so one sharding tree covers the
+        # whole state.
+        replicated = NamedSharding(mesh, jax.sharding.PartitionSpec())
+        opt_sh = jax.tree.map(
+            lambda a: a.sharding if isinstance(a.sharding, NamedSharding)
+            else replicated, opt_state)
+        opt_state = jax.device_put(opt_state, opt_sh)
         step = jax.jit(train_step,
-                       in_shardings=(param_sh, None, batch_sh),
-                       out_shardings=(param_sh, None, None),
+                       in_shardings=(param_sh, opt_sh, batch_sh),
+                       out_shardings=(param_sh, opt_sh, None),
                        donate_argnums=(0, 1))
         # compile + warm
         params, opt_state, loss = step(params, opt_state, tokens)

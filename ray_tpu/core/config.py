@@ -281,6 +281,32 @@ _FLAGS = [
          "per-directory entry cap of the head's shared directory "
          "service (FIFO eviction; bounds head memory no matter how "
          "many pages the fleet publishes)"),
+    # ---- multi-tenant serving (llm/multilora + tenant front door) ---- #
+    Flag("llm_lora_refresh_s", 0.25,
+         "TTL on a serving replica's cached latest-version lookups in "
+         "the adapter registry: the upper bound on how long a freshly "
+         "published adapter version takes to start serving (the "
+         "hot-swap observation window), and the floor on dir_query "
+         "cadence per adapter on the request hot path"),
+    Flag("serve_tenant_fair", True,
+         "weighted-fair admission queueing across tenants at the "
+         "proxies: parked requests drain round-robin per tenant "
+         "(deficit-weighted), so one tenant's burst cannot starve "
+         "another tenant's queue position; off restores one global "
+         "FIFO"),
+    Flag("serve_tenant_max_share", 0.5,
+         "per-tenant quota as a fraction of a deployment's admission "
+         "budget (and of its queue depth): a TENANTED request past its "
+         "tenant's share sheds 429+Retry-After (reason tenant_quota) "
+         "while other tenants keep admitting. Applies only to requests "
+         "that resolve a tenant id (header/body/adapter); untenanted "
+         "traffic keeps the plain budget. 1.0 disables the quota"),
+    Flag("serve_tenant_max_tracked", 64,
+         "per-gate bound on distinct tenant ids tracked for quota / "
+         "fair-queueing / metrics; tenants past the cap share one "
+         "__other__ bucket (tenant ids are client-controlled — "
+         "unbounded ids must not grow gate state or metric "
+         "cardinality)"),
     # ---- observability ----------------------------------------------- #
     Flag("metrics_export_port", 0,
          "Prometheus /metrics port (0 = ephemeral)"),

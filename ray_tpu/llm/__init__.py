@@ -25,6 +25,6 @@ from .tokenizer import ByteTokenizer, get_tokenizer
 __all__ = ["EngineConfig", "InferenceEngine", "SamplingParams",
            "PagedEngineConfig", "PagedInferenceEngine",
            "ByteTokenizer", "get_tokenizer", "serving", "batch", "lora",
-           "openai_api"]
+           "multilora", "openai_api"]
 
-from . import serving, batch, lora, openai_api  # noqa: E402
+from . import serving, batch, lora, multilora, openai_api  # noqa: E402

@@ -61,6 +61,16 @@ class _Request:
     slot: int = -1
     pages: list[int] = dataclasses.field(default_factory=list)
     prefill_pos: int = 0          # prompt tokens already prefilled (paged)
+    # multi-LoRA (paged engine, cfg.max_adapters): the slot-table row
+    # this request's dispatches gather — 0 = base model. Pinned for the
+    # request's whole life: a hot-swap to a newer adapter version lands
+    # in a different slot, so in-flight requests finish on the version
+    # they were admitted with.
+    adapter_slot: int = 0
+    # prefix-cache chain seed (paged engine): empty for base traffic;
+    # serving salts it with (adapter_id, version) so cached pages and
+    # cluster-directory entries can never match across tenants
+    prefix_salt: bytes = b""
     # content-hash chain of the prompt's FULL pages (paged engine prefix
     # caching); computed lazily at admission, None until then
     page_hashes: Optional[list] = None
@@ -156,7 +166,9 @@ class _EngineBase:
             self.step()
         return [self._result(r) for r in reqs]
 
-    def submit(self, prompt, params: SamplingParams) -> _Request:
+    def submit(self, prompt, params: SamplingParams,
+               adapter_slot: int = 0,
+               prefix_salt: bytes = b"") -> _Request:
         import time
         ids = (self.tokenizer.encode(prompt) if isinstance(prompt, str)
                else list(prompt))
@@ -165,6 +177,16 @@ class _EngineBase:
         ids = ids[: self.cfg.max_seq_len - 2]
         if not ids:
             raise ValueError("empty prompt")
+        if adapter_slot:
+            table = getattr(self, "lora", None)
+            if table is None:
+                raise ValueError(
+                    "adapter_slot requires a paged engine with "
+                    "PagedEngineConfig.max_adapters > 0")
+            if not 0 < adapter_slot < table.max_adapters:
+                raise ValueError(
+                    f"adapter_slot {adapter_slot} outside the slot "
+                    f"table [1, {table.max_adapters})")
         capacity = self.cfg.max_seq_len - 1 - len(ids)
         if params.max_tokens > capacity:
             params = dataclasses.replace(params,
@@ -172,6 +194,8 @@ class _EngineBase:
         from . import telemetry
         with self._lock:
             req = _Request(self._next_rid, ids, params)
+            req.adapter_slot = int(adapter_slot)
+            req.prefix_salt = bytes(prefix_salt)
             req.submit_t = time.perf_counter()
             self._next_rid += 1
             # stamp trace/request identity BEFORE publishing: once req is

@@ -1,0 +1,34 @@
+"""ray_tpu.llm.multilora — many tenants, one shared paged base model.
+
+Reference parity: ray.llm's multi-LoRA multiplexing (adapters resolved
+per request, applied in-kernel by vLLM) rebuilt TPU-first: XLA wants
+static shapes, so resident adapters live in a fixed-shape SLOT TABLE
+(slots.py — [max_adapters, L, d, r] stacked/padded A/B per target,
+slot 0 = base/no-op) and every engine dispatch carries per-row
+``adapter_slot`` ids, so ONE compiled program serves a mixed-tenant
+batch with zero per-tenant weight copies. Contrast llm/lora.py, which
+MERGES an adapter into a full param copy (one engine per adapter —
+kept as the single-tenant fast path and the parity oracle).
+
+The production loop this package closes (ROADMAP item 4):
+
+  train    — train.py LoRATrainer: base frozen, A/B trained on the
+             Train substrate, CheckpointManager save/resume;
+  publish  — registry.py AdapterRegistry: versioned adapter store on
+             the WeightBroadcast slot pattern (ONE objstore put per
+             publish, keep-window deletes; metadata rides the shared
+             directory service — no new wire frames);
+  serve    — manager.py MultiLoraManager: engine-side LRU of resident
+             slots, hot-swap without engine restart, in-flight
+             requests pinned to their admitted version; the serving
+             layer (llm/serving.py) resolves adapter ids at admission
+             and salts prefix-cache keys with (adapter_id, version) so
+             warmed prefixes never leak across tenants.
+"""
+from .manager import MultiLoraManager
+from .registry import AdapterRegistry
+from .slots import AdapterSlotTable
+from .train import LoRATrainConfig, LoRATrainer
+
+__all__ = ["AdapterSlotTable", "AdapterRegistry", "MultiLoraManager",
+           "LoRATrainConfig", "LoRATrainer"]

@@ -1,0 +1,139 @@
+"""Fixed-shape resident-adapter slot table for batched multi-LoRA decode.
+
+The device side of multi-tenant serving: per LoRA target one stacked,
+rank-padded pair of arrays
+
+    "<t>.A" [S, L, in_t, R]   "<t>.B" [S, L, R, out_t]   t in wq/wk/wv/wo
+    "lm_head.A" [S, d, R]     "lm_head.B" [S, R, V]
+    "scale" [S] f32           (alpha / rank per slot)
+
+where S = max_adapters and R = the table's max rank. Shapes never
+depend on which adapters are resident, so the engine's jitted programs
+compile ONCE and every dispatch just gathers rows by the batch's
+``adapter_slot`` ids (models/llama.py _lora_add). Slot 0 is the
+base-model no-op: all-zero A/B, scale 0 — padding contributes an exact
++0.0, so base rows through a lora-enabled program are bit-identical to
+the plain program (and rank-r adapters padded to R are bit-identical
+to their unpadded math: the extra lanes are 0·0 terms).
+
+Loading a slot is a handful of donated in-place row scatters (the
+import_prefill pattern) — callers must serialize loads against the
+engine's stepping thread, exactly like cross-replica page imports.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...models import llama
+
+_LAYER_TARGETS = ("wq", "wk", "wv", "wo")
+
+
+def _target_dims(cfg: llama.LlamaConfig, target: str) -> tuple:
+    d, hd = cfg.dim, cfg.head_dim
+    if target == "wq":
+        return d, cfg.n_heads * hd
+    if target in ("wk", "wv"):
+        return d, cfg.n_kv_heads * hd
+    if target == "wo":
+        return cfg.n_heads * hd, d
+    if target == "lm_head":
+        return d, cfg.vocab_size
+    raise ValueError(f"unknown LoRA target {target!r}")
+
+
+class AdapterSlotTable:
+    """max_adapters resident slots over one LlamaConfig; slot 0 = base."""
+
+    def __init__(self, cfg: llama.LlamaConfig, max_adapters: int,
+                 max_rank: int,
+                 targets: tuple = ("wq", "wk", "wv", "wo", "lm_head")):
+        if max_adapters < 2:
+            raise ValueError("max_adapters must be >= 2 (slot 0 is the "
+                             "reserved base/no-op slot)")
+        if max_rank < 1:
+            raise ValueError("max_rank must be >= 1")
+        self.cfg = cfg
+        self.max_adapters = int(max_adapters)
+        self.max_rank = int(max_rank)
+        self.targets = tuple(targets)
+        S, L, R = self.max_adapters, cfg.n_layers, self.max_rank
+        tree = {"scale": jnp.zeros((S,), jnp.float32)}
+        for t in self.targets:
+            din, dout = _target_dims(cfg, t)
+            lead = () if t == "lm_head" else (L,)
+            tree[f"{t}.A"] = jnp.zeros((S,) + lead + (din, R), jnp.float32)
+            tree[f"{t}.B"] = jnp.zeros((S,) + lead + (R, dout), jnp.float32)
+        self.tree = tree
+        # donated in-place row scatter, shared across every array (the
+        # jit cache keys on shapes); donation means a load never copies
+        # the table — same contract as paged_engine._import_fn
+        self._set_row = jax.jit(
+            lambda arr, s, val: arr.at[s].set(val), donate_argnums=(0,))
+
+    def nbytes(self) -> int:
+        return sum(int(a.size) * 4 for a in self.tree.values())
+
+    def _padded(self, adapter: dict, target: str):
+        """(A, B) padded to [.., in, R]/[.., R, out] f32, or None when
+        the adapter lacks the target. Rank padding is exact: the extra
+        lanes multiply 0·0 into the dot products."""
+        a = adapter.get(f"{target}.A")
+        if a is None:
+            return None
+        b = adapter[f"{target}.B"]
+        a = np.asarray(a, np.float32)
+        b = np.asarray(b, np.float32)
+        r = a.shape[-1]
+        if r > self.max_rank:
+            raise ValueError(
+                f"adapter rank {r} exceeds the slot table's max_rank "
+                f"{self.max_rank} (target {target!r})")
+        if r < self.max_rank:
+            pad_a = [(0, 0)] * (a.ndim - 1) + [(0, self.max_rank - r)]
+            pad_b = [(0, 0)] * (b.ndim - 2) + [(0, self.max_rank - r),
+                                               (0, 0)]
+            a = np.pad(a, pad_a)
+            b = np.pad(b, pad_b)
+        return a, b
+
+    def load(self, slot: int, adapter: Optional[dict]) -> None:
+        """Install ``adapter`` (llm/lora.py npz dict) into ``slot``;
+        None clears the slot back to the base no-op. The caller must
+        serialize against the engine's stepping thread (donated
+        scatters invalidate the old buffers mid-dispatch otherwise)."""
+        if not 0 < slot < self.max_adapters:
+            raise ValueError(
+                f"slot must be in [1, {self.max_adapters}); slot 0 is "
+                f"the reserved base slot")
+        if adapter is None:
+            scale = 0.0
+            per_target = {t: None for t in self.targets}
+        else:
+            rank = int(adapter.get("rank", 4))
+            alpha = float(adapter.get("alpha", rank))
+            scale = alpha / max(rank, 1)
+            per_target = {t: self._padded(adapter, t)
+                          for t in self.targets}
+            unknown = [k[:-2] for k in adapter
+                       if k.endswith(".A") and k[:-2] not in self.targets]
+            if unknown:
+                raise ValueError(
+                    f"adapter targets {unknown} are not in this table's "
+                    f"targets {self.targets}")
+        t = self.tree
+        for tgt, ab in per_target.items():
+            ka, kb = f"{tgt}.A", f"{tgt}.B"
+            if ab is None:
+                zero_a = jnp.zeros(t[ka].shape[1:], jnp.float32)
+                zero_b = jnp.zeros(t[kb].shape[1:], jnp.float32)
+                t[ka] = self._set_row(t[ka], slot, zero_a)
+                t[kb] = self._set_row(t[kb], slot, zero_b)
+            else:
+                t[ka] = self._set_row(t[ka], slot, jnp.asarray(ab[0]))
+                t[kb] = self._set_row(t[kb], slot, jnp.asarray(ab[1]))
+        t["scale"] = self._set_row(t["scale"], slot, jnp.float32(scale))

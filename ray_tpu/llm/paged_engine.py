@@ -86,6 +86,18 @@ class PagedEngineConfig:
     # extra XLA compiles than the narrower gathers win back on CI-scale
     # models). "on"/"off" force it.
     page_buckets: str = "auto"
+    # batched multi-LoRA (llm/multilora): > 0 builds a fixed-shape
+    # resident-adapter slot table of this many slots (slot 0 = base) and
+    # threads per-row adapter_slot ids through every dispatch, so ONE
+    # compiled program serves a mixed-tenant batch. Shapes are static —
+    # no new program per adapter mix — and slot 0 padding is an exact
+    # +0.0, so base traffic through a lora-enabled engine stays
+    # bit-identical. 0 disables (no extra args traced at all).
+    max_adapters: int = 0
+    # rank ceiling of the slot table; lower-rank adapters zero-pad
+    # (exact — padded lanes contribute 0·0 terms)
+    lora_rank: int = 8
+    lora_targets: tuple = ("wq", "wk", "wv", "wo", "lm_head")
     # automatic prefix caching (vLLM-style block-hash reuse): retired
     # requests park their full KV pages in a content-addressed LRU pool
     # instead of freeing them; a later request whose prompt shares a
@@ -159,6 +171,14 @@ class PagedInferenceEngine(_EngineBase):
         self._dir_new: list[bytes] = []
         self._dir_dropped: list[bytes] = []
         self._next_rid = 0
+        # resident-adapter slot table (cfg.max_adapters): device arrays
+        # every dispatch gathers per-row; loads are donated scatters the
+        # caller serializes against stepping (serving's step lock)
+        self.lora = None
+        if cfg.max_adapters > 0:
+            from .multilora.slots import AdapterSlotTable
+            self.lora = AdapterSlotTable(mc, cfg.max_adapters,
+                                         cfg.lora_rank, cfg.lora_targets)
         self._rng_base = jax.random.PRNGKey(rng_seed ^ 0x5EED)
         self._rng_ctr = 0
         self._lock = threading.Lock()
@@ -261,12 +281,14 @@ class PagedInferenceEngine(_EngineBase):
             interpret = self._interpret
             any_sampled, any_topk, want_logp = mode
 
-            def run(p, c, tok0, bt, ln0, key, ctr, temps, top_ks):
+            def run(p, c, tok0, bt, ln0, key, ctr, temps, top_ks,
+                    lora=None, slots=None):
                 def body(carry, i):
                     toks, lens, caches = carry
                     logits, caches = llama.decode_paged(
                         p, toks[:, None], caches, bt, lens, mc,
-                        page_size=page, interpret=interpret)
+                        page_size=page, interpret=interpret,
+                        lora=lora, slots=slots)
                     sub = jax.random.fold_in(
                         jax.random.fold_in(key, ctr), i)
                     nxt, lp = sample_logits_batch(
@@ -298,10 +320,11 @@ class PagedInferenceEngine(_EngineBase):
             interpret = self._interpret
             any_sampled, any_topk, want_logp = mode
 
-            def run(p, c, chunks, bts, sps, tls, key, ctr, temps, top_ks):
+            def run(p, c, chunks, bts, sps, tls, key, ctr, temps, top_ks,
+                    lora=None, slots=None):
                 last, c = llama.prefill_paged_rows(
                     p, chunks, c, bts, sps, tls, mc, page_size=page,
-                    interpret=interpret)
+                    interpret=interpret, lora=lora, slots=slots)
                 toks, lps = sample_logits_batch(
                     last, jax.random.fold_in(key, ctr), temps, top_ks,
                     any_sampled=any_sampled, any_topk=any_topk,
@@ -324,10 +347,10 @@ class PagedInferenceEngine(_EngineBase):
             mc, page = self.cfg.model, self.cfg.page_size
             interpret = self._interpret
 
-            def run(p, c, toks, bts, starts):
+            def run(p, c, toks, bts, starts, lora=None, slots=None):
                 logits, c = llama.verify_paged_rows(
                     p, toks, c, bts, starts, mc, page_size=page,
-                    interpret=interpret)
+                    interpret=interpret, lora=lora, slots=slots)
                 y = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 if not want_logp:
                     return y, None, c
@@ -339,6 +362,40 @@ class PagedInferenceEngine(_EngineBase):
             fn = jax.jit(run, donate_argnums=(1,))
             self._verify_fns[(r, s1, pages, want_logp)] = fn
         return fn
+
+    # -- multi-LoRA slot plumbing (cfg.max_adapters; llm/multilora) --------
+
+    def _lora_args(self, slots) -> tuple:
+        """Trailing (lora_tree, slots) args for a dispatch: (None, None)
+        when the table is disabled — the jitted programs then trace the
+        exact pre-LoRA math."""
+        if self.lora is None:
+            return (None, None)
+        return (self.lora.tree, np.asarray(slots, np.int32))
+
+    def load_adapter_slot(self, slot: int, adapter) -> None:
+        """Install an adapter into a slot table row (None clears it).
+        CALLER must serialize against the stepping thread (serving.py's
+        step lock): the donated row scatters invalidate the old table
+        buffers, same contract as import_prefix."""
+        if self.lora is None:
+            raise ValueError(
+                "engine built without a slot table "
+                "(PagedEngineConfig.max_adapters == 0)")
+        self.lora.load(slot, adapter)
+
+    def adapter_slots_in_use(self) -> dict:
+        """{slot: live request count} over pending+prefilling+active —
+        what the manager's LRU must NOT evict (a resident adapter with
+        in-flight requests is pinned to its admitted version)."""
+        with self._lock:
+            counts: dict[int, int] = {}
+            for req in (list(self._pending) + list(self._prefilling)
+                        + list(self._active.values())):
+                s = getattr(req, "adapter_slot", 0)
+                if s:
+                    counts[s] = counts.get(s, 0) + 1
+            return counts
 
     # -- public API --------------------------------------------------------
 
@@ -387,7 +444,8 @@ class PagedInferenceEngine(_EngineBase):
                         np.zeros((rb, maxp), np.int32),
                         np.zeros((rb,), np.int32), np.zeros((rb,), np.int32),
                         key, ctr, np.zeros((rb,), np.float32),
-                        np.zeros((rb,), np.int32))
+                        np.zeros((rb,), np.int32),
+                        *self._lora_args(np.zeros((rb,), np.int32)))
                     np.asarray(toks)
                     # book as compile (and mark the key warm) so the first
                     # REAL dispatch after warmup counts as execute time
@@ -406,7 +464,8 @@ class PagedInferenceEngine(_EngineBase):
                         np.zeros((bs, maxp), np.int32),
                         np.zeros((bs,), np.int32), key, ctr,
                         np.zeros((bs,), np.float32),
-                        np.zeros((bs,), np.int32))
+                        np.zeros((bs,), np.int32),
+                        *self._lora_args(np.zeros((bs,), np.int32)))
                     np.asarray(out)
                     self.profiler.record_compile(
                         _time.perf_counter() - tw, "decode", (w, mode, maxp))
@@ -421,7 +480,8 @@ class PagedInferenceEngine(_EngineBase):
                         self.params, self.caches,
                         np.zeros((rb, s1), np.int32),
                         np.zeros((rb, maxp), np.int32),
-                        np.zeros((rb,), np.int32))
+                        np.zeros((rb,), np.int32),
+                        *self._lora_args(np.zeros((rb,), np.int32)))
                     np.asarray(y)
                     # mark warm like prefill/decode: the first REAL spec
                     # dispatch must book as execute, not compile
@@ -559,7 +619,14 @@ class PagedInferenceEngine(_EngineBase):
 
     def _prompt_hashes(self, req: _Request) -> list[bytes]:
         if req.page_hashes is None:
-            req.page_hashes = self._hash_chain(req.prompt_ids)
+            # the chain SEED is the request's prefix salt (empty for the
+            # base model): adapter requests hash into a disjoint key
+            # space per (adapter_id, version), so cached/directory pages
+            # can never match across tenants — required for correctness
+            # (different adapters write different K/V for equal tokens),
+            # and what keeps warmed prefixes tenant-private
+            req.page_hashes = self._hash_chain(req.prompt_ids,
+                                               prev=req.prefix_salt)
         return req.page_hashes
 
     def _reuse_limit(self, req: _Request) -> int:
@@ -657,7 +724,7 @@ class PagedInferenceEngine(_EngineBase):
             tokens = (req.prompt_ids + req.out_ids)[
                 len(hashes) * page:n_full * page]
             hashes = hashes + self._hash_chain(
-                tokens, prev=hashes[-1] if hashes else b"")
+                tokens, prev=hashes[-1] if hashes else req.prefix_salt)
         for i in range(n_full):
             self._register_page(req.pages[i], hashes[i])
 
@@ -737,17 +804,20 @@ class PagedInferenceEngine(_EngineBase):
         tls = np.zeros((rb,), np.int32)
         temps = np.zeros((rb,), np.float32)
         topks = np.zeros((rb,), np.int32)
+        lslots = np.zeros((rb,), np.int32)
         for i, (req, pos, n) in enumerate(rows):
             chunks[i, :n] = req.prompt_ids[pos:pos + n]
             bts[i] = self._block_tables[req.slot][:W]
             sps[i], tls[i] = pos, n
             temps[i] = req.params.temperature
             topks[i] = req.params.top_k
+            lslots[i] = req.adapter_slot
         mode = self._sampling_mode([q for q, _, _ in rows])
         with self.profiler.step("prefill", (rb, mode, W)):
             toks, lps, self.caches = self._prefill_rows_fn(rb, mode, W)(
                 self.params, self.caches, chunks, bts, sps, tls,
-                self._rng_base, np.int32(self._rng_ctr), temps, topks)
+                self._rng_base, np.int32(self._rng_ctr), temps, topks,
+                *self._lora_args(lslots))
             toks = np.asarray(toks)     # block: the step must measure
             lps = None if lps is None else np.asarray(lps)
         self._rng_ctr += 1
@@ -857,6 +927,7 @@ class PagedInferenceEngine(_EngineBase):
         toks = np.zeros((rb, s1), np.int32)
         bts = np.zeros((rb, W), np.int32)
         starts = np.zeros((rb,), np.int32)
+        lslots = np.zeros((rb,), np.int32)
         allow: dict[int, int] = {}
         for i, slot in enumerate(slots):
             req = self._active[slot]
@@ -865,10 +936,12 @@ class PagedInferenceEngine(_EngineBase):
             toks[i, 1:1 + len(drafts[slot])] = drafts[slot]
             bts[i] = self._block_tables[slot][:W]
             starts[i] = self._lengths[slot]
+            lslots[i] = req.adapter_slot
         want_lp = any(self._active[sl].params.logprobs for sl in slots)
         with self.profiler.step("verify", (rb, s1, W, want_lp)):
             y, ylp, self.caches = self._verify_fn(rb, s1, W, want_lp)(
-                self.params, self.caches, toks, bts, starts)
+                self.params, self.caches, toks, bts, starts,
+                *self._lora_args(lslots))
             y = np.asarray(y)               # [r, s1]; block: measure
             ylp = None if ylp is None else np.asarray(ylp)
         self.stats["spec_dispatches"] += 1
@@ -944,6 +1017,7 @@ class PagedInferenceEngine(_EngineBase):
         lengths = np.zeros((bs,), np.int32)
         temps = np.zeros((bs,), np.float32)
         topks = np.zeros((bs,), np.int32)
+        lslots = np.zeros((bs,), np.int32)
         # slots not decoding this step get a zeroed block-table row: their
         # dummy writes go to sink page 0 instead of a live (possibly
         # reused) page
@@ -956,11 +1030,13 @@ class PagedInferenceEngine(_EngineBase):
             temps[slot] = req.params.temperature
             topks[slot] = req.params.top_k
             bt[slot] = self._block_tables[slot][:W]
+            lslots[slot] = req.adapter_slot
         mode = self._sampling_mode(self._active.values())
         with self.profiler.step("decode", (w, mode, W)):
             out, lps, self.caches = self._decode_window_fn(w, mode, W)(
                 self.params, self.caches, tokens, bt, lengths,
-                self._rng_base, np.int32(self._rng_ctr), temps, topks)
+                self._rng_base, np.int32(self._rng_ctr), temps, topks,
+                *self._lora_args(lslots))
             out = np.asarray(out)           # [bs, w]; block to measure
             lps = None if lps is None else np.asarray(lps)
         self._rng_ctr += 1
@@ -1049,6 +1125,9 @@ class PagedInferenceEngine(_EngineBase):
                 # order: the decode side dedupes payload pages it already
                 # holds instead of re-allocating and re-scattering them
                 "page_hashes": list(self._prompt_hashes(req)),
+                # the chain's seed, so the decode side's request hashes
+                # land in the same (tenant-scoped) key space
+                "prefix_salt": req.prefix_salt,
                 "pages": pages}
 
     def prefill_export(self, prompt, params: SamplingParams) -> dict:
@@ -1077,6 +1156,7 @@ class PagedInferenceEngine(_EngineBase):
         ids = list(payload["prompt_ids"])
         with self._lock:
             req = _Request(self._next_rid, ids, params)
+            req.prefix_salt = payload.get("prefix_salt", b"")
             req.submit_t = time.perf_counter()
             req.admit_t = req.submit_t
             from . import telemetry
@@ -1101,7 +1181,7 @@ class PagedInferenceEngine(_EngineBase):
             # it at position len(ids)).
             hashes = payload.get("page_hashes")
             if hashes is None and self._prefix_on:
-                hashes = self._hash_chain(ids)
+                hashes = self._hash_chain(ids, prev=req.prefix_salt)
             matched: list[int] = []
             if self._prefix_on and hashes:
                 for h in hashes:      # chain property: a prefix run
@@ -1166,18 +1246,20 @@ class PagedInferenceEngine(_EngineBase):
     # imported pages seed the CACHE — refcount 0, LRU-parked — instead
     # of a decode-ready request) ------------------------------------------
 
-    def hash_prompt(self, prompt) -> list[bytes]:
+    def hash_prompt(self, prompt, salt: bytes = b"") -> list[bytes]:
         """Chained hashes of the prompt's admission-reusable pages: the
         whole full pages inside the chunk-aligned _reuse_limit, exactly
-        the run _match_prefix can admit from cache. Pure computation —
-        no lock, no state."""
+        the run _match_prefix can admit from cache. ``salt`` must match
+        the prefix_salt the request will submit with (tenant-scoped
+        chains — _prompt_hashes). Pure computation — no lock, no
+        state."""
         ids = (self.tokenizer.encode(prompt) if isinstance(prompt, str)
                else list(prompt))
         c = self.cfg.chunk_size
         limit = ((len(ids) - 1) // c) * c
         if limit <= 0:
             return []
-        return self._hash_chain(ids[:limit])
+        return self._hash_chain(ids[:limit], prev=salt)
 
     def cached_prefix_len(self, hashes) -> int:
         """How many of `hashes` (a chain run) this engine's cache already
@@ -1335,18 +1417,21 @@ class PagedInferenceEngine(_EngineBase):
                     self.params, self.caches, np.zeros((bs,), np.int32),
                     np.zeros((bs, W), np.int32), np.zeros((bs,), np.int32),
                     rkey, ctr, np.zeros((bs,), np.float32),
-                    np.zeros((bs,), np.int32))
+                    np.zeros((bs,), np.int32),
+                    *self._lora_args(np.zeros((bs,), np.int32)))
         if kind == "prefill":
             rb, mode, W = key
             return (self._prefill_rows_fn(rb, mode, W),
                     self.params, self.caches, np.zeros((rb, c), np.int32),
                     np.zeros((rb, W), np.int32), np.zeros((rb,), np.int32),
                     np.zeros((rb,), np.int32), rkey, ctr,
-                    np.zeros((rb,), np.float32), np.zeros((rb,), np.int32))
+                    np.zeros((rb,), np.float32), np.zeros((rb,), np.int32),
+                    *self._lora_args(np.zeros((rb,), np.int32)))
         rb, s1, W, want_lp = key                      # verify
         return (self._verify_fn(rb, s1, W, want_lp),
                 self.params, self.caches, np.zeros((rb, s1), np.int32),
-                np.zeros((rb, W), np.int32), np.zeros((rb,), np.int32))
+                np.zeros((rb, W), np.int32), np.zeros((rb,), np.int32),
+                *self._lora_args(np.zeros((rb,), np.int32)))
 
     def profile_summary(self) -> dict:
         """Step-profiler view (util/profiling.py): compile/execute wall

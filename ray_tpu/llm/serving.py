@@ -29,10 +29,23 @@ class LLMConfig:
     PagedEngineConfig (paged-KV continuous batching — the production path);
     the default is paged.
 
-    LoRA: ``lora_dir`` holds ``<adapter_id>.npz`` adapters (llm/lora.py
-    format); a request carrying ``"lora": "<id>"`` (or
-    ``model="<model_id>:<id>"``) runs on an engine built from the merged
-    weights, cached per replica up to ``max_loras`` (LRU)."""
+    LoRA, two modes:
+
+    - **batched multi-LoRA** (production multi-tenant path): a
+      PagedEngineConfig with ``max_adapters > 0`` serves every adapter
+      from ONE engine — a request carrying ``"lora": "<id>"`` (or
+      ``model="<model_id>:<id>"``) resolves the adapter's latest
+      version in the AdapterRegistry (namespace ``lora_namespace``,
+      default the model_id) at admission, rides a resident slot-table
+      row, and shares the decode dispatch with every other tenant.
+      Hot-swap: a newly published version starts serving within
+      cfg.llm_lora_refresh_s, in-flight requests finish on their
+      admitted version. Prefix-cache keys are salted per
+      (adapter_id, version), so warmed prefixes never cross tenants.
+    - **merged engines** (legacy / single-tenant): ``lora_dir`` holds
+      ``<adapter_id>.npz`` adapters (llm/lora.py format) merged into a
+      full param copy each, one engine per resident adapter, LRU up to
+      ``max_loras``. Also the parity oracle for the batched path."""
     model_id: str = "llama-tiny"
     engine: Optional[EngineConfig | PagedEngineConfig] = None
     num_replicas: int = 1
@@ -40,6 +53,8 @@ class LLMConfig:
     tpus_per_replica: float = 0.0
     lora_dir: Optional[str] = None
     max_loras: int = 2
+    # registry namespace for batched multi-LoRA (None -> model_id)
+    lora_namespace: Optional[str] = None
     # compile every engine program family at replica init, before the
     # replica reports ready (vLLM-style deploy-time graph capture) —
     # keeps the first request burst from paying mid-burst XLA compiles.
@@ -95,6 +110,15 @@ class LLMServer:
             from ..serve.frontdoor.prefix import PrefixDirectoryClient
             self._prefix_dir = PrefixDirectoryClient(cfg.model_id)
             self.engine.track_page_publish = True
+        # batched multi-LoRA (llm/multilora): one engine, many tenants.
+        # The manager resolves adapter ids to resident slot-table rows
+        # at admission; version pinning, LRU and hot-swap live there.
+        self._multilora = None
+        if getattr(self.engine, "lora", None) is not None:
+            from .multilora import AdapterRegistry, MultiLoraManager
+            self._multilora = MultiLoraManager(
+                self.engine,
+                AdapterRegistry(cfg.lora_namespace or cfg.model_id))
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -113,12 +137,17 @@ class LLMServer:
         with self._lora_lock:
             return [self.engine, *self._lora_engines.values()]
 
-    def _engine_for(self, request: dict):
-        """Pick the engine for a request's LoRA id (None -> base)."""
+    @staticmethod
+    def _lora_id(request: dict) -> Optional[str]:
         lora_id = request.get("lora")
         model = request.get("model", "")
         if not lora_id and ":" in model:
             lora_id = model.split(":", 1)[1]
+        return lora_id or None
+
+    def _engine_for(self, request: dict):
+        """Pick the engine for a request's LoRA id (None -> base)."""
+        lora_id = self._lora_id(request)
         if not lora_id:
             return self.engine
         with self._lora_lock:
@@ -193,6 +222,40 @@ class LLMServer:
             top_k=int(request.get("top_k", 0)),
             logprobs=int(request.get("logprobs") or 0),
         )
+        lora_id = self._lora_id(request)
+        if lora_id and self._multilora is not None:
+            # batched multi-LoRA: resolve the adapter's latest version
+            # at ADMISSION (in-flight requests stay pinned to it), ride
+            # a slot-table row on the shared engine, and salt every
+            # prefix-cache key with (adapter_id, version). pin=True
+            # holds the slot against eviction across the tokenize +
+            # prefix-import window below — the engine's own in-flight
+            # accounting starts only at submit(). Errors stay TYPED:
+            # unknown adapter -> ValueError (client error), all slots
+            # live -> RuntimeError("overloaded: ...") the proxy turns
+            # into a retryable 503, never a bare 500.
+            try:
+                slot, _version, salt = self._multilora.resolve(
+                    lora_id, self._steplock, pin=True)
+            except KeyError as e:
+                raise ValueError(
+                    f"unknown LoRA adapter {lora_id!r} for model "
+                    f"{self.model_id!r}: {e}") from e
+            eng = self.engine
+            try:
+                prompt = (eng.tokenizer.encode(prompt)
+                          if isinstance(prompt, str) else list(prompt))
+                if self._prefix_dir is not None:
+                    # tenant-salted hashes: directory entries for this
+                    # (adapter_id, version) can only match its own pages
+                    self._prefix_dir.maybe_import(eng, self._steplock,
+                                                  prompt, salt=salt)
+                req = eng.submit(prompt, sp, adapter_slot=slot,
+                                 prefix_salt=salt)
+            finally:
+                self._multilora.unpin(slot)
+            self._wake.set()
+            return eng, req
         eng = self._engine_for(request)
         # tokenize ONCE: the prefix-directory lookup and submit share
         # the ids (a second encode of a long system prompt would tax
@@ -315,7 +378,13 @@ class LLMServer:
             return self.engine.export_prefix(list(hashes))
 
     def loaded_loras(self) -> list:
-        return list(self._lora_engines)
+        """Resident adapters: merged-engine ids plus the slot table's
+        (adapter_id, version) pairs."""
+        out = list(self._lora_engines)
+        if self._multilora is not None:
+            out.extend(f"{aid}@{v}" for aid, v in
+                       self._multilora.resident().values())
+        return out
 
     def __call__(self, request: dict) -> dict:
         return self.completions(request or {})

@@ -275,6 +275,59 @@ def _ship_stat_deltas(engine, stats: dict, tags: dict) -> None:
                 float(delta), tags={**tags, "family": family})
 
 
+# --------------------------------------------------------------------- #
+# multi-LoRA (llm/multilora) — the rtpu_llm_lora_* family
+# --------------------------------------------------------------------- #
+#   lora_requests_total        counter  adapter-routed requests resolved
+#   lora_hits_total            counter  resolves served by a resident slot
+#   lora_loads_total           counter  cold slot loads (registry fetch +
+#       device scatter)
+#   lora_evictions_total       counter  LRU slots reclaimed for a load
+#   lora_swaps_total           counter  hot-swaps: a newer version loaded
+#       while an older one stayed resident (pinned by in-flight requests)
+#   lora_publishes_total       counter  registry publishes, by namespace
+#   lora_resident_adapters     gauge    slots currently holding an adapter
+
+def lora_publishes() -> Counter:
+    return _counter("rtpu_llm_lora_publishes_total",
+                    "adapter versions published to the registry",
+                    tag_keys=("namespace",))
+
+
+_LORA_COUNTERS = (
+    ("requests", "rtpu_llm_lora_requests_total",
+     "requests resolved to an adapter slot"),
+    ("hits", "rtpu_llm_lora_hits_total",
+     "adapter resolves served by an already-resident slot"),
+    ("loads", "rtpu_llm_lora_loads_total",
+     "cold adapter loads into the slot table"),
+    ("evictions", "rtpu_llm_lora_evictions_total",
+     "resident slots LRU-reclaimed to load another adapter"),
+    ("swaps", "rtpu_llm_lora_swaps_total",
+     "hot-swaps (newer version loaded beside a pinned older one)"),
+)
+
+
+@_never_raise
+def on_lora_stats(manager) -> None:
+    """Ship the manager's counter deltas + residency gauge (called on
+    every resolve — a handful of dict updates, same budget as
+    on_step)."""
+    last = getattr(manager, "_telem_shipped", None)
+    if last is None:
+        last = manager._telem_shipped = {}
+    for key, name, desc in _LORA_COUNTERS:
+        cur = manager.stats.get(key, 0)
+        delta = cur - last.get(key, 0)
+        if delta > 0:
+            last[key] = cur
+            _counter(name, desc).inc(float(delta), tags={"engine": "paged"})
+    _gauge("rtpu_llm_lora_resident_adapters",
+           "slot-table rows currently holding an adapter").set(
+        float(len(manager._resident)),
+        tags={"engine": "paged", "proc": _proc()})
+
+
 def _emit_request_span(req) -> None:
     ctx: Optional[tuple] = getattr(req, "trace_ctx", None)
     if ctx is None:

@@ -226,17 +226,82 @@ def _attention(q, k, v, cfg: LlamaConfig, causal: bool, attn_impl):
     return mha_reference(q, k, v, causal=causal)
 
 
-def _qkv(h, p, cfg: LlamaConfig, cos, sin):
-    """Projections + RoPE, shared by every forward mode. h [B, S, D]."""
+def _qkv(h, p, cfg: LlamaConfig, cos, sin, lora=None, slots=None):
+    """Projections + RoPE, shared by every forward mode. h [B, S, D].
+
+    ``lora``/``slots``: optional per-layer adapter slot table
+    (_lora_at_layer) and per-row slot ids — the batched multi-LoRA
+    serving path adds scale·(h@A[slot])@B[slot] to each projection.
+    None (every training/base path) leaves the math untouched."""
     b, s, _ = h.shape
-    q = (h @ p["wq"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
-    k = (h @ p["wk"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
-    v = (h @ p["wv"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    q = h @ p["wq"]
+    k = h @ p["wk"]
+    v = h @ p["wv"]
+    if lora is not None:
+        q = _lora_add(q, h, lora, "wq", slots)
+        k = _lora_add(k, h, lora, "wk", slots)
+        v = _lora_add(v, h, lora, "wv", slots)
+    q = q.reshape(b, s, cfg.n_heads, cfg.head_dim)
+    k = k.reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    v = v.reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     q = constrain(q, ("batch", "sequence", "heads", "head_dim"))
     k = constrain(k, ("batch", "sequence", "kv_heads", "head_dim"))
     return q, k, v
+
+
+# ---------------------------------------------------------------------------
+# Batched multi-LoRA (llm/multilora): slot-table deltas on the serving paths
+# ---------------------------------------------------------------------------
+# The slot table is a fixed-shape pytree (llm/multilora/slots.py):
+#   "<t>.A" [S, L, in_t, R]  "<t>.B" [S, L, R, out_t]   t in wq/wk/wv/wo
+#   "lm_head.A" [S, d, R]    "lm_head.B" [S, R, V]
+#   "scale" [S] f32 (alpha/rank per slot; slot 0 = base, all-zero A/B)
+# so every dispatch keeps XLA-static shapes no matter which tenants are
+# in the batch; per-row `slots` ids select each row's adapter. Padding
+# (rank < R, missing targets, slot 0) contributes an exact +0.0, so the
+# base path through a lora-enabled program is bit-identical to the
+# plain program.
+
+_LORA_LAYER_TARGETS = ("wq", "wk", "wv", "wo")
+
+
+def _lora_at_layer(lora, layer: int):
+    """Slice the [S, L, ...] layer-stacked tables at one layer (static
+    index — the serving paths unroll layers in Python)."""
+    if lora is None:
+        return None
+    out = {"scale": lora["scale"]}
+    for t in _LORA_LAYER_TARGETS:
+        a = lora.get(f"{t}.A")
+        if a is not None:
+            out[f"{t}.A"] = a[:, layer]
+            out[f"{t}.B"] = lora[f"{t}.B"][:, layer]
+    return out
+
+
+def _lora_add(y, x, lora, target: str, slots):
+    """y + scale[slot]·(x @ A[slot]) @ B[slot] for one projection.
+
+    x [..., in]; slots is a scalar (single-sequence scan rows: prefill
+    chunk / verify) or [B] (batched decode). The low-rank math runs in
+    f32 — mirroring lora.merge, which merges in f32 before casting —
+    and the delta is cast back to y.dtype. Absent targets return y
+    unchanged."""
+    a = lora.get(f"{target}.A")
+    if a is None:
+        return y
+    b = lora[f"{target}.B"]
+    sc = lora["scale"][slots]
+    xf = x.astype(jnp.float32)
+    if jnp.ndim(slots) == 0:
+        d = ((xf @ a[slots]) @ b[slots]) * sc
+    else:
+        d = jnp.einsum("bsr,bro->bso",
+                       jnp.einsum("bsi,bir->bsr", xf, a[slots]),
+                       b[slots]) * sc[:, None, None]
+    return y + d.astype(y.dtype)
 
 
 def _mlp_block(x, p, cfg: LlamaConfig):
@@ -570,7 +635,7 @@ def _layer_params(params: dict, layer: int) -> dict:
 def decode_paged(params: dict, tokens: jax.Array, caches: list[dict],
                  block_tables: jax.Array, lengths: jax.Array,
                  cfg: LlamaConfig, *, page_size: int,
-                 interpret: bool = False):
+                 interpret: bool = False, lora=None, slots=None):
     """One decode step over paged caches.
 
     tokens [B, 1]; block_tables [B, max_pages]; lengths [B] = tokens already
@@ -578,6 +643,10 @@ def decode_paged(params: dict, tokens: jax.Array, caches: list[dict],
     (logits [B, V], updated caches). Inactive rows: pass length 0 and mask
     the output — their token writes land in page block_tables[b, 0] slot 0
     and are overwritten on real use.
+
+    ``lora``/``slots`` [B]: batched multi-LoRA — each row's projections
+    (and logits, for lm_head adapters) get its slot's low-rank delta, so
+    ONE dispatch serves a mixed-tenant batch (see _lora_add).
     """
     from ..ops.paged_attention import paged_decode_reference
     from ..ops.ragged_paged_attention import ragged_decode_attention
@@ -596,30 +665,37 @@ def decode_paged(params: dict, tokens: jax.Array, caches: list[dict],
     new_caches = []
     for layer in range(cfg.n_layers):
         p = _layer_params(params, layer)
+        ll = _lora_at_layer(lora, layer)
         cache = caches[layer]
         h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
-        q, k, v = _qkv(h, p, cfg, cos, sin)                # q [B,1,H,D]
+        q, k, v = _qkv(h, p, cfg, cos, sin, ll, slots)     # q [B,1,H,D]
         k_pages = cache["k"].at[page_ids, offsets].set(
             k[:, 0].astype(cache["k"].dtype))
         v_pages = cache["v"].at[page_ids, offsets].set(
             v[:, 0].astype(cache["v"].dtype))
         attn = attend(q[:, 0], k_pages, v_pages, block_tables,
                       lengths + 1)                         # [B, H, D]
-        x = x + attn.reshape(b, 1, -1) @ p["wo"]
+        proj = attn.reshape(b, 1, -1)
+        y = proj @ p["wo"]
+        if ll is not None:
+            y = _lora_add(y, proj, ll, "wo", slots)
+        x = x + y
         x, _ = _mlp_block(x, p, cfg)
         new_caches.append({"k": k_pages, "v": v_pages})
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"],
-                        preferred_element_type=jnp.float32)[:, 0]
-    return logits, new_caches
+                        preferred_element_type=jnp.float32)
+    if lora is not None and "lm_head.A" in lora:
+        logits = _lora_add(logits, x, lora, "lm_head", slots)
+    return logits[:, 0], new_caches
 
 
 def prefill_paged_chunk(params: dict, chunk: jax.Array, caches: list[dict],
                         block_table_row: jax.Array, start_pos: jax.Array,
                         cfg: LlamaConfig, *, page_size: int,
                         true_chunk_len: jax.Array | None = None,
-                        interpret: bool = False):
+                        interpret: bool = False, lora=None, slot=None):
     """Prefill ONE page-aligned chunk of one sequence.
 
     chunk [1, C] (C a multiple of page_size, right-padded with zeros);
@@ -672,9 +748,10 @@ def prefill_paged_chunk(params: dict, chunk: jax.Array, caches: list[dict],
     new_caches = []
     for layer in range(cfg.n_layers):
         p = _layer_params(params, layer)
+        ll = _lora_at_layer(lora, layer)
         cache = caches[layer]
         h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
-        q, k, v = _qkv(h, p, cfg, cos, sin)               # [1,C,H/KVH,D]
+        q, k, v = _qkv(h, p, cfg, cos, sin, ll, slot)     # [1,C,H/KVH,D]
 
         # write the chunk's K/V into its (page-aligned) pages
         k_w = k[0].reshape(n_chunk_pages, page_size,
@@ -702,20 +779,27 @@ def prefill_paged_chunk(params: dict, chunk: jax.Array, caches: list[dict],
             attn = ragged_paged_reference(
                 q, k_pages, v_pages, block_table_row[None], starts1,
                 qlens1, scale=scale).astype(cfg.dtype)
-        x = x + attn.reshape(1, c, -1) @ p["wo"]
+        proj = attn.reshape(1, c, -1)
+        y = proj @ p["wo"]
+        if ll is not None:
+            y = _lora_add(y, proj, ll, "wo", slot)
+        x = x + y
         x, _ = _mlp_block(x, p, cfg)
         new_caches.append({"k": k_pages, "v": v_pages})
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"],
-                        preferred_element_type=jnp.float32)[0]
-    return logits, new_caches
+                        preferred_element_type=jnp.float32)
+    if lora is not None and "lm_head.A" in lora:
+        logits = _lora_add(logits, x, lora, "lm_head", slot)
+    return logits[0], new_caches
 
 
 def prefill_paged_rows(params: dict, chunks: jax.Array, caches: list[dict],
                        bt_rows: jax.Array, start_pos: jax.Array,
                        true_lens: jax.Array, cfg: LlamaConfig, *,
-                       page_size: int, interpret: bool = False):
+                       page_size: int, interpret: bool = False,
+                       lora=None, slots=None):
     """Prefill up to R chunk-rows in ONE compiled program.
 
     chunks [R, C] (each row one page-aligned chunk, right-padded);
@@ -732,24 +816,32 @@ def prefill_paged_rows(params: dict, chunks: jax.Array, caches: list[dict],
     llm/_internal/serve/deployments/llm/vllm/vllm_engine.py:180).
     """
     c = chunks.shape[1]
+    # slots join the scanned operands only on the multi-LoRA path, so
+    # lora=None traces exactly the pre-LoRA program
+    if lora is not None and slots is None:
+        slots = jnp.zeros((chunks.shape[0],), jnp.int32)
 
     def body(carry, row):
-        chunk, bt, sp, tl = row
+        chunk, bt, sp, tl = row[:4]
+        sl = row[4] if lora is not None else None
         logits, carry = prefill_paged_chunk(
             params, chunk[None, :], carry, bt, sp, cfg,
-            page_size=page_size, true_chunk_len=tl, interpret=interpret)
+            page_size=page_size, true_chunk_len=tl, interpret=interpret,
+            lora=lora, slot=sl)
         last = logits[jnp.clip(tl - 1, 0, c - 1)]
         return carry, last
 
-    caches, last = jax.lax.scan(
-        body, caches, (chunks, bt_rows, start_pos, true_lens))
+    xs = (chunks, bt_rows, start_pos, true_lens)
+    if lora is not None:
+        xs = xs + (slots,)
+    caches, last = jax.lax.scan(body, caches, xs)
     return last, caches
 
 
 def verify_paged_rows(params: dict, tokens: jax.Array, caches: list[dict],
                       bt_rows: jax.Array, starts: jax.Array,
                       cfg: LlamaConfig, *, page_size: int,
-                      interpret: bool = False):
+                      interpret: bool = False, lora=None, slots=None):
     """Speculative-verification forward (the scorer role of vLLM-style
     speculative decoding in the reference's serving engine): for each of
     R rows feed S1 = 1 + n_draft tokens at positions
@@ -780,9 +872,12 @@ def verify_paged_rows(params: dict, tokens: jax.Array, caches: list[dict],
     s1 = tokens.shape[1]
     scale = cfg.head_dim ** -0.5
     use_kernel = interpret or _on_tpu()
+    if lora is not None and slots is None:
+        slots = jnp.zeros((tokens.shape[0],), jnp.int32)
 
     def body(carry, row):
-        toks, bt, start = row
+        toks, bt, start = row[:3]
+        sl = row[3] if lora is not None else None
         positions = start + jnp.arange(s1)                 # [S1]
         cos, sin = rope_freqs(cfg, positions[None])
         pidx = positions // page_size
@@ -793,9 +888,10 @@ def verify_paged_rows(params: dict, tokens: jax.Array, caches: list[dict],
         new_caches = []
         for layer in range(cfg.n_layers):
             p = _layer_params(params, layer)
+            ll = _lora_at_layer(lora, layer)
             cache = carry[layer]
             h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
-            q, k, v = _qkv(h, p, cfg, cos, sin)            # [1,S1,H/KVH,D]
+            q, k, v = _qkv(h, p, cfg, cos, sin, ll, sl)    # [1,S1,H/KVH,D]
             k_pages = cache["k"].at[page_ids, offsets].set(
                 k[0].astype(cache["k"].dtype))
             v_pages = cache["v"].at[page_ids, offsets].set(
@@ -819,16 +915,24 @@ def verify_paged_rows(params: dict, tokens: jax.Array, caches: list[dict],
                     jnp.reshape(start, (1,)).astype(jnp.int32),
                     jnp.full((1,), s1, jnp.int32),
                     scale=scale).astype(cfg.dtype)
-            x = x + attn.reshape(1, s1, -1) @ p["wo"]
+            proj = attn.reshape(1, s1, -1)
+            y = proj @ p["wo"]
+            if ll is not None:
+                y = _lora_add(y, proj, ll, "wo", sl)
+            x = x + y
             x, _ = _mlp_block(x, p, cfg)
             new_caches.append({"k": k_pages, "v": v_pages})
         x = rms_norm(x, params["final_norm"], cfg.norm_eps)
         logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"],
-                            preferred_element_type=jnp.float32)[0]
-        return new_caches, logits
+                            preferred_element_type=jnp.float32)
+        if lora is not None and "lm_head.A" in lora:
+            logits = _lora_add(logits, x, lora, "lm_head", sl)
+        return new_caches, logits[0]
 
-    caches, logits = jax.lax.scan(
-        body, caches, (tokens, bt_rows, starts))
+    xs = (tokens, bt_rows, starts)
+    if lora is not None:
+        xs = xs + (slots,)
+    caches, logits = jax.lax.scan(body, caches, xs)
     return logits, caches                                  # [R, S1, V]
 
 

@@ -33,9 +33,14 @@ from typing import Any, Optional
 
 
 class PrefixDirectoryClient:
-    """One per LLMServer replica (base engine only — LoRA-merged engines
-    produce different KV for the same tokens, so their pages must never
-    enter the shared-by-model directory)."""
+    """One per LLMServer replica, on the replica's PRIMARY paged engine.
+
+    LoRA-merged side engines stay out (different KV for the same
+    tokens, unsalted chains would collide). The batched multi-LoRA
+    path shares the primary engine safely: its requests hash with a
+    per-(adapter_id, version) salt (llm/multilora/manager.prefix_salt),
+    so directory keys are tenant-scoped by construction — a hit can
+    only come from the same adapter at the same version."""
 
     def __init__(self, model_id: str):
         self.dir_name = f"serve:prefix:{model_id}"
@@ -83,14 +88,16 @@ class PrefixDirectoryClient:
 
     # -- import ----------------------------------------------------------
 
-    def maybe_import(self, engine, steplock, prompt) -> int:
+    def maybe_import(self, engine, steplock, prompt,
+                     salt: bytes = b"") -> int:
         """Admission-time cross-replica import. Returns pages imported
         (0 on local-hit, no-entry, or any failure — all of which just
         mean a cold prefill). Called on a request thread; `steplock`
         serializes the cache scatter against the engine loop (the same
-        contract PD-disagg's import_prefill rides)."""
+        contract PD-disagg's import_prefill rides). ``salt`` must match
+        the submitting request's prefix_salt (tenant-scoped chains)."""
         try:
-            hashes = engine.hash_prompt(prompt)
+            hashes = engine.hash_prompt(prompt, salt=salt)
         except Exception:
             return 0
         if not hashes:

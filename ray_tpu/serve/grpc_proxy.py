@@ -82,32 +82,55 @@ class GrpcProxyActor:
             return None
         return cap
 
-    def _admit(self, app: str, context) -> bool:
+    def _admit(self, app: str, context, tenant: str = "") -> bool:
         """True = admitted (caller must _release); aborts the rpc with
-        RESOURCE_EXHAUSTED when the app is past budget."""
+        RESOURCE_EXHAUSTED when the app — or the request's TENANT share
+        of it (cfg.serve_tenant_max_share, same quota rule as the HTTP
+        gate) — is past budget."""
         import grpc
+
+        from ..core.config import cfg
         bound = self._budget_for(app)
+        if not cfg.serve_tenant_fair:
+            tenant = ""
+        reason = "queue_full"
         with self._adm_lock:
             cur = self._inflight.get(app, 0)
+            t_bound = None
+            if bound is not None and tenant and \
+                    cfg.serve_tenant_max_share < 1.0:
+                t_bound = max(1, int(bound * cfg.serve_tenant_max_share))
+            t_cur = self._inflight.get((app, tenant), 0) if tenant else 0
             if bound is not None and cur >= bound:
                 shed = True
+            elif t_bound is not None and t_cur >= t_bound:
+                shed, reason = True, "tenant_quota"
             else:
                 self._inflight[app] = cur + 1
+                if tenant:
+                    self._inflight[(app, tenant)] = t_cur + 1
                 shed = False
         if shed:
             try:
                 from . import metrics as sm
                 sm.admission_shed().inc(1.0, tags={
-                    "app": app, "deployment": "", "reason": "queue_full"})
+                    "app": app, "deployment": "", "reason": reason})
+                if tenant:
+                    sm.tenant_requests().inc(1.0, tags={
+                        "app": app, "deployment": "", "tenant": tenant,
+                        "outcome": "shed"})
             except Exception:
                 pass  # telemetry must never fail a request
             context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
                           "overloaded; retry_after_s=1")
         return True
 
-    def _release(self, app: str):
+    def _release(self, app: str, tenant: str = ""):
         with self._adm_lock:
             self._inflight[app] = max(0, self._inflight.get(app, 1) - 1)
+            if tenant and (app, tenant) in self._inflight:
+                self._inflight[(app, tenant)] = max(
+                    0, self._inflight[(app, tenant)] - 1)
 
     def start(self) -> int:
         import grpc
@@ -198,7 +221,9 @@ class GrpcProxyActor:
         except Exception as e:  # noqa: BLE001 — bad envelope
             import grpc
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, repr(e))
-        self._admit(app, context)
+        from .frontdoor.admission import resolve_tenant
+        tenant = resolve_tenant(None, payload)
+        self._admit(app, context, tenant)
         try:
             h = self._handle_for(app, method, False, model_id)
             resp = (h.remote(payload) if payload is not None
@@ -208,7 +233,7 @@ class GrpcProxyActor:
         except Exception as e:  # noqa: BLE001 — map to grpc status
             self._typed_abort(context, e)
         finally:
-            self._release(app)
+            self._release(app, tenant)
 
     def _call_stream(self, request_bytes: bytes, context):
         try:
@@ -216,7 +241,9 @@ class GrpcProxyActor:
         except Exception as e:  # noqa: BLE001 — bad envelope
             import grpc
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, repr(e))
-        self._admit(app, context)
+        from .frontdoor.admission import resolve_tenant
+        tenant = resolve_tenant(None, payload)
+        self._admit(app, context, tenant)
         try:
             h = self._handle_for(app, method, True, model_id)
             gen = (h.remote(payload) if payload is not None
@@ -229,7 +256,7 @@ class GrpcProxyActor:
         except Exception as e:  # noqa: BLE001
             self._typed_abort(context, e)
         finally:
-            self._release(app)
+            self._release(app, tenant)
 
     def stop(self):
         if self._server is not None:

@@ -31,6 +31,11 @@ Metric names and label sets:
       429s by reason: queue_full | slo | deadline)
   rtpu_serve_admission_queue_wait_seconds{app,deployment} histogram
   rtpu_serve_admission_inflight{app,deployment,proxy}     gauge
+  rtpu_serve_tenant_requests_total{app,deployment,tenant,outcome} counter
+      (per-tenant admission outcomes: admitted | shed; tenant ids are
+      clamped to a bounded tracked set per gate — see
+      cfg.serve_tenant_max_tracked — so cardinality stays bounded)
+  rtpu_serve_tenant_inflight{app,deployment,tenant,proxy} gauge
   rtpu_serve_proxies                                      gauge
   rtpu_serve_prefix_directory_hits_total{model}           counter
   rtpu_serve_prefix_directory_misses_total{model}         counter
@@ -161,6 +166,22 @@ def admission_inflight() -> Gauge:
                    tag_keys=("app", "deployment", "proxy"))
 
 
+def tenant_requests() -> Counter:
+    return _metric(Counter, "rtpu_serve_tenant_requests_total",
+                   "per-tenant admission outcomes at the front door "
+                   "(outcome: admitted | shed); only requests that "
+                   "resolve a tenant id mint series, and gate-side "
+                   "bucketing bounds the tenant label set",
+                   tag_keys=("app", "deployment", "tenant", "outcome"))
+
+
+def tenant_inflight() -> Gauge:
+    return _metric(Gauge, "rtpu_serve_tenant_inflight",
+                   "admission slots a tenant currently holds at this "
+                   "proxy",
+                   tag_keys=("app", "deployment", "tenant", "proxy"))
+
+
 def proxy_count() -> Gauge:
     return _metric(Gauge, "rtpu_serve_proxies",
                    "live controller-managed proxy actors")
@@ -238,6 +259,10 @@ def metrics_summary() -> dict:
           most-loaded process}
       prefix_cache — {hits, misses, evictions, tokens_saved, hit_rate,
           cached_pages: {<engine>: pages on the deepest-cache process}}
+      tenants — {<tenant>: {admitted, shed}} per-tenant admission
+          outcomes (front-door fairness/quota counter-verification)
+      lora — {requests, hits, loads, evictions, swaps, publishes,
+          resident_adapters} multi-LoRA lifecycle counters
       requests — {proxy, handle, replica, errors} cumulative counts
     Worker-side series ship on a ~2s cadence; a summary taken immediately
     after traffic may trail by one flush tick.
@@ -312,6 +337,40 @@ def metrics_summary() -> dict:
         }
         if qw is not None:
             out["admission"]["queue_wait"] = qw
+    trec = store.get("rtpu_serve_tenant_requests_total")
+    if trec:
+        tenants: dict = {}
+        for kk, vv in trec["series"].items():
+            ten = next((v for k, v in kk if k == "tenant"), "")
+            outcome = next((v for k, v in kk if k == "outcome"), "")
+            if ten:
+                tenants.setdefault(ten, {"admitted": 0.0, "shed": 0.0})
+                tenants[ten][outcome] = \
+                    tenants[ten].get(outcome, 0.0) + vv
+        if tenants:
+            out["tenants"] = tenants
+    lora_req = _counter_total(store.get("rtpu_llm_lora_requests_total"))
+    lora_loads = _counter_total(store.get("rtpu_llm_lora_loads_total"))
+    if lora_req or lora_loads:
+        resident: dict = {}
+        rec = store.get("rtpu_llm_lora_resident_adapters")
+        if rec:
+            for kk, vv in rec["series"].items():
+                eng = next((v for k, v in kk if k == "engine"), "")
+                resident[eng] = max(resident.get(eng, 0.0), vv)
+        out["lora"] = {
+            "requests": lora_req,
+            "hits": _counter_total(
+                store.get("rtpu_llm_lora_hits_total")),
+            "loads": lora_loads,
+            "evictions": _counter_total(
+                store.get("rtpu_llm_lora_evictions_total")),
+            "swaps": _counter_total(
+                store.get("rtpu_llm_lora_swaps_total")),
+            "publishes": _counter_total(
+                store.get("rtpu_llm_lora_publishes_total")),
+            "resident_adapters": resident,
+        }
     dhits = _counter_total(
         store.get("rtpu_serve_prefix_directory_hits_total"))
     dmiss = _counter_total(

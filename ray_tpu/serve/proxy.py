@@ -269,14 +269,28 @@ class ProxyActor:
         if not meta["route"]:
             meta["route"] = "/" + app_name
 
+        # body parse BEFORE the gate: tenant resolution (adapter id /
+        # body fields) needs it, and a shed should not have done any
+        # replica work anyway
+        payload: Optional[dict] = None
+        if request.can_read_body:
+            try:
+                payload = await request.json()
+            except Exception:
+                payload = {"body": (await request.read()).decode(
+                    errors="replace")}
+
         # -- admission gate (frontdoor/admission.py): budget-admit,
-        # bounded-queue, or shed BEFORE any replica work happens --------
+        # bounded-queue (weighted-fair per tenant), or shed BEFORE any
+        # replica work happens ------------------------------------------
         from ..core.config import cfg as _cfg
         release = None
         if _cfg.serve_admission_control:
-            from .frontdoor.admission import ShedError
+            from .frontdoor.admission import ShedError, resolve_tenant
+            tenant = resolve_tenant(request.headers, payload)
             try:
-                release = await self._admission.acquire(app_name, ingress)
+                release = await self._admission.acquire(
+                    app_name, ingress, tenant)
             except ShedError as shed:
                 return web.json_response(
                     {"error": "overloaded", "reason": shed.reason,
@@ -287,26 +301,18 @@ class ProxyActor:
         t_adm = _time.perf_counter()
         try:
             return await self._dispatch_admitted(
-                request, rid, meta, app_name, ingress, method)
+                request, rid, meta, app_name, ingress, method, payload)
         finally:
             if release is not None:
                 release(_time.perf_counter() - t_adm)
 
     async def _dispatch_admitted(self, request, rid: str, meta: dict,
                                  app_name: str, ingress: str,
-                                 method: str):
+                                 method: str, payload: Optional[dict]):
         from aiohttp import web
 
         from ..exceptions import (ActorDiedError, GetTimeoutError,
                                   WorkerCrashedError)
-
-        payload: Optional[dict] = None
-        if request.can_read_body:
-            try:
-                payload = await request.json()
-            except Exception:
-                payload = {"body": (await request.read()).decode(
-                    errors="replace")}
 
         # session affinity across the fleet: an explicit session header
         # becomes the request's affinity key (handle._affinity_key), so
@@ -371,6 +377,13 @@ class ProxyActor:
                 return web.json_response(
                     {"error": "replica_unavailable",
                      "detail": "no replicas"},
+                    status=503, headers={"Retry-After": "1"})
+            if str(e).startswith("overloaded") or "overloaded:" in str(e):
+                # replica-side overload raised as a typed marker (e.g.
+                # multi-LoRA: every adapter slot live) — retryable, not
+                # a bare 500
+                return web.json_response(
+                    {"error": "overloaded", "detail": str(e)[:200]},
                     status=503, headers={"Retry-After": "1"})
             raise
         if want_stream:

@@ -553,6 +553,39 @@ def test_gl007_data_namespace_lookalikes_rejected():
 # GL008 swallowed exceptions
 # ------------------------------------------------------------------ #
 
+def test_gl007_multitenant_families_allowed():
+    """The multi-tenant serving families ride the existing llm/serve
+    namespaces (rtpu_llm_lora_*, rtpu_serve_tenant_*): first-class, no
+    allowlist change needed — pinned here so a namespace rename can't
+    silently orphan them from dashboards/metrics_summary()."""
+    src = """
+        from ray_tpu.util.metrics import Counter, Gauge, cached_metric
+
+        OK1 = Counter("rtpu_llm_lora_loads_total")
+        OK2 = Gauge("rtpu_llm_lora_resident_adapters")
+        OK3 = Counter("rtpu_serve_tenant_requests_total",
+                      tag_keys=("app", "deployment", "tenant",
+                                "outcome"))
+
+        def ok_cached():
+            return cached_metric(Gauge, "rtpu_serve_tenant_inflight")
+    """
+    assert lint(src, rules={"GL007"}) == []
+
+
+def test_gl007_multitenant_lookalikes_rejected():
+    src = """
+        from ray_tpu.util.metrics import Counter, cached_metric
+
+        BAD1 = Counter("rtpu_lora_loads_total")
+        BAD2 = cached_metric(Counter, "rtpu_tenant_requests_total")
+        BAD3 = Counter("rtpu_llm_lora_Swaps_total")
+    """
+    found = lint(src, rules={"GL007"})
+    assert len(found) == 3
+    assert all("does not match" in f.message for f in found)
+
+
 def test_gl008_positive():
     src = """
         def f(x):

@@ -126,7 +126,7 @@ def test_admission_budget_queue_and_shed():
         r2(0.01)
         r3(0.01)
         g = ac.gate_for("app", "dep")
-        assert g.inflight == 0 and len(g._parked) == 0
+        assert g.inflight == 0 and g.parked_total() == 0
         # double-release is a no-op
         r3(0.01)
         assert g.inflight == 0
