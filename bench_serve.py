@@ -289,8 +289,12 @@ def _soak():
     CPU-only by design: the gates under test (zero 500s, sheds are
     429-with-Retry-After ONLY, bounded p99 for admitted traffic,
     cross-replica prefix-directory hits bit-identical to cold prefill)
-    are data-plane properties, not device speed. Prints ONE JSON line;
-    vs_baseline = 1.0 iff every gate holds.
+    are data-plane properties, not device speed. Prints the headline
+    JSON line (vs_baseline = 1.0 iff every gate holds) plus an
+    admission-counter line and a ``serve_soak_slo_verdict`` line — the
+    shipped serve SLOs evaluated against the soak's own TSDB capture
+    (the burn engine must flag the deliberate shed storm and clear the
+    zero-500s error ratio); ``bench_trend --history`` folds all three.
 
     Flags: ``--connections N`` (default 2500), ``--quick`` (400)."""
     import asyncio
@@ -311,7 +315,9 @@ def _soak():
     if "--connections" in sys.argv:
         conns = int(sys.argv[sys.argv.index("--connections") + 1])
 
-    rcfg.override(worker_prestart=2)
+    # fast TSDB tick so the soak's own capture carries enough points
+    # for the SLO burn windows (fast-short = 20 ticks = 10 s here)
+    rcfg.override(worker_prestart=2, tsdb_scrape_s=0.5)
     ray_tpu.init(num_cpus=2, object_store_memory=512 << 20)
     ecfg = PagedEngineConfig(
         model=llama.llama_tiny(vocab_size=258, max_seq_len=256),
@@ -430,6 +436,38 @@ def _soak():
                       "value": ms.get("admission"),
                       "unit": "admitted/shed counters + queue waits"},
                      default=str))
+
+    # SLO verdict against the soak's OWN TSDB capture: the burn engine
+    # must DETECT the deliberate shed storm (shed_ratio burning) while
+    # correctly reporting the zero-500s run healthy (error_ratio ok) —
+    # a counter-verified exercise of the whole obs pipeline under real
+    # overload. Folded round-over-round by bench_trend --history.
+    from ray_tpu import state as state_mod
+    from ray_tpu.core import runtime as rt_mod
+    rt = rt_mod.get_runtime_if_exists()
+    if rt is not None and getattr(rt, "obs", None) is not None:
+        rt.obs.scrape_once()    # fold the final post-load counters
+    slo = state_mod.slo_report()
+    rows = {r["slo"]: r for r in slo.get("slos", [])}
+    shed_row = rows.get("shed_ratio", {})
+    err_row = rows.get("error_ratio", {})
+    slo_gates = {
+        "all_shipped_slos_evaluated": len(rows) >= 4,
+        "shed_storm_detected": (shed_row.get("state") != "ok"
+                                or (shed_row.get("burn_fast")
+                                    or [0.0])[0] > 1.0),
+        "error_ratio_ok": err_row.get("state", "ok") == "ok",
+    }
+    print(json.dumps({
+        "metric": "serve_soak_slo_verdict",
+        "value": round((shed_row.get("burn_fast") or [0.0])[0], 3),
+        "unit": (f"shed_ratio fast-short burn rate (states="
+                 f"{slo.get('states')}, "
+                 f"tsdb {slo.get('tsdb', {}).get('series', 0)} series/"
+                 f"{slo.get('tsdb', {}).get('ticks', 0)} ticks, "
+                 f"slo_gates={slo_gates})"),
+        "vs_baseline": 1.0 if all(slo_gates.values()) else 0.0,
+    }))
     from bench import flight_report, trace_arg
     flight_report(trace_arg(sys.argv), trace_t0)
     serve.shutdown()

@@ -17,6 +17,8 @@ Commands:
     state tasks|actors|nodes|objects|jobs  (state API, ray list analog)
     stack [--all]   (live thread stacks cluster-wide, ray stack analog)
     doctor          (summary + stuck tasks + deadlocks + stacks + memory)
+    top [--window S] [--once]  (live serving table from the metrics TSDB)
+    slo             (SLO burn-rate report; exit 1 when paging)
     timeline --out FILE
 """
 from __future__ import annotations
@@ -407,6 +409,157 @@ def cmd_doctor(args) -> int:
         ray.shutdown()
 
 
+def _top_frame(state_mod, window_s: float) -> str:
+    """One rendered `top` frame: per-deployment live table out of the
+    head TSDB (rates and windowed quantiles, not spot reads)."""
+    def last_by_dep(hist):
+        """(app, deployment) -> max of each matching series' newest
+        sample (gauges are one series per deployment; max covers
+        stragglers from a replaced series)."""
+        out = {}
+        for s in hist["series"]:
+            if not s["points"]:
+                continue
+            key = dict(s["key"])
+            k = (key.get("app", ""), key.get("deployment", ""))
+            out[k] = max(out.get(k, 0.0), s["points"][-1][1])
+        return out
+
+    def by_group(hist, field):
+        """(app, deployment) -> server-computed per-group aggregate."""
+        out = {}
+        for row in hist.get("groups", []):
+            k = (row["key"].get("app", ""),
+                 row["key"].get("deployment", ""))
+            out[k] = row.get(field)
+        return out
+
+    GB = ("app", "deployment")
+    # windowed queries even for last-value reads: without a window the
+    # head materializes + pickles every retained point (up to
+    # retention_points per series) just for points[-1]
+    replicas = last_by_dep(state_mod.metrics_history(
+        "rtpu_serve_replicas", None, window_s))
+    ongoing = last_by_dep(state_mod.metrics_history(
+        "rtpu_serve_queue_depth", None, window_s))
+    deps = sorted(set(replicas) | set(ongoing))
+    lines = []
+    ttft = state_mod.metrics_history(
+        "rtpu_llm_ttft_seconds", None, window_s,
+        quantiles=(0.5, 0.95))["quantiles"]
+    slo = state_mod.slo_report()
+    states = slo.get("states", {})
+    badge = " ".join(f"{n}:{s}" for n, s in sorted(states.items())) \
+        or "(no slos evaluated yet)"
+    t95 = ttft.get("0.95")
+    lines.append(
+        f"cluster ttft p50/p95 = "
+        f"{_ms(ttft.get('0.5'))}/{_ms(t95)}  |  slo: {badge}")
+    lines.append(f"{'deployment':<28}{'repl':>5}{'ongoing':>8}"
+                 f"{'rps':>8}{'p95 ms':>8}{'shed/s':>8}{'queued':>8}")
+    # one RPC per COLUMN (server-side group_by), not one per deployment:
+    # a 50-deployment cluster renders a frame in the same ~7 round-trips
+    # as a 1-deployment one
+    rps_by = by_group(state_mod.metrics_history(
+        "rtpu_serve_replica_requests_total", None, window_s,
+        group_by=GB), "rate_per_s")
+    shed_by = by_group(state_mod.metrics_history(
+        "rtpu_serve_admission_shed_total", None, window_s,
+        group_by=GB), "rate_per_s")
+    p95_by = by_group(state_mod.metrics_history(
+        "rtpu_serve_replica_latency_seconds", None, window_s,
+        quantiles=(0.95,), group_by=GB), "quantiles")
+    queued_by: dict = {}
+    for s in state_mod.metrics_history(
+            "rtpu_serve_tenant_queued", None, window_s)["series"]:
+        if s["points"]:
+            key = dict(s["key"])
+            k = (key.get("app", ""), key.get("deployment", ""))
+            queued_by[k] = queued_by.get(k, 0.0) + s["points"][-1][1]
+    for app, dep in deps:
+        k = (app, dep)
+        p95 = (p95_by.get(k) or {}).get("0.95")
+        lines.append(f"{app + '/' + dep:<28}"
+                     f"{replicas.get(k, 0):>5.0f}"
+                     f"{ongoing.get(k, 0):>8.0f}"
+                     f"{rps_by.get(k) or 0.0:>8.2f}{_ms(p95):>8}"
+                     f"{shed_by.get(k) or 0.0:>8.2f}"
+                     f"{queued_by.get(k, 0.0):>8.0f}")
+    if not deps:
+        lines.append("(no serve deployments reporting)")
+    return "\n".join(lines)
+
+
+def _ms(v) -> str:
+    return "-" if v is None else f"{v * 1e3:.0f}"
+
+
+def cmd_top(args) -> int:
+    """Live refreshing cluster serving table (`top` for deployments):
+    replicas, ongoing, RPS, windowed p95, shed rate and admission queue
+    depth per deployment — every number a TSDB rate/quantile over
+    --window seconds, so it reads as a trendline, not a spot sample."""
+    ray, rt, _ = _client(args.address)
+    try:
+        from . import state as state_mod
+        while True:
+            try:
+                frame = _top_frame(state_mod, args.window)
+            except RuntimeError as e:
+                # clusters started with tsdb_enable=0 have no history
+                print(f"cli top needs the metrics TSDB: {e}",
+                      file=sys.stderr)
+                return 1
+            if args.once:
+                print(frame)
+                return 0
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        ray.shutdown()
+
+
+def cmd_slo(args) -> int:
+    """SLO burn-rate report: per objective the alert state, fast/slow
+    window burn rates and error budget. Exit 1 when anything is paging
+    so scripts can gate on it (the `cli doctor` convention)."""
+    ray, rt, _ = _client(args.address)
+    try:
+        from . import state as state_mod
+        try:
+            rep = state_mod.slo_report()
+        except RuntimeError as e:
+            # clusters started with tsdb_enable=0 have no SLO engine
+            print(f"cli slo needs the metrics TSDB: {e}",
+                  file=sys.stderr)
+            return 1
+        rows = rep.get("slos", [])
+        if not rows:
+            print("(slo engine has not evaluated yet — is "
+                  "cfg.tsdb_enable on?)")
+            return 0
+        print(f"{'slo':<14}{'state':<7}{'objective':<18}"
+              f"{'burn fast':>16}{'burn slow':>16}  windows")
+        for r in rows:
+            bf = "/".join(f"{b:.2f}" for b in r["burn_fast"])
+            bs = "/".join(f"{b:.2f}" for b in r["burn_slow"])
+            w = r["windows_s"]["fast"]
+            print(f"{r['slo']:<14}{r['state']:<7}"
+                  f"{r['objective']:<18}{bf:>16}{bs:>16}  "
+                  f"{w[0]:.0f}s/{w[1]:.0f}s")
+        ts = rep.get("tsdb", {})
+        print(f"tsdb: {ts.get('series', 0)} series, "
+              f"{ts.get('samples_recorded', 0)} samples, "
+              f"{ts.get('ticks', 0)} scrapes @ "
+              f"{ts.get('period_s', 0)}s")
+        return 1 if "page" in rep.get("states", {}).values() else 0
+    finally:
+        ray.shutdown()
+
+
 def cmd_timeline(args) -> int:
     ray, rt, _ = _client(args.address)
     try:
@@ -516,6 +669,23 @@ def build_parser() -> argparse.ArgumentParser:
                                        "summary + hangs + stacks + memory")
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_doctor)
+
+    sp = sub.add_parser("top", help="live serving table from the "
+                                    "metrics TSDB (rates + windowed "
+                                    "quantiles per deployment)")
+    sp.add_argument("--window", type=float, default=60.0,
+                    help="rate/quantile window in seconds")
+    sp.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period")
+    sp.add_argument("--once", action="store_true",
+                    help="print one frame and exit (scripts/tests)")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_top)
+
+    sp = sub.add_parser("slo", help="SLO burn-rate report (exit 1 "
+                                    "when any objective is paging)")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_slo)
 
     sp = sub.add_parser("timeline", help="dump chrome trace")
     sp.add_argument("--out", default="timeline.json")

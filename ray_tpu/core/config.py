@@ -307,6 +307,49 @@ _FLAGS = [
          "__other__ bucket (tenant ids are client-controlled — "
          "unbounded ids must not grow gate state or metric "
          "cardinality)"),
+    # ---- metrics plane (ray_tpu/obs/) -------------------------------- #
+    Flag("tsdb_enable", True,
+         "head-side metrics TSDB (obs/tsdb.py): a scraper thread folds "
+         "the merged user-metric store into fixed-memory per-series "
+         "rings every tsdb_scrape_s, powering metrics_history(), the "
+         "SLO burn-rate engine, cli top/slo and signal-driven "
+         "autoscaling; off = instantaneous snapshots only (pre-PR-13 "
+         "behavior)"),
+    Flag("tsdb_scrape_s", 15.0,
+         "TSDB scrape tick; SLO burn windows scale with it (240 ticks "
+         "= the canonical 1h fast window at the 15 s default), so "
+         "tests with a 50 ms tick exercise the full page/warn ladder "
+         "in seconds"),
+    Flag("tsdb_retention_points", 2048,
+         "per-series ring capacity in samples (preallocated: 16 bytes "
+         "per point; 2048 x 15 s default = 8.5 h of history, enough "
+         "for the 6 h slow-burn window)"),
+    Flag("tsdb_max_series", 4096,
+         "hard cardinality cap across all (name, label-set) series; "
+         "past it, samples for unseen label sets fold into a per-name "
+         "__overflow__ sink — client-controlled labels can never grow "
+         "head memory (ceiling = (max_series + one sink per metric "
+         "NAME, code-controlled) x retention x 16 bytes, ~128 MiB at "
+         "the defaults)"),
+    Flag("serve_slo_ttft_s", 2.0,
+         "shipped TTFT SLO threshold: 95% of requests must see first "
+         "token within this many seconds (obs/slo.py ttft_p95)"),
+    Flag("serve_slo_e2e_s", 10.0,
+         "shipped end-to-end latency SLO threshold: 99% of proxied "
+         "requests complete within this many seconds (e2e_p99)"),
+    Flag("serve_slo_error_ratio", 0.01,
+         "shipped error-ratio SLO budget: at most this fraction of "
+         "proxy requests may error (error_ratio)"),
+    Flag("serve_slo_shed_ratio", 0.05,
+         "shipped admission shed-ratio SLO budget: at most this "
+         "fraction of arrivals may shed 429 (shed_ratio)"),
+    Flag("serve_autoscale_signals", "on",
+         "signal-driven autoscaling (obs/scraper.py autoscale_signals "
+         "composed into the serve controller's queue-depth rule): "
+         "scale OUT when the shed rate, TTFT/e2e burn rate, TTFT "
+         "slope or a per-tenant admission backlog says the SLO will "
+         "be violated — BEFORE the first 429; 'off' reproduces the "
+         "legacy ongoing-requests-only autoscaler exactly"),
     # ---- observability ----------------------------------------------- #
     Flag("metrics_export_port", 0,
          "Prometheus /metrics port (0 = ephemeral)"),

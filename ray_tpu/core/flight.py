@@ -137,6 +137,9 @@ DATA_STAGE_BEGIN = 80  # B  (stage_idx, worker_idx)
 DATA_STAGE_END = 81    # E  (stage_idx, blocks)
 DATA_BLOCK = 82        # i  (stage_idx, block_idx)
 
+# metrics plane (obs/slo.py) — SLO alert state-machine transitions
+SLO_TRANSITION = 90   # i  (slo_idx, to_state, from_state) 0 ok/1 warn/2 page
+
 # jax step profiling (util/profiling.py)
 STEP_BEGIN = 70       # B  (kind,)
 STEP_END = 71         # E  (kind,)
@@ -189,6 +192,8 @@ CODES: dict[int, tuple] = {
     DATA_STAGE_END: ("data_stage", "data", "E", None,
                      ("stage", "blocks")),
     DATA_BLOCK: ("data_block", "data", "i", None, ("stage", "idx")),
+    SLO_TRANSITION: ("slo_transition", "obs", "i", None,
+                     ("slo", "to", "from")),
     STEP_BEGIN: ("jax_step", "jax", "B", None, ("kind",)),
     STEP_END: ("jax_step", "jax", "E", None, ("kind",)),
     JIT_COMPILE_BEGIN: ("jit_compile", "jax", "B", None, ("key",)),

@@ -605,6 +605,14 @@ class Runtime:
                          name="rtpu-rebalance").start()
         threading.Thread(target=self._stall_watchdog_loop, daemon=True,
                          name="rtpu-stall-watchdog").start()
+        # metrics plane (ray_tpu/obs): TSDB scraper + SLO engine. Rides
+        # the merged user-metric store — no new wire frames; remote
+        # drivers query it over metrics_history/slo_report/obs_signals
+        # in _RPC_METHODS
+        self.obs = None
+        if cfg.tsdb_enable:
+            from ..obs.scraper import MetricsScraper
+            self.obs = MetricsScraper(self).start()
 
         # cross-node data plane: serve this node's store to pullers
         # (object_manager.h:119 Push/Pull analog; object_transfer.py)
@@ -1185,6 +1193,8 @@ class Runtime:
                     "state_list", "state_summary",
                     "memory_summary", "autoscaler_status",
                     "user_metrics_dump", "pubsub_poll",
+                    "metrics_history", "metrics_names", "slo_report",
+                    "obs_signals",
                     "kv_put", "kv_get", "kv_del", "kv_keys", "locate",
                     "locate_many", "request_resources_rpc",
                     "job_submit", "job_list", "job_status", "job_logs",
@@ -2471,6 +2481,82 @@ class Runtime:
             return {n: {"kind": r["kind"], "desc": r["desc"],
                         "series": dict(r["series"])}
                     for n, r in self.user_metrics.items()}
+
+    # -- metrics plane (ray_tpu/obs): TSDB history + SLOs + signals ---- #
+
+    def _obs(self):
+        if self.obs is None:
+            raise RuntimeError(
+                "metrics TSDB disabled (cfg.tsdb_enable=0); history/SLO "
+                "queries need the head scraper")
+        return self.obs
+
+    def metrics_history(self, name: str, tags=None, window_s=None,
+                        quantiles=None, group_by=None) -> dict:
+        """RPC: range-query the head TSDB. With ``quantiles``, also fold
+        the matching histogram bucket series into windowed quantile
+        values (state.metrics_history / cli top / dashboard). With
+        ``group_by`` (label names), additionally return per-group
+        rate/quantile aggregates under "groups" — one round-trip serves
+        a whole `cli top` column instead of one RPC per deployment."""
+        obs = self._obs()
+        tags = dict(tags) if tags else None
+        out = {
+            "name": name,
+            "kind": obs.tsdb.kind_of(name),
+            "series": obs.tsdb.query(name, tags, window_s),
+            "scrape_s": obs.tsdb.scrape_s,
+        }
+        qs = tuple(float(q) for q in quantiles) if quantiles else None
+        if qs:
+            out["quantiles"] = dict(zip(
+                (str(q) for q in qs),
+                obs.tsdb.histogram_quantiles(name, tags, window_s, qs)))
+        if out["kind"] == "counter":
+            out["rate_per_s"] = obs.tsdb.rate(name, tags, window_s)
+        if group_by:
+            gb = tuple(group_by)
+            keys: list[dict] = []
+            for s in out["series"]:
+                key = dict(s["key"])
+                # only labels the series actually carries: a "" filler
+                # could never subset-match back into the TSDB
+                gk = {k: key[k] for k in gb if k in key}
+                if gk not in keys:
+                    keys.append(gk)
+            rows = []
+            for gk in keys:
+                # group aggregates honor the caller's tags filter too
+                qtags = {**tags, **gk} if tags else (gk or None)
+                row: dict = {"key": gk}
+                if qs:
+                    row["quantiles"] = dict(zip(
+                        (str(q) for q in qs),
+                        obs.tsdb.histogram_quantiles(
+                            name, qtags, window_s, qs)))
+                if out["kind"] == "counter":
+                    row["rate_per_s"] = obs.tsdb.rate(name, qtags,
+                                                      window_s)
+                rows.append(row)
+            out["groups"] = rows
+        return out
+
+    def metrics_names(self) -> list[str]:
+        return self._obs().tsdb.names()
+
+    def slo_report(self) -> dict:
+        """RPC: the SLO engine's latest evaluation + TSDB health."""
+        obs = self._obs()
+        rep = dict(obs.engine.report())
+        rep["tsdb"] = obs.stats()
+        return rep
+
+    def obs_signals(self, app: str, deployment: str) -> dict:
+        """RPC: the autoscaler's composed scale-out signals for one
+        deployment (serve controller, once per scrape period)."""
+        from ..obs.scraper import autoscale_signals
+        obs = self._obs()
+        return autoscale_signals(obs.tsdb, obs.engine, app, deployment)
 
     def _rebalance_pipelines_locked(self):
         """A worker just went idle with nothing pending: if another worker
@@ -4037,6 +4123,8 @@ class Runtime:
         # of the tables (watch-proc death path), and a successor must see
         # them as they were while alive
         self.memory_monitor.stop()
+        if self.obs is not None:
+            self.obs.stop()
         if self._snapshot_stop is not None:
             self._snapshot_stop.set()
         try:

@@ -18,6 +18,9 @@ separate process tree, no node agents, no build step.
     #     /api/serve   | /api/serve_metrics | /api/logs
     #     /api/stacks  | /api/hangs   (stall doctor: live stacks + hang
     #                                  diagnosis, see core/stacks.py)
+    # GET /api/metrics_history?name=N[&window=S][&tags=JSON]
+    #     [&quantiles=0.5,0.95]      -> TSDB range query (ray_tpu/obs)
+    # GET /api/slo                   -> SLO burn-rate report
     # GET /api/task/{id}   -> full task record + its timeline events
     # GET /api/actor/{id}  -> full actor record + per-call queues
     # GET /api/log?file=worker-X.log&tail=N -> log tail (session dir only)
@@ -45,6 +48,8 @@ _PAGE = """<!DOCTYPE html>
  .ALIVE, .FINISHED, .SUCCEEDED, .alive { background: #d4f7d4; }
  .DEAD, .FAILED, .ERROR, .dead { background: #f7d4d4; }
  .RUNNING, .PENDING, .busy { background: #fdf3cf; }
+ .ok { background: #d4f7d4; } .warn { background: #fdf3cf; }
+ .page { background: #f7d4d4; }
 </style></head>
 <body>
 <h1>ray_tpu dashboard</h1>
@@ -54,6 +59,7 @@ _PAGE = """<!DOCTYPE html>
 <h2>Actors</h2><table id="actors"></table>
 <h2>Jobs</h2><table id="jobs"></table>
 <h2>Serve</h2><table id="serve"></table>
+<h2>SLOs</h2><table id="slo"></table>
 <h2>Autoscaler</h2><table id="autoscaler"></table>
 <h2>Recent tasks</h2><table id="tasks"></table>
 <h2>Memory <small>(<a href="api/timeline" download="timeline.json">
@@ -142,6 +148,13 @@ async function refresh() {
     fill('serve', ['app', 'deployment', 'status', 'replicas'], rows);
   } catch (e) { fill('serve', ['(serve not running)'], []); }
   try {
+    const so = await (await fetch('api/slo')).json();
+    fill('slo', ['slo', 'state', 'objective', 'burn fast', 'burn slow'],
+         (so.slos || []).map(r => [r.slo, {pill: r.state}, r.objective,
+           (r.burn_fast || []).map(b => b.toFixed(2)).join('/'),
+           (r.burn_slow || []).map(b => b.toFixed(2)).join('/')]));
+  } catch (e) { fill('slo', ['(no slo engine)'], []); }
+  try {
     const ac = await (await fetch('api/autoscaler')).json();
     fill('autoscaler', ['instance', 'type', 'state', 'provider_id', 'retries'],
          (ac.instances || []).map(r => [r.instance, r.type, {pill: r.state},
@@ -216,6 +229,30 @@ def start_dashboard(port: int = 0, host: str = "127.0.0.1") -> int:
                 from .serve.metrics import metrics_summary
                 loop = asyncio.get_event_loop()
                 out = await loop.run_in_executor(None, metrics_summary)
+            elif kind == "metrics_history":
+                # TSDB range query: ?name=...&window=S&tags={"app":..}
+                # &quantiles=0.5,0.95 — the trend source for any panel
+                name = request.query.get("name", "")
+                if not name:
+                    return web.json_response(
+                        {"error": "name parameter required"},
+                        status=400)
+                window = request.query.get("window")
+                tags = request.query.get("tags")
+                qs = request.query.get("quantiles")
+                # TSDB lock + point materialization (up to
+                # max_series x retention tuples): keep it off the
+                # dashboard event loop, same rule as the serve branch
+                loop = asyncio.get_event_loop()
+                out = await loop.run_in_executor(
+                    None, rt.metrics_history, name,
+                    json.loads(tags) if tags else None,
+                    float(window) if window else None,
+                    tuple(float(q) for q in qs.split(","))
+                    if qs else None)
+            elif kind == "slo":
+                loop = asyncio.get_event_loop()
+                out = await loop.run_in_executor(None, rt.slo_report)
             elif kind == "memory":
                 # head lock + per-object residency probes: keep it off
                 # the dashboard event loop (same rule as the serve branch)

@@ -334,6 +334,13 @@ class _DeploymentState:
         self.version = next(version_counter)
         self._last_scale_up = 0.0
         self._last_scale_down = 0.0
+        # cached TSDB autoscale signals (obs/scraper.py), refreshed at
+        # most once per scrape period per deployment; the remote fetch
+        # runs OFF the controller's event loop (_sig_fetching guards
+        # one in-flight refresh)
+        self._sig = None
+        self._sig_ts = 0.0
+        self._sig_fetching = False
         # long-poll wakeup (reference: _private/long_poll.py:222 — waiters
         # park on the event; bump() swaps in a fresh one)
         self.changed = asyncio.Event()
@@ -657,11 +664,31 @@ class ServeController:
     def _autoscale(self, st: _DeploymentState, cfg: AutoscalingConfig,
                    total_ongoing: int):
         """(reference: autoscaling_policy.py:12
-        _calculate_desired_num_replicas)"""
+        _calculate_desired_num_replicas) — the ongoing-requests rule,
+        composed with the TSDB signals (shed rate, TTFT/e2e burn rate,
+        TTFT slope, per-tenant admission backlog) so a deployment scales
+        OUT before the first 429 fires. cfg.serve_autoscale_signals=off
+        reproduces the legacy queue-depth-only decisions exactly: the
+        signal path then contributes nothing to ``desired``."""
         now = time.monotonic()
         desired = math.ceil(total_ongoing / max(cfg.target_ongoing_requests,
                                                 1e-9))
         desired = max(cfg.min_replicas, min(cfg.max_replicas, desired))
+        sig_reason = None
+        sig = self._signals_for(st)
+        if sig is not None and sig.get("scale_out"):
+            # step out by one replica per decision: the signals say
+            # "capacity is short", not by how much — the burn windows
+            # re-fire next period if one replica wasn't enough. A
+            # firing signal also vetoes any concurrent scale-DOWN
+            # (including at max_replicas, where stepped == target and
+            # the down branch's desired < target can no longer hold —
+            # an overloaded deployment at max must not oscillate)
+            legacy = desired
+            stepped = min(cfg.max_replicas, st.target + 1)
+            desired = max(desired, stepped)
+            if desired > st.target and stepped > legacy:
+                sig_reason = (sig.get("reasons") or ["signal"])[0]
         direction = None
         if desired > st.target and \
                 now - self._last(st, "up") >= cfg.upscale_delay_s:
@@ -679,8 +706,56 @@ class ServeController:
                 sm.autoscale_decisions().inc(1.0, tags={
                     "app": st.app, "deployment": st.spec.name,
                     "direction": direction})
+                if direction == "up" and sig_reason is not None:
+                    sm.autoscale_signal().inc(1.0, tags={
+                        "app": st.app, "deployment": st.spec.name,
+                        "reason": sig_reason})
             except Exception:
                 pass  # telemetry is best-effort here
+
+    def _signals_for(self, st: _DeploymentState) -> Optional[dict]:
+        """The deployment's cached TSDB scale-out signals; None when
+        signals are off, the TSDB is disabled, or the head is
+        unreachable — every failure mode falls back to the legacy
+        ongoing-requests rule. The remote fetch blocks up to the rpc
+        timeout when the head is wedged, so it runs in an executor
+        thread and THIS call returns the previous cache immediately —
+        the reconcile loop (replica/proxy respawn) must never stall
+        behind a slow head."""
+        from ..core.config import cfg
+        if str(cfg.serve_autoscale_signals).lower() in ("off", "0",
+                                                        "false"):
+            return None
+        now = time.monotonic()
+        refresh = max(0.25, min(float(cfg.tsdb_scrape_s), 15.0))
+        if (not st._sig_fetching
+                and (not st._sig_ts or now - st._sig_ts >= refresh)):
+            st._sig_fetching = True
+            st._sig_ts = now
+
+            def fetch():
+                sig = None
+                try:
+                    from ..core import runtime as rt_mod
+                    rt = rt_mod.get_runtime_if_exists()
+                    if isinstance(rt, rt_mod.Runtime):
+                        sig = rt.obs_signals(st.app, st.spec.name)
+                    elif rt is not None:
+                        sig = rt._rpc("obs_signals", st.app,
+                                      st.spec.name)
+                except Exception:
+                    sig = None  # TSDB off / head mid-restart: legacy
+                st._sig = sig
+                st._sig_fetching = False
+
+            try:
+                asyncio.get_running_loop().run_in_executor(None, fetch)
+            except RuntimeError:
+                # no running loop (unit tests drive _autoscale
+                # directly): the head-local path is lock-light and
+                # sub-ms, safe to run inline
+                fetch()
+        return st._sig
 
     @staticmethod
     def _last(st: _DeploymentState, which: str) -> float:

@@ -264,12 +264,15 @@ class AdmissionController:
         their app was deleted mid-wait."""
         for key in [k for k in self._gates if k not in live]:
             g = self._gates.pop(key)
-            for q in g._queues.values():
+            for tenant, q in g._queues.items():
                 for fut, _t in q:
                     if not fut.done():
                         fut.set_exception(ShedError("deadline", 1,
                                                     "deployment removed"))
                 q.clear()
+                # last-write-wins gauge: a removed deployment must not
+                # pin a stale queue depth on the TSDB forever
+                self._set_queued(key[0], key[1], g, tenant)
 
     def gate_for(self, app: str, deployment: str) -> \
             Optional[_DeploymentGate]:
@@ -315,12 +318,36 @@ class AdmissionController:
         fut = asyncio.get_event_loop().create_future()
         t0 = time.perf_counter()
         g.park(t, fut, t0)
+        self._set_queued(app, deployment, g, t)
         try:
             await asyncio.wait_for(fut, g.timeout_s)
         except asyncio.TimeoutError:
-            g.unpark(t, fut, t0)
+            if fut.done() and not fut.cancelled():
+                # same-tick race (Python >= 3.12 wait_for discards a
+                # completed result when the timer fires first): a
+                # releaser already transferred its slot to us — pass it
+                # onward or g.inflight leaks one budget slot forever
+                self._releaser(app, deployment, g, t)(None)
+            else:
+                g.unpark(t, fut, t0)
+            self._set_queued(app, deployment, g, t)
             self._count_shed(app, deployment, "deadline", g, t)
             raise ShedError("deadline", g.retry_after_s()) from None
+        except asyncio.CancelledError:
+            # client disconnected while parked: withdraw from the queue
+            # and re-record the gauge — rtpu_serve_tenant_queued feeds
+            # the tenant_queue autoscale signal, so a waiter that left
+            # without unparking would pin a stale backlog that scales
+            # the deployment out forever. If a releaser handed us its
+            # slot in the same tick (fut completed before the cancel
+            # landed), the pop-time bookkeeping already transferred the
+            # inflight count to us: release it onward.
+            if fut.done() and not fut.cancelled():
+                self._releaser(app, deployment, g, t)(None)
+            else:
+                g.unpark(t, fut, t0)
+            self._set_queued(app, deployment, g, t)
+            raise
         # a releaser handed us its slot (inflight + our tenant count
         # are already transferred/incremented by pop-time bookkeeping)
         self._count_admit(app, deployment, g, t,
@@ -349,6 +376,7 @@ class AdmissionController:
                 g._inflight_t[w_t] = g._inflight_t.get(w_t, 0) + 1
                 fut.set_result(None)
                 self._set_inflight(app, deployment, g, w_t)
+                self._set_queued(app, deployment, g, w_t)
             else:
                 g.inflight -= 1
             self._set_inflight(app, deployment, g, tenant)
@@ -379,6 +407,23 @@ class AdmissionController:
                 sm.tenant_requests().inc(1.0, tags={
                     "app": app, "deployment": deployment,
                     "tenant": tenant, "outcome": "shed"})
+        except Exception:
+            pass  # telemetry must never fail a request
+
+    def _set_queued(self, app, deployment, g, tenant=""):
+        """Per-tenant queue-depth gauge (the "" bucket doubles as the
+        deployment's plain admission backlog). The TSDB turns these
+        last-write samples into the per-tenant queue-depth SERIES the
+        adapter-aware autoscaling signal reads; the proc label keys the
+        head's death sweep (a killed proxy's backlog zeroes instead of
+        pinning the scale-out signal on forever)."""
+        try:
+            from ...llm.telemetry import _proc
+            from .. import metrics as sm
+            sm.tenant_queued().set(float(g.parked_of(tenant)), tags={
+                "app": app, "deployment": deployment,
+                "tenant": tenant, "proxy": self._proxy,
+                "proc": _proc()})
         except Exception:
             pass  # telemetry must never fail a request
 

@@ -36,6 +36,15 @@ Metric names and label sets:
       clamped to a bounded tracked set per gate — see
       cfg.serve_tenant_max_tracked — so cardinality stays bounded)
   rtpu_serve_tenant_inflight{app,deployment,tenant,proxy} gauge
+  rtpu_serve_tenant_queued{app,deployment,tenant,proxy,proc} gauge
+      (requests parked in a tenant's admission queue — the per-tenant
+      queue-depth series the adapter-aware autoscaler signal reads from
+      the TSDB; the proc label lets the head's worker-death sweep zero
+      a killed proxy's series so a stale backlog can't scale out
+      forever)
+  rtpu_serve_autoscale_signal_total{app,deployment,reason} counter
+      (TSDB-signal-driven scale-out decisions by triggering reason:
+      shed | burn | ttft_slope | tenant_queue)
   rtpu_serve_proxies                                      gauge
   rtpu_serve_prefix_directory_hits_total{model}           counter
   rtpu_serve_prefix_directory_misses_total{model}         counter
@@ -180,6 +189,26 @@ def tenant_inflight() -> Gauge:
                    "admission slots a tenant currently holds at this "
                    "proxy",
                    tag_keys=("app", "deployment", "tenant", "proxy"))
+
+
+def tenant_queued() -> Gauge:
+    # the proc label (host:pid) rides along so the head's worker-death
+    # sweep zeroes a killed proxy's series — this gauge DRIVES
+    # autoscaling, and a pinned stale backlog would scale out forever
+    return _metric(Gauge, "rtpu_serve_tenant_queued",
+                   "requests parked in a tenant's admission queue at "
+                   "this proxy (per-tenant queue depth; the "
+                   "adapter-aware autoscaling signal's input series)",
+                   tag_keys=("app", "deployment", "tenant", "proxy",
+                             "proc"))
+
+
+def autoscale_signal() -> Counter:
+    return _metric(Counter, "rtpu_serve_autoscale_signal_total",
+                   "scale-out decisions driven by the TSDB signals "
+                   "(obs/scraper.py autoscale_signals), by the reason "
+                   "that fired",
+                   tag_keys=("app", "deployment", "reason"))
 
 
 def proxy_count() -> Gauge:

@@ -217,7 +217,56 @@ def summary() -> dict:
     # stall-doctor watchdog health (scan counters only — a summary poll
     # must never trigger a cluster-wide stack collection)
     out["watchdog"] = rt.watchdog_health()
+    # metrics plane: per-SLO alert states + TSDB health (the scraper's
+    # cached report — a summary poll never re-evaluates burn windows)
+    if rt.obs is not None:
+        rep = rt.obs.engine.report()
+        out["slo"] = {"states": dict(rep.get("states", {})),
+                      "paging": sorted(
+                          n for n, s in rep.get("states", {}).items()
+                          if s == "page"),
+                      "tsdb": rt.obs.stats()}
     return out
+
+
+def metrics_history(name: str, tags: Optional[dict] = None,
+                    window_s: Optional[float] = None,
+                    quantiles: Optional[tuple] = None,
+                    group_by: Optional[tuple] = None) -> dict:
+    """Range-query the head's metrics TSDB (obs/tsdb.py): every retained
+    (ts, value) point per matching series, trimmed to ``window_s``.
+    ``tags`` matches subset-style ({"app": "default"} aggregates across
+    unnamed labels); ``quantiles=(0.5, 0.95)`` additionally folds
+    histogram bucket series into windowed quantile values. Counters get
+    a reset-aware ``rate_per_s``. ``group_by=("app", "deployment")``
+    adds per-group rate/quantile rows under "groups" so a table column
+    costs one round-trip, not one per deployment. Works from a remote
+    driver over the existing rpc path."""
+    remote = _remote()
+    if remote is not None:
+        return remote._rpc("metrics_history", name, tags, window_s,
+                           quantiles, group_by)
+    return _head().metrics_history(name, tags, window_s, quantiles,
+                                   group_by)
+
+
+def metrics_names() -> list[str]:
+    """Every metric name with at least one retained TSDB series."""
+    remote = _remote()
+    if remote is not None:
+        return remote._rpc("metrics_names")
+    return _head().metrics_names()
+
+
+def slo_report() -> dict:
+    """The SLO engine's latest multi-window burn-rate evaluation: per
+    objective the alert state (ok | warn | page), fast/slow window burn
+    rates, budget and window spans — plus TSDB health. What ``cli slo``
+    and GET /api/slo render."""
+    remote = _remote()
+    if remote is not None:
+        return remote._rpc("slo_report")
+    return _head().slo_report()
 
 
 def stack_report(timeout_s: float = 3.0) -> dict:

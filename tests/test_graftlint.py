@@ -752,6 +752,100 @@ def test_gl010_suppression():
 
 
 # ------------------------------------------------------------------ #
+# GL007 obs namespace (metrics plane, ray_tpu/obs)
+# ------------------------------------------------------------------ #
+
+def test_gl007_obs_namespace_allowed():
+    """The metrics plane's rtpu_obs_* family (SLO state/burn gauges +
+    transition counter) is first-class."""
+    src = """
+        from ray_tpu.util.metrics import Counter, Gauge, cached_metric
+
+        OK1 = Gauge("rtpu_obs_slo_state", tag_keys=("slo",))
+        OK2 = Gauge("rtpu_obs_slo_burn_rate", tag_keys=("slo", "pair"))
+
+        def ok_cached():
+            return cached_metric(Counter,
+                                 "rtpu_obs_slo_transitions_total")
+    """
+    assert lint(src, rules={"GL007"}) == []
+
+
+def test_gl007_obs_namespace_lookalikes_rejected():
+    src = """
+        from ray_tpu.util.metrics import Counter, cached_metric
+
+        BAD1 = Counter("rtpu_obsx_slo_state")
+        BAD2 = cached_metric(Counter, "obs_slo_transitions_total")
+    """
+    found = lint(src, rules={"GL007"})
+    assert len(found) == 2
+    assert all("does not match" in f.message for f in found)
+
+
+# ------------------------------------------------------------------ #
+# GL011 unbounded request-controlled metric/TSDB label values
+# ------------------------------------------------------------------ #
+
+def test_gl011_flags_formatted_tag_values():
+    src = """
+        def record(m, tenant, route, rid, tsdb, ts):
+            m.inc(1.0, tags={"tenant": f"t-{tenant}"})
+            m.set(2.0, tags={"route": str(route)})
+            m.observe(0.1, tags={"req": "%s" % rid})
+            m.inc(1.0, tags={"req": "id-" + rid})
+            m.inc(1.0, tags={"req": "{}".format(rid)})
+            tsdb.record("rtpu_serve_x", "gauge",
+                        (("tenant", f"t-{tenant}"),), ts, 1.0)
+    """
+    found = lint(src, rules={"GL011"})
+    assert len(found) == 6
+    kinds = " ".join(f.message for f in found)
+    for frag in ("f-string", "str() call", "%-formatting",
+                 "string concatenation", ".format() call"):
+        assert frag in kinds, frag
+    assert any("__overflow__" in f.message for f in found)
+
+
+def test_gl011_negatives():
+    # bounded-vocabulary variables (the gate's bucket(), enums) and
+    # formatting OUTSIDE a record site are the intended shapes; .set()
+    # calls without a tags= dict (plain setters) are not record sites
+    src = """
+        def record(m, g, tenant, d, tsdb, ts, key):
+            t = g.bucket(tenant)
+            m.inc(1.0, tags={"tenant": t, "outcome": "admitted"})
+            d.set("free", f"form-{tenant}")
+            name = f"t-{tenant}"
+            tsdb.record("rtpu_serve_x", "gauge", key, ts, 1.0)
+    """
+    assert lint(src, rules={"GL011"}) == []
+
+
+def test_gl011_integer_modulo_is_not_formatting():
+    # n % 4 in a tag value is the bounded-bucketing pattern the rule
+    # RECOMMENDS — only a string left operand makes Mod %-formatting
+    src = """
+        def record(m, n, rid):
+            m.inc(1.0, tags={"shard": n % 4})
+            m.set(2.0, tags={"req": "%s" % rid})
+            m.observe(0.1, tags={"req": f"%s" % rid})
+    """
+    found = lint(src, rules={"GL011"})
+    assert len(found) == 2
+    assert all("%-formatting" in f.message for f in found)
+
+
+def test_gl011_suppression():
+    src = """
+        def record(m, status):
+            # bounded server-chosen code
+            m.inc(1.0, tags={"status": str(status)})  # graftlint: disable=GL011
+    """
+    assert lint(src, rules={"GL011"}) == []
+
+
+# ------------------------------------------------------------------ #
 # engine: baseline mechanics + CLI
 # ------------------------------------------------------------------ #
 

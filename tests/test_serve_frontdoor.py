@@ -139,6 +139,47 @@ def test_admission_budget_queue_and_shed():
     _run(body())
 
 
+def test_admission_cancelled_waiter_unparks_and_clears_gauge():
+    # a client that disconnects while parked must leave the queue (and
+    # the rtpu_serve_tenant_queued gauge) exactly as it found them —
+    # that gauge feeds the tenant_queue autoscale signal, so a stale
+    # nonzero backlog would scale the deployment out and veto every
+    # scale-down forever
+    from ray_tpu.serve.frontdoor.admission import AdmissionController
+    from ray_tpu.util import metrics as um
+
+    def queued_value():
+        rec = um.collect_store().get("rtpu_serve_tenant_queued")
+        for key, v in (rec or {}).get("series", {}).items():
+            if ("deployment", "dcancel") in key:
+                return v
+        return 0.0
+
+    async def body():
+        ac = AdmissionController("proxy-c")
+        ac.configure("app", "dcancel", capacity=1, n_proxies=1,
+                     queue_depth=4, timeout_s=30.0)
+        hold = await ac.acquire("app", "dcancel")
+        parked = asyncio.ensure_future(ac.acquire("app", "dcancel"))
+        await asyncio.sleep(0.05)
+        g = ac.gate_for("app", "dcancel")
+        assert g.parked_total() == 1
+        assert queued_value() == 1.0
+        parked.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await parked
+        # queue AND gauge are back to empty; the held slot is intact
+        assert g.parked_total() == 0
+        assert queued_value() == 0.0
+        assert g.inflight == 1
+        hold(0.01)
+        assert g.inflight == 0
+        # budget never leaks across the cancel: a fresh acquire admits
+        r = await ac.acquire("app", "dcancel")
+        r(0.01)
+    _run(body())
+
+
 def test_admission_slo_shed_and_prune():
     from ray_tpu.serve.frontdoor.admission import (AdmissionController,
                                                    ShedError)

@@ -388,10 +388,17 @@ def test_no_false_deadlock_on_healthy_pipeline(stall_ray):
     try:
         for i in range(3):
             w.write(i)
-        time.sleep(0.3)  # consumer drains and parks on slot 3
+        # gate on OBSERVED completion, not a wall-clock margin (the
+        # test_wait precedent): under concurrent suite load the
+        # consumer may take arbitrarily long to drain three items, and
+        # a fixed sleep flaked exactly once that way. The deadline is a
+        # failure bound, never the pass condition.
+        deadline = time.time() + 30
+        while time.time() < deadline and len(got) < 3:
+            time.sleep(0.02)
+        assert got == [0, 1, 2]
         hang = state.hang_report(timeout_s=2.0)
         assert hang["deadlocks"] == []
-        assert got == [0, 1, 2]
     finally:
         channel.signal_stop(rt.store, stop)
         t.join(timeout=10)

@@ -689,14 +689,15 @@ def update_frames_manifest(ctxs: dict[str, FileContext]) -> dict:
 # GL007 — metric naming + once-only registration
 # --------------------------------------------------------------------- #
 # Motivation: the head merges every process's series by NAME; names
-# outside the rtpu_(core|llm|serve|rl|data)_ namespaces silently fall
-# off the dashboards and the metrics_summary() aggregations.
+# outside the rtpu_(core|llm|serve|rl|data|obs)_ namespaces silently
+# fall off the dashboards and the metrics_summary() aggregations.
 # Constructing a Metric per call re-validates against the registry on a
 # hot path — construct at module scope or through cached_metric
 # (llm/telemetry.py's pattern).
 
 _METRIC_CTORS = ("Counter", "Gauge", "Histogram")
-_METRIC_NAME_RE = re.compile(r"^rtpu_(core|llm|serve|rl|data)_[a-z0-9_]+$")
+_METRIC_NAME_RE = re.compile(
+    r"^rtpu_(core|llm|serve|rl|data|obs)_[a-z0-9_]+$")
 _GL007_EXEMPT_FILES = ("ray_tpu/util/metrics.py",)
 
 
@@ -747,7 +748,7 @@ def check_metric_conventions(ctx: FileContext) -> Iterable[Finding]:
                 findings.append(Finding(
                     "GL007", ctx.relpath, node.lineno, node.col_offset,
                     f'metric name "{name}" does not match '
-                    f"rtpu_(core|llm|serve|rl|data)_[a-z0-9_]+"))
+                    f"rtpu_(core|llm|serve|rl|data|obs)_[a-z0-9_]+"))
         if fn in _METRIC_CTORS and id(node) in in_func:
             findings.append(Finding(
                 "GL007", ctx.relpath, node.lineno, node.col_offset,
@@ -928,6 +929,102 @@ def check_flight_emit_cost(ctx: FileContext) -> Iterable[Finding]:
                     f"evt() args must be plain ints (codes + "
                     f"flight.lo48 ids) — formatting belongs at export "
                     f"time"))
+    return findings
+
+
+# --------------------------------------------------------------------- #
+# GL011 — unbounded request-controlled TSDB/metric label values
+# --------------------------------------------------------------------- #
+# Motivation: the metrics plane (ray_tpu/obs) retains one preallocated
+# ring PER (name, label-set) series. The TSDB's hard cardinality cap
+# folds overflow into an __overflow__ sink, so memory is safe — but a
+# record site that mints label values by FORMATTING request-controlled
+# data (f"tenant-{tid}", str(request_id), "%s" % route) fills the whole
+# series table with one-sample garbage and evicts the real series into
+# the sink: the history silently goes blind. Label values must come
+# from bounded vocabularies (the admission gate's bucket(), fixed
+# enums, config) — bounding belongs at the call site that OWNS the
+# vocabulary, not in the store. Flagged: f-string / str()-family /
+# %-format / .format() / string-concat VALUES inside a `tags=` dict at
+# metric record sites (.inc/.set/.observe) and inside the key tuple of
+# TSDB .record() calls. Plain variables pass — the rule catches the
+# syntactic act of minting a fresh string per record, which is exactly
+# the unbounded case.
+
+_GL011_RECORD_METHODS = ("inc", "set", "observe")
+
+
+def _gl011_bad_value(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.JoinedStr):
+        return "f-string"
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod) and \
+            (isinstance(node.left, ast.JoinedStr) or
+             (isinstance(node.left, ast.Constant) and
+              isinstance(node.left.value, str))):
+        # only string % value is formatting; integer modulo (n % 4) is
+        # the bounded-bucketing pattern this rule RECOMMENDS
+        return "%-formatting"
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        # "pfx" + x (or x + "sfx"): minting a fresh string per record
+        if any(isinstance(s, ast.Constant) and isinstance(s.value, str)
+               for s in (node.left, node.right)):
+            return "string concatenation"
+    if isinstance(node, ast.Call):
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "format":
+            return ".format() call"
+        if isinstance(node.func, ast.Name) and \
+                node.func.id in _GL010_STR_BUILDERS:
+            return f"{node.func.id}() call"
+    return None
+
+
+def _gl011_scan_dict(d: ast.Dict) -> Iterable[tuple[ast.AST, str]]:
+    for v in d.values:
+        why = _gl011_bad_value(v)
+        if why:
+            yield v, why
+
+
+@file_rule("GL011")
+def check_unbounded_metric_labels(ctx: FileContext) -> Iterable[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or \
+                not isinstance(node.func, ast.Attribute):
+            continue
+        meth = node.func.attr
+        if meth in _GL011_RECORD_METHODS:
+            for kw in node.keywords:
+                if kw.arg != "tags" or not isinstance(kw.value, ast.Dict):
+                    continue
+                for v, why in _gl011_scan_dict(kw.value):
+                    findings.append(Finding(
+                        "GL011", ctx.relpath, v.lineno, v.col_offset,
+                        f"{why} mints a label value at a metric record "
+                        f"site — one fresh string per record grows a "
+                        f"TSDB series each; bound the vocabulary at "
+                        f"the call site (bucket()/enum/config) before "
+                        f"tagging"))
+        elif meth == "record":
+            # TSDB.record(name, kind, key, ts, value): the key tuple's
+            # (k, v) pairs are the label set
+            if len(node.args) < 3 or not isinstance(
+                    node.args[2], (ast.Tuple, ast.List)):
+                continue
+            for pair in node.args[2].elts:
+                if not isinstance(pair, (ast.Tuple, ast.List)) or \
+                        len(pair.elts) != 2:
+                    continue
+                why = _gl011_bad_value(pair.elts[1])
+                if why:
+                    findings.append(Finding(
+                        "GL011", ctx.relpath, pair.elts[1].lineno,
+                        pair.elts[1].col_offset,
+                        f"{why} mints a TSDB label value at a "
+                        f".record() site — unbounded label sets evict "
+                        f"real series into the __overflow__ sink; "
+                        f"bound the vocabulary before recording"))
     return findings
 
 
