@@ -18,6 +18,15 @@ requests sharing one long system prompt with varied tails, caching on vs
 off; reports hit rate, prompt tokens saved, and the TTFT delta the cache
 buys (paged_engine.py enable_prefix_caching).
 
+``--long-tail``: session-replay scenario for the cache heat plane —
+Zipf-distributed sessions whose combined prefix working set is a
+multiple of the page pool, so hot sessions stay cached while the long
+tail churns through eviction. Emits a warm-TTFT + hit-rate line and a
+per-chain heat-histogram line (fold both with ``bench_trend
+--history``), counter-verified: per-chain totals == engine aggregates
+== flushed ``rtpu_llm_prefix_cache_*`` counters. This is ROADMAP item
+4's success-metric harness, recorded before tiering lands.
+
 ``--trace out.json``: flight-record the measured section (core/flight.py)
 and print a wait/dispatch breakdown JSON line next to the numbers; the
 trace file opens in Perfetto/chrome://tracing.
@@ -33,6 +42,8 @@ import numpy as np
 def main():
     if "--shared-prefix" in sys.argv:
         return _shared_prefix()
+    if "--long-tail" in sys.argv:
+        return _long_tail()
     if "--decode-plan" in sys.argv:
         return _decode_plan()
     if "--soak" in sys.argv:
@@ -208,6 +219,150 @@ def _shared_prefix():
                  f"shared prefix, {jax.devices()[0].platform})"),
         "vs_baseline": round(p50_off / max(p50_on, 1e-9), 4),
     }))
+
+
+def _long_tail():
+    """Cache heat plane scenario: N sessions, request popularity drawn
+    Zipf(alpha) so a few sessions dominate while a long tail barely
+    repeats; every session's prefix is distinct and the combined
+    working set is a multiple of the page pool, forcing the cache to
+    keep the hot head resident and churn the tail through eviction.
+    Reports the hit rate and warm-vs-cold TTFT (vs_baseline =
+    cold_p50 / warm_p50 — what cache residency buys a revisited
+    session), plus a per-chain heat histogram. Before printing, the
+    per-chain table is counter-verified against the engine aggregates
+    AND the flushed rtpu_llm_prefix_cache_* metric store — one page
+    event, one attribution, no drift."""
+    from bench import _probe_accelerator, repin_jax_platforms
+    repin_jax_platforms()
+    from ray_tpu.llm import SamplingParams
+    from ray_tpu.llm import telemetry
+    from ray_tpu.llm.paged_engine import (
+        PagedEngineConfig, PagedInferenceEngine,
+    )
+    from ray_tpu.models import llama
+    from ray_tpu.util.metrics import collect_store
+
+    if not _probe_accelerator():
+        print(json.dumps({
+            "metric": "serve_longtail_warm_ttft_p50", "value": None,
+            "unit": "seconds", "vs_baseline": None,
+            "error": "accelerator unreachable (tunnel probe timed out)",
+        }))
+        raise SystemExit(3)
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        model = llama.LlamaConfig(
+            vocab_size=32000, dim=1024, n_layers=8, n_heads=16,
+            n_kv_heads=8, mlp_dim=4096, max_seq_len=2048,
+            dtype=jax.numpy.bfloat16, remat=False, use_flash=False)
+        cfg = PagedEngineConfig(
+            model=model, max_batch_size=16, page_size=64, num_pages=512,
+            max_pages_per_seq=16, chunk_size=256, prefill_rows=8)
+        n_sessions, n_requests = 96, 400
+        prefix_len, tail_len, max_tokens = 512, 64, 8
+    else:  # CPU smoke — numbers not meaningful, the shape is
+        model = llama.llama_tiny(vocab_size=258, max_seq_len=256)
+        cfg = PagedEngineConfig(
+            model=model, max_batch_size=4, page_size=8, num_pages=192,
+            max_pages_per_seq=16, chunk_size=32)
+        n_sessions, n_requests = 72, 300
+        prefix_len, tail_len, max_tokens = 64, 8, 4
+    alpha = 1.1
+
+    rng = np.random.RandomState(0)
+    sessions = [list(rng.randint(1, model.vocab_size, (prefix_len,)))
+                for _ in range(n_sessions)]
+    # working set: every session's prefix pages + a decode page; the
+    # pool holds a fraction of it, so residency is earned by heat
+    pages_per_prefix = prefix_len // cfg.page_size
+    working_set = n_sessions * pages_per_prefix
+    # Zipf-ranked popularity over the session ids
+    weights = 1.0 / np.arange(1, n_sessions + 1) ** alpha
+    weights /= weights.sum()
+    order = rng.choice(n_sessions, size=n_requests, p=weights)
+
+    eng = PagedInferenceEngine(cfg, rng_seed=0)
+    eng.warmup()
+    sp = SamplingParams(max_tokens=max_tokens, temperature=0.0)
+
+    trace_t0 = time.monotonic_ns()
+    seen: set = set()
+    warm_ttfts, cold_ttfts = [], []
+    t0 = time.perf_counter()
+    for i, sid in enumerate(order):
+        ids = sessions[sid] + list(
+            rng.randint(1, model.vocab_size, (tail_len,)))
+        r = eng.submit(ids, sp)
+        while not r.done:
+            eng.step()
+        ttft = r.first_token_t - r.submit_t
+        (warm_ttfts if sid in seen else cold_ttfts).append(ttft)
+        seen.add(sid)
+    wall = time.perf_counter() - t0
+
+    # force one final telemetry publish (chain gauges are rate-limited)
+    eng._chain_ship_t = 0.0
+    telemetry.on_step(eng)
+
+    # -- counter verification: table == engine.stats == metric store -- #
+    st, totals = eng.stats, eng.chains.totals()
+    for tk, sk in (("hits", "prefix_hits"), ("misses", "prefix_misses"),
+                   ("evictions", "prefix_evictions"),
+                   ("tokens_saved", "prefix_tokens_saved")):
+        assert totals[tk] == st[sk], \
+            f"chain-table drift: {tk}={totals[tk]} vs {sk}={st[sk]}"
+    store = collect_store()
+
+    def _shipped(name):
+        rec = store.get(name)
+        return sum(rec["series"].values()) if rec else 0.0
+    for name, sk in (
+            ("rtpu_llm_prefix_cache_hits_total", "prefix_hits"),
+            ("rtpu_llm_prefix_cache_misses_total", "prefix_misses"),
+            ("rtpu_llm_prefix_cache_evictions_total",
+             "prefix_evictions"),
+            ("rtpu_llm_prefix_cache_tokens_saved_total",
+             "prefix_tokens_saved")):
+        assert int(_shipped(name)) == st[sk], \
+            f"metric-store drift: {name}={_shipped(name)} vs {st[sk]}"
+
+    acct = eng.prefix_accounting()
+    warm_p50 = sorted(warm_ttfts)[len(warm_ttfts) // 2]
+    cold_p50 = sorted(cold_ttfts)[len(cold_ttfts) // 2]
+    print(json.dumps({
+        "metric": "serve_longtail_warm_ttft_p50",
+        "value": round(warm_p50, 4),
+        "unit": (f"s (cold={cold_p50:.4f}s, hit_rate="
+                 f"{acct['hit_rate']:.3f}, tokens_saved="
+                 f"{acct['tokens_saved']}, evictions="
+                 f"{acct['evictions']}, {n_requests} reqs over "
+                 f"{n_sessions} zipf({alpha}) sessions, working set "
+                 f"{working_set}p vs pool {cfg.num_pages}p, "
+                 f"wall {wall:.1f}s, {jax.devices()[0].platform})"),
+        "vs_baseline": round(cold_p50 / max(warm_p50, 1e-9), 4),
+    }))
+    # heat histogram: how concentrated cache value is across chains —
+    # the shape tiering will exploit (spill the cold right half)
+    rows = eng.chains.top(n_sessions)
+    hist = {"buckets": [0, 1, 4, 16, 64, 256],
+            "chains": [0] * 6, "hits": [0] * 6}
+    for row in rows:
+        b = sum(1 for lo in hist["buckets"][1:] if row["hits"] >= lo)
+        hist["chains"][b] += 1
+        hist["hits"][b] += row["hits"]
+    print(json.dumps({
+        "metric": "serve_longtail_heat_histogram",
+        "value": hist,
+        "unit": (f"chains/hits per hit-count bucket; tracked="
+                 f"{eng.chains.stats()['tracked']}, overflow_assign="
+                 f"{eng.chains.stats()['overflow_assignments']}, "
+                 f"table_max_bytes={eng.chains.stats()['max_bytes']}"),
+        "vs_baseline": None,
+    }))
+    from bench import flight_report, trace_arg
+    flight_report(trace_arg(sys.argv), trace_t0)
 
 
 def _decode_plan():

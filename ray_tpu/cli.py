@@ -19,6 +19,8 @@ Commands:
     doctor          (summary + stuck tasks + deadlocks + stacks + memory)
     top [--window S] [--once]  (live serving table from the metrics TSDB)
     slo             (SLO burn-rate report; exit 1 when paging)
+    cache [--top K] (prefix-cache heat map: hot chains, reclaimable
+                     pages, per-tenant warmth — the cache heat plane)
     timeline --out FILE
 """
 from __future__ import annotations
@@ -560,6 +562,80 @@ def cmd_slo(args) -> int:
         ray.shutdown()
 
 
+def _bytes_h(n) -> str:
+    n = float(n or 0)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n:.0f}B"
+        n /= 1024
+    return f"{n:.1f}GiB"
+
+
+def _cache_frame(rep: dict) -> str:
+    """Render one `cli cache` frame from a state.cache_report() dict.
+    Pure function of the report (tested without a terminal)."""
+    lines = []
+    t = rep.get("totals", {})
+    tr = rep.get("trend")
+    head = (f"prefix cache: hit rate {t.get('hit_rate', 0.0):.2%} "
+            f"cumulative ({int(t.get('hits', 0))} hits / "
+            f"{int(t.get('misses', 0))} misses, "
+            f"{int(t.get('evictions', 0))} evictions, "
+            f"{int(t.get('tokens_saved', 0))} tokens saved)")
+    if tr and tr.get("hit_rate") is not None:
+        head += (f"  |  last {tr['window_s']:.0f}s: "
+                 f"{tr['hit_rate']:.2%} @ "
+                 f"{tr['hits_per_s'] + tr['misses_per_s']:.1f} pages/s")
+    lines.append(head)
+    pg = rep.get("pages", {})
+    if pg.get("total"):
+        active = pg["total"] - pg["free"] - pg["cached"]
+        lines.append(
+            f"pages: {active} active, {pg['cached']} cached "
+            f"(reclaimable {_bytes_h(pg['reclaimable_bytes'])}), "
+            f"{pg['free']} free / {pg['total']} total "
+            f"across {len(rep.get('replicas', []))} replica(s)")
+    chains = rep.get("chains", [])
+    if chains:
+        lines.append(f"{'chain':<14}{'hits':>10}{'tok saved':>12}"
+                     f"{'resident':>10}{'repl':>6}{'last hit':>10}")
+        for c in chains:
+            age = c.get("last_hit_age_s")
+            lines.append(
+                f"{c['chain']:<14}{int(c.get('hits', 0)):>10}"
+                f"{int(c.get('tokens_saved', 0)):>12}"
+                f"{int(c.get('resident_pages', 0)):>10}"
+                f"{c.get('replicas', 0):>6}"
+                f"{'-' if age is None else f'{age:.0f}s ago':>10}")
+    else:
+        lines.append("(no per-chain series yet — is an engine with "
+                     "chain_stats_slots > 0 taking traffic?)")
+    tenants = rep.get("tenants", {})
+    if tenants:
+        lines.append("tenant warmth (from replica heat summaries):")
+        for name, row in sorted(tenants.items(),
+                                key=lambda kv: -kv[1]["hits"]):
+            lines.append(
+                f"  {name or '(unlabeled)':<12} {row['hits']} hits, "
+                f"{row['tokens_saved']} tokens saved, "
+                f"{_bytes_h(row['resident_bytes'])} resident")
+    return "\n".join(lines)
+
+
+def cmd_cache(args) -> int:
+    """Cluster prefix-cache heat map (cache heat plane): fleet hit/miss
+    totals with recent trend, the hottest prompt chains folded across
+    replicas, active vs reclaimable pages, and per-tenant warmth. Works
+    without the TSDB (trend line simply absent)."""
+    ray, rt, _ = _client(args.address)
+    try:
+        from . import state as state_mod
+        print(_cache_frame(state_mod.cache_report(top_k=args.top)))
+        return 0
+    finally:
+        ray.shutdown()
+
+
 def cmd_timeline(args) -> int:
     ray, rt, _ = _client(args.address)
     try:
@@ -686,6 +762,14 @@ def build_parser() -> argparse.ArgumentParser:
                                     "when any objective is paging)")
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_slo)
+
+    sp = sub.add_parser("cache", help="prefix-cache heat map: hot "
+                                      "chains, reclaimable pages, "
+                                      "tenant warmth")
+    sp.add_argument("--top", type=int, default=10,
+                    help="hot chains to show")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_cache)
 
     sp = sub.add_parser("timeline", help="dump chrome trace")
     sp.add_argument("--out", default="timeline.json")

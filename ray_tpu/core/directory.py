@@ -96,6 +96,17 @@ class DirectoryService:
                         entries[k] = got[0]
             return {"v": self._versions.get(name, 0), "entries": entries}
 
+    def lookup_prefix(self, name: str, prefix: str) -> dict:
+        """-> {key: value} for string keys starting with `prefix`. The
+        cache heat plane files one bounded per-replica summary under a
+        ``"heat:<proc>"`` string key next to the (bytes-keyed) page
+        entries; this reads just those summaries without copying the
+        up-to-64k page hashes a full lookup() would."""
+        with self._lock:
+            d = self._dirs.get(name) or {}
+            return {k: v for k, (v, _o) in d.items()
+                    if isinstance(k, str) and k.startswith(prefix)}
+
     def sweep_owner(self, wid: str) -> int:
         """Drop every entry a disconnected worker published; returns the
         number of entries removed."""

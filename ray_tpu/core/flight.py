@@ -140,6 +140,10 @@ DATA_BLOCK = 82        # i  (stage_idx, block_idx)
 # metrics plane (obs/slo.py) — SLO alert state-machine transitions
 SLO_TRANSITION = 90   # i  (slo_idx, to_state, from_state) 0 ok/1 warn/2 page
 
+# llm prefix-cache heat plane (llm/paged_engine.py) — cache churn
+PREFIX_EVICT = 91     # i  (pid, chain_slot)
+PREFIX_IMPORT = 92    # i  (pages, chain_slot)
+
 # jax step profiling (util/profiling.py)
 STEP_BEGIN = 70       # B  (kind,)
 STEP_END = 71         # E  (kind,)
@@ -194,6 +198,9 @@ CODES: dict[int, tuple] = {
     DATA_BLOCK: ("data_block", "data", "i", None, ("stage", "idx")),
     SLO_TRANSITION: ("slo_transition", "obs", "i", None,
                      ("slo", "to", "from")),
+    PREFIX_EVICT: ("prefix_evict", "llm", "i", None, ("pid", "chain")),
+    PREFIX_IMPORT: ("prefix_import", "llm", "i", None,
+                    ("pages", "chain")),
     STEP_BEGIN: ("jax_step", "jax", "B", None, ("kind",)),
     STEP_END: ("jax_step", "jax", "E", None, ("kind",)),
     JIT_COMPILE_BEGIN: ("jit_compile", "jax", "B", None, ("key",)),

@@ -1194,7 +1194,7 @@ class Runtime:
                     "memory_summary", "autoscaler_status",
                     "user_metrics_dump", "pubsub_poll",
                     "metrics_history", "metrics_names", "slo_report",
-                    "obs_signals",
+                    "obs_signals", "cache_report",
                     "kv_put", "kv_get", "kv_del", "kv_keys", "locate",
                     "locate_many", "request_resources_rpc",
                     "job_submit", "job_list", "job_status", "job_logs",
@@ -2557,6 +2557,103 @@ class Runtime:
         from ..obs.scraper import autoscale_signals
         obs = self._obs()
         return autoscale_signals(obs.tsdb, obs.engine, app, deployment)
+
+    def cache_report(self, top_k: int = 10) -> dict:
+        """RPC: the cluster-wide prefix-cache heat map (cache heat
+        plane). Folds three independent sources — the replicas'
+        ``heat:*`` directory summaries (per-replica pools + hot
+        chains), the merged metric store's ``rtpu_llm_prefix_cache_*``
+        aggregates, and the per-chain ``rtpu_llm_prefix_chain_*``
+        gauges — so it works whether or not the TSDB scraper is on
+        (trend is attached only when it is)."""
+        now = time.time()
+        top_k = max(int(top_k), 1)
+        # -- per-replica heat summaries from the shared directories ---- #
+        replicas: list[dict] = []
+        dir_sizes = self.dirs.stats()["directories"]
+        for name in sorted(dir_sizes):
+            if not name.startswith("serve:prefix:"):
+                continue
+            heats = self.dirs.lookup_prefix(name, "heat:")
+            for _k, v in sorted(heats.items()):
+                row = dict(v)
+                ts = row.pop("ts", None)
+                row["age_s"] = round(now - ts, 1) if ts else None
+                row["directory_pages"] = dir_sizes[name] - len(heats)
+                replicas.append(row)
+        # -- fleet totals from the merged counter store ---------------- #
+        def _total(metric: str) -> float:
+            rec = self.user_metrics.get(metric)
+            return sum(rec["series"].values()) if rec else 0.0
+        with self.lock:
+            totals = {k: _total(f"rtpu_llm_prefix_cache_{k}_total")
+                      for k in ("hits", "misses", "evictions",
+                                "tokens_saved", "imported_pages",
+                                "exported_pages")}
+            seen = totals["hits"] + totals["misses"]
+            totals["hit_rate"] = round(totals["hits"] / seen, 4) \
+                if seen else 0.0
+            # -- cluster chain fold: sum per-chain gauges across procs - #
+            chains: dict[str, dict] = {}
+            for metric, field, fold in (
+                    ("rtpu_llm_prefix_chain_hits", "hits", "sum"),
+                    ("rtpu_llm_prefix_chain_tokens_saved",
+                     "tokens_saved", "sum"),
+                    ("rtpu_llm_prefix_chain_resident_pages",
+                     "resident_pages", "sum"),
+                    ("rtpu_llm_prefix_chain_last_hit_age_s",
+                     "last_hit_age_s", "min")):
+                rec = self.user_metrics.get(metric)
+                for key, val in (rec["series"] if rec else {}).items():
+                    labels = dict(key)
+                    chain = labels.get("chain", "")
+                    row = chains.setdefault(
+                        chain, {"chain": chain, "replicas": 0})
+                    if fold == "sum":
+                        row[field] = row.get(field, 0) + val
+                    else:
+                        row[field] = min(row.get(field, val), val)
+                    if metric.endswith("_hits"):
+                        row["replicas"] += 1
+        chain_rows = sorted(chains.values(),
+                            key=lambda r: -r.get("hits", 0))[:top_k]
+        # -- per-tenant warmth + pool rollup from replica summaries ---- #
+        tenants: dict[str, dict] = {}
+        pages = {"free": 0, "cached": 0, "total": 0,
+                 "reclaimable_bytes": 0}
+        for rep in replicas:
+            pool = rep.get("pool") or {}
+            pages["free"] += pool.get("free_pages", 0)
+            pages["cached"] += pool.get("cached_pages", 0)
+            pages["total"] += pool.get("total_pages", 0)
+            pages["reclaimable_bytes"] += pool.get("reclaimable_bytes", 0)
+            for c in rep.get("chains") or ():
+                t = tenants.setdefault(
+                    c.get("tenant", ""), {"hits": 0, "tokens_saved": 0,
+                                          "resident_bytes": 0})
+                t["hits"] += c.get("hits", 0)
+                t["tokens_saved"] += c.get("tokens_saved", 0)
+                t["resident_bytes"] += c.get("resident_bytes", 0)
+        out = {"generated_at": now, "totals": totals,
+               "chains": chain_rows, "replicas": replicas,
+               "pages": pages, "tenants": tenants}
+        # -- recent trend, only when the TSDB scraper is running ------- #
+        if self.obs is not None:
+            try:
+                hr = self.obs.tsdb.rate(
+                    "rtpu_llm_prefix_cache_hits_total", None, 300.0)
+                mr = self.obs.tsdb.rate(
+                    "rtpu_llm_prefix_cache_misses_total", None, 300.0)
+                out["trend"] = {
+                    "window_s": 300.0,
+                    "hits_per_s": round(hr, 3),
+                    "misses_per_s": round(mr, 3),
+                    "hit_rate": round(hr / (hr + mr), 4)
+                    if hr + mr else None,
+                }
+            except Exception:
+                pass  # trend is garnish; the report stands without it
+        return out
 
     def _rebalance_pipelines_locked(self):
         """A worker just went idle with nothing pending: if another worker

@@ -21,6 +21,9 @@ separate process tree, no node agents, no build step.
     # GET /api/metrics_history?name=N[&window=S][&tags=JSON]
     #     [&quantiles=0.5,0.95]      -> TSDB range query (ray_tpu/obs)
     # GET /api/slo                   -> SLO burn-rate report
+    # GET /api/cache                 -> prefix-cache heat map (cache
+    #                                   heat plane: hot chains, pools,
+    #                                   tenant warmth)
     # GET /api/task/{id}   -> full task record + its timeline events
     # GET /api/actor/{id}  -> full actor record + per-call queues
     # GET /api/log?file=worker-X.log&tail=N -> log tail (session dir only)
@@ -253,6 +256,11 @@ def start_dashboard(port: int = 0, host: str = "127.0.0.1") -> int:
             elif kind == "slo":
                 loop = asyncio.get_event_loop()
                 out = await loop.run_in_executor(None, rt.slo_report)
+            elif kind == "cache":
+                # prefix-cache heat map: walks directories + the merged
+                # metric store under the head lock — off the event loop
+                loop = asyncio.get_event_loop()
+                out = await loop.run_in_executor(None, rt.cache_report)
             elif kind == "memory":
                 # head lock + per-object residency probes: keep it off
                 # the dashboard event loop (same rule as the serve branch)

@@ -74,6 +74,9 @@ class _Request:
     # content-hash chain of the prompt's FULL pages (paged engine prefix
     # caching); computed lazily at admission, None until then
     page_hashes: Optional[list] = None
+    # cache heat plane (llm/chainstats.py): the per-chain stats slot
+    # this request's prompt family resolved to; -1 = untracked
+    chain_slot: int = -1
     done: bool = False
     submit_t: float = 0.0
     first_token_t: float = 0.0    # TTFT = first_token_t - submit_t

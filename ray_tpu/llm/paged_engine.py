@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import flight
 from ..models import llama
 from .engine import (  # noqa: F401 — SamplingParams re-exported
     SamplingParams, _EngineBase, _Request, sample_logits_batch,
@@ -107,6 +108,15 @@ class PagedEngineConfig:
     # cached region, so divergence copies instead of corrupting); the LRU
     # pool is reclaimed page-by-page under allocation pressure.
     enable_prefix_caching: bool = True
+    # cache heat plane (llm/chainstats.py): fixed-memory per-chain stats
+    # keyed by chain-head hash — hits/misses/evictions/imports per
+    # prompt family, with a hard cardinality cap and an __overflow__
+    # sink (à la obs/tsdb.py tsdb_max_series) so prompt diversity can
+    # never grow engine memory. Pure observation: engine outputs are
+    # bit-identical with the table on or off. 0 disables. top_k bounds
+    # how many chains telemetry ships / the prefix directory publishes.
+    chain_stats_slots: int = 256
+    chain_stats_top_k: int = 8
     tokenizer: Any = None
 
     def __post_init__(self):
@@ -116,6 +126,9 @@ class PagedEngineConfig:
             raise ValueError("prefill_rows and decode_window must be >= 1")
         if self.page_buckets not in ("auto", "on", "off"):
             raise ValueError("page_buckets must be 'auto', 'on' or 'off'")
+        if self.chain_stats_slots < 0 or self.chain_stats_top_k < 1:
+            raise ValueError("chain_stats_slots must be >= 0 and "
+                             "chain_stats_top_k >= 1")
 
     @property
     def max_seq_len(self) -> int:
@@ -170,6 +183,19 @@ class PagedInferenceEngine(_EngineBase):
         self.track_page_publish = False
         self._dir_new: list[bytes] = []
         self._dir_dropped: list[bytes] = []
+        # per-chain heat table (llm/chainstats.py): observation only —
+        # no policy path reads it. _chain_of maps a registered page to
+        # the chain slot it was published under, so evictions can be
+        # attributed without re-deriving hashes; pages whose chain was
+        # never learned fall to the overflow sink on eviction.
+        self.chains = None
+        self._chain_of: dict[int, int] = {}
+        if self._prefix_on and cfg.chain_stats_slots > 0:
+            from .chainstats import ChainStatsTable
+            page_nbytes = sum(int(l["k"].nbytes) + int(l["v"].nbytes)
+                              for l in self.caches) // max(cfg.num_pages, 1)
+            self.chains = ChainStatsTable(cfg.chain_stats_slots,
+                                          page_nbytes)
         self._next_rid = 0
         # resident-adapter slot table (cfg.max_adapters): device arrays
         # every dispatch gathers per-row; loads are donated scatters the
@@ -209,8 +235,10 @@ class PagedInferenceEngine(_EngineBase):
                       "prefix_hits": 0, "prefix_misses": 0,
                       "prefix_evictions": 0, "prefix_tokens_saved": 0,
                       # pages seeded from ANOTHER replica's cache via the
-                      # cluster prefix directory (import_prefix)
-                      "prefix_imported_pages": 0}
+                      # cluster prefix directory (import_prefix), and
+                      # cached pages gathered FOR a peer (export_prefix)
+                      "prefix_imported_pages": 0,
+                      "prefix_exported_pages": 0}
         # speculation controller: EMA of tokens-per-slot-per-spec-dispatch
         # (starts optimistic), plus a cooldown of windowed dispatches
         # before re-probing once the EMA drops below the window
@@ -528,6 +556,17 @@ class PagedInferenceEngine(_EngineBase):
                     # drop the log — un-dropped stale entries are hints
                     # the importer validates anyway
                     del self._dir_dropped[:]
+        if self.chains is not None:
+            # heat attribution: pages whose chain was never learned fold
+            # to the overflow sink, so per-chain eviction totals always
+            # sum to the aggregate prefix_evictions counter
+            slot = self._chain_of.pop(pid, None)
+            if slot is None:
+                slot = 0
+            else:
+                self.chains.resident_sub(slot)
+            self.chains.evict(slot)
+            flight.evt(flight.PREFIX_EVICT, pid, slot)
 
     def _incref(self, pid: int):
         """Pin a page for a request; a cached (refcount-0) page leaves
@@ -685,15 +724,20 @@ class PagedInferenceEngine(_EngineBase):
             pos += c
             self.stats["prefix_hits"] += len(pids)
             self.stats["prefix_tokens_saved"] += c
+            if self.chains is not None and req.chain_slot >= 0:
+                self.chains.hit(req.chain_slot, len(pids), c)
         if pos != req.prefill_pos:
             req.prefill_pos = pos
             self._block_tables[req.slot, :len(req.pages)] = req.pages
 
-    def _register_page(self, pid: int, h: bytes):
+    def _register_page(self, pid: int, h: bytes, chain: int = -1):
         if pid in self._page_to_hash or h in self._hash_to_page:
             return      # already published, or duplicate content elsewhere
         self._page_to_hash[pid] = h
         self._hash_to_page[h] = pid
+        if self.chains is not None and chain >= 0:
+            self._chain_of[pid] = chain
+            self.chains.resident_add(chain)
         if self.track_page_publish:
             self._dir_new.append(h)
             if len(self._dir_new) > 4 * self.cfg.num_pages:
@@ -725,8 +769,15 @@ class PagedInferenceEngine(_EngineBase):
                 len(hashes) * page:n_full * page]
             hashes = hashes + self._hash_chain(
                 tokens, prev=hashes[-1] if hashes else req.prefix_salt)
+        if self.chains is not None and req.chain_slot < 0 and hashes:
+            # short prompts never visited the admission-time chain
+            # assignment; learn the chain here so the published pages'
+            # evictions attribute to it instead of the overflow sink
+            req.chain_slot = self.chains.slot_for(hashes[0],
+                                                  req.prefix_salt)
         for i in range(n_full):
-            self._register_page(req.pages[i], hashes[i])
+            self._register_page(req.pages[i], hashes[i],
+                                chain=req.chain_slot)
 
     # -- engine loop -------------------------------------------------------
 
@@ -753,12 +804,20 @@ class PagedInferenceEngine(_EngineBase):
                 req.slot = self._free_slots.popleft()
                 req.pages = pages
                 self._block_tables[req.slot, :len(pages)] = pages
+                if self.chains is not None:
+                    hs = self._prompt_hashes(req)
+                    if hs:
+                        req.chain_slot = self.chains.slot_for(
+                            hs[0], req.prefix_salt)
                 if matched:
                     # chunked prefill starts at the first uncached chunk
                     # boundary
                     req.prefill_pos = len(matched) * self.cfg.page_size
                     self.stats["prefix_hits"] += len(matched)
                     self.stats["prefix_tokens_saved"] += req.prefill_pos
+                    if self.chains is not None:
+                        self.chains.hit(req.chain_slot, len(matched),
+                                        req.prefill_pos)
                 self._prefilling.append(req)
                 from . import telemetry
                 telemetry.on_admit(self, req)
@@ -830,9 +889,13 @@ class PagedInferenceEngine(_EngineBase):
                 # (their K/V is fully written once this dispatch returns)
                 lo, hi = pos // page, (pos + n) // page
                 self.stats["prefix_misses"] += hi - lo
+                if self.chains is not None and hi > lo \
+                        and req.chain_slot >= 0:
+                    self.chains.miss(req.chain_slot, hi - lo)
                 hashes = self._prompt_hashes(req)
                 for j in range(lo, hi):
-                    self._register_page(req.pages[j], hashes[j])
+                    self._register_page(req.pages[j], hashes[j],
+                                        chain=req.chain_slot)
         for i, (req, pos, n) in enumerate(rows):
             req.prefill_pos = pos + n
             if req.prefill_pos < len(req.prompt_ids):
@@ -1205,6 +1268,14 @@ class PagedInferenceEngine(_EngineBase):
                 self.stats["prefix_hits"] += len(matched)
                 nf = len(ids) // self.cfg.page_size  # full prompt pages
                 self.stats["prefix_misses"] += nf - len(matched)
+                if self.chains is not None and hashes:
+                    req.chain_slot = self.chains.slot_for(
+                        hashes[0], req.prefix_salt)
+                    if matched:
+                        self.chains.hit(req.chain_slot, len(matched))
+                    if nf > len(matched):
+                        self.chains.miss(req.chain_slot,
+                                         nf - len(matched))
             if fresh:
                 idx = jnp.asarray(np.asarray(
                     [pages[i] for i in fresh], np.int32))
@@ -1219,7 +1290,8 @@ class PagedInferenceEngine(_EngineBase):
                 if self._prefix_on and hashes:
                     for i in fresh:
                         if i < len(hashes):
-                            self._register_page(pages[i], hashes[i])
+                            self._register_page(pages[i], hashes[i],
+                                                chain=req.chain_slot)
             tok = int(payload["first_token"])
             req.out_ids.append(tok)
             self.stats["tokens_out"] += 1
@@ -1294,6 +1366,13 @@ class PagedInferenceEngine(_EngineBase):
             pages = [{"k": np.asarray(layer["k"][idx]),
                       "v": np.asarray(layer["v"][idx])}
                      for layer in self.caches]
+            self.stats["prefix_exported_pages"] += len(pids)
+            if self.chains is not None:
+                # peek, never assign: an export targets pages this
+                # engine already registered, so the chain (or the
+                # overflow sink) exists
+                self.chains.exported(self.chains.peek(hashes[0]),
+                                     len(pids))
             return {"page_size": self.cfg.page_size,
                     "page_hashes": list(hashes[:len(pids)]),
                     "pages": pages}
@@ -1344,8 +1423,17 @@ class PagedInferenceEngine(_EngineBase):
                 layer["v"] = self._import_fn(
                     layer["v"], idx,
                     jnp.asarray(payload["pages"][li]["v"][sel]))
+            slot = -1
+            if self.chains is not None:
+                # the exporter's chain-head hash carries the tenant salt
+                # inside the digest; the salt arg only labels a freshly
+                # minted slot, and cross-replica imports are keyed by
+                # content alone
+                slot = self.chains.slot_for(hashes[0])
+                self.chains.imported(slot, len(take_pids))
+                flight.evt(flight.PREFIX_IMPORT, len(take_pids), slot)
             for i, pid in zip(take_idx, take_pids):
-                self._register_page(pid, hashes[i])
+                self._register_page(pid, hashes[i], chain=slot)
                 self._cached_lru[pid] = None
             self.stats["prefix_imported_pages"] += len(take_pids)
             return len(take_pids)
@@ -1441,20 +1529,48 @@ class PagedInferenceEngine(_EngineBase):
             "decode": self.stats["decode_dispatches"],
             "spec": self.stats["spec_dispatches"]}}
 
-    def pool_stats(self) -> dict:
+    def prefix_accounting(self) -> dict:
+        """THE accounting source for prefix-cache counters. pool_stats(),
+        the telemetry gauges (llm/telemetry.py) and the fleet rollup
+        (serve.metrics_summary()["prefix_cache"]) all derive from this
+        one snapshot, so the surfaces can never drift from each other —
+        tests/test_cache_heat.py asserts the parity."""
         hits = self.stats["prefix_hits"]
         misses = self.stats["prefix_misses"]
+        return {
+            "hits": hits,
+            "misses": misses,
+            "evictions": self.stats["prefix_evictions"],
+            "tokens_saved": self.stats["prefix_tokens_saved"],
+            "imported_pages": self.stats["prefix_imported_pages"],
+            "exported_pages": self.stats["prefix_exported_pages"],
+            "cached_pages": len(self._cached_lru),
+            "hit_rate": round(hits / (hits + misses), 4)
+            if hits + misses else 0.0,
+        }
+
+    def pool_stats(self) -> dict:
+        acct = self.prefix_accounting()
         return {
             # free + cached together are the allocatable pool: cached
             # pages hold reusable prefix KV but evict on demand, so a
             # "full" pool with a deep cache is warm, not saturated
             "free_pages": len(self._free_pages),
-            "cached_pages": len(self._cached_lru),
+            "cached_pages": acct["cached_pages"],
             "total_pages": self.cfg.num_pages,
-            "prefix_hit_rate": round(hits / (hits + misses), 4)
-            if hits + misses else 0.0,
+            "prefix_hit_rate": acct["hit_rate"],
             "active": len(self._active),
             "prefilling": len(self._prefilling),
             "pending": len(self._pending),
             **self.stats,
         }
+
+    def chain_stats_report(self, top_k: Optional[int] = None) -> dict:
+        """Heat-plane snapshot: bounded-table stats, whole-table totals
+        (== the matching prefix_accounting() aggregates), and the top-K
+        hot chains. Empty dict when the table is disabled."""
+        if self.chains is None:
+            return {}
+        if top_k is None:
+            top_k = self.cfg.chain_stats_top_k
+        return self.chains.report(top_k)

@@ -40,6 +40,23 @@ Metric names (all prefixed ``rtpu_llm_``):
       skipped via cached pages
   prefix_cached_pages    gauge      unreferenced pages retained for reuse
   prefix_cache_hit_rate  gauge      hits / (hits + misses), cumulative
+  prefix_cache_imported_pages_total counter  pages seeded from another
+      replica's export (cross-replica prefix sharing)
+  prefix_cache_exported_pages_total counter  cached pages gathered to host
+      for another replica's import
+
+Cache heat plane (llm/chainstats.py) — per-chain series, bounded to the
+engine's top-K chains plus the ``__overflow__`` sink so label
+cardinality can never follow prompt diversity:
+  prefix_chain_hits         gauge  cumulative page hits, per hot chain
+  prefix_chain_tokens_saved gauge  prompt tokens skipped, per hot chain
+  prefix_chain_resident_pages gauge  pages of the chain now in HBM
+  prefix_chain_last_hit_age_s gauge  seconds since the chain last hit
+  prefix_chain_tracked      gauge  chains with dedicated slots (rollup)
+
+The prefix gauges and the fleet rollup both read
+``engine.prefix_accounting()`` — the single accounting source shared
+with ``pool_stats()`` — so surfaces cannot drift apart.
 """
 from __future__ import annotations
 
@@ -218,18 +235,22 @@ def on_step(engine) -> None:
                "KV pages in use / pool size").set(
             (pool - len(free) - cached) / max(pool, 1), tags=gtags)
         if getattr(engine, "_prefix_on", False):
+            # single accounting source (paged_engine.prefix_accounting):
+            # the gauges here, pool_stats() and metrics_summary() must
+            # agree by construction, not by parallel bookkeeping
+            acct = engine.prefix_accounting()
             _gauge("rtpu_llm_prefix_cached_pages",
                    "unreferenced KV pages retained for prefix reuse").set(
-                cached, tags=gtags)
-            hits = engine.stats.get("prefix_hits", 0)
-            misses = engine.stats.get("prefix_misses", 0)
-            if hits + misses:
+                acct["cached_pages"], tags=gtags)
+            if acct["hits"] + acct["misses"]:
                 _gauge("rtpu_llm_prefix_cache_hit_rate",
                        "prefix cache hits / (hits + misses)").set(
-                    hits / (hits + misses), tags=gtags)
+                    acct["hit_rate"], tags=gtags)
     stats = getattr(engine, "stats", None)
     if stats:
         _ship_stat_deltas(engine, stats, tags)
+    if getattr(engine, "chains", None) is not None:
+        _ship_chain_stats(engine, gtags)
 
 
 _STAT_COUNTERS = (
@@ -253,6 +274,10 @@ _STAT_COUNTERS = (
      "cached pages reclaimed under allocation pressure", None),
     ("prefix_tokens_saved", "rtpu_llm_prefix_cache_tokens_saved_total",
      "prompt tokens whose prefill was skipped via cached pages", None),
+    ("prefix_imported_pages", "rtpu_llm_prefix_cache_imported_pages_total",
+     "pages seeded from another replica's export", None),
+    ("prefix_exported_pages", "rtpu_llm_prefix_cache_exported_pages_total",
+     "cached pages gathered to host for another replica", None),
 )
 
 
@@ -273,6 +298,52 @@ def _ship_stat_deltas(engine, stats: dict, tags: dict) -> None:
         else:
             _counter(name, desc, tag_keys=("engine", "family")).inc(
                 float(delta), tags={**tags, "family": family})
+
+
+def _chain_gauge(name, desc):
+    # per-chain gauges: the `chain` label values come verbatim from the
+    # ChainStatsTable's slot identities (minted once, at most
+    # chain_stats_slots of them, plus __overflow__), so the series set
+    # stays bounded no matter how diverse client prompts are
+    return cached_metric(Gauge, name, desc,
+                         tag_keys=("engine", "proc", "chain"))
+
+
+#: seconds between chain-gauge publishes. The per-chain table updates at
+#: O(1) on the hot path; only this snapshot walk is rate-limited.
+_CHAIN_SHIP_INTERVAL_S = 2.0
+
+
+def _ship_chain_stats(engine, gtags: dict) -> None:
+    """Publish the engine's top-K hot chains (+ overflow sink) as
+    per-chain gauges. Gauge semantics fit: per-chain values are
+    last-write-wins snapshots of cumulative table counters, and a
+    replica's series zero out with the other proc gauges on exit."""
+    now = time.monotonic()
+    last = getattr(engine, "_chain_ship_t", 0.0)
+    if now - last < _CHAIN_SHIP_INTERVAL_S:
+        return
+    engine._chain_ship_t = now
+    rows = engine.chains.top(engine.cfg.chain_stats_top_k, now)
+    for row in rows:
+        ctags = {**gtags, "chain": row["chain"]}
+        _chain_gauge("rtpu_llm_prefix_chain_hits",
+                     "cumulative prefix-cache page hits, per hot "
+                     "chain").set(row["hits"], tags=ctags)
+        _chain_gauge("rtpu_llm_prefix_chain_tokens_saved",
+                     "prompt tokens whose prefill was skipped, per hot "
+                     "chain").set(row["tokens_saved"], tags=ctags)
+        _chain_gauge("rtpu_llm_prefix_chain_resident_pages",
+                     "KV pages of the chain currently in HBM").set(
+            row["resident_pages"], tags=ctags)
+        age = row["last_hit_age_s"]
+        if age is not None:
+            _chain_gauge("rtpu_llm_prefix_chain_last_hit_age_s",
+                         "seconds since the chain last served a "
+                         "hit").set(age, tags=ctags)
+    _gauge("rtpu_llm_prefix_chain_tracked",
+           "chains holding dedicated heat-table slots").set(
+        engine.chains.stats()["tracked"], tags=gtags)
 
 
 # --------------------------------------------------------------------- #

@@ -17,7 +17,16 @@ ONE cluster cache:
   seeds its own cache via ``import_prefix``; admission then hits
   locally as if the pages had been computed here. Greedy decoding over
   imported pages is bit-identical to a cold prefill — the pages ARE
-  the cold prefill's pages, moved.
+  the cold prefill's pages, moved;
+- **heat**: each publish cadence also files ONE bounded summary entry
+  under the string key ``"heat:<host:pid>"`` in the same directory —
+  pool occupancy, hit rate, and the engine's top-K hot chains from the
+  cache heat plane (llm/chainstats.py). String keys cannot collide
+  with the 16-byte page-hash keys and importers only query by hash, so
+  the summaries are invisible to the import path; they ride the same
+  dir_update frames (no protocol change), are owner-stamped so a dead
+  replica's summary sweeps with its page entries, and feed the head's
+  ``cache_report()`` / ``cli cache`` cluster heat map.
 
 Failure model (the consistency rule the README documents): every
 directory entry is a HINT. Owner dead, pages evicted, head gone — the
@@ -71,12 +80,16 @@ class PrefixDirectoryClient:
             return 0
         self._last_publish = now
         new, dropped = engine.drain_directory_delta()
-        if not new and not dropped:
+        put: dict = {h: self._self_handle for h in new}
+        heat = self._heat_summary(engine)
+        if heat is not None:
+            # refreshed every cadence even with no page deltas: last-hit
+            # ages and pool occupancy move while the key set stands still
+            put[heat["key"]] = heat["value"]
+        if not put and not dropped:
             return 0
         from ...core import directory as cdir
-        ok = cdir.update(self.dir_name,
-                         put={h: self._self_handle for h in new},
-                         drop=list(dropped))
+        ok = cdir.update(self.dir_name, put=put, drop=list(dropped))
         if ok and new:
             try:
                 from .. import metrics as sm
@@ -85,6 +98,39 @@ class PrefixDirectoryClient:
             except Exception:
                 pass  # telemetry must never fail the engine loop
         return len(new) if ok else 0
+
+    def _heat_summary(self, engine) -> Optional[dict]:
+        """One bounded dict describing this replica's cache heat —
+        {"key": "heat:<proc>", "value": {...}} — or None when the
+        engine's heat plane is off. Size is capped by construction:
+        top-K chain rows + a handful of pool scalars."""
+        try:
+            report = engine.chain_stats_report()
+            if not report:
+                return None
+            from ...llm.telemetry import _proc
+            acct = engine.prefix_accounting()
+            pool = engine.pool_stats()
+            page_bytes = report["table"]["page_bytes"]
+            cached = acct["cached_pages"]
+            return {"key": f"heat:{_proc()}", "value": {
+                "model": self.model_id,
+                "proc": _proc(),
+                "ts": time.time(),
+                "hit_rate": acct["hit_rate"],
+                "pool": {
+                    "free_pages": pool["free_pages"],
+                    "cached_pages": cached,
+                    "total_pages": pool["total_pages"],
+                    "page_bytes": page_bytes,
+                    # what tiering could spill today: refcount-0 pages
+                    # held only for possible reuse
+                    "reclaimable_bytes": cached * page_bytes,
+                },
+                "chains": report["chains"],
+            }}
+        except Exception:
+            return None  # heat is telemetry; never fail the engine loop
 
     # -- import ----------------------------------------------------------
 

@@ -286,8 +286,13 @@ def metrics_summary() -> dict:
           {count, mean, p50, p95, p99} in seconds
       kv_utilization / batch_occupancy — {<engine>: value of the
           most-loaded process}
-      prefix_cache — {hits, misses, evictions, tokens_saved, hit_rate,
+      prefix_cache — {hits, misses, evictions, tokens_saved,
+          imported_pages, exported_pages, hit_rate,
           cached_pages: {<engine>: pages on the deepest-cache process}}
+      cache — the heat plane's per-chain fold: {chains: [{chain, hits,
+          tokens_saved, resident_pages, last_hit_age_s}, ...hot-first],
+          tracked_chains} summed across replicas from the bounded
+          rtpu_llm_prefix_chain_* gauges
       tenants — {<tenant>: {admitted, shed}} per-tenant admission
           outcomes (front-door fairness/quota counter-verification)
       lora — {requests, hits, loads, evictions, swaps, publishes,
@@ -335,8 +340,38 @@ def metrics_summary() -> dict:
                 store.get("rtpu_llm_prefix_cache_evictions_total")),
             "tokens_saved": _counter_total(
                 store.get("rtpu_llm_prefix_cache_tokens_saved_total")),
+            "imported_pages": _counter_total(
+                store.get("rtpu_llm_prefix_cache_imported_pages_total")),
+            "exported_pages": _counter_total(
+                store.get("rtpu_llm_prefix_cache_exported_pages_total")),
             "hit_rate": hits / (hits + misses),
             "cached_pages": cached,
+        }
+    # cache heat plane: the per-chain gauge fold (bounded — top-K per
+    # engine plus __overflow__ by construction, llm/telemetry.py)
+    chains: dict = {}
+    for name, field, fold in (
+            ("rtpu_llm_prefix_chain_hits", "hits", "sum"),
+            ("rtpu_llm_prefix_chain_tokens_saved", "tokens_saved",
+             "sum"),
+            ("rtpu_llm_prefix_chain_resident_pages", "resident_pages",
+             "sum"),
+            ("rtpu_llm_prefix_chain_last_hit_age_s", "last_hit_age_s",
+             "min")):
+        rec = store.get(name)
+        for kk, vv in (rec or {}).get("series", {}).items():
+            chain = next((v for k, v in kk if k == "chain"), "")
+            row = chains.setdefault(chain, {"chain": chain})
+            if fold == "sum":
+                row[field] = row.get(field, 0.0) + vv
+            else:
+                row[field] = min(row.get(field, vv), vv)
+    if chains:
+        out["cache"] = {
+            "chains": sorted(chains.values(),
+                             key=lambda r: -r.get("hits", 0.0)),
+            "tracked_chains": _counter_total(
+                store.get("rtpu_llm_prefix_chain_tracked")),
         }
     disp = store.get("rtpu_serve_stream_dispatches_total")
     items = store.get("rtpu_serve_stream_items_total")

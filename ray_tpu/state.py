@@ -269,6 +269,20 @@ def slo_report() -> dict:
     return _head().slo_report()
 
 
+def cache_report(top_k: int = 10) -> dict:
+    """The cluster-wide prefix-cache heat map (cache heat plane):
+    fleet hit/miss/eviction totals, the ``top_k`` hottest prompt chains
+    folded across replicas, per-replica pool summaries from the shared
+    prefix directories (with reclaimable — cached-but-unreferenced —
+    bytes), per-tenant warmth, and a recent hit-rate trend when the
+    TSDB scraper is on. What ``cli cache`` and GET /api/cache render,
+    and the signal base for KV tiering / tenant prewarming."""
+    remote = _remote()
+    if remote is not None:
+        return remote._rpc("cache_report", top_k)
+    return _head().cache_report(top_k=top_k)
+
+
 def stack_report(timeout_s: float = 3.0) -> dict:
     """Cluster-wide live thread stacks (reference: `ray stack`), pulled
     over the control plane from every worker and driver and annotated

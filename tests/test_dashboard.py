@@ -66,6 +66,14 @@ def test_dashboard_pages(dash):
     status, body = _get(port, "/api/timeline")
     assert status == 200 and isinstance(json.loads(body), list)
 
+    # cache heat plane: /api/cache renders the cluster heat map shape
+    # even on a cluster with no LLM traffic (empty but well-formed)
+    status, body = _get(port, "/api/cache")
+    assert status == 200
+    cache = json.loads(body)
+    assert "totals" in cache and "chains" in cache \
+        and "replicas" in cache and "pages" in cache
+
     status, body = _get(port, "/api/bogus")
     assert status == 404 or "error" in body
 

@@ -549,6 +549,42 @@ def test_gl007_data_namespace_lookalikes_rejected():
     assert all("does not match" in f.message for f in found)
 
 
+def test_gl007_prefix_chain_family_allowed():
+    """The cache heat plane's per-chain family (llm/telemetry.py's
+    _chain_gauge) rides the llm namespace: rtpu_llm_prefix_chain_*
+    passes as-is — pinned so a namespace rename can't silently orphan
+    the heat map from cache_report()/`cli cache` — while lookalikes
+    (rtpu_chain_, bare prefix_chain_) still fail."""
+    src = """
+        from ray_tpu.util.metrics import Gauge, cached_metric
+
+        def chain_gauge(name, desc):
+            return cached_metric(Gauge, name, desc,
+                                 tag_keys=("engine", "proc", "chain"))
+
+        def ship():
+            chain_gauge("rtpu_llm_prefix_chain_hits", "d")
+            chain_gauge("rtpu_llm_prefix_chain_tokens_saved", "d")
+            chain_gauge("rtpu_llm_prefix_chain_resident_pages", "d")
+            chain_gauge("rtpu_llm_prefix_chain_last_hit_age_s", "d")
+            chain_gauge("rtpu_llm_prefix_chain_tracked", "d")
+    """
+    assert lint(src, rules={"GL007"}) == []
+
+
+def test_gl007_prefix_chain_lookalikes_rejected():
+    src = """
+        from ray_tpu.util.metrics import Gauge, cached_metric
+
+        BAD1 = cached_metric(Gauge, "rtpu_chain_prefix_hits")
+        BAD2 = cached_metric(Gauge, "prefix_chain_hits")
+        BAD3 = Gauge("rtpu_llm_Prefix_Chain_hits")
+    """
+    found = lint(src, rules={"GL007"})
+    assert len(found) == 3
+    assert all("does not match" in f.message for f in found)
+
+
 # ------------------------------------------------------------------ #
 # GL008 swallowed exceptions
 # ------------------------------------------------------------------ #
@@ -841,6 +877,38 @@ def test_gl011_suppression():
         def record(m, status):
             # bounded server-chosen code
             m.inc(1.0, tags={"status": str(status)})  # graftlint: disable=GL011
+    """
+    assert lint(src, rules={"GL011"}) == []
+
+
+def test_gl011_formatted_chain_hash_labels_rejected():
+    """A chain-hash label minted BY FORMATTING at the record site is
+    exactly the unbounded case the heat plane was designed around:
+    client prompts choose the hash, so f"{head.hex()}" / str(head) in
+    tags= grows one series per distinct prompt family. The table's
+    precomputed row["chain"] labels (bounded by chain_stats_slots) are
+    the sanctioned shape."""
+    src = """
+        def ship(g, head, slot):
+            g.set(1.0, tags={"chain": f"{head}"})
+            g.set(1.0, tags={"chain": str(head)})
+            g.set(1.0, tags={"chain": "chain-" + head})
+    """
+    found = lint(src, rules={"GL011"})
+    assert len(found) == 3
+
+
+def test_gl011_precomputed_chain_labels_pass():
+    # telemetry.py's _ship_chain_stats shape: label values come verbatim
+    # from the ChainStatsTable rows (minted once at slot creation, at
+    # most chain_stats_slots + __overflow__ of them) — plain variables
+    # at the record site, so the rule stays quiet
+    src = """
+        def ship(g, engine, gtags, now):
+            rows = engine.chains.top(engine.cfg.chain_stats_top_k, now)
+            for row in rows:
+                ctags = {**gtags, "chain": row["chain"]}
+                g.set(row["hits"], tags=ctags)
     """
     assert lint(src, rules={"GL011"}) == []
 
