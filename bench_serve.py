@@ -25,7 +25,16 @@ tail churns through eviction. Emits a warm-TTFT + hit-rate line and a
 per-chain heat-histogram line (fold both with ``bench_trend
 --history``), counter-verified: per-chain totals == engine aggregates
 == flushed ``rtpu_llm_prefix_cache_*`` counters. This is ROADMAP item
-4's success-metric harness, recorded before tiering lands.
+4's success-metric harness.
+
+``--long-tail --tiered``: adds an A/B arm replaying the bit-identical
+request stream with ``kv_spill`` on (host tier budget 10x the device
+pool): evicted prefixes demote to the host spill tier and promote
+back on revisit instead of re-prefilling cold. Asserts the two arms'
+greedy outputs match token-for-token and counter-verifies the tiered
+arm against ``rtpu_llm_prefix_spill_*`` and
+``metrics_summary()["cache"]["spill"]``; emits a third JSON line with
+the tiered hit rate (vs_baseline = tiered / untiered hit rate).
 
 ``--trace out.json``: flight-record the measured section (core/flight.py)
 and print a wait/dispatch breakdown JSON line next to the numbers; the
@@ -270,41 +279,51 @@ def _long_tail():
         n_sessions, n_requests = 72, 300
         prefix_len, tail_len, max_tokens = 64, 8, 4
     alpha = 1.1
-
-    rng = np.random.RandomState(0)
-    sessions = [list(rng.randint(1, model.vocab_size, (prefix_len,)))
-                for _ in range(n_sessions)]
+    tiered = "--tiered" in sys.argv
     # working set: every session's prefix pages + a decode page; the
     # pool holds a fraction of it, so residency is earned by heat
     pages_per_prefix = prefix_len // cfg.page_size
     working_set = n_sessions * pages_per_prefix
-    # Zipf-ranked popularity over the session ids
-    weights = 1.0 / np.arange(1, n_sessions + 1) ** alpha
-    weights /= weights.sum()
-    order = rng.choice(n_sessions, size=n_requests, p=weights)
-
-    eng = PagedInferenceEngine(cfg, rng_seed=0)
-    eng.warmup()
-    sp = SamplingParams(max_tokens=max_tokens, temperature=0.0)
 
     trace_t0 = time.monotonic_ns()
-    seen: set = set()
-    warm_ttfts, cold_ttfts = [], []
-    t0 = time.perf_counter()
-    for i, sid in enumerate(order):
-        ids = sessions[sid] + list(
-            rng.randint(1, model.vocab_size, (tail_len,)))
-        r = eng.submit(ids, sp)
-        while not r.done:
-            eng.step()
-        ttft = r.first_token_t - r.submit_t
-        (warm_ttfts if sid in seen else cold_ttfts).append(ttft)
-        seen.add(sid)
-    wall = time.perf_counter() - t0
 
-    # force one final telemetry publish (chain gauges are rate-limited)
-    eng._chain_ship_t = 0.0
-    telemetry.on_step(eng)
+    def _run_arm(acfg, spill_budget_pages=None):
+        # fresh rng per arm, same seed: every arm replays a
+        # bit-identical session/order/tail stream
+        rng = np.random.RandomState(0)
+        sessions = [list(rng.randint(1, model.vocab_size,
+                                     (prefix_len,)))
+                    for _ in range(n_sessions)]
+        # Zipf-ranked popularity over the session ids
+        weights = 1.0 / np.arange(1, n_sessions + 1) ** alpha
+        weights /= weights.sum()
+        order = rng.choice(n_sessions, size=n_requests, p=weights)
+        arm = PagedInferenceEngine(acfg, rng_seed=0)
+        if spill_budget_pages is not None:
+            arm.spill.max_bytes = (spill_budget_pages
+                                   * arm.spill.page_nbytes)
+        arm.warmup()
+        sp = SamplingParams(max_tokens=max_tokens, temperature=0.0)
+        seen: set = set()
+        warm, cold, outs = [], [], []
+        t0 = time.perf_counter()
+        for sid in order:
+            ids = sessions[sid] + list(
+                rng.randint(1, model.vocab_size, (tail_len,)))
+            r = arm.submit(ids, sp)
+            while not r.done:
+                arm.step()
+            ttft = r.first_token_t - r.submit_t
+            (warm if sid in seen else cold).append(ttft)
+            seen.add(sid)
+            outs.append(tuple(r.out_ids))
+        arm_wall = time.perf_counter() - t0
+        # force a final telemetry publish (chain gauges rate-limited)
+        arm._chain_ship_t = 0.0
+        telemetry.on_step(arm)
+        return arm, warm, cold, outs, arm_wall
+
+    eng, warm_ttfts, cold_ttfts, outs_u, wall = _run_arm(cfg)
 
     # -- counter verification: table == engine.stats == metric store -- #
     st, totals = eng.stats, eng.chains.totals()
@@ -361,6 +380,77 @@ def _long_tail():
                  f"table_max_bytes={eng.chains.stats()['max_bytes']}"),
         "vs_baseline": None,
     }))
+
+    if tiered:
+        # A/B arm: same engine config + kv_spill on, host budget 10x
+        # the device pool — evicted prefixes demote to the host tier
+        # instead of dying, and a revisit promotes them back
+        # (bit-identical pages) instead of re-prefilling cold.
+        import dataclasses
+        from ray_tpu.serve.metrics import metrics_summary
+        budget_pages = 10 * cfg.num_pages
+        teng, t_warm, t_cold, outs_t, t_wall = _run_arm(
+            dataclasses.replace(cfg, kv_spill=True),
+            spill_budget_pages=budget_pages)
+        # promoted pages must be bit-identical to a cold prefill:
+        # greedy outputs of the two arms match token-for-token
+        assert outs_t == outs_u, \
+            "tiered arm outputs diverged from untiered arm"
+        # counter-verify the tiered arm: chain-table sums == engine
+        # aggregates == live tier residence == shipped
+        # rtpu_llm_prefix_spill_* store == metrics_summary() fold
+        # (the untiered arm ships zero spill events, so the store's
+        # spill rows are the tiered arm's alone)
+        ts, ttot = teng.stats, teng.chains.totals()
+        tacct = teng.prefix_accounting()
+        assert ttot["spilled_pages"] == teng.spill.resident_pages()
+        assert ttot["promotions"] == ts["spill_promotions"]
+        assert tacct["spill_resident_pages"] == \
+            teng.spill.resident_pages()
+        assert tacct["spill_demotions"] == ts["spill_demotions"]
+        store2 = collect_store()
+
+        def _shipped2(name):
+            rec = store2.get(name)
+            return sum(rec["series"].values()) if rec else 0.0
+        for name, sk in (
+                ("rtpu_llm_prefix_spill_demotions_total",
+                 "spill_demotions"),
+                ("rtpu_llm_prefix_spill_promotions_total",
+                 "spill_promotions"),
+                ("rtpu_llm_prefix_spill_expired_total",
+                 "spill_expired"),
+                ("rtpu_llm_prefix_spill_pages_total", "spill_pages"),
+                ("rtpu_llm_prefix_spill_bytes_total", "spill_bytes")):
+            assert int(_shipped2(name)) == ts[sk], \
+                f"spill metric drift: {name}={_shipped2(name)} " \
+                f"vs {sk}={ts[sk]}"
+        fold = metrics_summary()["cache"]["spill"]
+        assert fold["demotions"] == ts["spill_demotions"]
+        assert fold["promotions"] == ts["spill_promotions"]
+        assert ts["spill_promotions"] > 0, \
+            "tiered arm never promoted — scenario broken"
+        t_warm_p50 = sorted(t_warm)[len(t_warm) // 2]
+        t_cold_p50 = sorted(t_cold)[len(t_cold) // 2]
+        hit_gain = tacct["hit_rate"] / max(acct["hit_rate"], 1e-9)
+        print(json.dumps({
+            "metric": "serve_longtail_tiered_hit_rate",
+            "value": round(tacct["hit_rate"], 4),
+            "unit": (f"hit rate with kv_spill on vs "
+                     f"{acct['hit_rate']:.3f} untiered (spill budget "
+                     f"{budget_pages}p = 10x pool, demotions="
+                     f"{ts['spill_demotions']}, promotions="
+                     f"{ts['spill_promotions']}, expired="
+                     f"{ts['spill_expired']}, tokens_saved="
+                     f"{tacct['tokens_saved']} vs "
+                     f"{acct['tokens_saved']}, warm p50 "
+                     f"{t_warm_p50:.4f}s vs {warm_p50:.4f}s, cold p50 "
+                     f"{t_cold_p50:.4f}s vs {cold_p50:.4f}s, outputs "
+                     f"bit-identical, wall {t_wall:.1f}s vs "
+                     f"{wall:.1f}s, {jax.devices()[0].platform})"),
+            "vs_baseline": round(hit_gain, 4),
+        }))
+
     from bench import flight_report, trace_arg
     flight_report(trace_arg(sys.argv), trace_t0)
 

@@ -7,7 +7,13 @@ never queue behind the rpc pool). The serve front door rides two of
 these: ``serve:routes`` (the proxies' shared route table, one snapshot
 entry the controller republishes on every topology change) and
 ``serve:prefix:<model>`` (the cluster-wide prefix-cache directory:
-chained page hash -> owning replica).
+chained page hash -> owning replica). The prefix directories also
+carry two string-keyed entry families beside the 16-byte page hashes
+— ``"heat:<proc>"`` replica cache summaries and, under the tiered
+KV-cache, ``"spill:<hash hex>" -> {"m": model, "oid": ref bytes}``
+rows pointing at store-materialized demoted pages. String keys cannot
+collide with hash keys; both families are owner-stamped like any
+entry, so they sweep with their replica.
 
 Consistency model — entries are HINTS, never correctness:
 

@@ -2565,7 +2565,11 @@ class Runtime:
         chains), the merged metric store's ``rtpu_llm_prefix_cache_*``
         aggregates, and the per-chain ``rtpu_llm_prefix_chain_*``
         gauges — so it works whether or not the TSDB scraper is on
-        (trend is attached only when it is)."""
+        (trend is attached only when it is). When the tiered KV-cache
+        ran anywhere, a ``spill`` section carries the fleet's
+        ``rtpu_llm_prefix_spill_*`` lifecycle totals and residency,
+        and each replica row counts its directory's ``spill:``
+        store-backed entries."""
         now = time.time()
         top_k = max(int(top_k), 1)
         # -- per-replica heat summaries from the shared directories ---- #
@@ -2575,11 +2579,16 @@ class Runtime:
             if not name.startswith("serve:prefix:"):
                 continue
             heats = self.dirs.lookup_prefix(name, "heat:")
+            # spill: entries (tiered KV-cache, llm/tiering.py) share
+            # the directory but are store-backed pages, not live ones
+            n_spill = len(self.dirs.lookup_prefix(name, "spill:"))
             for _k, v in sorted(heats.items()):
                 row = dict(v)
                 ts = row.pop("ts", None)
                 row["age_s"] = round(now - ts, 1) if ts else None
-                row["directory_pages"] = dir_sizes[name] - len(heats)
+                row["directory_pages"] = \
+                    dir_sizes[name] - len(heats) - n_spill
+                row["directory_spilled"] = n_spill
                 replicas.append(row)
         # -- fleet totals from the merged counter store ---------------- #
         def _total(metric: str) -> float:
@@ -2593,6 +2602,14 @@ class Runtime:
             seen = totals["hits"] + totals["misses"]
             totals["hit_rate"] = round(totals["hits"] / seen, 4) \
                 if seen else 0.0
+            spill_totals = {
+                k: _total(f"rtpu_llm_prefix_spill_{k}_total")
+                for k in ("pages", "bytes", "demotions", "promotions",
+                          "expired", "drops")}
+            spill_totals["resident_pages"] = _total(
+                "rtpu_llm_prefix_spill_resident_pages")
+            spill_totals["resident_bytes"] = _total(
+                "rtpu_llm_prefix_spill_resident_bytes")
             # -- cluster chain fold: sum per-chain gauges across procs - #
             chains: dict[str, dict] = {}
             for metric, field, fold in (
@@ -2620,13 +2637,16 @@ class Runtime:
         # -- per-tenant warmth + pool rollup from replica summaries ---- #
         tenants: dict[str, dict] = {}
         pages = {"free": 0, "cached": 0, "total": 0,
-                 "reclaimable_bytes": 0}
+                 "reclaimable_bytes": 0,
+                 "spilled": 0, "spilled_bytes": 0}
         for rep in replicas:
             pool = rep.get("pool") or {}
             pages["free"] += pool.get("free_pages", 0)
             pages["cached"] += pool.get("cached_pages", 0)
             pages["total"] += pool.get("total_pages", 0)
             pages["reclaimable_bytes"] += pool.get("reclaimable_bytes", 0)
+            pages["spilled"] += pool.get("spilled_pages", 0)
+            pages["spilled_bytes"] += pool.get("spilled_bytes", 0)
             for c in rep.get("chains") or ():
                 t = tenants.setdefault(
                     c.get("tenant", ""), {"hits": 0, "tokens_saved": 0,
@@ -2637,6 +2657,8 @@ class Runtime:
         out = {"generated_at": now, "totals": totals,
                "chains": chain_rows, "replicas": replicas,
                "pages": pages, "tenants": tenants}
+        if any(spill_totals.values()) or pages["spilled"]:
+            out["spill"] = spill_totals
         # -- recent trend, only when the TSDB scraper is running ------- #
         if self.obs is not None:
             try:

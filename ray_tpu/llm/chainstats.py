@@ -63,6 +63,12 @@ class ChainStatsTable:
         self.imported_pages = np.zeros((n,), np.int64)
         self.exported_pages = np.zeros((n,), np.int64)
         self.resident_pages = np.zeros((n,), np.int64)
+        # spill tier (llm/tiering.py): pages of the chain resident in
+        # the host tier, and pages promoted back into HBM from it —
+        # zero everywhere while kv_spill is off, so legacy accounting
+        # is reproduced exactly
+        self.spilled_pages = np.zeros((n,), np.int64)
+        self.promotions = np.zeros((n,), np.int64)
         self.last_hit = np.zeros((n,), np.float64)  # time.monotonic()
         self._slot_by_key: dict[bytes, int] = {}
         # slot identity, written once at creation (bounded label mint)
@@ -119,6 +125,15 @@ class ChainStatsTable:
     def resident_sub(self, slot: int) -> None:
         self.resident_pages[slot] -= 1
 
+    def spilled_add(self, slot: int) -> None:
+        self.spilled_pages[slot] += 1
+
+    def spilled_sub(self, slot: int) -> None:
+        self.spilled_pages[slot] -= 1
+
+    def promoted(self, slot: int, pages: int) -> None:
+        self.promotions[slot] += pages
+
     # -- reporting -----------------------------------------------------
 
     def _row(self, s: int, now: float) -> dict:
@@ -133,6 +148,8 @@ class ChainStatsTable:
             "exported_pages": int(self.exported_pages[s]),
             "resident_pages": int(self.resident_pages[s]),
             "resident_bytes": int(self.resident_pages[s]) * self.page_bytes,
+            "spilled_pages": int(self.spilled_pages[s]),
+            "promotions": int(self.promotions[s]),
             "last_hit_age_s": round(now - self.last_hit[s], 3)
             if self.last_hit[s] else None,
         }
@@ -164,12 +181,15 @@ class ChainStatsTable:
             "imported_pages": int(self.imported_pages.sum()),
             "exported_pages": int(self.exported_pages.sum()),
             "resident_pages": int(self.resident_pages.sum()),
+            "spilled_pages": int(self.spilled_pages.sum()),
+            "promotions": int(self.promotions.sum()),
         }
 
     def stats(self) -> dict:
         arrays = (self.hits, self.misses, self.tokens_saved,
                   self.evictions, self.imported_pages,
-                  self.exported_pages, self.resident_pages, self.last_hit)
+                  self.exported_pages, self.resident_pages,
+                  self.spilled_pages, self.promotions, self.last_hit)
         return {
             "slots": self.cap,
             "tracked": self._next - 1,

@@ -28,6 +28,7 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import threading
+import time
 from collections import OrderedDict, deque
 from typing import Any, Optional
 
@@ -117,6 +118,18 @@ class PagedEngineConfig:
     # how many chains telemetry ships / the prefix directory publishes.
     chain_stats_slots: int = 256
     chain_stats_top_k: int = 8
+    # tiered KV-cache (llm/tiering.py): demote an evicted refcount-0
+    # cached page's KV to a host spill tier instead of freeing it, and
+    # promote spilled runs back at admission time before cold prefill.
+    # Heat-gated by the chain-stats table (min_hits / max_idle_s) and
+    # byte-budgeted (kv_spill_max_bytes; coldest chains expire first).
+    # Off by default: with kv_spill off the engine reproduces legacy
+    # eviction accounting exactly — pages free, nothing is captured,
+    # every spill counter stays zero.
+    kv_spill: bool = False
+    kv_spill_max_bytes: int = 64 << 20
+    kv_spill_min_hits: int = 0
+    kv_spill_max_idle_s: float = 0.0
     tokenizer: Any = None
 
     def __post_init__(self):
@@ -129,6 +142,11 @@ class PagedEngineConfig:
         if self.chain_stats_slots < 0 or self.chain_stats_top_k < 1:
             raise ValueError("chain_stats_slots must be >= 0 and "
                              "chain_stats_top_k >= 1")
+        if self.kv_spill and not self.enable_prefix_caching:
+            raise ValueError("kv_spill requires enable_prefix_caching "
+                             "(the tier holds content-hashed pages)")
+        if self.kv_spill and self.kv_spill_max_bytes <= 0:
+            raise ValueError("kv_spill_max_bytes must be > 0")
 
     @property
     def max_seq_len(self) -> int:
@@ -190,12 +208,28 @@ class PagedInferenceEngine(_EngineBase):
         # never learned fall to the overflow sink on eviction.
         self.chains = None
         self._chain_of: dict[int, int] = {}
+        page_nbytes = sum(int(l["k"].nbytes) + int(l["v"].nbytes)
+                          for l in self.caches) // max(cfg.num_pages, 1)
         if self._prefix_on and cfg.chain_stats_slots > 0:
             from .chainstats import ChainStatsTable
-            page_nbytes = sum(int(l["k"].nbytes) + int(l["v"].nbytes)
-                              for l in self.caches) // max(cfg.num_pages, 1)
             self.chains = ChainStatsTable(cfg.chain_stats_slots,
                                           page_nbytes)
+        # host spill tier (cfg.kv_spill, llm/tiering.py): demoted page
+        # KV staged host-side / materialized to the object store by the
+        # serving loop; all tier mutations happen under self._lock on
+        # the same call paths that mutate the hot-cache structures
+        self.spill = None
+        # longest known head-rooted hash run per chain slot — what
+        # proactive re-warm promotes (bounded: chain_stats_slots runs
+        # of at most max_pages_per_seq 16-byte hashes)
+        self._chain_runs: dict[int, list[bytes]] = {}
+        if self._prefix_on and cfg.kv_spill:
+            from .tiering import SpillPolicy, SpillTier
+            self.spill = SpillTier(
+                cfg.kv_spill_max_bytes, page_nbytes,
+                SpillPolicy(min_hits=cfg.kv_spill_min_hits,
+                            max_idle_s=cfg.kv_spill_max_idle_s))
+            self.spill.bind_chains(self.chains)
         self._next_rid = 0
         # resident-adapter slot table (cfg.max_adapters): device arrays
         # every dispatch gathers per-row; loads are donated scatters the
@@ -238,7 +272,19 @@ class PagedInferenceEngine(_EngineBase):
                       # cluster prefix directory (import_prefix), and
                       # cached pages gathered FOR a peer (export_prefix)
                       "prefix_imported_pages": 0,
-                      "prefix_exported_pages": 0}
+                      "prefix_exported_pages": 0,
+                      # spill tier (cfg.kv_spill): pages/bytes captured
+                      # into the host tier, demote decisions that kept
+                      # a tier copy (captures + clean re-evictions),
+                      # pages promoted back into HBM (admission-time,
+                      # re-warm, or cross-replica via the directory),
+                      # pages expired from the tier (budget/teardown),
+                      # and validate-on-promote drops (stale/corrupt
+                      # tier content — cost a cold prefill, nothing
+                      # else). All permanently 0 while kv_spill is off.
+                      "spill_pages": 0, "spill_bytes": 0,
+                      "spill_demotions": 0, "spill_promotions": 0,
+                      "spill_expired": 0, "spill_drops": 0}
         # speculation controller: EMA of tokens-per-slot-per-spec-dispatch
         # (starts optimistic), plus a cooldown of windowed dispatches
         # before re-probing once the EMA drops below the window
@@ -541,9 +587,63 @@ class PagedInferenceEngine(_EngineBase):
         if self._free_pages:
             return self._free_pages.pop()
         pid, _ = self._cached_lru.popitem(last=False)
+        if self.spill is not None:
+            # demote hook: capture the page's KV for the host tier
+            # BEFORE _unregister drops the hash mapping and the page id
+            # is handed back (the device page gets overwritten by its
+            # next owner)
+            self._maybe_demote(pid)
         self._unregister(pid)
         self.stats["prefix_evictions"] += 1
         return pid
+
+    def _maybe_demote(self, pid: int):
+        h = self._page_to_hash.get(pid)
+        if h is None or self._hash_to_page.get(h) != pid:
+            return      # unpublished page: nothing content-addressed
+        if self.spill.has(h):
+            # content already in the tier (promoted or re-computed,
+            # then evicted again): a clean eviction — refresh recency,
+            # copy nothing
+            self.spill.touch(h)
+            self.stats["spill_demotions"] += 1
+            return
+        slot = self._chain_of.get(pid)
+        now = time.monotonic()
+        if not self.spill.policy.admit(self.chains, slot, now):
+            return      # heat-gated: not worth tier residence — free
+        ks = [np.asarray(layer["k"][pid]) for layer in self.caches]
+        vs = [np.asarray(layer["v"][pid]) for layer in self.caches]
+        chain = slot if slot is not None else 0
+        expired = self.spill.add(h, chain, ks, vs, now)
+        captured = self.spill.has(h)
+        if captured:
+            self.stats["spill_demotions"] += 1
+            self.stats["spill_pages"] += 1
+            self.stats["spill_bytes"] += self.spill.page_nbytes
+            if self.chains is not None:
+                self.chains.spilled_add(chain)
+        self._spill_expired(expired, skip_accounted=not captured)
+
+    def _spill_expired(self, removed, skip_accounted: bool = False):
+        """Account tier entries expired under the byte budget (or
+        refused entry outright, skip_accounted — never counted in)."""
+        for _h, chain in removed:
+            if skip_accounted:
+                skip_accounted = False
+                continue    # the refused page itself: was never added
+            self.stats["spill_expired"] += 1
+            if self.chains is not None:
+                self.chains.spilled_sub(chain)
+
+    def _spill_dropped(self, removed):
+        """Account validate-on-promote failures: stale/corrupt tier
+        content purged — costs this request a cold prefill, nothing
+        else (the module failure model, llm/tiering.py)."""
+        for _h, chain in removed:
+            self.stats["spill_drops"] += 1
+            if self.chains is not None:
+                self.chains.spilled_sub(chain)
 
     def _unregister(self, pid: int):
         h = self._page_to_hash.pop(pid, None)
@@ -796,6 +896,12 @@ class PagedInferenceEngine(_EngineBase):
                 # the whole prompt (avoids deadlocking a half-prefilled seq)
                 req = self._pending[0]
                 matched = self._match_prefix(req)
+                if self.spill is not None and \
+                        self._promote_for_locked(req, len(matched)) > 0:
+                    # promoted pages registered + LRU-parked: re-walk
+                    # so the match (and the hit accounting below) sees
+                    # them exactly like never-evicted pages
+                    matched = self._match_prefix(req)
                 pages = self._claim_pages(
                     matched, self._pages_needed(len(req.prompt_ids) + 1))
                 if pages is None:
@@ -809,6 +915,13 @@ class PagedInferenceEngine(_EngineBase):
                     if hs:
                         req.chain_slot = self.chains.slot_for(
                             hs[0], req.prefix_salt)
+                        if self.spill is not None and req.chain_slot > 0:
+                            # remember the chain's longest head-rooted
+                            # hash run — what proactive re-warm promotes
+                            prev = self._chain_runs.get(req.chain_slot)
+                            if prev is None or len(hs) > len(prev):
+                                self._chain_runs[req.chain_slot] = \
+                                    list(hs[:self.cfg.max_pages_per_seq])
                 if matched:
                     # chunked prefill starts at the first uncached chunk
                     # boundary
@@ -1398,45 +1511,173 @@ class PagedInferenceEngine(_EngineBase):
         with self._lock:
             if reserve_pages is None:
                 reserve_pages = self.cfg.max_batch_size
-            hashes = payload["page_hashes"]
-            take_idx: list[int] = []
-            take_pids: list[int] = []
-            budget = self._pages_avail() - int(reserve_pages)
-            for i, h in enumerate(hashes):
-                if h in self._hash_to_page:
-                    continue    # already cached locally (either source)
-                if budget <= 0:
-                    break
-                pid = self._pop_free_page()
-                self._page_refs[pid] = 0
-                take_idx.append(i)
-                take_pids.append(pid)
-                budget -= 1
-            if not take_pids:
-                return 0
-            idx = jnp.asarray(np.asarray(take_pids, np.int32))
-            sel = np.asarray(take_idx)
-            for li, layer in enumerate(self.caches):
-                layer["k"] = self._import_fn(
-                    layer["k"], idx,
-                    jnp.asarray(payload["pages"][li]["k"][sel]))
-                layer["v"] = self._import_fn(
-                    layer["v"], idx,
-                    jnp.asarray(payload["pages"][li]["v"][sel]))
-            slot = -1
-            if self.chains is not None:
-                # the exporter's chain-head hash carries the tenant salt
-                # inside the digest; the salt arg only labels a freshly
-                # minted slot, and cross-replica imports are keyed by
-                # content alone
-                slot = self.chains.slot_for(hashes[0])
-                self.chains.imported(slot, len(take_pids))
-                flight.evt(flight.PREFIX_IMPORT, len(take_pids), slot)
-            for i, pid in zip(take_idx, take_pids):
-                self._register_page(pid, hashes[i], chain=slot)
-                self._cached_lru[pid] = None
+            return self._import_payload_locked(payload,
+                                               int(reserve_pages))
+
+    def _import_payload_locked(self, payload: dict, reserve_pages: int,
+                               chain: Optional[int] = None) -> int:
+        """The shared allocate/scatter/register/LRU-park core behind
+        import_prefix (cross-replica) and the spill-tier promote paths
+        (same payload format — a promoted page is bit-identical to a
+        never-evicted one by construction). ``chain`` pins the heat
+        attribution (promotes know their chain from the tier entry);
+        None means cross-replica import accounting: slot from the
+        payload's head hash, imported_pages counters, flight event.
+        Caller holds self._lock and serializes against stepping."""
+        hashes = payload["page_hashes"]
+        take_idx: list[int] = []
+        take_pids: list[int] = []
+        budget = self._pages_avail() - reserve_pages
+        for i, h in enumerate(hashes):
+            if h in self._hash_to_page:
+                continue    # already cached locally (either source)
+            if budget <= 0:
+                break
+            pid = self._pop_free_page()
+            self._page_refs[pid] = 0
+            take_idx.append(i)
+            take_pids.append(pid)
+            budget -= 1
+        if not take_pids:
+            return 0
+        idx = jnp.asarray(np.asarray(take_pids, np.int32))
+        sel = np.asarray(take_idx)
+        for li, layer in enumerate(self.caches):
+            layer["k"] = self._import_fn(
+                layer["k"], idx,
+                jnp.asarray(payload["pages"][li]["k"][sel]))
+            layer["v"] = self._import_fn(
+                layer["v"], idx,
+                jnp.asarray(payload["pages"][li]["v"][sel]))
+        slot = -1
+        if chain is not None:
+            slot = chain
+        elif self.chains is not None:
+            # the exporter's chain-head hash carries the tenant salt
+            # inside the digest; the salt arg only labels a freshly
+            # minted slot, and cross-replica imports are keyed by
+            # content alone
+            slot = self.chains.slot_for(hashes[0])
+        if chain is None and self.chains is not None:
+            self.chains.imported(slot, len(take_pids))
+            flight.evt(flight.PREFIX_IMPORT, len(take_pids), slot)
+        for i, pid in zip(take_idx, take_pids):
+            self._register_page(pid, hashes[i], chain=slot)
+            self._cached_lru[pid] = None
+        if chain is None:
             self.stats["prefix_imported_pages"] += len(take_pids)
-            return len(take_pids)
+        return len(take_pids)
+
+    # -- spill tier (cfg.kv_spill, llm/tiering.py) -------------------------
+
+    def _promote_for_locked(self, req: _Request, have: int) -> int:
+        """Admission-time promote: when the hot cache's longest-prefix
+        match ends but the spill tier holds the next consecutive pages
+        of the request's chain, scatter them back into HBM BEFORE cold
+        prefill. Runs under self._lock on the stepping thread (called
+        from _admit). Returns pages promoted; the caller re-matches."""
+        page = self.cfg.page_size
+        limit = self._reuse_limit(req) // page
+        if have >= limit:
+            return 0
+        need = self._pages_needed(len(req.prompt_ids) + 1)
+        if self._pages_avail() < need:
+            return 0    # admission would stall regardless: no churn
+        hashes = self._prompt_hashes(req)
+        run = self.spill.covered_run(hashes[have:limit])
+        if run <= 0:
+            return 0
+        want = hashes[have:have + run]
+        chain = self.spill.chain_of(want[0])
+        payload, dropped = self.spill.payload_for(want, page)
+        if dropped:
+            self._spill_dropped(dropped)
+        if payload is None:
+            return 0
+        n = self._import_payload_locked(payload, 0, chain=chain)
+        if n > 0:
+            self.stats["spill_promotions"] += n
+            if self.chains is not None:
+                self.chains.promoted(chain, n)
+        return n
+
+    def maybe_rewarm(self, max_pages: Optional[int] = None) -> int:
+        """Proactive re-warm: promote the hottest spilled chain's known
+        head run back into HBM while the pool has idle headroom — the
+        policy's rewarm gate (SpillPolicy.rewarm_slot). Called by the
+        serving layer's engine loop between steps (same serialization
+        as import_prefix: the scatter donates the cache pools); safe to
+        call any time, a no-op without headroom. Returns pages
+        promoted."""
+        if self.spill is None or self.chains is None:
+            return 0
+        with self._lock:
+            pool = self.cfg.num_pages - 1
+            free_frac = len(self._free_pages) / max(pool, 1)
+            slot = self.spill.policy.rewarm_slot(
+                self.chains, self.spill.spilled_slots(), free_frac)
+            if slot is None:
+                return 0
+            run = self._chain_runs.get(slot)
+            if not run:
+                return 0
+            # the head-rooted usable run: pages already hot pass
+            # through (the scatter skips them), tier-resident pages
+            # promote, the first page in neither tier ends the run
+            want: list[bytes] = []
+            for h in run:
+                if h in self._hash_to_page:
+                    want.append(h)
+                elif self.spill.has(h):
+                    want.append(h)
+                else:
+                    break
+            want = [h for h in want if h not in self._hash_to_page]
+            if max_pages is not None:
+                want = want[:max(int(max_pages), 0)]
+            if not want:
+                return 0
+            payload, dropped = self.spill.payload_for(
+                want, self.cfg.page_size)
+            if dropped:
+                self._spill_dropped(dropped)
+            if payload is None:
+                return 0
+            n = self._import_payload_locked(
+                payload, self.cfg.max_batch_size, chain=slot)
+            if n > 0:
+                self.stats["spill_promotions"] += n
+                self.chains.promoted(slot, n)
+            return n
+
+    def note_spill_promotion(self, head: bytes, pages: int) -> None:
+        """Cross-replica promote accounting (serve/frontdoor/prefix.py):
+        pages seeded via a ``spill:`` directory entry's store payload
+        count as spill promotions HERE (the tier recovered them for
+        this engine) on top of the imported_pages the scatter already
+        counted."""
+        with self._lock:
+            self.stats["spill_promotions"] += int(pages)
+            if self.chains is not None:
+                self.chains.promoted(self.chains.peek(head), int(pages))
+
+    def note_spill_drops(self, n: int) -> None:
+        """Cross-replica validate-on-promote failure accounting: a
+        stale/corrupt ``spill:`` entry cost a cold prefill."""
+        with self._lock:
+            self.stats["spill_drops"] += int(n)
+
+    def spill_teardown(self) -> int:
+        """Drop every tier entry — and with them every store segment
+        ref — so the host object store drains to exact baseline on
+        engine teardown (replica death gets the same result from the
+        owner sweep). Returns entries dropped."""
+        if self.spill is None:
+            return 0
+        with self._lock:
+            removed = self.spill.clear()
+            self._spill_expired(removed)
+            return len(removed)
 
     def drain_directory_delta(self) -> tuple:
         """-> (new_hashes, dropped_hashes) accumulated since the last
@@ -1547,6 +1788,19 @@ class PagedInferenceEngine(_EngineBase):
             "cached_pages": len(self._cached_lru),
             "hit_rate": round(hits / (hits + misses), 4)
             if hits + misses else 0.0,
+            # spill tier (cfg.kv_spill): cumulative counters + current
+            # tier residence — all zero while the tier is off, so the
+            # accounting schema is uniform across configurations
+            "spill_pages": self.stats["spill_pages"],
+            "spill_bytes": self.stats["spill_bytes"],
+            "spill_demotions": self.stats["spill_demotions"],
+            "spill_promotions": self.stats["spill_promotions"],
+            "spill_expired": self.stats["spill_expired"],
+            "spill_drops": self.stats["spill_drops"],
+            "spill_resident_pages": self.spill.resident_pages()
+            if self.spill is not None else 0,
+            "spill_resident_bytes": self.spill.resident_bytes
+            if self.spill is not None else 0,
         }
 
     def pool_stats(self) -> dict:

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time as _time
 from typing import Any, Optional
 
 from .engine import EngineConfig, InferenceEngine, SamplingParams
@@ -92,6 +93,7 @@ class LLMServer:
         self._lora_lock = threading.Lock()
         self._wake = threading.Event()
         self._stop = False
+        self._last_rewarm = 0.0   # spill-tier re-warm cadence (loop)
         self._error: Optional[BaseException] = None
         # serializes engine stepping against cross-replica page
         # import/export (the dispatches donate engine.caches, so a
@@ -199,6 +201,17 @@ class LLMServer:
                     # cluster directory (rate-limited inside; this IS
                     # the stepping thread, per the drain contract)
                     self._prefix_dir.maybe_publish(self.engine)
+                if getattr(self.engine, "spill", None) is not None:
+                    now = _time.monotonic()
+                    if now - self._last_rewarm >= 0.25:
+                        # proactive promote of the hottest spilled
+                        # chain into idle pool headroom; bounded pages
+                        # per tick so the scatter never stalls a step.
+                        # Under the steplock: the scatter donates the
+                        # cache pools (import_prefix contract).
+                        self._last_rewarm = now
+                        with self._steplock:
+                            self.engine.maybe_rewarm(max_pages=32)
                 if not worked:
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()

@@ -246,6 +246,16 @@ def on_step(engine) -> None:
                 _gauge("rtpu_llm_prefix_cache_hit_rate",
                        "prefix cache hits / (hits + misses)").set(
                     acct["hit_rate"], tags=gtags)
+            if getattr(engine, "spill", None) is not None:
+                # tier-resident gauges: what the host tier holds NOW
+                # (same accounting snapshot as the counters above)
+                _gauge("rtpu_llm_prefix_spill_resident_pages",
+                       "prefix pages resident in the host spill "
+                       "tier").set(
+                    acct["spill_resident_pages"], tags=gtags)
+                _gauge("rtpu_llm_prefix_spill_resident_bytes",
+                       "bytes resident in the host spill tier").set(
+                    acct["spill_resident_bytes"], tags=gtags)
     stats = getattr(engine, "stats", None)
     if stats:
         _ship_stat_deltas(engine, stats, tags)
@@ -278,6 +288,24 @@ _STAT_COUNTERS = (
      "pages seeded from another replica's export", None),
     ("prefix_exported_pages", "rtpu_llm_prefix_cache_exported_pages_total",
      "cached pages gathered to host for another replica", None),
+    # spill tier (cfg.kv_spill, llm/tiering.py) — the
+    # rtpu_llm_prefix_spill_* family; engine.stats is the single source
+    ("spill_pages", "rtpu_llm_prefix_spill_pages_total",
+     "evicted prefix pages captured into the host spill tier", None),
+    ("spill_bytes", "rtpu_llm_prefix_spill_bytes_total",
+     "bytes demoted into the host spill tier", None),
+    ("spill_demotions", "rtpu_llm_prefix_spill_demotions_total",
+     "eviction-site demote decisions that kept a tier copy "
+     "(captures plus clean re-evictions of tier-resident content)",
+     None),
+    ("spill_promotions", "rtpu_llm_prefix_spill_promotions_total",
+     "spilled pages promoted back into HBM (admission-time, re-warm, "
+     "or cross-replica via the prefix directory)", None),
+    ("spill_expired", "rtpu_llm_prefix_spill_expired_total",
+     "tier pages expired under the byte budget or at teardown", None),
+    ("spill_drops", "rtpu_llm_prefix_spill_drops_total",
+     "validate-on-promote failures: stale/corrupt spill content "
+     "dropped, request prefilled cold", None),
 )
 
 

@@ -292,7 +292,12 @@ def metrics_summary() -> dict:
       cache — the heat plane's per-chain fold: {chains: [{chain, hits,
           tokens_saved, resident_pages, last_hit_age_s}, ...hot-first],
           tracked_chains} summed across replicas from the bounded
-          rtpu_llm_prefix_chain_* gauges
+          rtpu_llm_prefix_chain_* gauges; plus, when the spill tier
+          ran anywhere, spill — {demotions, promotions, expired,
+          drops, spilled_pages, spilled_bytes, resident_pages,
+          resident_bytes} from the rtpu_llm_prefix_spill_* families
+          (residency summed across replicas: every tier is distinct
+          host memory)
       tenants — {<tenant>: {admitted, shed}} per-tenant admission
           outcomes (front-door fairness/quota counter-verification)
       lora — {requests, hits, loads, evictions, swaps, publishes,
@@ -366,13 +371,38 @@ def metrics_summary() -> dict:
                 row[field] = row.get(field, 0.0) + vv
             else:
                 row[field] = min(row.get(field, vv), vv)
-    if chains:
+    # spill tier (llm/tiering.py): lifecycle counters + live residency.
+    # Zero everywhere unless some engine ran with kv_spill — the fold
+    # only appears when the tier actually moved or holds pages.
+    spill = {
+        "demotions": _counter_total(
+            store.get("rtpu_llm_prefix_spill_demotions_total")),
+        "promotions": _counter_total(
+            store.get("rtpu_llm_prefix_spill_promotions_total")),
+        "expired": _counter_total(
+            store.get("rtpu_llm_prefix_spill_expired_total")),
+        "drops": _counter_total(
+            store.get("rtpu_llm_prefix_spill_drops_total")),
+        "spilled_pages": _counter_total(
+            store.get("rtpu_llm_prefix_spill_pages_total")),
+        "spilled_bytes": _counter_total(
+            store.get("rtpu_llm_prefix_spill_bytes_total")),
+        "resident_pages": _counter_total(
+            store.get("rtpu_llm_prefix_spill_resident_pages")),
+        "resident_bytes": _counter_total(
+            store.get("rtpu_llm_prefix_spill_resident_bytes")),
+    }
+    if not any(spill.values()):
+        spill = None
+    if chains or spill:
         out["cache"] = {
             "chains": sorted(chains.values(),
                              key=lambda r: -r.get("hits", 0.0)),
             "tracked_chains": _counter_total(
                 store.get("rtpu_llm_prefix_chain_tracked")),
         }
+        if spill:
+            out["cache"]["spill"] = spill
     disp = store.get("rtpu_serve_stream_dispatches_total")
     items = store.get("rtpu_serve_stream_items_total")
     if disp or items:

@@ -585,6 +585,44 @@ def test_gl007_prefix_chain_lookalikes_rejected():
     assert all("does not match" in f.message for f in found)
 
 
+def test_gl007_prefix_spill_family_allowed():
+    """The tiered KV-cache family (llm/telemetry.py's spill counters +
+    residence gauges) rides the llm namespace: rtpu_llm_prefix_spill_*
+    passes as-is — pinned so a namespace rename can't silently orphan
+    the tier from metrics_summary()["cache"]["spill"] and
+    cache_report()'s spill section."""
+    src = """
+        from ray_tpu.util.metrics import Counter, Gauge, cached_metric
+
+        def ship():
+            cached_metric(Counter, "rtpu_llm_prefix_spill_pages_total")
+            cached_metric(Counter, "rtpu_llm_prefix_spill_bytes_total")
+            cached_metric(Counter,
+                          "rtpu_llm_prefix_spill_demotions_total")
+            cached_metric(Counter,
+                          "rtpu_llm_prefix_spill_promotions_total")
+            cached_metric(Counter,
+                          "rtpu_llm_prefix_spill_expired_total")
+            cached_metric(Counter, "rtpu_llm_prefix_spill_drops_total")
+            cached_metric(Gauge, "rtpu_llm_prefix_spill_resident_pages")
+            cached_metric(Gauge, "rtpu_llm_prefix_spill_resident_bytes")
+    """
+    assert lint(src, rules={"GL007"}) == []
+
+
+def test_gl007_prefix_spill_lookalikes_rejected():
+    src = """
+        from ray_tpu.util.metrics import Counter, cached_metric
+
+        BAD1 = Counter("rtpu_spill_pages_total")
+        BAD2 = cached_metric(Counter, "prefix_spill_pages_total")
+        BAD3 = Counter("rtpu_llm_Prefix_Spill_pages_total")
+    """
+    found = lint(src, rules={"GL007"})
+    assert len(found) == 3
+    assert all("does not match" in f.message for f in found)
+
+
 # ------------------------------------------------------------------ #
 # GL008 swallowed exceptions
 # ------------------------------------------------------------------ #
@@ -896,6 +934,26 @@ def test_gl011_formatted_chain_hash_labels_rejected():
     """
     found = lint(src, rules={"GL011"})
     assert len(found) == 3
+
+
+def test_gl011_spill_record_sites():
+    """The spill tier's record sites (telemetry.py ships the counters
+    and residence gauges with the bounded engine/proc tags) stay quiet;
+    a segment/oid label minted by formatting at an .inc/.set site is
+    the unbounded shape the rule rejects — store oids are arbitrary
+    bytes, one series per segment would grow without bound."""
+    src = """
+        def ship(c, g, engine_kind, proc, oid, acct):
+            tags = {"engine": engine_kind, "proc": proc}
+            c.inc(float(acct["spill_demotions"]), tags=tags)
+            g.set(float(acct["spill_resident_pages"]), tags=tags)
+            g.set(1.0, tags={"segment": f"seg-{oid}"})
+            c.inc(1.0, tags={"segment": str(oid)})
+    """
+    found = lint(src, rules={"GL011"})
+    assert len(found) == 2
+    kinds = " ".join(f.message for f in found)
+    assert "f-string" in kinds and "str() call" in kinds
 
 
 def test_gl011_precomputed_chain_labels_pass():
