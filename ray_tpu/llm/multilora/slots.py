@@ -78,6 +78,48 @@ class AdapterSlotTable:
     def nbytes(self) -> int:
         return sum(int(a.size) * 4 for a in self.tree.values())
 
+    # -- mesh-parallel placement (parallel/sharding.py) --------------------
+
+    def logical_axes(self) -> dict:
+        """Logical axis names per table key, mirroring the base weights
+        they add onto (models/llama.py logical_axes): each B matrix
+        shards its OUTPUT dim the way the target weight shards it
+        (wq→heads, wk/wv→kv_heads, wo→embed, lm_head→vocab), each A
+        matrix shards its input dim, and the slot/layer/rank dims stay
+        replicated — so the per-row gather + lora matmul compose with
+        the sharded base matmul without moving either operand."""
+        out_axis = {"wq": "heads", "wk": "kv_heads", "wv": "kv_heads",
+                    "wo": "embed", "lm_head": "vocab"}
+        in_axis = {"wq": "embed", "wk": "embed", "wv": "embed",
+                   "wo": "heads", "lm_head": "embed"}
+        axes = {"scale": (None,)}
+        for t in self.targets:
+            lead = (None,) if t == "lm_head" else (None, None)
+            axes[f"{t}.A"] = lead + (in_axis[t], None)
+            axes[f"{t}.B"] = lead + (None, out_axis[t])
+        return axes
+
+    def shard(self, mesh, shardings: dict) -> None:
+        """Commit the table to ``shardings`` (a {key: NamedSharding}
+        matching logical_axes()) on ``mesh`` and swap in per-key pinned
+        scatter jits: a load's donated row scatter must carry
+        out_shardings == in_shardings or XLA un-aliases the donated
+        buffer and silently copies the whole table (the PR 12 donated-
+        buffer lesson). Caller holds the same serialization contract as
+        load()."""
+        self.tree = jax.device_put(self.tree, shardings)
+        repl = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec())
+        self._set_row_fns = {
+            k: jax.jit(lambda arr, s, val: arr.at[s].set(val),
+                       donate_argnums=(0,),
+                       in_shardings=(sh, repl, repl), out_shardings=sh)
+            for k, sh in shardings.items()}
+
+    def _row_fn(self, key: str):
+        fns = getattr(self, "_set_row_fns", None)
+        return self._set_row if fns is None else fns[key]
+
     def _padded(self, adapter: dict, target: str):
         """(A, B) padded to [.., in, R]/[.., R, out] f32, or None when
         the adapter lacks the target. Rank padding is exact: the extra
@@ -131,9 +173,10 @@ class AdapterSlotTable:
             if ab is None:
                 zero_a = jnp.zeros(t[ka].shape[1:], jnp.float32)
                 zero_b = jnp.zeros(t[kb].shape[1:], jnp.float32)
-                t[ka] = self._set_row(t[ka], slot, zero_a)
-                t[kb] = self._set_row(t[kb], slot, zero_b)
+                t[ka] = self._row_fn(ka)(t[ka], slot, zero_a)
+                t[kb] = self._row_fn(kb)(t[kb], slot, zero_b)
             else:
-                t[ka] = self._set_row(t[ka], slot, jnp.asarray(ab[0]))
-                t[kb] = self._set_row(t[kb], slot, jnp.asarray(ab[1]))
-        t["scale"] = self._set_row(t["scale"], slot, jnp.float32(scale))
+                t[ka] = self._row_fn(ka)(t[ka], slot, jnp.asarray(ab[0]))
+                t[kb] = self._row_fn(kb)(t[kb], slot, jnp.asarray(ab[1]))
+        t["scale"] = self._row_fn("scale")(
+            t["scale"], slot, jnp.float32(scale))

@@ -130,6 +130,16 @@ class PagedEngineConfig:
     kv_spill_max_bytes: int = 64 << 20
     kv_spill_min_hits: int = 0
     kv_spill_max_idle_s: float = 0.0
+    # mesh-parallel serving (parallel/mesh.py MeshSpec or its dict form,
+    # e.g. {"tp": 4} or {"dp": 2, "tp": 2}): weights, the LoRA slot
+    # table and the paged KV pool are placed with explicit NamedShardings
+    # (KV over kv-heads on tp, block tables / token ids replicated) and
+    # every program family compiles with in/out shardings pinned, so
+    # steady-state decode moves NO bytes between devices beyond the
+    # token-id inputs and sampled-token outputs (counter-verified:
+    # stats["mesh_reshard_bytes"] stays 0). None = single-device engine,
+    # exactly the pre-mesh traces.
+    mesh: Any = None
     tokenizer: Any = None
 
     def __post_init__(self):
@@ -239,6 +249,13 @@ class PagedInferenceEngine(_EngineBase):
             from .multilora.slots import AdapterSlotTable
             self.lora = AdapterSlotTable(mc, cfg.max_adapters,
                                          cfg.lora_rank, cfg.lora_targets)
+        # mesh-parallel placement (cfg.mesh): committed NamedShardings
+        # for weights / KV pool / slot table, and the pinned in/out
+        # sharding tuples every program family compiles with
+        self.mesh = None
+        self._shardings = None
+        if cfg.mesh is not None:
+            self._init_mesh()
         self._rng_base = jax.random.PRNGKey(rng_seed ^ 0x5EED)
         self._rng_ctr = 0
         self._lock = threading.Lock()
@@ -284,7 +301,16 @@ class PagedInferenceEngine(_EngineBase):
                       # else). All permanently 0 while kv_spill is off.
                       "spill_pages": 0, "spill_bytes": 0,
                       "spill_demotions": 0, "spill_promotions": 0,
-                      "spill_expired": 0, "spill_drops": 0}
+                      "spill_expired": 0, "spill_drops": 0,
+                      # mesh-parallel dispatch accounting (cfg.mesh):
+                      # host<->device bytes a dispatch legitimately moves
+                      # (token-id/table inputs, sampled-token outputs) vs
+                      # bytes that would move because a committed buffer
+                      # drifted off its pinned sharding. The reshard
+                      # counter staying 0 IS the zero-involuntary-reshard
+                      # contract; all permanently 0 while mesh is off.
+                      "mesh_dispatches": 0, "mesh_input_bytes": 0,
+                      "mesh_output_bytes": 0, "mesh_reshard_bytes": 0}
         # speculation controller: EMA of tokens-per-slot-per-spec-dispatch
         # (starts optimistic), plus a cooldown of windowed dispatches
         # before re-probing once the EMA drops below the window
@@ -296,6 +322,121 @@ class PagedInferenceEngine(_EngineBase):
         # estimate_flops() has run
         from ..util.profiling import StepProfiler
         self.profiler = StepProfiler("paged_engine")
+
+    # -- mesh-parallel placement (cfg.mesh) --------------------------------
+
+    def _init_mesh(self):
+        """Build the device mesh and commit weights, KV pool and the
+        adapter slot table onto it with explicit NamedShardings: KV
+        pages shard over kv-heads on tp, weights follow
+        llama.logical_axes, block tables / token ids stay replicated.
+        The pinned tuples cached here are what every program family
+        compiles with (in == out for the donated caches, so page updates
+        keep aliasing in place — an unconstrained output sharding breaks
+        donation, the way it once did for sharded opt_state)."""
+        from ..parallel import sharding as shardlib
+        from ..parallel.mesh import MeshSpec, build_mesh, use_mesh
+        cfg, mc = self.cfg, self.cfg.model
+        spec = cfg.mesh
+        if isinstance(spec, dict):
+            spec = MeshSpec(**spec)
+        # an engine's mesh spec names how many chips it WANTS, not how
+        # many the process sees: take the leading slice so tp=2 works on
+        # an 8-device host (replicas each build their own sub-mesh)
+        devices = jax.devices()
+        import math as _math
+        want = _math.prod(
+            getattr(spec, a) for a in ("pp", "dp", "fsdp", "ep", "sp", "tp"))
+        if 0 < want <= len(devices):
+            devices = devices[:want]
+        self.mesh = build_mesh(spec, devices=devices)
+        sizes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        tp = sizes.get("tp", 1)
+        if mc.n_kv_heads % tp or mc.n_heads % tp or mc.mlp_dim % tp:
+            raise ValueError(
+                f"mesh tp={tp} must divide n_heads={mc.n_heads}, "
+                f"n_kv_heads={mc.n_kv_heads} and mlp_dim={mc.mlp_dim}")
+        # vocab shards over (tp, fsdp) — embeddings/lm_head split both ways
+        vocab_ways = tp * sizes.get("fsdp", 1)
+        if mc.vocab_size % vocab_ways:
+            raise ValueError(
+                f"mesh tp*fsdp={vocab_ways} must divide "
+                f"vocab_size={mc.vocab_size}")
+        with use_mesh(self.mesh):
+            repl = shardlib.named_sharding(())
+            pshard = shardlib.logical_sharding(llama.logical_axes(mc))
+            kv = shardlib.named_sharding(
+                (None, None, "kv_heads", "head_dim"))
+            cshard = [{"k": kv, "v": kv} for _ in self.caches]
+            lshard = repl
+            if self.lora is not None:
+                lshard = shardlib.logical_sharding(
+                    self.lora.logical_axes())
+        self.params = jax.device_put(self.params, pshard)
+        self.caches = jax.device_put(self.caches, cshard)
+        if self.lora is not None:
+            self.lora.shard(self.mesh, lshard)
+        self._shardings = {"params": pshard, "caches": cshard,
+                           "lora": lshard, "repl": repl}
+
+    def _mesh_scope(self):
+        """Context manager making self.mesh the current mesh for jax work
+        on this thread (dispatch, trace-time constrain() resolution,
+        import scatters); a no-op nullcontext off-mesh."""
+        if self.mesh is None:
+            import contextlib
+            return contextlib.nullcontext()
+        from ..parallel.mesh import use_mesh
+        return use_mesh(self.mesh)
+
+    def _family_jit(self, run, n_plain: int):
+        """jit a dispatch family with the donated caches at arg 1. With a
+        mesh: every in/out sharding pinned — params/caches/lora at their
+        committed placements, the n_plain host-array args (token ids,
+        block tables, lengths, rng, temps) replicated, outputs (sampled
+        tokens, logprobs) replicated and the cache outputs bit-matching
+        their inputs so donation aliases. Pinning is what guarantees the
+        compiled program never inserts an involuntary reshard of a
+        committed buffer: any transfer beyond the declared host arrays
+        would need an in/out sharding this signature forbids."""
+        if self.mesh is None:
+            return jax.jit(run, donate_argnums=(1,))
+        sh = self._shardings
+        ins = (sh["params"], sh["caches"]) + (sh["repl"],) * n_plain + (
+            sh["lora"], sh["repl"])
+        outs = (sh["repl"], sh["repl"], sh["caches"])
+        return jax.jit(run, donate_argnums=(1,), in_shardings=ins,
+                       out_shardings=outs)
+
+    def _mesh_account(self, host_in: int, host_out: int):
+        """Per-dispatch transfer accounting (mesh on only): declared
+        host->device input bytes and device->host output bytes, plus a
+        walk of every committed tree (params, caches, slot table)
+        checking each leaf still sits at its pinned sharding — a leaf
+        that drifted counts its full nbytes as involuntary-reshard
+        traffic. Cheap (pure Python attribute compares), and the walk IS
+        the counter-verification the zero-reshard contract is asserted
+        against."""
+        if self.mesh is None:
+            return
+        st = self.stats
+        st["mesh_dispatches"] += 1
+        st["mesh_input_bytes"] += int(host_in)
+        st["mesh_output_bytes"] += int(host_out)
+        sh = self._shardings
+        bad = 0
+        for tree, shtree in ((self.params, sh["params"]),
+                             (self.caches, sh["caches"])):
+            for leaf, want in zip(jax.tree.leaves(tree),
+                                  jax.tree.leaves(shtree)):
+                if not want.is_equivalent_to(leaf.sharding, leaf.ndim):
+                    bad += int(leaf.nbytes)
+        if self.lora is not None and self._shardings["lora"] is not None:
+            for leaf, want in zip(jax.tree.leaves(self.lora.tree),
+                                  jax.tree.leaves(sh["lora"])):
+                if not want.is_equivalent_to(leaf.sharding, leaf.ndim):
+                    bad += int(leaf.nbytes)
+        st["mesh_reshard_bytes"] += bad
 
     @staticmethod
     def _sampling_mode(reqs) -> tuple:
@@ -379,7 +520,7 @@ class PagedInferenceEngine(_EngineBase):
                     return out.T, lps.T, c          # [B, w] each
                 return ys.T, None, c
 
-            fn = jax.jit(run, donate_argnums=(1,))
+            fn = self._family_jit(run, n_plain=7)
             self._decode_win_fns[(w, mode, pages)] = fn
         return fn
 
@@ -405,7 +546,7 @@ class PagedInferenceEngine(_EngineBase):
                     want_logp=want_logp)
                 return toks, lps, c
 
-            fn = jax.jit(run, donate_argnums=(1,))
+            fn = self._family_jit(run, n_plain=8)
             self._prefill_rows_fns[(r, mode, pages)] = fn
         return fn
 
@@ -433,7 +574,7 @@ class PagedInferenceEngine(_EngineBase):
                     axis=-1)[..., 0]
                 return y, lp, c
 
-            fn = jax.jit(run, donate_argnums=(1,))
+            fn = self._family_jit(run, n_plain=3)
             self._verify_fns[(r, s1, pages, want_logp)] = fn
         return fn
 
@@ -499,7 +640,12 @@ class PagedInferenceEngine(_EngineBase):
         caches round-trip through each program.
         """
         import time as _time
-        t0 = _time.perf_counter()
+        with self._mesh_scope():
+            return self._warmup_traced(sample_modes, families,
+                                       _time.perf_counter())
+
+    def _warmup_traced(self, sample_modes, families, t0) -> float:
+        import time as _time
         cfg = self.cfg
         bs, c = cfg.max_batch_size, cfg.chunk_size
         key, ctr = self._rng_base, np.int32(0)
@@ -884,8 +1030,11 @@ class PagedInferenceEngine(_EngineBase):
     def step(self):
         """One iteration: admit, one prefill chunk (bounded), one decode."""
         self._admit()
-        self._prefill_step()
-        self._decode_step()
+        # the mesh scope pins trace-time constrain() resolution for any
+        # program a dispatch compiles below (a no-op off-mesh)
+        with self._mesh_scope():
+            self._prefill_step()
+            self._decode_step()
         from . import telemetry
         telemetry.on_step(self)
 
@@ -994,6 +1143,10 @@ class PagedInferenceEngine(_EngineBase):
             lps = None if lps is None else np.asarray(lps)
         self._rng_ctr += 1
         self.stats["prefill_dispatches"] += 1
+        self._mesh_account(
+            chunks.nbytes + bts.nbytes + sps.nbytes + tls.nbytes
+            + temps.nbytes + topks.nbytes + lslots.nbytes,
+            toks.nbytes + (0 if lps is None else lps.nbytes))
         if self._prefix_on:
             page = cfg.page_size
             for req, pos, n in rows:
@@ -1121,6 +1274,9 @@ class PagedInferenceEngine(_EngineBase):
             y = np.asarray(y)               # [r, s1]; block: measure
             ylp = None if ylp is None else np.asarray(ylp)
         self.stats["spec_dispatches"] += 1
+        self._mesh_account(
+            toks.nbytes + bts.nbytes + starts.nbytes + lslots.nbytes,
+            y.nbytes + (0 if ylp is None else ylp.nbytes))
         emitted = 0
         for i, slot in enumerate(slots):
             req = self._active[slot]
@@ -1217,6 +1373,10 @@ class PagedInferenceEngine(_EngineBase):
             lps = None if lps is None else np.asarray(lps)
         self._rng_ctr += 1
         self.stats["decode_dispatches"] += 1
+        self._mesh_account(
+            tokens.nbytes + bt.nbytes + lengths.nbytes + temps.nbytes
+            + topks.nbytes + lslots.nbytes,
+            out.nbytes + (0 if lps is None else lps.nbytes))
         for slot in list(self._active):
             req = self._active[slot]
             for j in range(w):
@@ -1420,8 +1580,19 @@ class PagedInferenceEngine(_EngineBase):
         fn = getattr(self, "_import_fn_cached", None)
         if fn is None:
             # donated in-place page scatter: cache pools are not copied
-            fn = jax.jit(lambda c, idx, data: c.at[idx].set(data),
-                         donate_argnums=(0,))
+            if self.mesh is None:
+                fn = jax.jit(lambda c, idx, data: c.at[idx].set(data),
+                             donate_argnums=(0,))
+            else:
+                # pinned shardings keep the donated pool usable in place
+                # (out == in) and land the host payload replicated-then-
+                # scattered without resharding the pool itself
+                kv = self._shardings["caches"][0]["k"]
+                repl = self._shardings["repl"]
+                fn = jax.jit(lambda c, idx, data: c.at[idx].set(data),
+                             donate_argnums=(0,),
+                             in_shardings=(kv, repl, repl),
+                             out_shardings=kv)
             self._import_fn_cached = fn
         return fn
 

@@ -27,6 +27,24 @@ from typing import Any, Optional
 from .engine import SamplingParams
 
 
+def _chan_counter(name: str, desc: str):
+    from ..util.metrics import Counter, cached_metric
+    return cached_metric(Counter, name, desc)
+
+
+def _shared_store():
+    """The process's shared object store, or None when sealed channels
+    can't engage (no runtime, local mode, or an own-store node that
+    cannot share rings with its peers — same gate as the serve stream
+    channel, controller._start_stream_channel)."""
+    import os
+    if os.environ.get("RTPU_OWN_STORE") == "1":
+        return None
+    from ..core import runtime as rt_mod
+    rt = rt_mod.get_runtime_if_exists()
+    return getattr(rt, "store", None)
+
+
 class PrefillReplica:
     """Owns a paged engine used exclusively for prefill; returns the KV
     payload (pages + first sampled token) instead of decoding."""
@@ -39,6 +57,7 @@ class PrefillReplica:
         if warmup:
             # prefill-only replica: never dispatches decode/verify
             self.engine.warmup(families=("prefill",))
+        self._kv_writer = None
 
     def prefill(self, prompt, params: Optional[SamplingParams] = None):
         """Run chunked prefill; returns the exported KV payload dict
@@ -53,6 +72,76 @@ class PrefillReplica:
         NIXL plays for the reference's PD deployments)."""
         import ray_tpu
         return ray_tpu.put(self.prefill(prompt, params))
+
+    # -- sealed-channel KV handoff (dag/channel.py ring; the replica is
+    # the ring's single sequential producer) -------------------------------
+
+    def connect_kv_channel(self, spec: dict) -> bool:
+        """Attach this replica as the producer of a paired decode
+        replica's KV ring (spec from DecodeReplica.open_kv_channel).
+        After this, prefill_chan() hands finished KV payloads over with
+        ZERO control dispatches — the payload is sealed into shm and the
+        decode replica's drain thread imports it. Returns False when no
+        shared store is available (caller falls back to actor-call
+        handoff)."""
+        store = _shared_store()
+        if store is None or not spec:
+            return False
+        from ..core.ids import ObjectID
+        from ..dag.channel import RingWriter
+        self._kv_writer = RingWriter(store, spec["base"],
+                                     ObjectID(spec["stop"]),
+                                     int(spec["ring"]))
+        return True
+
+    def prefill_chan(self, prompt, cid,
+                     params: Optional[SamplingParams] = None) -> Any:
+        """Chunked-prefill `prompt` and stream its KV payload to the
+        paired decode replica over the sealed ring; `cid` is the
+        caller's correlation id (results surface on the decode side
+        keyed by it). Credit backpressure runs BEFORE prefill: when the
+        decoder's ring is full, admission parks here — a slow decoder
+        throttles prefill instead of ballooning the store with payloads
+        nobody is importing yet."""
+        import time as _time
+        w = self._kv_writer
+        if w is None:
+            raise RuntimeError("connect_kv_channel() first")
+        from ..dag.channel import ChannelClosed
+        stalls = _chan_counter(
+            "rtpu_llm_pd_chan_credit_stalls_total",
+            "prefill admissions parked on decode-ring credit")
+        while not w.credit_ready():
+            if w.closed():
+                raise ChannelClosed("decode replica closed the KV ring")
+            stalls.inc(1.0)
+            _time.sleep(0.005)
+        payload = self.engine.prefill_export(
+            prompt, params or SamplingParams())
+        w.write(("kv", {"cid": cid, "payload": payload,
+                        "params": params}))
+        _chan_counter("rtpu_llm_pd_chan_kv_writes_total",
+                      "KV payloads sealed into decode rings").inc(1.0)
+        return cid
+
+    def has_kv_channel(self) -> bool:
+        """Capability probe for serve-path callers: True once the
+        controller (or proxy) has paired this replica with a decode
+        ring — the signal that prefill_chan() routing can engage."""
+        return self._kv_writer is not None
+
+    def close_kv_channel(self) -> None:
+        """End the stream: the sentinel retires the decode-side drain
+        thread, which sweeps the ring (reader.retire()) so the channel
+        leaves zero store objects behind."""
+        w, self._kv_writer = self._kv_writer, None
+        if w is None:
+            return
+        from ..dag.channel import ChannelClosed
+        try:
+            w.write(("e", None))
+        except ChannelClosed:
+            pass  # consumer already cancelled: ring swept on its side
 
     def check_health(self):
         return True
@@ -83,6 +172,18 @@ class DecodeReplica:
         self._wake = threading.Event()
         self._stop = False
         self._error: Optional[BaseException] = None
+        # sealed-channel handoff state: correlation id -> rid for KV
+        # payloads that arrived over a ring instead of an actor call
+        self._cids: dict[Any, int] = {}
+        self._cid_cv = threading.Condition()
+        self._chan_threads: list = []
+        # ONE result ring per replica (a ring has one sequential
+        # producer): every KV drain thread funnels finished decodes
+        # through this shared flusher state
+        self._res_writer = None
+        self._res_pending: list = []
+        self._res_cv = threading.Condition()
+        self._kv_rings_open = 0
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -199,6 +300,148 @@ class DecodeReplica:
         finally:
             self._reqs.pop(rid, None)
 
+    # -- sealed-channel KV handoff (consumer side) -------------------------
+
+    def open_kv_channel(self, ring: int = 4,
+                        result_chan: Optional[dict] = None) -> dict:
+        """Mint a KV-handoff ring this replica consumes and start its
+        drain thread; returns the channel spec the paired prefill
+        replica connects to (empty dict = no shared store, caller falls
+        back to actor-call handoff). Each paired prefill replica gets
+        its OWN ring — a ring has exactly one sequential producer.
+
+        ``result_chan`` (optional, same spec shape) makes finished
+        decodes flow back the same way: a writer this replica produces
+        into, carrying ("res", {cid, result}) — so in steady state a
+        request's handoff AND its completion cross zero control
+        dispatches, exactly the serve stream-channel economics."""
+        import os
+        import threading
+        store = _shared_store()
+        if store is None:
+            return {}
+        from ..core.ids import ObjectID
+        from ..dag.channel import ChannelClosed, RingReader, RingWriter
+        spec = {"base": os.urandom(16), "stop": os.urandom(16),
+                "ring": max(2, int(ring))}
+        reader = RingReader(store, spec["base"], ObjectID(spec["stop"]),
+                            spec["ring"])
+        want_results = bool(result_chan)
+        with self._res_cv:
+            self._kv_rings_open += 1
+            if want_results and self._res_writer is None:
+                self._res_writer = RingWriter(
+                    store, result_chan["base"],
+                    ObjectID(result_chan["stop"]),
+                    int(result_chan["ring"]))
+                tf = threading.Thread(target=self._flush_results,
+                                      daemon=True,
+                                      name="pd-kv-chan-results")
+                tf.start()
+                self._chan_threads.append(tf)
+
+        def drain():
+            try:
+                while True:
+                    try:
+                        kind, item = reader.read(timeout_s=None)
+                    except ChannelClosed:
+                        reader.retire()
+                        break
+                    if kind != "kv":            # ("e", None) sentinel
+                        reader.retire()
+                        break
+                    rid = self.start(item["payload"], item["params"])
+                    _chan_counter(
+                        "rtpu_llm_pd_chan_kv_imports_total",
+                        "KV payloads imported from sealed rings").inc(1.0)
+                    with self._cid_cv:
+                        self._cids[item["cid"]] = rid
+                        self._cid_cv.notify_all()
+                    if want_results:
+                        with self._res_cv:
+                            self._res_pending.append((item["cid"], rid))
+                            self._res_cv.notify_all()
+            except BaseException as e:  # noqa: BLE001 — surface via health
+                if self._error is None:
+                    self._error = e
+            finally:
+                with self._res_cv:
+                    self._kv_rings_open -= 1
+                    self._res_cv.notify_all()
+
+        t = threading.Thread(target=drain, daemon=True,
+                             name="pd-kv-chan-drain")
+        t.start()
+        self._chan_threads.append(t)
+        return spec
+
+    def _flush_results(self):
+        """Seal finished decodes into the replica's ONE result ring
+        (completion order within the in-flight window); the EOS
+        sentinel trails the last result — after every KV ring closed —
+        so the consumer's retire() leaves zero store objects."""
+        import time as _time
+        from ..dag.channel import ChannelClosed
+        live: list = []
+        try:
+            while True:
+                with self._res_cv:
+                    if not self._res_pending and not live \
+                            and self._kv_rings_open > 0:
+                        self._res_cv.wait(timeout=0.5)
+                    live.extend(self._res_pending)
+                    self._res_pending.clear()
+                    rings_open = self._kv_rings_open
+                progressed = False
+                for cid, rid in list(live):
+                    req = self._reqs.get(rid)
+                    if req is not None and not req.done:
+                        continue
+                    live.remove((cid, rid))
+                    progressed = True
+                    res = self.wait(rid, timeout=600.0)
+                    with self._cid_cv:
+                        self._cids.pop(cid, None)
+                    self._res_writer.write(("res", {"cid": cid,
+                                                    "result": res}))
+                    _chan_counter(
+                        "rtpu_llm_pd_chan_results_total",
+                        "decode results sealed into result rings").inc(1.0)
+                if rings_open == 0 and not live:
+                    with self._res_cv:
+                        if not self._res_pending:
+                            self._res_writer.write(("e", None))
+                            return
+                elif live and not progressed:
+                    _time.sleep(0.005)
+        except ChannelClosed:
+            pass  # result consumer cancelled: ring swept on its side
+        except BaseException as e:  # noqa: BLE001 — surface via health
+            if self._error is None:
+                self._error = e
+
+    def wait_cid(self, cid, timeout: float = 600.0) -> dict:
+        """Block until the request handed off under correlation id
+        ``cid`` (prefill_chan) finishes; returns the engine result dict.
+        The serve PD path uses this when no result ring is wired: the
+        KV handoff itself still crossed zero dispatches."""
+        import time as _time
+        deadline = _time.monotonic() + timeout
+        with self._cid_cv:
+            while cid not in self._cids:
+                if self._error is not None:
+                    raise RuntimeError(
+                        "decode engine died") from self._error
+                if not self._cid_cv.wait(timeout=min(
+                        0.5, max(deadline - _time.monotonic(), 0.001))):
+                    if _time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"KV payload for cid {cid!r} never arrived")
+            rid = self._cids.pop(cid)
+        return self.wait(rid, timeout=max(deadline - _time.monotonic(),
+                                          0.001))
+
     def check_health(self):
         if self._error is not None or not self._thread.is_alive():
             raise RuntimeError("decode engine loop died") from self._error
@@ -217,7 +460,8 @@ class PDProxy:
     other, round-robin (reference PDProxyServer:64 — its router also
     round-robins pow-2 within each group)."""
 
-    def __init__(self, prefill_handles: list, decode_handles: list):
+    def __init__(self, prefill_handles: list, decode_handles: list,
+                 use_channels: bool = False):
         import threading
         if not prefill_handles or not decode_handles:
             raise ValueError("need at least one prefill and one decode "
@@ -227,6 +471,75 @@ class PDProxy:
         self.stats = _PDStats()
         # generate() runs on max_concurrency threads: counters need a lock
         self._lock = threading.Lock()
+        self._chan = False
+        self._next_cid = 0
+        self._futures: dict[int, list] = {}   # cid -> [Event, result]
+        if use_channels:
+            self._chan = self._wire_channels()
+
+    def _wire_channels(self) -> bool:
+        """Sealed-channel pipeline: prefill i produces into a KV ring
+        its paired decode replica (i mod n_decode) consumes; every
+        decode replica produces finished results into ONE result ring
+        this proxy consumes. Steady-state per request: one admission
+        call to the prefill replica, then the KV handoff AND the result
+        cross zero control dispatches (the decode-plan economics applied
+        to the PD handoff). Wiring costs O(replicas) dispatches ONCE."""
+        import os
+        import threading
+        import ray_tpu
+        store = _shared_store()
+        if store is None:
+            return False
+        from ..core.ids import ObjectID
+        from ..dag.channel import ChannelClosed, RingReader
+        self._res_readers = []
+        res_spec = {di: {"base": os.urandom(16), "stop": os.urandom(16),
+                         "ring": 8} for di in range(len(self.decode))}
+        res_handed = []
+        kv_specs = {}
+        for pi in range(len(self.prefill)):
+            di = pi % len(self.decode)
+            rs = res_spec.pop(di, None)     # one result ring per decode
+            spec = ray_tpu.get(self.decode[di].open_kv_channel.remote(
+                4, rs), timeout=60)
+            if not spec:
+                return False
+            if rs is not None:
+                res_handed.append(rs)
+            kv_specs[pi] = spec
+        for pi, p in enumerate(self.prefill):
+            if not ray_tpu.get(p.connect_kv_channel.remote(kv_specs[pi]),
+                               timeout=60):
+                return False
+
+        def drain(spec):
+            reader = RingReader(store, spec["base"],
+                                ObjectID(spec["stop"]), int(spec["ring"]))
+            try:
+                while True:
+                    try:
+                        kind, item = reader.read(timeout_s=None)
+                    except ChannelClosed:
+                        reader.retire()
+                        return
+                    if kind != "res":           # ("e", None) sentinel
+                        reader.retire()
+                        return
+                    fut = self._futures.get(item["cid"])
+                    if fut is not None:
+                        fut[1] = item["result"]
+                        fut[0].set()
+            except Exception:
+                import traceback
+                traceback.print_exc()
+
+        for spec in res_handed:
+            t = threading.Thread(target=drain, args=(spec,), daemon=True,
+                                 name="pd-proxy-results")
+            t.start()
+            self._res_readers.append(t)
+        return True
 
     def generate(self, prompt, params: Optional[SamplingParams] = None):
         import ray_tpu
@@ -237,15 +550,46 @@ class PDProxy:
             d = self.decode[s.decode_rr % len(self.decode)]
             s.prefill_rr += 1
             s.decode_rr += 1
+        if self._chan:
+            import threading
+            with self._lock:
+                cid = self._next_cid
+                self._next_cid += 1
+                fut = self._futures[cid] = [threading.Event(), None]
+            # admission is the ONLY control dispatch: the KV payload
+            # rides the sealed ring to the paired decode replica and
+            # the result rides the result ring back
+            admit = p.prefill_chan.remote(prompt, cid, params)
+            if not fut[0].wait(timeout=600):
+                raise TimeoutError(f"PD channel request {cid} timed out")
+            with self._lock:
+                self._futures.pop(cid, None)
+            ray_tpu.get(admit, timeout=60)  # reclaim the admission ref
+            return fut[1]
         # the payload ObjectRef flows straight into the decode call — the
         # KV bytes move store-to-store, never through this proxy
         payload_ref = p.prefill.remote(prompt, params)
         return ray_tpu.get(d.decode.remote(payload_ref, params),
                            timeout=600)
 
+    def shutdown_channels(self, timeout: float = 60.0) -> None:
+        """Teardown: close every KV ring (sentinel -> decode drains
+        retire -> result rings EOS -> proxy drains retire). After this,
+        the channels hold zero store objects."""
+        if not self._chan:
+            return
+        import ray_tpu
+        ray_tpu.get([p.close_kv_channel.remote() for p in self.prefill],
+                    timeout=timeout)
+        for t in self._res_readers:
+            t.join(timeout=timeout)
+        self._chan = False
+
     def proxy_stats(self) -> dict:
         with self._lock:
-            return dataclasses.asdict(self.stats)
+            st = dataclasses.asdict(self.stats)
+        st["channels"] = self._chan
+        return st
 
 
 def _params_from_request(request: dict) -> SamplingParams:
@@ -265,12 +609,77 @@ class PDServer:
     payload crosses as an ObjectRef — store-to-store on the data plane,
     never through this proxy."""
 
-    def __init__(self, model_id: str, prefill_handle, decode_handle):
+    def __init__(self, model_id: str, prefill_handle, decode_handle,
+                 use_channels: bool = False):
+        import threading
         from ..core.usage import record_library_usage
         record_library_usage("llm")
         self.model_id = model_id
         self.prefill = prefill_handle
         self.decode = decode_handle
+        self._chan = bool(use_channels)
+        self._chan_ok: Optional[bool] = None  # lazy capability probe
+        self._n_pre = 0
+        self._n_dec = 0
+        self._rr = 0
+        self._rr_lock = threading.Lock()
+
+    def _chan_ready(self) -> bool:
+        """Probe (once) whether the sealed-channel handoff is wired:
+        the controller pairs role=prefill replicas to decode KV rings
+        asynchronously after deploy, so the first request that finds
+        the pairing incomplete settles the server onto the ref-based
+        path for good — routing stays deterministic per process."""
+        if not self._chan:
+            return False
+        if self._chan_ok is None:
+            try:
+                self._n_pre = self.prefill.num_replicas()
+                self._n_dec = self.decode.num_replicas()
+                ok = self.prefill.options(
+                    method_name="has_kv_channel",
+                    replica_index=0).remote().result(timeout_s=30)
+                self._chan_ok = bool(ok) and \
+                    self._n_pre > 0 and self._n_dec > 0
+            except Exception:
+                self._chan_ok = False
+        return self._chan_ok
+
+    def _chan_completion(self, request: dict) -> dict:
+        """Channel-path unary completion: prefill_chan seals the KV
+        payload straight into the paired decode replica's ring (zero
+        handoff dispatches — the two control calls here are admission
+        and result collection, same count as the ref path, but the KV
+        bytes never surface as an ObjectRef). Replica indices follow
+        the controller's pairing rule (prefill i -> decode i % n_dec),
+        so the wait lands on the replica that imports the payload."""
+        import os
+        sp = _params_from_request(request)
+        with self._rr_lock:
+            i_pre = self._rr % self._n_pre
+            self._rr += 1
+        i_dec = i_pre % self._n_dec
+        cid = os.urandom(8).hex()
+        admit = self.prefill.options(
+            method_name="prefill_chan", replica_index=i_pre).remote(
+                request.get("prompt", ""), cid, sp)
+        admit.result(timeout_s=300)  # surfaces prefill/ring errors
+        out = self.decode.options(
+            method_name="wait_cid", replica_index=i_dec).remote(
+                cid).result(timeout_s=600)
+        return {
+            "object": "text_completion",
+            "model": self.model_id,
+            "choices": [{
+                "text": out["text"],
+                "finish_reason": out["finish_reason"],
+                "index": 0,
+            }],
+            "usage": {
+                "prompt_tokens": out["prompt_tokens"],
+                "completion_tokens": len(out["token_ids"]),
+            },
+        }
 
     def _prefill_ref(self, request: dict):
         """Run prefill on one replica; returns (payload ObjectRef,
@@ -282,6 +691,8 @@ class PDServer:
                 request.get("prompt", ""), sp).result(timeout_s=300), sp
 
     def completions(self, request: dict) -> dict:
+        if self._chan_ready():
+            return self._chan_completion(request or {})
         # one unary call per request: the serve handle picks a decode
         # replica once and the whole decode happens there (no
         # cross-replica request-id routing to get wrong)
@@ -343,23 +754,29 @@ class PDServer:
 
 
 def build_pd_openai_app(model_id: str, n_prefill: int, n_decode: int,
-                        engine_cfg, params=None, rng_seed: int = 0):
+                        engine_cfg, params=None, rng_seed: int = 0,
+                        use_channels: bool = False):
     """Disaggregated OpenAI app (reference build_app,
     prefill_decode_disagg.py:160): prefill and decode replica groups as
     Serve deployments, a PDServer deployment routing between them, and
     the OpenAI router as ingress — /v1/completions with stream=true
     crosses the prefill->decode handoff and streams SSE out the HTTP
-    proxy."""
+    proxy. The role tags let the controller pair each prefill replica
+    with a decode KV ring; with ``use_channels`` the PDServer routes
+    unary completions over that sealed handoff once pairing lands."""
     from .. import serve
     from .openai_api import OpenAIRouter
     pre = serve.deployment(
         PrefillReplica, name=f"pd-prefill:{model_id}",
-        num_replicas=n_prefill).bind(engine_cfg, params, rng_seed)
+        num_replicas=n_prefill,
+        role="prefill").bind(engine_cfg, params, rng_seed)
     dec = serve.deployment(
         DecodeReplica, name=f"pd-decode:{model_id}",
-        num_replicas=n_decode).bind(engine_cfg, params, rng_seed)
+        num_replicas=n_decode,
+        role="decode").bind(engine_cfg, params, rng_seed)
     pd = serve.deployment(
-        PDServer, name=f"pd:{model_id}").bind(model_id, pre, dec)
+        PDServer, name=f"pd:{model_id}").bind(
+            model_id, pre, dec, use_channels)
     router = serve.deployment(OpenAIRouter, name="openai-router")
     return router.bind([model_id], pd)
 
@@ -367,9 +784,13 @@ def build_pd_openai_app(model_id: str, n_prefill: int, n_decode: int,
 def build_pd_proxy(n_prefill: int, n_decode: int, engine_cfg,
                    params=None, rng_seed: int = 0,
                    prefill_options: Optional[dict] = None,
-                   decode_options: Optional[dict] = None):
+                   decode_options: Optional[dict] = None,
+                   use_channels: bool = False):
     """Actor-graph wiring (reference build_app:160): N prefill + M decode
-    replica actors behind one PDProxy actor. Returns the proxy handle."""
+    replica actors behind one PDProxy actor. Returns the proxy handle.
+    With ``use_channels`` the proxy wires the sealed-ring KV handoff at
+    construction (falls back to actor-call handoff when no shared store
+    is available)."""
     import ray_tpu
     popts = prefill_options or {}
     dopts = decode_options or {}
@@ -380,4 +801,5 @@ def build_pd_proxy(n_prefill: int, n_decode: int, engine_cfg,
     decodes = [Dec.options(**dopts).remote(engine_cfg, params, rng_seed)
                for _ in range(n_decode)]
     Proxy = ray_tpu.remote(PDProxy)
-    return Proxy.options(max_concurrency=16).remote(prefills, decodes)
+    return Proxy.options(max_concurrency=16).remote(
+        prefills, decodes, use_channels)

@@ -390,6 +390,18 @@ class LLMServer:
         with self._steplock:
             return self.engine.export_prefix(list(hashes))
 
+    def engine_stats(self) -> dict:
+        """Counter snapshot for ops introspection: the base engine's
+        stats dict plus the resolved mesh axis sizes (None single-chip).
+        On a mesh, ``mesh_reshard_bytes`` staying 0 IS the steady-state
+        zero-involuntary-reshard invariant — a nonzero value means some
+        dispatch committed a buffer off its pinned sharding."""
+        st = dict(getattr(self.engine, "stats", {}) or {})
+        mesh = getattr(self.engine, "mesh", None)
+        st["mesh"] = None if mesh is None else {
+            k: int(v) for k, v in mesh.shape.items()}
+        return st
+
     def loaded_loras(self) -> list:
         """Resident adapters: merged-engine ids plus the slot table's
         (adapter_id, version) pairs."""

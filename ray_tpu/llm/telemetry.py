@@ -306,6 +306,19 @@ _STAT_COUNTERS = (
     ("spill_drops", "rtpu_llm_prefix_spill_drops_total",
      "validate-on-promote failures: stale/corrupt spill content "
      "dropped, request prefilled cold", None),
+    # mesh-parallel engine (cfg.mesh): the zero-involuntary-reshard
+    # contract is that reshard_bytes stays 0 while input/output bytes
+    # track exactly the declared host arrays (token ids in, tokens out)
+    ("mesh_dispatches", "rtpu_llm_mesh_dispatches_total",
+     "device dispatches executed under a sharded mesh", None),
+    ("mesh_input_bytes", "rtpu_llm_mesh_input_bytes_total",
+     "declared host->mesh input bytes (token ids, block tables)", None),
+    ("mesh_output_bytes", "rtpu_llm_mesh_output_bytes_total",
+     "declared mesh->host output bytes (sampled tokens, logprobs)",
+     None),
+    ("mesh_reshard_bytes", "rtpu_llm_mesh_reshard_bytes_total",
+     "bytes of committed buffers found off their pinned sharding "
+     "after a dispatch (must stay 0)", None),
 )
 
 

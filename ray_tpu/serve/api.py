@@ -39,6 +39,11 @@ class DeploymentSpec:
     # pushed to replicas' reconfigure(user_config) at boot and on
     # update_user_config — lightweight updates without restarts
     user_config: Any = None
+    # MPMD stage role within the app (e.g. "prefill"/"decode"): the
+    # controller pairs same-app role groups after reconcile — each
+    # prefill replica gets a sealed KV ring to its paired decode
+    # replica (llm/pd_disagg.py channel handoff)
+    role: Optional[str] = None
 
 
 class Application:
@@ -89,7 +94,7 @@ class Deployment:
     def options(self, **kwargs) -> "Deployment":
         allowed = {"name", "num_replicas", "max_ongoing_requests",
                    "ray_actor_options", "autoscaling_config",
-                   "user_config"}
+                   "user_config", "role"}
         bad = set(kwargs) - allowed
         if bad:
             raise ValueError(f"unknown deployment options {sorted(bad)}")
@@ -109,7 +114,7 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
                num_replicas: int = 1, max_ongoing_requests: int = 16,
                ray_actor_options: Optional[dict] = None,
                autoscaling_config: Optional[dict | AutoscalingConfig] = None,
-               user_config: Any = None,
+               user_config: Any = None, role: Optional[str] = None,
                **_ignored) -> Any:
     """@serve.deployment decorator (reference: serve/api.py:deployment)."""
     if isinstance(autoscaling_config, dict):
@@ -127,6 +132,7 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
             ray_actor_options=ray_actor_options or {},
             autoscaling_config=autoscaling_config,
             user_config=user_config,
+            role=role,
         ))
     if _func_or_class is not None:
         return wrap(_func_or_class)

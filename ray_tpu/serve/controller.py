@@ -369,6 +369,9 @@ class ServeController:
         self._shutdown = False
         self._version_counter = itertools.count(1)
         self._ticks = 0
+        # app -> prefill-replica keys already wired to a decode KV ring
+        # (MPMD PD pairing over DeploymentSpec.role)
+        self._pd_paired: dict[str, set] = {}
         # last published route-table snapshot (minus the version field):
         # republished through frontdoor/routetable.py whenever topology
         # drifts from it
@@ -407,6 +410,7 @@ class ServeController:
             self._routes.pop(app_name, None)
         for st in states.values():
             await self._scale_to_target(st)
+        await self._pair_pd_roles(app_name)
         if http_port is not None:
             await self._ensure_proxies(http_port, num_proxies)
         self._publish_routes()
@@ -457,6 +461,42 @@ class ServeController:
             pass  # replica already dying; reconcile replaces it
         st.replicas.append(actor)
         st.bump()
+
+    async def _pair_pd_roles(self, app: str) -> None:
+        """MPMD prefill/decode pairing: for an app carrying
+        role="prefill" and role="decode" deployment groups, give every
+        prefill replica a sealed KV ring into a decode peer (round-robin
+        i mod n_decode — llm/pd_disagg.py open_kv_channel /
+        connect_kv_channel). Steady-state KV handoff between the pair
+        then costs zero control dispatches. Idempotent per prefill
+        replica; a replacement replica gets wired on the next reconcile
+        tick. Decode replicas may consume several rings (one per paired
+        prefill producer)."""
+        states = self._apps.get(app, {})
+        pre = [r for st in states.values()
+               if getattr(st.spec, "role", None) == "prefill"
+               for r in st.replicas]
+        dec = [r for st in states.values()
+               if getattr(st.spec, "role", None) == "decode"
+               for r in st.replicas]
+        if not pre or not dec:
+            return
+        paired = self._pd_paired.setdefault(app, set())
+        for i, p in enumerate(pre):
+            key = getattr(p, "_actor_id", None) or id(p)
+            if key in paired:
+                continue
+            d = dec[i % len(dec)]
+            try:
+                spec = await d.handle_request.remote(
+                    "open_kv_channel", (4, None), {}, None)
+                if not spec:
+                    continue  # no shared store: actor-call handoff stays
+                if await p.handle_request.remote(
+                        "connect_kv_channel", (spec,), {}, None):
+                    paired.add(key)
+            except Exception:
+                pass  # replica dying; reconcile replaces then re-pairs
 
     async def _scale_to_target(self, st: _DeploymentState):
         while len(st.replicas) < st.target:
@@ -655,6 +695,11 @@ class ServeController:
                     if cfg is not None:
                         self._autoscale(st, cfg, ongoing)
                     await self._scale_to_target(st)
+            if deep:
+                # replacement replicas of role="prefill" groups need a
+                # fresh KV ring to a decode peer; no-op once paired
+                for app in list(self._apps):
+                    await self._pair_pd_roles(app)
             if deep and self._proxies:
                 await self._check_proxies()
             # topology drift (replica counts, proxy replacements) reaches

@@ -271,13 +271,15 @@ def _listen_loop_weak(handle_ref):
 class DeploymentHandle:
     def __init__(self, deployment: str, app: str, controller,
                  method: str = "__call__", stream: bool = False,
-                 multiplexed_model_id: str = ""):
+                 multiplexed_model_id: str = "",
+                 replica_index: Optional[int] = None):
         self.deployment_name = deployment
         self.app_name = app
         self._ctrl = controller
         self._method = method
         self._stream = stream
         self._model_id = multiplexed_model_id
+        self._replica_index = replica_index
         self._replicas: list = []
         self._version = -1
         self._inflight: dict[int, int] = {}
@@ -288,27 +290,38 @@ class DeploymentHandle:
     def __reduce__(self):
         return (DeploymentHandle,
                 (self.deployment_name, self.app_name, self._ctrl,
-                 self._method, self._stream, self._model_id))
+                 self._method, self._stream, self._model_id,
+                 self._replica_index))
 
     def options(self, method_name: Optional[str] = None,
                 stream: Optional[bool] = None,
                 multiplexed_model_id: Optional[str] = None,
+                replica_index: Optional[int] = None,
                 **_ignored) -> "DeploymentHandle":
         return DeploymentHandle(
             self.deployment_name, self.app_name, self._ctrl,
             method_name or self._method,
             self._stream if stream is None else stream,
             self._model_id if multiplexed_model_id is None
-            else multiplexed_model_id)
+            else multiplexed_model_id,
+            self._replica_index if replica_index is None
+            else replica_index)
 
     def __getattr__(self, name: str) -> "DeploymentHandle":
         if name.startswith("_"):
             raise AttributeError(name)
         return DeploymentHandle(self.deployment_name, self.app_name,
                                 self._ctrl, name, self._stream,
-                                self._model_id)
+                                self._model_id, self._replica_index)
 
     # -- routing ----------------------------------------------------------
+
+    def num_replicas(self) -> int:
+        """Live replica count (fresh poll) — lets index-pinned callers
+        (see ``options(replica_index=...)``) size their routing modulus
+        to the deployment's actual width."""
+        self._refresh(force=True)
+        return len(self._replicas)
 
     def _ensure_listener(self):
         """Long-poll push of replica-set changes (reference:
@@ -433,7 +446,13 @@ class DeploymentHandle:
                       if isinstance(v, DeploymentResponse) else v)
                   for k, v in kwargs.items()}
         replicas = self._replicas  # snapshot: listener may swap the list
-        idx = self._pick(replicas, self._affinity_key(args, kwargs))
+        if self._replica_index is not None:
+            # pinned routing (PD channel pairing): the caller addresses a
+            # specific replica by stable index, modulo the live count so a
+            # scale-down degrades to wraparound instead of erroring
+            idx = self._replica_index % len(replicas)
+        else:
+            idx = self._pick(replicas, self._affinity_key(args, kwargs))
         replica = replicas[idx]
         self._inflight[idx] = self._inflight.get(idx, 0) + 1
         _fl.evt(_fl.SRV_DISPATCH, idx, int(self._stream))

@@ -7,6 +7,7 @@ XLA_FLAGS=--xla_force_host_platform_device_count=8 — the analog of
 cluster_utils.Cluster for collective/pjit tests.
 """
 import os
+import tempfile
 
 # Must happen before any jax import anywhere in the test process.
 os.environ["JAX_PLATFORMS"] = "cpu"
@@ -14,6 +15,19 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Persistent XLA compilation cache: the suite rebuilds many identical
+# tiny-model engines (and forks replica subprocesses that do the same),
+# so duplicate compiles of identical HLO dominate wall time. Entries are
+# content-addressed on serialized HLO + compile options + jax version,
+# so reuse within and across runs is safe. Env (not jax.config) so
+# subprocess replicas inherit it. min_compile_time must drop to 0 or
+# the sub-second tiny-model compiles are never persisted.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(tempfile.gettempdir(), "ray_tpu_xla_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 
 import contextlib  # noqa: E402
 

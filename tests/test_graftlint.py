@@ -1043,3 +1043,39 @@ def test_ray_tpu_tree_has_zero_nonbaselined_findings():
     new, _stale = apply_baseline(findings, load_baseline())
     assert new == [], "graftlint regressions:\n" + "\n".join(
         f.render() for f in new)
+
+
+def test_gl007_mesh_and_pd_chan_families_allowed():
+    """The mesh-serving counters (llm/telemetry.py _STAT_COUNTERS mesh_*
+    entries) and the sealed-channel PD handoff counters (pd_disagg.py
+    _chan_counter) ride the llm namespace — pinned so a rename can't
+    silently orphan the zero-reshard invariant (mesh_reshard_bytes must
+    stay 0) or the handoff accounting from their dashboards."""
+    src = """
+        from ray_tpu.util.metrics import Counter, cached_metric
+
+        def ship():
+            cached_metric(Counter, "rtpu_llm_mesh_dispatches_total")
+            cached_metric(Counter, "rtpu_llm_mesh_input_bytes_total")
+            cached_metric(Counter, "rtpu_llm_mesh_output_bytes_total")
+            cached_metric(Counter, "rtpu_llm_mesh_reshard_bytes_total")
+            cached_metric(Counter,
+                          "rtpu_llm_pd_chan_credit_stalls_total")
+            cached_metric(Counter, "rtpu_llm_pd_chan_kv_writes_total")
+            cached_metric(Counter, "rtpu_llm_pd_chan_kv_imports_total")
+            cached_metric(Counter, "rtpu_llm_pd_chan_results_total")
+    """
+    assert lint(src, rules={"GL007"}) == []
+
+
+def test_gl007_mesh_and_pd_chan_lookalikes_rejected():
+    src = """
+        from ray_tpu.util.metrics import Counter, cached_metric
+
+        BAD1 = Counter("rtpu_mesh_dispatches_total")
+        BAD2 = cached_metric(Counter, "pd_chan_kv_writes_total")
+        BAD3 = Counter("rtpu_llm_Mesh_Reshard_bytes_total")
+    """
+    found = lint(src, rules={"GL007"})
+    assert len(found) == 3
+    assert all("does not match" in f.message for f in found)

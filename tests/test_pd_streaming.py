@@ -107,3 +107,70 @@ def test_pd_streams_through_http_proxy(ray):
         out = json.loads(r.read())
     # greedy sampling: streamed and blocking paths agree token-for-token
     assert out["choices"][0]["text"] == text
+
+
+def test_deployment_role_spec():
+    """role= threads through deployment()/options() — the tag the
+    controller's MPMD pairing keys on."""
+    from ray_tpu import serve
+
+    class R:
+        pass
+
+    d = serve.deployment(R, name="r", role="prefill")
+    assert d._spec.role == "prefill"
+    assert d.options(role="decode")._spec.role == "decode"
+    assert d.options(num_replicas=2)._spec.role == "prefill"
+
+
+@pytest.mark.slow  # full serve e2e (~40s): controller role-pairing +
+# channel-path completions; the replica-level sealed-channel handoff is
+# covered fast in test_pd_disagg.py
+def test_serve_channel_pd_completions(ray):
+    """MPMD disaggregation on serve: the controller pairs role=prefill
+    replicas with role=decode KV rings, and PDServer routes unary
+    completions over the sealed handoff — token-identical to a single
+    engine, no ObjectRef ever carrying the payload."""
+    import urllib.request
+
+    from ray_tpu import serve
+    from ray_tpu.llm.paged_engine import PagedInferenceEngine
+    from ray_tpu.llm.pd_disagg import build_pd_openai_app
+    from ray_tpu.serve.api import _controller
+    from ray_tpu.serve.handle import DeploymentHandle
+
+    cfg = _cfg()
+    app = build_pd_openai_app("pd-tiny", n_prefill=1, n_decode=1,
+                              engine_cfg=cfg, use_channels=True)
+    serve.run(app, name="pdc", http_port=18341)
+
+    # the controller pairs roles during deploy; probe the capability
+    # (replica_index pins the probe to the paired prefill replica)
+    h = DeploymentHandle("pd-prefill:pd-tiny", "pdc",
+                         _controller(create=False))
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if h.options(method_name="has_kv_channel",
+                     replica_index=0).remote().result(timeout_s=30):
+            break
+        time.sleep(0.5)
+    else:
+        pytest.fail("controller never paired the PD roles")
+
+    prompt = _prompt(20)
+    body = {"model": "pd-tiny", "prompt": prompt, "max_tokens": 24,
+            "temperature": 0.0}
+    req = urllib.request.Request(
+        "http://127.0.0.1:18341/pdc/v1/completions",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    texts = []
+    for _ in range(2):
+        with urllib.request.urlopen(req, timeout=300) as r:
+            texts.append(json.loads(r.read())["choices"][0]["text"])
+
+    eng = PagedInferenceEngine(cfg, rng_seed=0)
+    sp = SamplingParams(max_tokens=24, temperature=0.0)
+    ref = eng.generate([eng.tokenizer.encode(prompt)], sp)[0]
+    want = eng.tokenizer.decode(ref["token_ids"])
+    assert texts == [want, want]
