@@ -606,12 +606,25 @@ class SharedObjectStore:
     # -- object-level API --------------------------------------------------
 
     def put(self, oid: ObjectID, value: Any, is_exception: bool = False) -> int:
-        """Serialize `value` into the store under `oid`. Returns payload size."""
+        """Serialize `value` into the store under `oid`. Returns payload size.
+
+        Atomic on failure: a raise between create_raw and seal deletes
+        the half-written object, so `oid` never wedges in the unsealed
+        state (a stranded unsealed object makes every retry die with
+        FileExistsError and parks wait_sealed callers forever)."""
         frame = _FramedValue(value, is_exception)
         buf = self.create_raw(oid, frame.total)
-        frame.write_into(buf)
-        del buf
-        self.seal(oid)
+        try:
+            frame.write_into(buf)
+            del buf
+            self.seal(oid)
+        except BaseException:
+            buf = None  # release the view before delete, or the segment pins
+            try:
+                self.delete(oid)
+            except Exception:
+                pass  # store closing / already reclaimed
+            raise
         return frame.total
 
     def put_or_spill(self, oid: ObjectID, value: Any, is_exception: bool,
@@ -639,9 +652,17 @@ class SharedObjectStore:
                 raise
             spill.spill_frame(oid, frame)
             return True
-        frame.write_into(buf)
-        del buf
-        self.seal(oid)
+        try:
+            frame.write_into(buf)
+            del buf
+            self.seal(oid)
+        except BaseException:
+            buf = None
+            try:
+                self.delete(oid)
+            except Exception:
+                pass  # store closing / already reclaimed
+            raise
         return False
 
     def get(self, oid: ObjectID, timeout_ms: int = -1,

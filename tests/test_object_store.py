@@ -269,3 +269,40 @@ class TestZeroCopyGet:
         finally:
             cfg.reset("zero_copy_get")
             ray_tpu.shutdown()
+
+
+# -- put atomicity (graftlint GL014 burn-down regressions) ----------------
+
+
+def test_put_failure_leaves_no_unsealed_object(store):
+    # regression: a raise between create_raw and seal used to strand the
+    # oid UNSEALED — every retry then died with FileExistsError and
+    # wait_sealed callers parked forever
+    oid = ObjectID.from_random()
+    real_seal = store.seal
+
+    def boom(o):
+        raise RuntimeError("injected seal failure")
+
+    store.seal = boom
+    with pytest.raises(RuntimeError):
+        store.put(oid, [1, 2, 3])
+    store.seal = real_seal
+    assert not store.contains(oid)
+    store.put(oid, [1, 2, 3])  # retry must not die with FileExistsError
+    assert store.get(oid) == [1, 2, 3]
+
+
+def test_put_or_spill_failure_leaves_no_unsealed_object(store):
+    oid = ObjectID.from_random()
+    real_seal = store.seal
+
+    def boom(o):
+        raise RuntimeError("injected seal failure")
+
+    store.seal = boom
+    with pytest.raises(RuntimeError):
+        store.put_or_spill(oid, "v", False, None)
+    store.seal = real_seal
+    assert store.put_or_spill(oid, "v", False, None) is False
+    assert store.get(oid) == "v"
