@@ -115,7 +115,16 @@ class CompletionMux:
         except FileExistsError:
             pass  # already rung; the loop hasn't consumed it yet
         except Exception:
-            pass  # store closing: the loop is exiting anyway
+            # Store closing (loop exiting, nothing to do) — or a failed
+            # write/seal, which would strand the bell UNSEALED: every
+            # later ring would die on FileExistsError above and the mux
+            # loop would never wake again. Drop the half-created bell so
+            # the next ring re-creates it.
+            try:
+                self._store.delete(self._bell)
+            except Exception:
+                pass  # store really is closing
+
 
     # -- the mux thread ----------------------------------------------------
 

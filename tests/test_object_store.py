@@ -306,3 +306,23 @@ def test_put_or_spill_failure_leaves_no_unsealed_object(store):
     store.seal = real_seal
     assert store.put_or_spill(oid, "v", False, None) is False
     assert store.get(oid) == "v"
+
+
+def test_mux_ring_seal_failure_does_not_wedge_doorbell(store):
+    # regression: a failed doorbell seal left the bell UNSEALED, so every
+    # later _ring died on FileExistsError and the mux loop never woke
+    from types import SimpleNamespace
+
+    from ray_tpu.core.completion import CompletionMux
+
+    mux = CompletionMux(SimpleNamespace(store=store, spill=None))
+    real_seal = store.seal
+
+    def boom(o):
+        raise RuntimeError("injected seal failure")
+
+    store.seal = boom
+    mux._ring()  # swallowed; must drop the half-created bell
+    store.seal = real_seal
+    mux._ring()
+    assert store.wait_sealed([mux._bell], 1, 0) == [True]
