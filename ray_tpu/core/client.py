@@ -225,7 +225,9 @@ class DriverRuntime(WorkerRuntime):
                     continue
                 break
             if conn is None:
-                time.sleep(delay)
+                # reconnect backoff runs on the conn-loop thread while the
+                # link is DOWN: there are no inbound frames to stall
+                time.sleep(delay)  # graftlint: disable=GL013
                 delay = min(delay * 2, 2.0)
                 continue
             store = SharedObjectStore(reply["store_path"], create=False)
@@ -417,7 +419,9 @@ def _dial(cf_path: str):
         conn = Client((host, cf["tcp_port"]), "AF_INET", authkey=authkey)
     conn.send({"t": "register_driver", "pid": os.getpid(),
                "pv": PROTOCOL_VERSION})
-    reply = conn.recv()
+    # dial-time handshake: the conn loop only reaches _dial while the old
+    # link is dead, so blocking on the registration reply is the point
+    reply = conn.recv()  # graftlint: disable=GL013
     if reply.get("t") == "rejected":
         # structured refusal (e.g. wire-protocol mismatch): deterministic,
         # NOT retryable — reconnect loops must surface it, not back off

@@ -1470,7 +1470,13 @@ class Runtime:
             wid=wid, node_id_hex=node.node_id.hex(), tpu=tpu,
             spill_dir=self.spill.dir)
         log = open(os.path.join(self.session_dir, f"worker-{wid}.log"), "wb")
-        proc = subprocess.Popen(
+        # fork under the runtime lock is deliberate: wid allocation and
+        # the workers-table insert must be atomic with the scheduling
+        # pass that decided to spawn (dropping the lock here would let a
+        # concurrent pass double-assign the bundle). The local-process
+        # path only runs on the head node — agent-backed nodes (the
+        # scale path) take the non-blocking send branch above.
+        proc = subprocess.Popen(  # graftlint: disable=GL012,GL013
             [sys.executable, "-m", "ray_tpu.core.worker"],
             env=env, stdout=log, stderr=subprocess.STDOUT,
             start_new_session=True)
@@ -1747,10 +1753,11 @@ class Runtime:
             # re-materializes
             try:
                 self.store.put(oid, True)
-                if oid not in self.directory:
-                    self.directory[oid] = DirEntry(READY)
             except Exception:
                 pass  # store full: get() falls back to ensure/locate
+            else:
+                if oid not in self.directory:
+                    self.directory[oid] = DirEntry(READY)
         # not popped: the entry persists (one small dict slot per dead
         # actor) so every FUTURE ref — including one deserialized after
         # the first observer's error object was freed — re-materializes
