@@ -1079,3 +1079,545 @@ def test_gl007_mesh_and_pd_chan_lookalikes_rejected():
     found = lint(src, rules={"GL007"})
     assert len(found) == 3
     assert all("does not match" in f.message for f in found)
+
+
+# ------------------------------------------------------------------ #
+# v2: call-graph engine, GL012-GL015, cache, --changed
+# ------------------------------------------------------------------ #
+
+def _v2_lint(tmp_path, files, rules):
+    _write_pkg(tmp_path, files)
+    return run_lint([str(tmp_path / "ray_tpu")], root=str(tmp_path),
+                    rules=rules)
+
+
+# -- GL012: lock-contract reachability ------------------------------ #
+
+def test_gl012_cross_object_locked_call_off_lock(tmp_path):
+    found = _v2_lint(tmp_path, {
+        "ray_tpu/core/helper.py": """
+            class Helper:
+                def run(self, eng):
+                    eng._refresh_locked()
+        """,
+    }, rules={"GL012"})
+    assert rules_of(found) == ["GL012"]
+    assert "_refresh_locked" in found[0].message
+
+
+def test_gl012_quiet_when_lock_held_at_site(tmp_path):
+    found = _v2_lint(tmp_path, {
+        "ray_tpu/core/helper.py": """
+            class Helper:
+                def run(self, eng):
+                    with eng.lock:
+                        eng._refresh_locked()
+        """,
+    }, rules={"GL012"})
+    assert found == []
+
+
+def test_gl012_quiet_when_caller_carries_contract(tmp_path):
+    found = _v2_lint(tmp_path, {
+        "ray_tpu/core/helper.py": """
+            class Helper:
+                def run_locked(self, eng):
+                    eng._refresh_locked()
+        """,
+    }, rules={"GL012"})
+    assert found == []
+
+
+def test_gl012_leaves_lock_owning_classes_to_gl001(tmp_path):
+    # self-calls inside a class that owns a detected lock are GL001's
+    # file-local turf; GL012 must not double-report them
+    found = _v2_lint(tmp_path, {
+        "ray_tpu/core/eng.py": """
+            import threading
+
+            class Eng:
+                def __init__(self):
+                    self.lock = threading.Lock()
+                def poke(self):
+                    self._refresh_locked()
+                def _refresh_locked(self):
+                    pass
+        """,
+    }, rules={"GL012"})
+    assert found == []
+
+
+def test_gl012_blocking_inside_contract_function(tmp_path):
+    # the dual obligation: a *_locked body executes WITH the lock held,
+    # so reachable blocking is blocking-under-lock GL002 cannot see
+    found = _v2_lint(tmp_path, {
+        "ray_tpu/core/eng.py": """
+            import subprocess
+
+            class Eng:
+                def _spawn_locked(self):
+                    self._fork()
+                def _fork(self):
+                    subprocess.Popen(["sleep", "1"])
+        """,
+    }, rules={"GL012"})
+    assert rules_of(found) == ["GL012"]
+    assert "Popen" in found[0].message
+    assert "_spawn_locked -> Eng._fork" in found[0].message
+
+
+def test_gl012_blocking_under_syntactic_lock_is_gl002s(tmp_path):
+    found = _v2_lint(tmp_path, {
+        "ray_tpu/core/eng.py": """
+            import time, threading
+
+            io_lock = threading.Lock()
+
+            def flush_locked():
+                with io_lock:
+                    time.sleep(0.1)
+        """,
+    }, rules={"GL012"})
+    assert found == []
+
+
+def test_gl012_suppression(tmp_path):
+    found = _v2_lint(tmp_path, {
+        "ray_tpu/core/helper.py": """
+            class Helper:
+                def run(self, eng):
+                    eng._refresh_locked()  # graftlint: disable=GL012
+        """,
+    }, rules={"GL012"})
+    assert found == []
+
+
+# -- GL013: blocking reachability into single-threaded contexts ----- #
+
+_GL013_LOOP = """
+    import time
+
+    class Loop:
+        def run(self):
+            while True:
+                msg = self.conn.recv()
+                t = msg.get("t")
+                if t == "a":
+                    self._on_a(msg)
+                elif t == "b":
+                    self._on_b(msg)
+                elif t == "stop":
+                    break
+
+        def _on_a(self, m):
+            self._slow()
+
+        def _on_b(self, m):
+            pass
+
+        def _slow(self):
+            time.sleep(1)
+"""
+
+
+def test_gl013_transitive_blocking_from_frame_handler(tmp_path):
+    found = _v2_lint(tmp_path,
+                     {"ray_tpu/core/loop.py": _GL013_LOOP},
+                     rules={"GL013"})
+    assert rules_of(found) == ["GL013"]
+    assert "time.sleep" in found[0].message
+    assert "Loop._on_a -> Loop._slow" in found[0].message
+    # the dispatcher's own conn.recv is its job, never a finding
+    assert all(".recv" not in f.message for f in found)
+
+
+def test_gl013_no_edge_through_thread_handoff(tmp_path):
+    # pool.submit(fn) moves the work OFF the hot thread: that hop is the
+    # sanctioned fix, so it must never create a call edge
+    found = _v2_lint(tmp_path, {
+        "ray_tpu/core/loop.py": _GL013_LOOP.replace(
+            "self._slow()", "self.pool.submit(self._slow)"),
+    }, rules={"GL013"})
+    assert found == []
+
+
+def test_gl013_unresolvable_call_is_no_edge_no_finding(tmp_path):
+    # conservatism unit: a call the resolver cannot bind (unknown
+    # receiver) yields NO edge — and a missing edge can only suppress
+    found = _v2_lint(tmp_path, {
+        "ray_tpu/core/loop.py": _GL013_LOOP.replace(
+            "self._slow()", "helpers.do_stuff(m)"),
+    }, rules={"GL013"})
+    assert found == []
+
+
+def test_gl013_async_transitive_only(tmp_path):
+    found = _v2_lint(tmp_path, {
+        "ray_tpu/serve/h.py": """
+            import time
+
+            async def handler(req):
+                work(req)
+
+            def work(req):
+                time.sleep(1)
+        """,
+    }, rules={"GL013"})
+    assert rules_of(found) == ["GL013"]
+    assert "async handler" in found[0].message
+    # depth-0 blocking in an async body is GL003's file-local finding
+    found0 = _v2_lint(tmp_path, {
+        "ray_tpu/serve/h0.py": """
+            import time
+
+            async def handler(req):
+                time.sleep(1)
+        """,
+    }, rules={"GL013"})
+    assert [f for f in found0 if f.file.endswith("h0.py")] == []
+
+
+def test_gl013_rpc_methods_are_roots(tmp_path):
+    found = _v2_lint(tmp_path, {
+        "ray_tpu/core/rt.py": """
+            import time
+
+            class Runtime:
+                _RPC_METHODS = ("pg_wait",)
+
+                def pg_wait(self, pg_id):
+                    self._block()
+
+                def _block(self):
+                    time.sleep(5)
+        """,
+    }, rules={"GL013"})
+    assert rules_of(found) == ["GL013"]
+    assert "_RPC_METHODS" in found[0].message
+
+
+def test_gl013_suppression_at_blocking_site(tmp_path):
+    found = _v2_lint(tmp_path, {
+        "ray_tpu/core/loop.py": _GL013_LOOP.replace(
+            "time.sleep(1)",
+            "time.sleep(1)  # graftlint: disable=GL013"),
+    }, rules={"GL013"})
+    assert found == []
+
+
+# -- GL014: store-object lifecycle ---------------------------------- #
+
+def test_gl014_create_raw_span_with_swallowing_handler(tmp_path):
+    found = _v2_lint(tmp_path, {
+        "ray_tpu/core/w.py": """
+            class W:
+                def ring(self, oid):
+                    try:
+                        buf = self.store.create_raw(oid, 1)
+                        buf[0:1] = b"x"
+                        self.store.seal(oid)
+                    except Exception:
+                        pass  # oops: unsealed object stranded
+        """,
+    }, rules={"GL014"})
+    assert rules_of(found) == ["GL014"]
+    assert "create_raw" in found[0].message
+
+
+def test_gl014_quiet_when_handler_releases(tmp_path):
+    found = _v2_lint(tmp_path, {
+        "ray_tpu/core/w.py": """
+            class W:
+                def ring(self, oid):
+                    try:
+                        buf = self.store.create_raw(oid, 1)
+                        self.store.seal(oid)
+                    except Exception:
+                        self.store.delete(oid)
+        """,
+    }, rules={"GL014"})
+    assert found == []
+
+
+def test_gl014_quiet_when_handler_reraises(tmp_path):
+    found = _v2_lint(tmp_path, {
+        "ray_tpu/core/w.py": """
+            class W:
+                def ring(self, oid):
+                    try:
+                        buf = self.store.create_raw(oid, 1)
+                        self.store.seal(oid)
+                    except Exception:
+                        raise
+        """,
+    }, rules={"GL014"})
+    assert found == []
+
+
+def test_gl014_quiet_when_finally_releases(tmp_path):
+    found = _v2_lint(tmp_path, {
+        "ray_tpu/core/w.py": """
+            class W:
+                def ring(self, oid, ok):
+                    try:
+                        buf = self.store.create_raw(oid, 1)
+                        self.store.seal(oid)
+                    except Exception:
+                        pass  # cleanup below
+                    finally:
+                        if not ok:
+                            self.store.delete(oid)
+        """,
+    }, rules={"GL014"})
+    assert found == []
+
+
+def test_gl014_transitive_release_through_call_graph(tmp_path):
+    # the handler's cleanup lives behind a helper: the call graph must
+    # resolve it and dismiss the candidate
+    found = _v2_lint(tmp_path, {
+        "ray_tpu/core/w.py": """
+            class W:
+                def ring(self, oid):
+                    try:
+                        buf = self.store.create_raw(oid, 1)
+                        self.store.seal(oid)
+                    except Exception:
+                        self._cleanup(oid)
+
+                def _cleanup(self, oid):
+                    self.store.delete(oid)
+        """,
+    }, rules={"GL014"})
+    assert found == []
+
+
+def test_gl014_atomic_put_as_final_statement_is_fine(tmp_path):
+    # put() deletes its half-written object on failure; as the try's
+    # final step there is nothing for the handler to release
+    found = _v2_lint(tmp_path, {
+        "ray_tpu/core/w.py": """
+            class W:
+                def reply(self, oid, payload):
+                    try:
+                        self.store.put(oid, payload)
+                    except Exception:
+                        pass  # requester times out
+        """,
+    }, rules={"GL014"})
+    assert found == []
+
+
+def test_gl014_put_with_later_failing_steps_is_flagged(tmp_path):
+    # a SEALED object created early in a try whose later steps fail into
+    # a swallowing handler is orphaned: nobody learns the oid exists
+    found = _v2_lint(tmp_path, {
+        "ray_tpu/core/w.py": """
+            class W:
+                def reply(self, oid, payload):
+                    try:
+                        self.store.put(oid, payload)
+                        self.notify(oid)
+                    except Exception:
+                        pass  # orphan: sealed object, no consumer
+        """,
+    }, rules={"GL014"})
+    assert rules_of(found) == ["GL014"]
+
+
+def test_gl014_suppression(tmp_path):
+    found = _v2_lint(tmp_path, {
+        "ray_tpu/core/w.py": """
+            class W:
+                def ring(self, oid):
+                    try:
+                        buf = self.store.create_raw(oid, 1)  # graftlint: disable=GL014
+                        self.store.seal(oid)
+                    except Exception:
+                        pass  # why: store only closes at shutdown
+        """,
+    }, rules={"GL014"})
+    assert found == []
+
+
+# -- GL015: cfg flag registry --------------------------------------- #
+
+_GL015_CONFIG = """
+    class Flag:
+        def __init__(self, name, default, doc=""):
+            self.name = name
+
+    _FLAGS = [
+        Flag("alpha", 1),
+        Flag("beta", "x"),
+    ]
+
+    class Config:
+        def override(self, **kw):
+            pass
+
+    cfg = Config()
+"""
+
+
+def _gl015_tree(user_src):
+    return {
+        "ray_tpu/__init__.py": "",
+        "ray_tpu/core/__init__.py": "",
+        "ray_tpu/core/config.py": _GL015_CONFIG,
+        "ray_tpu/core/user.py": user_src,
+    }
+
+
+def test_gl015_flags_undeclared_cfg_read(tmp_path):
+    found = _v2_lint(tmp_path, _gl015_tree("""
+        from ray_tpu.core.config import cfg
+
+        def f():
+            return cfg.alpha + cfg.gamma
+    """), rules={"GL015"})
+    assert rules_of(found) == ["GL015"]
+    assert "cfg.gamma" in found[0].message
+
+
+def test_gl015_aliased_and_relative_imports_resolve(tmp_path):
+    found = _v2_lint(tmp_path, _gl015_tree("""
+        from .config import cfg as rcfg
+
+        def f():
+            return rcfg.delta
+    """), rules={"GL015"})
+    assert rules_of(found) == ["GL015"]
+    assert "cfg.delta" in found[0].message
+
+
+def test_gl015_local_rebinding_shadows_the_singleton(tmp_path):
+    # the `cfg = PagedEngineConfig(...)` idiom: a locally bound cfg is a
+    # model config, not the flag registry
+    found = _v2_lint(tmp_path, _gl015_tree("""
+        from ray_tpu.core.config import cfg
+
+        def f(engine):
+            cfg = engine.make_config()
+            return cfg.not_a_flag
+    """), rules={"GL015"})
+    assert found == []
+
+
+def test_gl015_config_methods_are_not_flags(tmp_path):
+    found = _v2_lint(tmp_path, _gl015_tree("""
+        from ray_tpu.core.config import cfg
+
+        def f():
+            cfg.override(alpha=2)
+            return cfg.beta
+    """), rules={"GL015"})
+    assert found == []
+
+
+def test_gl015_suppression(tmp_path):
+    found = _v2_lint(tmp_path, _gl015_tree("""
+        from ray_tpu.core.config import cfg
+
+        def f():
+            return cfg.gamma  # graftlint: disable=GL015
+    """), rules={"GL015"})
+    assert found == []
+
+
+# -- call-graph resolution units ------------------------------------ #
+
+def test_callgraph_cross_module_resolution():
+    import ast as _ast
+    from tools.graftlint import callgraph
+    srcs = {
+        "ray_tpu/core/a.py": "def helper():\n    pass\n",
+        "ray_tpu/core/b.py": ("from ray_tpu.core.a import helper\n"
+                              "def go():\n    helper()\n"),
+    }
+    facts = {rel: callgraph.extract_module(rel, _ast.parse(src))
+             for rel, src in srcs.items()}
+    g = callgraph.CallGraph(facts)
+    go = g.toplevel[("ray_tpu/core/b.py", "go")]
+    callee = g.resolve(go, go.calls[0])
+    assert callee is not None
+    assert callee.module == "ray_tpu/core/a.py"
+    assert callee.name == "helper"
+
+
+def test_callgraph_unresolvable_receiver_yields_no_edge():
+    import ast as _ast
+    from tools.graftlint import callgraph
+    src = "def go(obj):\n    obj.method()\n    unknown_fn()\n"
+    facts = {"ray_tpu/core/b.py":
+             callgraph.extract_module("ray_tpu/core/b.py",
+                                      _ast.parse(src))}
+    g = callgraph.CallGraph(facts)
+    go = g.toplevel[("ray_tpu/core/b.py", "go")]
+    assert [g.resolve(go, s) for s in go.calls] == [None, None]
+
+
+def test_callgraph_nested_defs_do_not_leak_facts():
+    import ast as _ast
+    from tools.graftlint import callgraph
+    src = ("def outer():\n"
+           "    def later():\n"
+           "        import time\n"
+           "        time.sleep(1)\n"
+           "    return later\n")
+    facts = callgraph.extract_module("ray_tpu/core/n.py",
+                                     _ast.parse(src))
+    outer = [f for f in facts.functions if f.name == "outer"][0]
+    assert outer.blocking == []  # `later` runs at an unknown time
+
+
+# -- cache + --changed ---------------------------------------------- #
+
+_CACHE_PKG = {
+    "ray_tpu/__init__.py": "",
+    "ray_tpu/core/__init__.py": "",
+    "ray_tpu/core/q.py": "def f(q):\n    return q.pop(0)\n",
+}
+
+
+def test_cache_roundtrip_and_content_hash(tmp_path, monkeypatch):
+    from tools.graftlint import engine
+    _write_pkg(tmp_path, _CACHE_PKG)
+    monkeypatch.setattr(engine, "REPO_ROOT", str(tmp_path))
+    monkeypatch.setattr(engine, "CACHE_PATH",
+                        str(tmp_path / ".graftlint_cache.json"))
+    target = [str(tmp_path / "ray_tpu")]
+    cold = engine.run_lint(target, root=str(tmp_path))
+    assert "GL004" in rules_of(cold)
+    assert (tmp_path / ".graftlint_cache.json").exists()
+    warm = engine.run_lint(target, root=str(tmp_path))
+    assert [f.render() for f in warm] == [f.render() for f in cold]
+    # mtime bump with identical content: the sha1 path must still hit
+    import os as _os
+    q = tmp_path / "ray_tpu/core/q.py"
+    _os.utime(q, (1, 1))
+    hashed = engine.run_lint(target, root=str(tmp_path))
+    assert [f.render() for f in hashed] == [f.render() for f in cold]
+
+
+def test_cache_invalidates_on_edit(tmp_path, monkeypatch):
+    from tools.graftlint import engine
+    _write_pkg(tmp_path, _CACHE_PKG)
+    monkeypatch.setattr(engine, "REPO_ROOT", str(tmp_path))
+    monkeypatch.setattr(engine, "CACHE_PATH",
+                        str(tmp_path / ".graftlint_cache.json"))
+    target = [str(tmp_path / "ray_tpu")]
+    cold = engine.run_lint(target, root=str(tmp_path))
+    q = tmp_path / "ray_tpu/core/q.py"
+    q.write_text("def f(q):\n    return q.popleft()\n")
+    fixed = engine.run_lint(target, root=str(tmp_path))
+    assert "GL004" in rules_of(cold)
+    assert "GL004" not in rules_of(fixed)
+
+
+def test_cli_changed_and_no_cache_modes():
+    for extra in (["--changed"], ["--no-cache"]):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.graftlint", "ray_tpu"] + extra,
+            cwd=REPO_ROOT, capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stdout + proc.stderr

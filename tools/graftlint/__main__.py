@@ -8,10 +8,13 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 
-from .engine import (DEFAULT_BASELINE, REPO_ROOT, apply_baseline,
-                     load_baseline, parse_files, run_lint, write_baseline)
+from .engine import (DEFAULT_BASELINE, PROJECT_RULES, REPO_ROOT,
+                     apply_baseline, load_baseline, parse_files, run_lint,
+                     write_baseline)
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -35,6 +38,14 @@ def main(argv: list[str] | None = None) -> int:
                          "frame inventory + PROTOCOL_VERSION")
     ap.add_argument("--rules", default=None,
                     help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--changed", action="store_true",
+                    help="report file-rule findings only for files changed "
+                         "vs HEAD (git diff + untracked); project rules "
+                         "still scan the whole tree (cache-backed), since "
+                         "a one-file edit can break a cross-file invariant")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="ignore and do not write the per-file result "
+                         "cache (tools/graftlint/.cache.json)")
     args = ap.parse_args(argv)
 
     paths = args.paths or ["ray_tpu"]
@@ -48,6 +59,21 @@ def main(argv: list[str] | None = None) -> int:
         return 2
 
 
+def _changed_files() -> set:
+    """Repo-relative paths changed vs HEAD, plus untracked files."""
+    changed: set = set()
+    for cmd in (["git", "diff", "--name-only", "HEAD"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            out = subprocess.run(cmd, cwd=REPO_ROOT, capture_output=True,
+                                 text=True, timeout=30).stdout
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+        changed.update(ln.strip().replace(os.sep, "/")
+                       for ln in out.splitlines() if ln.strip())
+    return changed
+
+
 def _run(args, paths, rules) -> int:
     if args.update_frames:
         from . import rules as rules_mod
@@ -58,7 +84,16 @@ def _run(args, paths, rules) -> int:
               f"{rules_mod.FRAMES_MANIFEST}")
         return 0
 
-    findings = run_lint(paths, REPO_ROOT, rules=rules)
+    findings = run_lint(paths, REPO_ROOT, rules=rules,
+                        use_cache=False if args.no_cache else None)
+    if args.changed:
+        # file rules are per-file, so unchanged files cannot have NEW
+        # file-rule findings; project findings always survive the filter
+        # because a one-file edit can break parity anywhere in the tree
+        changed = _changed_files()
+        project_ids = {rid for rid, _ in PROJECT_RULES}
+        findings = [f for f in findings
+                    if f.rule in project_ids or f.file in changed]
 
     if args.baseline_update:
         prev = load_baseline(args.baseline)

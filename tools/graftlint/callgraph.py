@@ -1,0 +1,729 @@
+"""graftlint v2: project-wide call graph + per-function fact table.
+
+GL001–GL011 are file-local and intra-procedural by design (rules.py
+docstring); the interprocedural bug classes the review cycles kept
+catching by hand — a ``*_locked`` contract function reached off-lock
+through a helper, blocking work on the head recv thread behind one
+level of indirection, a store object created on a path with no
+reachable cleanup — need one project-wide pass. This module builds it:
+
+  - ``extract_module()`` walks one parsed module and produces a
+    ``ModuleFacts`` value: every top-level function / method with its
+    facts (acquires a lock, is ``*_locked``, contains a blocking
+    primitive, creates/releases store objects, is an ``async def``,
+    dispatches wire frames) plus every call site with its syntactic
+    held-lock state. ModuleFacts is plain JSON-serializable data, so
+    the engine's mtime+hash cache can persist it per file and the
+    project pass never re-parses an unchanged tree.
+
+  - ``CallGraph`` indexes the facts of every module and resolves call
+    sites to callees: ``self._meth(...)`` to a method of the enclosing
+    class, bare names to same-module functions or ``from x import f``
+    targets, ``alias.f(...)`` through the module's import table.
+    Resolution is bounded and CONSERVATIVE: an unresolvable target
+    (getattr dispatch, a receiver that is not ``self``, a name bound
+    dynamically, an aliased-ambiguous import) yields NO edge — and a
+    missing edge can only suppress a finding, never create one.
+
+What deliberately does NOT create edges (each would need type
+inference to be sound):
+  - calls through non-``self`` receivers (``obj.meth()``) — the
+    receiver's class is unknown statically;
+  - function references passed as arguments (``pool.submit(fn)``,
+    ``Thread(target=fn)``, ``loop.run_in_executor(None, fn)``) — those
+    run on ANOTHER thread, which is exactly why the blocking rules
+    must not follow them;
+  - code inside nested ``def``/``lambda`` bodies — it runs at an
+    unknown later time on an unknown thread (same reasoning GL001/GL002
+    use to reset their held-lock set).
+"""
+from __future__ import annotations
+
+import ast
+import collections
+import dataclasses
+import re
+from typing import Iterable, Optional
+
+# --------------------------------------------------------------------- #
+# shared syntactic helpers (kept self-contained so rules.py and this
+# module do not import each other circularly)
+# --------------------------------------------------------------------- #
+
+_LOCKISH_RE = re.compile(r"(lock|cv|cond|mutex)$", re.IGNORECASE)
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _last(name: Optional[str]) -> str:
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+def _is_funcdef(node: ast.AST) -> bool:
+    return isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda))
+
+
+# --------------------------------------------------------------------- #
+# blocking / store-lifecycle primitive tables (GL013 / GL014 facts)
+# --------------------------------------------------------------------- #
+
+# Primitives that park the calling thread on another party's progress.
+# pickle is deliberately absent: "pickle of a large payload" is a size
+# property the AST cannot decide, and flagging every pickle call would
+# bury the real findings (README "what is conservatively skipped").
+_BLOCKING_DOTTED = {
+    "time.sleep": "time.sleep()",
+    "subprocess.run": "subprocess.run()",
+    "subprocess.Popen": "subprocess.Popen()",
+    "subprocess.call": "subprocess.call()",
+    "subprocess.check_call": "subprocess.check_call()",
+    "subprocess.check_output": "subprocess.check_output()",
+    "os.system": "os.system()",
+    "os.waitpid": "os.waitpid()",
+    "urllib.request.urlopen": "urlopen()",
+    "urlopen": "urlopen()",
+    "socket.create_connection": "socket.create_connection()",
+}
+# method names that park in the native store's futex waits
+_BLOCKING_STORE_WAITS = {"wait_sealed", "wait_sealed_indices",
+                         "os_wait_sealed", "os_chan_get", "os_wait_seq"}
+_CONN_RECV = {"recv", "recv_bytes", "recv_bytes_into", "accept"}
+
+# store-object creation + release vocabularies (GL014). Receiver must
+# look like an object store for creation (a bare ``.put()`` is any
+# queue); release is matched on method name alone — the rule only ever
+# USES releases to dismiss a candidate leak, so over-matching releases
+# is the conservative direction.
+_STORE_CREATE_METHS = {"put", "put_or_spill", "create_raw", "seal",
+                       "create"}
+_STORE_RELEASE_METHS = {"delete", "release", "unpin", "retire", "sweep",
+                        "reclaim", "abort", "drain_trailing",
+                        "spill_teardown", "teardown", "close"}
+
+
+def _storeish_receiver(func: ast.Attribute) -> bool:
+    seg = _last(_dotted(func.value)) if _dotted(func.value) else ""
+    return seg in ("store", "spill", "objstore", "shm") or \
+        seg.endswith("_store")
+
+
+def _conn_receiver(func: ast.Attribute) -> bool:
+    seg = _last(_dotted(func.value)) if _dotted(func.value) else ""
+    return seg in ("conn", "sock", "socket", "connection") or \
+        seg.endswith("_conn") or seg.endswith("_sock")
+
+
+def _blocking_desc(node: ast.Call) -> Optional[str]:
+    """Why this call can park the calling thread, or None."""
+    d = _dotted(node.func)
+    if d is not None:
+        if d in _BLOCKING_DOTTED:
+            return _BLOCKING_DOTTED[d]
+        if _last(d) == "sleep" and d.split(".")[0].startswith("time"):
+            return "time.sleep()"  # import time as _time idiom
+    if isinstance(node.func, ast.Attribute):
+        meth = node.func.attr
+        if meth in _BLOCKING_STORE_WAITS:
+            return f".{meth}() (futex wait on a seal)"
+        if meth in _CONN_RECV and _conn_receiver(node.func):
+            return f".{meth}() (blocks on the peer)"
+        if meth == "join" and not node.args and not node.keywords:
+            return ".join() (blocks until another thread/process exits)"
+    return None
+
+
+def _t_ish(node: ast.AST) -> bool:
+    """Frame-tag read: t / msg["t"] / m.get("t") (GL006's detector)."""
+    if isinstance(node, ast.Name) and node.id == "t":
+        return True
+    if isinstance(node, ast.Subscript):
+        sl = node.slice
+        return isinstance(sl, ast.Constant) and sl.value == "t"
+    if isinstance(node, ast.Call) and \
+            isinstance(node.func, ast.Attribute) and \
+            node.func.attr == "get" and node.args:
+        a0 = node.args[0]
+        return isinstance(a0, ast.Constant) and a0.value == "t"
+    return False
+
+
+# --------------------------------------------------------------------- #
+# per-function facts
+# --------------------------------------------------------------------- #
+
+
+@dataclasses.dataclass
+class CallSite:
+    lineno: int
+    col: int
+    target: str          # dotted source text, e.g. "self._admit", "mod.f"
+    under_lock: bool     # a lockish `with` is held at this site
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    module: str              # relpath of the defining file
+    qualname: str            # "Class.meth" or "func"
+    name: str
+    cls: Optional[str]
+    lineno: int
+    col: int
+    is_async: bool
+    locked_contract: bool    # name carries the *_locked caller-holds rule
+    acquires_lock: bool      # contains `with <lockish>` anywhere
+    blocking: list           # [(lineno, col, desc, under_syntactic_lock)]
+    creates: list            # [(lineno, col, desc)] store-object births
+    releases: bool           # contains a release-vocabulary call
+    frame_dispatch: bool     # >=3 frame-tag comparisons: a recv-loop body
+    calls: list              # [CallSite]
+    gl014: list              # leak candidates, see _scan_try_leaks
+
+    def ref(self) -> str:
+        return f"{self.module}::{self.qualname}"
+
+    def as_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["calls"] = [dataclasses.asdict(c) for c in self.calls]
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FuncInfo":
+        d = dict(d)
+        d["calls"] = [CallSite(**c) for c in d["calls"]]
+        d["blocking"] = [tuple(b) for b in d["blocking"]]
+        d["creates"] = [tuple(c) for c in d["creates"]]
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class ModuleFacts:
+    module_name: Optional[str]       # dotted name ("ray_tpu.core.worker")
+    functions: list                  # [FuncInfo]
+    imports: dict                    # alias -> module dotted name
+    from_imports: dict               # local name -> "module:attr"
+    rpc_methods: list                # names from _RPC_METHODS tuples
+    cfg_reads: list                  # [(lineno, col, attr)] on the cfg flag
+    #                                  singleton (GL015)
+    flag_decls: list                 # Flag("name", ...) declarations
+    #                                  (non-empty only for core/config.py)
+
+    def as_dict(self) -> dict:
+        return {"module_name": self.module_name,
+                "functions": [f.as_dict() for f in self.functions],
+                "imports": self.imports,
+                "from_imports": self.from_imports,
+                "rpc_methods": self.rpc_methods,
+                "cfg_reads": self.cfg_reads,
+                "flag_decls": self.flag_decls}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ModuleFacts":
+        return cls(module_name=d["module_name"],
+                   functions=[FuncInfo.from_dict(f)
+                              for f in d["functions"]],
+                   imports=d["imports"],
+                   from_imports=d["from_imports"],
+                   rpc_methods=d["rpc_methods"],
+                   cfg_reads=[tuple(r) for r in d["cfg_reads"]],
+                   flag_decls=d["flag_decls"])
+
+
+# --------------------------------------------------------------------- #
+# extraction
+# --------------------------------------------------------------------- #
+
+CFG_MODULE = "ray_tpu.core.config"
+CONFIG_FILE = "ray_tpu/core/config.py"
+
+
+def module_name_of(relpath: str) -> Optional[str]:
+    if not relpath.endswith(".py"):
+        return None
+    parts = relpath[:-3].replace("\\", "/").split("/")
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts) if parts else None
+
+
+def _resolve_relative(pkg: str, level: int, module: Optional[str]) -> str:
+    """Absolute dotted module for a `from ...x import y` seen in `pkg`."""
+    if level == 0:
+        return module or ""
+    base_parts = pkg.split(".") if pkg else []
+    up = level - 1
+    if up:
+        base_parts = base_parts[:-up] if up < len(base_parts) else []
+    base = ".".join(base_parts)
+    if module:
+        return f"{base}.{module}" if base else module
+    return base
+
+
+def _pkg_of(relpath: str, mod_name: Optional[str]) -> str:
+    if not mod_name:
+        return ""
+    if relpath.endswith("__init__.py"):
+        return mod_name
+    return mod_name.rsplit(".", 1)[0] if "." in mod_name else ""
+
+
+class _FuncScanner:
+    """One pass over a function body collecting facts + call sites.
+
+    Nested def/lambda bodies are skipped entirely (they run later, on an
+    unknown thread); `with <lockish>` nesting is tracked syntactically
+    the same way GL001/GL002 do.
+    """
+
+    def __init__(self):
+        self.blocking: list = []
+        self.creates: list = []
+        self.releases = False
+        self.acquires = False
+        self.calls: list[CallSite] = []
+        self.tag_compares = 0
+
+    def scan(self, body: Iterable[ast.stmt]):
+        for stmt in body:
+            self._walk(stmt, held=False)
+
+    def _walk(self, node: ast.AST, held: bool):
+        if _is_funcdef(node):
+            return  # runs later, elsewhere
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new_held = held
+            for item in node.items:
+                self._walk(item.context_expr, held)
+                d = _dotted(item.context_expr)
+                if d and _LOCKISH_RE.search(_last(d)):
+                    new_held = True
+                    self.acquires = True
+            for ch in node.body:
+                self._walk(ch, new_held)
+            return
+        if isinstance(node, ast.Compare):
+            sides = [node.left] + list(node.comparators)
+            if any(_t_ish(s) for s in sides):
+                self.tag_compares += 1
+        if isinstance(node, ast.Call):
+            desc = _blocking_desc(node)
+            if desc:
+                # `held` rides along so GL012 can skip sites under a
+                # syntactic with-lock (GL002's file-local turf)
+                self.blocking.append(
+                    (node.lineno, node.col_offset, desc, held))
+            if isinstance(node.func, ast.Attribute):
+                meth = node.func.attr
+                if meth in _STORE_CREATE_METHS and \
+                        _storeish_receiver(node.func):
+                    recv = _last(_dotted(node.func.value)) or "store"
+                    self.creates.append(
+                        (node.lineno, node.col_offset,
+                         f"{recv}.{meth}()"))
+                if meth in _STORE_RELEASE_METHS:
+                    self.releases = True
+            target = _dotted(node.func)
+            if target:
+                self.calls.append(CallSite(
+                    node.lineno, node.col_offset, target, held))
+        for ch in ast.iter_child_nodes(node):
+            self._walk(ch, held)
+
+
+def _scan_try_leaks(fn_node: ast.AST) -> list:
+    """GL014 candidates: try statements whose body creates/seals a store
+    object while a broad handler neither re-raises nor releases.
+
+    Each candidate is serialized as
+      (lineno, col, create_desc, handler_lineno, [handler call targets])
+    — the project pass dismisses the candidate if any recorded handler
+    call resolves (through the call graph) to a function that releases.
+    A `finally:` that releases dismisses the try at extraction time:
+    cleanup runs on both the success and the exception edge.
+    """
+    out = []
+
+    def call_targets(body) -> list[str]:
+        targets = []
+        for stmt in body:
+            for n in ast.walk(stmt):
+                if isinstance(n, ast.Call):
+                    d = _dotted(n.func)
+                    if d:
+                        targets.append(d)
+        return targets
+
+    def releases_in(body) -> bool:
+        for stmt in body:
+            for n in ast.walk(stmt):
+                if isinstance(n, ast.Call) and \
+                        isinstance(n.func, ast.Attribute) and \
+                        n.func.attr in _STORE_RELEASE_METHS:
+                    return True
+        return False
+
+    def reraises(handler: ast.ExceptHandler) -> bool:
+        for n in ast.walk(handler):
+            if isinstance(n, ast.Raise):
+                return True
+        return False
+
+    def creates_in(body):
+        last = len(body) - 1
+        for idx, stmt in enumerate(body):
+            for n in ast.walk(stmt):
+                if _is_funcdef(n):
+                    continue
+                if isinstance(n, ast.Call) and \
+                        isinstance(n.func, ast.Attribute) and \
+                        n.func.attr in _STORE_CREATE_METHS and \
+                        _storeish_receiver(n.func):
+                    if n.func.attr in ("put", "put_or_spill") and \
+                            idx == last:
+                        # an atomic create as the try's final step:
+                        # put() deletes its half-written object on
+                        # failure, so the handler has nothing to
+                        # release. create_raw/seal spans stay flagged —
+                        # the object is unsealed between them.
+                        continue
+                    recv = _last(_dotted(n.func.value)) or "store"
+                    return (n.lineno, n.col_offset,
+                            f"{recv}.{n.func.attr}()")
+        return None
+
+    for node in ast.walk(fn_node):
+        if not isinstance(node, ast.Try):
+            continue
+        created = creates_in(node.body)
+        if created is None:
+            continue
+        if releases_in(node.finalbody):
+            continue  # finally cleans up both edges
+        for handler in node.handlers:
+            types = []
+            if handler.type is not None:
+                elts = handler.type.elts if isinstance(
+                    handler.type, ast.Tuple) else [handler.type]
+                types = [_last(_dotted(e)) or "?" for e in elts]
+            broad = handler.type is None or \
+                any(t in ("Exception", "BaseException") for t in types)
+            if not broad:
+                continue
+            if reraises(handler) or releases_in(handler.body):
+                continue
+            out.append((created[0], created[1], created[2],
+                        handler.lineno, call_targets(handler.body)))
+    return out
+
+
+# the Config singleton's public surface: attribute reads that are method
+# calls, not flag lookups (GL015 must not flag cfg.override(...))
+_CFG_METHODS = {"override", "reset", "dump", "describe",
+                "overrides_for_env"}
+
+
+def _scan_cfg_reads(tree: ast.Module, pkg: str) -> list:
+    """(lineno, col, flag_name) for every attribute read on a name bound
+    to ray_tpu.core.config's ``cfg`` singleton, with real lexical
+    scoping: a function that rebinds the alias (parameter, assignment,
+    loop target — the `cfg = PagedEngineConfig(...)` idiom all over
+    llm/) makes its reads invisible to the rule."""
+
+    def cfg_aliases(node: ast.AST) -> set:
+        """Names this ImportFrom binds to the flag singleton."""
+        found = set()
+        if isinstance(node, ast.ImportFrom):
+            mod = _resolve_relative(pkg, node.level, node.module)
+            if mod == CFG_MODULE:
+                for alias in node.names:
+                    if alias.name == "cfg":
+                        found.add(alias.asname or "cfg")
+        return found
+
+    def own_nodes(scope: ast.AST):
+        """All nodes of `scope` excluding nested function/lambda bodies."""
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            n = stack.pop()
+            yield n
+            if not _is_funcdef(n):
+                stack.extend(ast.iter_child_nodes(n))
+
+    def local_bindings(fn) -> set:
+        bound = set()
+        args = fn.args
+        for a in (args.posonlyargs + args.args + args.kwonlyargs):
+            bound.add(a.arg)
+        if args.vararg:
+            bound.add(args.vararg.arg)
+        if args.kwarg:
+            bound.add(args.kwarg.arg)
+        for n in own_nodes(fn):
+            if isinstance(n, ast.Name) and \
+                    isinstance(n.ctx, (ast.Store, ast.Del)):
+                bound.add(n.id)
+            elif isinstance(n, ast.ExceptHandler) and n.name:
+                bound.add(n.name)
+        return bound
+
+    reads: list = []
+
+    def visit(scope: ast.AST, active: set):
+        own = list(own_nodes(scope))
+        for n in own:
+            active = active | cfg_aliases(n)
+        for n in own:
+            if isinstance(n, ast.Attribute) and \
+                    isinstance(n.value, ast.Name) and \
+                    n.value.id in active and \
+                    isinstance(n.ctx, ast.Load) and \
+                    n.attr not in _CFG_METHODS and \
+                    not n.attr.startswith("_"):
+                reads.append((n.lineno, n.col_offset, n.attr))
+        for n in own:
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                inner = set()
+                for sub in own_nodes(n):
+                    inner |= cfg_aliases(sub)
+                shadowed = local_bindings(n) - inner
+                visit(n, (active - shadowed) | inner)
+
+    visit(tree, set())
+    return sorted(set(reads))
+
+
+def _scan_flag_decls(tree: ast.Module) -> list:
+    names = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                _last(_dotted(node.func)) == "Flag" and node.args and \
+                isinstance(node.args[0], ast.Constant) and \
+                isinstance(node.args[0].value, str):
+            names.append(node.args[0].value)
+    return names
+
+
+def extract_module(relpath: str, tree: ast.Module) -> ModuleFacts:
+    mod_name = module_name_of(relpath)
+    pkg = _pkg_of(relpath, mod_name)
+
+    imports: dict = {}
+    from_imports: dict = {}
+    ambiguous: set = set()
+
+    def bind(table: dict, key: str, val: str):
+        if table.get(key, val) != val:
+            ambiguous.add(key)  # same alias, two targets: unresolvable
+        else:
+            table[key] = val
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                bind(imports, alias.asname or alias.name.split(".")[0],
+                     alias.name if alias.asname else
+                     alias.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            mod = _resolve_relative(pkg, node.level, node.module)
+            if not mod:
+                continue
+            for alias in node.names:
+                bind(from_imports, alias.asname or alias.name,
+                     f"{mod}:{alias.name}")
+    for k in ambiguous:
+        imports.pop(k, None)
+        from_imports.pop(k, None)
+
+    functions: list[FuncInfo] = []
+    rpc_methods: list = []
+
+    def add_func(fn, cls_name: Optional[str]):
+        scanner = _FuncScanner()
+        scanner.scan(fn.body)
+        qual = f"{cls_name}.{fn.name}" if cls_name else fn.name
+        functions.append(FuncInfo(
+            module=relpath, qualname=qual, name=fn.name, cls=cls_name,
+            lineno=fn.lineno, col=fn.col_offset,
+            is_async=isinstance(fn, ast.AsyncFunctionDef),
+            locked_contract="_locked" in fn.name,
+            acquires_lock=scanner.acquires,
+            blocking=scanner.blocking,
+            creates=scanner.creates,
+            releases=scanner.releases,
+            frame_dispatch=scanner.tag_compares >= 3,
+            calls=scanner.calls,
+            gl014=_scan_try_leaks(fn)))
+
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            add_func(node, None)
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    add_func(sub, node.name)
+                elif isinstance(sub, ast.Assign) and \
+                        len(sub.targets) == 1 and \
+                        isinstance(sub.targets[0], ast.Name) and \
+                        sub.targets[0].id == "_RPC_METHODS" and \
+                        isinstance(sub.value, (ast.Tuple, ast.List)):
+                    for el in sub.value.elts:
+                        if isinstance(el, ast.Constant) and \
+                                isinstance(el.value, str):
+                            rpc_methods.append(el.value)
+
+    return ModuleFacts(
+        module_name=mod_name,
+        functions=functions,
+        imports=imports,
+        from_imports=from_imports,
+        rpc_methods=rpc_methods,
+        cfg_reads=([] if relpath == CONFIG_FILE
+                   else _scan_cfg_reads(tree, pkg)),
+        flag_decls=(_scan_flag_decls(tree) if relpath == CONFIG_FILE
+                    else []))
+
+
+# --------------------------------------------------------------------- #
+# the project-wide graph
+# --------------------------------------------------------------------- #
+
+
+class CallGraph:
+    """Resolution + reachability over every module's extracted facts."""
+
+    def __init__(self, facts: dict):
+        # facts: {relpath: ModuleFacts}
+        self.facts = facts
+        self.by_module_name: dict = {}     # dotted name -> relpath
+        self.funcs: dict = {}              # (relpath, qualname) -> FuncInfo
+        self.toplevel: dict = {}           # (relpath, name) -> FuncInfo
+        self.methods: dict = {}            # (relpath, cls, name) -> FuncInfo
+        for rel, mf in facts.items():
+            if mf.module_name:
+                self.by_module_name[mf.module_name] = rel
+            for fi in mf.functions:
+                self.funcs[(rel, fi.qualname)] = fi
+                if fi.cls is None:
+                    self.toplevel[(rel, fi.name)] = fi
+                else:
+                    self.methods[(rel, fi.cls, fi.name)] = fi
+
+    # -- resolution ---------------------------------------------------- #
+
+    def _module_func(self, mod: str, name: str) -> Optional[FuncInfo]:
+        rel = self.by_module_name.get(mod)
+        if rel is None:
+            return None
+        return self.toplevel.get((rel, name))
+
+    def resolve(self, caller: FuncInfo, site: CallSite) -> Optional[FuncInfo]:
+        parts = site.target.split(".")
+        mf = self.facts.get(caller.module)
+        if mf is None:
+            return None
+        if parts[0] == "self" and caller.cls:
+            if len(parts) == 2:
+                return self.methods.get(
+                    (caller.module, caller.cls, parts[1]))
+            return None  # self.attr.meth(): receiver type unknown
+        if len(parts) == 1:
+            name = parts[0]
+            tgt = mf.from_imports.get(name)
+            if tgt:
+                mod, attr = tgt.split(":", 1)
+                return self._module_func(mod, attr)
+            return self.toplevel.get((caller.module, name))
+        if len(parts) == 2:
+            alias, fname = parts
+            mod = mf.imports.get(alias)
+            if mod:
+                return self._module_func(mod, fname)
+            tgt = mf.from_imports.get(alias)
+            if tgt:
+                mod, attr = tgt.split(":", 1)
+                # `from ray_tpu.core import runtime` binds a MODULE
+                return self._module_func(f"{mod}.{attr}", fname)
+            return None
+        if len(parts) >= 3:
+            # fully dotted module path: a.b.c.f()
+            mod, fname = ".".join(parts[:-1]), parts[-1]
+            root = mf.imports.get(parts[0])
+            if root and root != parts[0]:
+                mod = ".".join([root] + parts[1:-1])
+            if mod in self.by_module_name:
+                return self._module_func(mod, fname)
+        return None
+
+    # -- reachability -------------------------------------------------- #
+
+    def reachable_blocking(self, root: FuncInfo, max_depth: int = 10,
+                           skip_async_callees: bool = True):
+        """BFS from `root` over resolved edges; yields
+        (func, path, (lineno, col, desc)) for every blocking primitive
+        reached. `path` is the chain of FuncInfo from root to the
+        blocking function inclusive. Does not descend into async
+        callees when skip_async_callees (each async def is its own
+        GL013 root, so descending would double-report)."""
+        seen = {root.ref()}
+        queue = collections.deque([(root, [root], 0)])
+        while queue:
+            fn, path, depth = queue.popleft()
+            for b in fn.blocking:
+                yield fn, path, b
+            if depth >= max_depth:
+                continue
+            for site in fn.calls:
+                callee = self.resolve(fn, site)
+                if callee is None or callee.ref() in seen:
+                    continue
+                if skip_async_callees and callee.is_async:
+                    continue
+                seen.add(callee.ref())
+                queue.append((callee, path + [callee], depth + 1))
+
+    def releases_reachable(self, caller: FuncInfo, targets: list,
+                           max_depth: int = 3) -> bool:
+        """Does any of `targets` (dotted call expressions inside an
+        except handler) resolve to a function that releases store
+        objects, directly or transitively?"""
+        frontier: list[FuncInfo] = []
+        for t in targets:
+            fi = self.resolve(caller, CallSite(0, 0, t, False))
+            if fi is not None:
+                frontier.append(fi)
+        seen = {f.ref() for f in frontier}
+        depth = 0
+        while frontier and depth <= max_depth:
+            nxt: list[FuncInfo] = []
+            for fn in frontier:
+                if fn.releases:
+                    return True
+                for site in fn.calls:
+                    callee = self.resolve(fn, site)
+                    if callee is not None and callee.ref() not in seen:
+                        seen.add(callee.ref())
+                        nxt.append(callee)
+            frontier = nxt
+            depth += 1
+        return False
+
+    def direct_callees(self, fn: FuncInfo):
+        out = []
+        seen = set()
+        for site in fn.calls:
+            callee = self.resolve(fn, site)
+            if callee is not None and callee.ref() not in seen:
+                seen.add(callee.ref())
+                out.append(callee)
+        return out

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import hashlib
 import json
 import os
 import re
@@ -36,8 +37,12 @@ from typing import Callable, Iterable, Optional
 
 REPO_ROOT = os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
-DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                "baseline.json")
+_TOOL_DIR = os.path.dirname(os.path.abspath(__file__))
+DEFAULT_BASELINE = os.path.join(_TOOL_DIR, "baseline.json")
+CACHE_PATH = os.path.join(_TOOL_DIR, ".cache.json")
+# editing any of these invalidates the whole cache: a rule change must
+# re-lint every file, not just the ones whose mtime moved
+_TOOL_SOURCES = ("engine.py", "rules.py", "callgraph.py", "__main__.py")
 
 _SUPPRESS_RE = re.compile(
     r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+)")
@@ -116,6 +121,12 @@ class FileContext:
 
 
 # rule registry -------------------------------------------------------- #
+#
+# File rules consume a FileContext (full AST + source). Project rules
+# consume `summaries: dict[relpath, dict]` — the plain-JSON per-module
+# digest built by rules.build_summary() — NOT parse trees, so the v2
+# cache can serve the whole project pass for unchanged files without
+# re-parsing anything.
 
 FILE_RULES: list[tuple[str, Callable[[FileContext], Iterable[Finding]]]] = []
 PROJECT_RULES: list[tuple[str, Callable[[dict], Iterable[Finding]]]] = []
@@ -183,26 +194,135 @@ def parse_files(paths: list[str], root: str = REPO_ROOT,
     return ctxs, findings
 
 
+# cache ---------------------------------------------------------------- #
+
+def _tool_fingerprint() -> str:
+    h = hashlib.sha1()
+    for name in _TOOL_SOURCES:
+        p = os.path.join(_TOOL_DIR, name)
+        if os.path.exists(p):
+            with open(p, "rb") as f:
+                h.update(f.read())
+        h.update(b"\x00")
+    return h.hexdigest()
+
+
+def _load_cache() -> dict:
+    try:
+        with open(CACHE_PATH) as f:
+            data = json.load(f)
+        if data.get("fingerprint") == _tool_fingerprint():
+            return data
+    except (OSError, ValueError):
+        pass
+    return {"fingerprint": _tool_fingerprint(), "files": {}}
+
+
+def _save_cache(cache: dict) -> None:
+    tmp = CACHE_PATH + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(cache, f)
+        os.replace(tmp, CACHE_PATH)
+    except OSError:
+        pass  # a read-only checkout just runs uncached
+
+
+def _summary_suppressed(f: Finding, summaries: dict) -> bool:
+    s = summaries.get(f.file)
+    if s is None:
+        return False
+    sup = s.get("suppressions", {})
+    file_rules = sup.get("file", ())
+    if f.rule in file_rules or "all" in file_rules:
+        return True
+    line_rules = sup.get("lines", {}).get(str(f.line), ())
+    return f.rule in line_rules or "all" in line_rules
+
+
 def run_lint(paths: list[str], root: str = REPO_ROOT,
-             rules: Optional[set[str]] = None) -> list[Finding]:
-    """All unsuppressed findings for `paths` (baseline NOT applied)."""
+             rules: Optional[set[str]] = None,
+             use_cache: Optional[bool] = None) -> list[Finding]:
+    """All unsuppressed findings for `paths` (baseline NOT applied).
+
+    The cache only engages on full-rule runs rooted at the repo (the
+    tier-1 gate and the plain CLI): a rule subset would poison cached
+    findings, and a foreign root (unit-test tmp trees) would collide on
+    relpath keys. A cache hit reuses both the file-rule findings and the
+    project-rule summary, so unchanged files cost one stat() each.
+    """
     from . import rules as _rules  # noqa: F401  (registers on import)
-    ctxs, findings = parse_files(paths, root)
-    for rule_id, fn in FILE_RULES:
-        if rules is not None and rule_id not in rules:
+    cacheable = rules is None and os.path.abspath(root) == REPO_ROOT
+    if use_cache is None:
+        use_cache = cacheable
+    cache = _load_cache() if (use_cache and cacheable) else None
+    dirty = False
+    need_summaries = rules is None or \
+        any(rid in rules for rid, _ in PROJECT_RULES)
+
+    findings: list[Finding] = []          # GL000 + project findings
+    file_findings: list[Finding] = []     # already suppression-filtered
+    summaries: dict[str, dict] = {}
+
+    for path in iter_py_files(paths):
+        rel = _relpath(path, root)
+        src: Optional[str] = None
+        entry = cache["files"].get(rel) if cache is not None else None
+        if entry is not None:
+            st = os.stat(path)
+            hit = (entry["mtime_ns"] == st.st_mtime_ns and
+                   entry["size"] == st.st_size)
+            if not hit and entry["size"] == st.st_size:
+                # the build farm touches mtimes; fall back to content
+                with open(path, encoding="utf-8", errors="replace") as f:
+                    src = f.read()
+                if hashlib.sha1(src.encode()).hexdigest() == entry["sha1"]:
+                    entry["mtime_ns"] = st.st_mtime_ns
+                    dirty = True
+                    hit = True
+            if hit:
+                file_findings.extend(
+                    Finding(**d) for d in entry["findings"])
+                summaries[rel] = entry["summary"]
+                continue
+        try:
+            if src is None:
+                with open(path, encoding="utf-8", errors="replace") as f:
+                    src = f.read()
+            ctx = FileContext(path, src, rel)
+        except SyntaxError as e:
+            findings.append(Finding(
+                "GL000", rel, e.lineno or 0, e.offset or 0,
+                f"syntax error: {e.msg}"))
             continue
-        for ctx in ctxs.values():
-            findings.extend(fn(ctx))
+        ff: list[Finding] = []
+        for rule_id, fn in FILE_RULES:
+            if rules is not None and rule_id not in rules:
+                continue
+            ff.extend(fn(ctx))
+        ff = [f for f in ff if not ctx.suppressed(f)]
+        file_findings.extend(ff)
+        if need_summaries:
+            summaries[rel] = _rules.build_summary(ctx)
+        if cache is not None:
+            st = os.stat(path)
+            cache["files"][rel] = {
+                "mtime_ns": st.st_mtime_ns, "size": st.st_size,
+                "sha1": hashlib.sha1(src.encode()).hexdigest(),
+                "findings": [f.as_dict() for f in ff],
+                "summary": summaries[rel]}
+            dirty = True
+
     for rule_id, fn in PROJECT_RULES:
         if rules is not None and rule_id not in rules:
             continue
-        findings.extend(fn(ctxs))
-    out = []
-    for f in findings:
-        ctx = ctxs.get(f.file)
-        if ctx is not None and ctx.suppressed(f):
-            continue
-        out.append(f)
+        findings.extend(fn(summaries))
+
+    if cache is not None and dirty:
+        _save_cache(cache)
+
+    out = file_findings + [f for f in findings
+                           if not _summary_suppressed(f, summaries)]
     out.sort(key=lambda f: (f.file, f.line, f.rule))
     return out
 

@@ -1,10 +1,16 @@
-"""graftlint rules GL001-GL008.
+"""graftlint rules GL001-GL015.
 
 Each rule encodes an invariant the runtime actually relies on (see the
-per-rule docstrings for the motivating subsystem). All checks are
+per-rule docstrings for the motivating subsystem). GL001-GL011 are
 lexical/AST-level and intra-procedural: a blocking call hidden behind a
-helper method is not traced through the call graph. That keeps the pass
-fast and predictable; the suppression/baseline machinery absorbs the
+helper method is not traced. The v2 rules (GL012-GL015) close exactly
+that gap: they run on the project-wide call graph built by
+``callgraph.py`` from the per-module summaries this module emits
+(``build_summary``), so a ``*_locked`` contract reached off-lock through
+a helper, or a ``time.sleep`` two calls below a frame handler, is now a
+finding. Resolution stays conservative — an unresolvable call is a
+missing edge, never an error — so the transitive rules under-report
+rather than cry wolf; the suppression/baseline machinery absorbs the
 residue where the heuristic and the code disagree.
 """
 from __future__ import annotations
@@ -15,6 +21,7 @@ import os
 import re
 from typing import Iterable, Optional
 
+from . import callgraph as _callgraph
 from .engine import (Finding, FileContext, file_rule, project_rule)
 
 # --------------------------------------------------------------------- #
@@ -438,17 +445,18 @@ def _top_level_imports(tree: ast.Module):
 
 
 @project_rule("GL005")
-def check_import_hygiene(ctxs: dict[str, FileContext]) -> Iterable[Finding]:
-    modules: dict[str, FileContext] = {}
-    for rel, ctx in ctxs.items():
+def check_import_hygiene(summaries: dict[str, dict]) -> Iterable[Finding]:
+    # (relpath, top_imports) per in-package module, keyed by dotted name
+    modules: dict[str, tuple[str, list]] = {}
+    for rel, s in summaries.items():
         name = _module_name(rel)
         if name and (name == IMPORT_ROOT
                      or name.startswith(IMPORT_ROOT + ".")):
-            modules[name] = ctx
+            modules[name] = (rel, s["top_imports"])
     if IMPORT_ROOT not in modules:
         return []
 
-    def deps_of(name: str, ctx: FileContext) -> set[str]:
+    def deps_of(name: str) -> set[str]:
         deps: set[str] = set()
 
         def add(target: str):
@@ -459,28 +467,30 @@ def check_import_hygiene(ctxs: dict[str, FileContext]) -> Iterable[Finding]:
                 if cand in modules:
                     deps.add(cand)
 
-        pkg = name if modules[name].relpath.endswith("__init__.py") \
+        rel, imports = modules[name]
+        pkg = name if rel.endswith("__init__.py") \
             else name.rsplit(".", 1)[0]
-        for node in _top_level_imports(ctx.tree):
-            if isinstance(node, ast.Import):
-                for alias in node.names:
-                    add(alias.name)
+        for rec in imports:
+            if rec["kind"] == "import":
+                for target in rec["names"]:
+                    add(target)
             else:
-                if node.level:
+                if rec["level"]:
                     base_parts = pkg.split(".")
-                    up = node.level - 1
+                    up = rec["level"] - 1
                     if up:
                         base_parts = base_parts[:-up] if up < len(
                             base_parts) else []
                     base = ".".join(base_parts)
                 else:
                     base = ""
-                mod = (base + "." + node.module if base and node.module
-                       else (node.module or base))
+                mod = (base + "." + rec["module"]
+                       if base and rec["module"]
+                       else (rec["module"] or base))
                 if mod:
                     add(mod)
-                    for alias in node.names:
-                        add(mod + "." + alias.name)
+                    for target in rec["names"]:
+                        add(mod + "." + target)
         return deps
 
     # BFS the import closure from the package root
@@ -491,22 +501,21 @@ def check_import_hygiene(ctxs: dict[str, FileContext]) -> Iterable[Finding]:
         if name in closure:
             continue
         closure.add(name)
-        frontier.extend(deps_of(name, modules[name]) - closure)
+        frontier.extend(deps_of(name) - closure)
 
     findings: list[Finding] = []
     for name in sorted(closure):
-        ctx = modules[name]
-        for node in _top_level_imports(ctx.tree):
+        rel, imports = modules[name]
+        for rec in imports:
             roots = []
-            if isinstance(node, ast.Import):
-                roots = [a.name.split(".")[0] for a in node.names]
-            elif node.level == 0 and node.module:
-                roots = [node.module.split(".")[0]]
+            if rec["kind"] == "import":
+                roots = [t.split(".")[0] for t in rec["names"]]
+            elif rec["level"] == 0 and rec["module"]:
+                roots = [rec["module"].split(".")[0]]
             for r in roots:
                 if r in HEAVY_MODULES:
                     findings.append(Finding(
-                        "GL005", ctx.relpath, node.lineno,
-                        node.col_offset,
+                        "GL005", rel, rec["lineno"], rec["col"],
                         f"top-level `import {r}` in a module on the "
                         f"eager `import {IMPORT_ROOT}` path; import it "
                         f"lazily inside the function that needs it"))
@@ -598,27 +607,26 @@ def _protocol_version(ctx: FileContext) -> Optional[int]:
     return None
 
 
-def compute_frame_inventory(ctxs: dict[str, FileContext]):
+def compute_frame_inventory(summaries: dict[str, dict]):
     sent: dict[str, tuple[str, int]] = {}
     handled: dict[str, tuple[str, int]] = {}
     for rel in FRAME_MODULES:
-        ctx = ctxs.get(rel)
-        if ctx is None:
+        s = summaries.get(rel)
+        if s is None:
             continue
-        s, h = _collect_frames(ctx)
-        for ty, line in s.items():
+        for ty, line in s["frames_sent"].items():
             sent.setdefault(ty, (rel, line))
-        for ty, line in h.items():
+        for ty, line in s["frames_handled"].items():
             handled.setdefault(ty, (rel, line))
     return sent, handled
 
 
 @project_rule("GL006")
-def check_frame_parity(ctxs: dict[str, FileContext]) -> Iterable[Finding]:
-    present = [rel for rel in FRAME_MODULES if rel in ctxs]
+def check_frame_parity(summaries: dict[str, dict]) -> Iterable[Finding]:
+    present = [rel for rel in FRAME_MODULES if rel in summaries]
     if len(present) < len(FRAME_MODULES):
         return []  # partial-tree lint (unit tests, single files)
-    sent, handled = compute_frame_inventory(ctxs)
+    sent, handled = compute_frame_inventory(summaries)
     findings: list[Finding] = []
     for ty in sorted(set(sent) - set(handled)):
         rel, line = sent[ty]
@@ -635,8 +643,8 @@ def check_frame_parity(ctxs: dict[str, FileContext]) -> Iterable[Finding]:
             f"modules)"))
 
     # version pinning
-    pctx = ctxs.get(PROTOCOL_FILE)
-    pv = _protocol_version(pctx) if pctx else None
+    ps = summaries.get(PROTOCOL_FILE)
+    pv = ps.get("protocol_version") if ps else None
     frames = sorted(set(sent) | set(handled))
     if pv is not None:
         if not os.path.exists(FRAMES_MANIFEST):
@@ -674,7 +682,8 @@ def update_frames_manifest(ctxs: dict[str, FileContext]) -> dict:
         raise FileNotFoundError(
             "--update-frames needs the full tree (run it over ray_tpu/); "
             "missing: " + ", ".join(missing))
-    sent, handled = compute_frame_inventory(ctxs)
+    summaries = {rel: build_summary(ctx) for rel, ctx in ctxs.items()}
+    sent, handled = compute_frame_inventory(summaries)
     pctx = ctxs.get(PROTOCOL_FILE)
     pv = _protocol_version(pctx) if pctx else None
     manifest = {"protocol_version": pv,
@@ -1053,4 +1062,286 @@ def check_swallowed_exceptions(ctx: FileContext) -> Iterable[Finding]:
                 "GL008", ctx.relpath, node.lineno, node.col_offset,
                 f"broad `except {'/'.join(types)}` silently swallowed; "
                 f"add a `# why` comment or handle/narrow it"))
+    return findings
+
+
+# --------------------------------------------------------------------- #
+# v2: per-module summaries + call-graph project rules (GL012-GL015)
+# --------------------------------------------------------------------- #
+# The engine caches summaries per file (mtime+sha1), so everything a
+# project rule needs must live in this plain-JSON digest — never in the
+# parse tree, which a cache hit does not have.
+
+
+def build_summary(ctx: FileContext) -> dict:
+    """The per-module digest the project rules (and the cache) run on."""
+    facts = _callgraph.extract_module(ctx.relpath, ctx.tree)
+    top_imports = []
+    for node in _top_level_imports(ctx.tree):
+        if isinstance(node, ast.Import):
+            top_imports.append({
+                "kind": "import",
+                "names": [a.name for a in node.names],
+                "lineno": node.lineno, "col": node.col_offset})
+        else:
+            top_imports.append({
+                "kind": "from", "module": node.module or "",
+                "level": node.level,
+                "names": [a.name for a in node.names],
+                "lineno": node.lineno, "col": node.col_offset})
+    sent, handled = _collect_frames(ctx)
+    classes_with_locks = []
+    for node in ctx.tree.body:
+        if isinstance(node, ast.ClassDef):
+            locks, _cond, _guarded = _collect_class_locks(ctx, node)
+            if locks:
+                classes_with_locks.append(node.name)
+    return {
+        "module_name": facts.module_name,
+        "facts": facts.as_dict(),
+        "top_imports": top_imports,
+        "frames_sent": sent,
+        "frames_handled": handled,
+        "protocol_version": (_protocol_version(ctx)
+                             if ctx.relpath == PROTOCOL_FILE else None),
+        "classes_with_locks": classes_with_locks,
+        "suppressions": {
+            "file": sorted(ctx.file_suppressions),
+            "lines": {str(k): sorted(v)
+                      for k, v in ctx.line_suppressions.items()}},
+    }
+
+
+def _build_graph(summaries: dict) -> "_callgraph.CallGraph":
+    facts = {rel: _callgraph.ModuleFacts.from_dict(s["facts"])
+             for rel, s in summaries.items()}
+    return _callgraph.CallGraph(facts)
+
+
+# --------------------------------------------------------------------- #
+# GL012 — lock-contract reachability
+# --------------------------------------------------------------------- #
+# Motivation: the *_locked suffix is this codebase's caller-holds-lock
+# contract (GL001 enforces it inside a lock-owning class). What GL001
+# structurally cannot see is a *_locked function reached from ANOTHER
+# file or from a class that owns no lock — exactly the PR 15
+# `_promote_for` bug, where a helper called `_import_payload_locked`
+# with no lock anywhere on the stack. The transitive closure works by
+# induction: a caller is compliant if it holds a lock at the site or
+# carries the contract in its own name, in which case ITS callers are
+# checked the same way.
+
+
+@project_rule("GL012")
+def check_lock_contract_reachability(summaries: dict,
+                                     ) -> Iterable[Finding]:
+    graph = _build_graph(summaries)
+    findings: list[Finding] = []
+    for rel in sorted(summaries):
+        s = summaries[rel]
+        locked_classes = set(s["classes_with_locks"])
+        for fi in graph.facts[rel].functions:
+            # __init__/__del__ run before/after the object is shared, so
+            # the lock is not yet (no longer) contended
+            caller_ok = fi.locked_contract or \
+                fi.name in ("__init__", "__del__")
+            if caller_ok:
+                continue
+            for site in fi.calls:
+                if "_locked" not in site.target.rsplit(".", 1)[-1]:
+                    continue
+                if site.under_lock:
+                    continue
+                if site.target.startswith("self.") and \
+                        fi.cls in locked_classes:
+                    continue  # GL001's file-local turf (it sees the
+                    #           class's own lock set; we would double-
+                    #           report every finding it already has)
+                findings.append(Finding(
+                    "GL012", rel, site.lineno, site.col,
+                    f"`{site.target}()` carries the *_locked "
+                    f"caller-holds-lock contract, but `{fi.qualname}` "
+                    f"calls it with no lock held and without carrying "
+                    f"the contract itself; acquire the lock here, or "
+                    f"rename `{fi.qualname}` to `*_locked` so the "
+                    f"obligation propagates to its callers"))
+
+    # Part 2 — the dual obligation: a *_locked function EXECUTES with
+    # the lock held, so any blocking primitive in (or reachable from)
+    # its body blocks every thread contending that lock. GL002 only
+    # sees blocking under a syntactic `with <lock>`, which a contract
+    # function never has — this is GL002 made transitive. Sites that
+    # ARE under a syntactic with-lock are skipped (GL002's turf).
+    seen_sites: set = set()
+    for rel in sorted(summaries):
+        for fi in graph.facts[rel].functions:
+            if not fi.locked_contract:
+                continue
+            for fn, path, blk in graph.reachable_blocking(fi):
+                ln, col, why = blk[0], blk[1], blk[2]
+                if len(blk) > 3 and blk[3]:
+                    continue  # under a syntactic lock: GL002 flags it
+                key = (fn.module, ln, col)
+                if key in seen_sites:
+                    continue
+                seen_sites.add(key)
+                chain = " -> ".join(p.qualname for p in path)
+                findings.append(Finding(
+                    "GL012", fn.module, ln, col,
+                    f"blocking {why} runs with the lock held by the "
+                    f"*_locked contract (via {chain}); move it off the "
+                    f"locked path or split the function so the lock "
+                    f"drops first"))
+    return findings
+
+
+# --------------------------------------------------------------------- #
+# GL013 — blocking-reachability into single-threaded contexts
+# --------------------------------------------------------------------- #
+# Motivation: GL002/GL003 flag a blocking primitive written directly in
+# a frame handler or async def; one helper call hides it. The head
+# recv thread (Runtime._recv_loop -> _handle_msg), the node agent and
+# worker frame loops, the scheduler pump, and every asyncio handler are
+# single-threaded hot paths: one os_wait_sealed two frames down the
+# call chain stalls the whole control plane (the PR 13 dashboard bug).
+# Entry points:
+#   - functions named in a class's _RPC_METHODS tuple (the rpc-pool
+#     dispatch surface — a blocked handler eats one of 32 pool threads);
+#   - direct resolved callees of any auto-detected frame dispatcher
+#     (>=3 frame-tag comparisons: the elif-chain recv loops) — the
+#     dispatcher itself is exempt, conn.recv IS its job;
+#   - every `async def` (transitive only: depth-0 blocking in an async
+#     body is GL003's file-local finding already);
+#   - the explicit extras below for pumps the heuristics cannot name.
+# Edges never cross pool.submit/Thread(target=...)/run_in_executor —
+# those hops move the work OFF the hot thread, which is the sanctioned
+# fix this rule is meant to force.
+
+_GL013_EXTRA_ROOTS = (
+    ("ray_tpu/core/runtime.py", "Runtime._sched_pump_loop",
+     "scheduler pump"),
+)
+
+
+@project_rule("GL013")
+def check_blocking_reachability(summaries: dict) -> Iterable[Finding]:
+    graph = _build_graph(summaries)
+    # (root FuncInfo, context description, kind tag, min call depth)
+    roots: list = []
+    for rel in sorted(summaries):
+        mf = graph.facts[rel]
+        rpc = set(mf.rpc_methods)
+        for fi in mf.functions:
+            if fi.cls is not None and fi.name in rpc:
+                roots.append((fi, f"worker-RPC handler `{fi.qualname}` "
+                                  f"(_RPC_METHODS pool dispatch)",
+                              "rpc", 0))
+            if fi.frame_dispatch:
+                for callee in graph.direct_callees(fi):
+                    if callee.frame_dispatch:
+                        # a dispatcher handing the connection to another
+                        # dispatch loop (recv_loop -> agent_loop): the
+                        # callee's recv IS its job, and its own callees
+                        # are enumerated as roots in their own right
+                        continue
+                    roots.append((callee,
+                                  f"frame handler `{callee.qualname}` "
+                                  f"(dispatched from `{fi.qualname}`)",
+                                  "frame", 0))
+            if fi.is_async:
+                roots.append((fi, f"async handler `{fi.qualname}` "
+                                  f"(event loop)", "async", 1))
+    for rel, qual, desc in _GL013_EXTRA_ROOTS:
+        fi = graph.funcs.get((rel, qual))
+        if fi is not None:
+            roots.append((fi, f"{desc} `{fi.qualname}`", "pump", 0))
+
+    findings: list[Finding] = []
+    seen: set = set()
+    for fi, desc, kind, min_depth in roots:
+        for fn, path, blk in graph.reachable_blocking(fi):
+            ln, col, why = blk[0], blk[1], blk[2]
+            if len(path) - 1 < min_depth:
+                continue
+            key = (fn.module, ln, col, kind)
+            if key in seen:
+                continue
+            seen.add(key)
+            chain = " -> ".join(p.qualname for p in path)
+            findings.append(Finding(
+                "GL013", fn.module, ln, col,
+                f"blocking {why} reachable from {desc} via {chain}; "
+                f"move the blocking step onto a pool/executor or make "
+                f"it event-driven"))
+    return findings
+
+
+# --------------------------------------------------------------------- #
+# GL014 — store-object lifecycle on the exception edge
+# --------------------------------------------------------------------- #
+# Motivation: a store object created (put/seal/create_raw) inside a
+# `try` whose broad handler neither re-raises nor releases is a leak:
+# the failure is swallowed, the caller never learns the object exists,
+# and nothing ever deletes it (the PR 10 `_fail_actor_locked` and PR 11
+# rpc-reply leaks, both found by hand in review). The candidate is
+# extracted per-file (callgraph._scan_try_leaks); here the call graph
+# gets a veto: if anything the handler calls resolves — transitively —
+# to a function that releases store objects, the cleanup is reachable
+# and the candidate is dismissed. A `finally:` that releases dismisses
+# at extraction time.
+
+
+@project_rule("GL014")
+def check_store_lifecycle(summaries: dict) -> Iterable[Finding]:
+    graph = _build_graph(summaries)
+    findings: list[Finding] = []
+    for rel in sorted(summaries):
+        for fi in graph.facts[rel].functions:
+            for cand in fi.gl014:
+                ln, col, desc, h_ln, h_targets = \
+                    cand[0], cand[1], cand[2], cand[3], list(cand[4])
+                if graph.releases_reachable(fi, h_targets):
+                    continue
+                findings.append(Finding(
+                    "GL014", rel, ln, col,
+                    f"store object created by {desc} inside a try whose "
+                    f"broad except (line {h_ln}) neither re-raises nor "
+                    f"reaches a release; on failure the object leaks in "
+                    f"the store — delete/release it in the handler, "
+                    f"re-raise, or move cleanup to a finally"))
+    return findings
+
+
+# --------------------------------------------------------------------- #
+# GL015 — cfg flag registry
+# --------------------------------------------------------------------- #
+# Motivation: core/config.py's Config raises AttributeError on unknown
+# flags — but only at RUNTIME, on the code path that reads the typo.
+# A misspelled `cfg.prefetch_depht` in a rarely-taken branch ships
+# silently. This closes the loop statically: every `cfg.<name>` read
+# (through any alias of the singleton, with real lexical scoping so the
+# `cfg = PagedEngineConfig(...)` locals in llm/ stay invisible) must
+# name a declared Flag.
+
+
+@project_rule("GL015")
+def check_cfg_registry(summaries: dict) -> Iterable[Finding]:
+    cfg_s = summaries.get(_callgraph.CONFIG_FILE)
+    if cfg_s is None:
+        return []  # partial-tree lint (unit tests, single files)
+    declared = set(cfg_s["facts"]["flag_decls"])
+    if not declared:
+        return []
+    findings: list[Finding] = []
+    for rel in sorted(summaries):
+        for read in summaries[rel]["facts"]["cfg_reads"]:
+            ln, col, attr = read[0], read[1], read[2]
+            if attr in declared:
+                continue
+            findings.append(Finding(
+                "GL015", rel, ln, col,
+                f"`cfg.{attr}` is not declared in core/config.py's flag "
+                f"registry; an unknown flag raises AttributeError only "
+                f"on the branch that reads it — declare "
+                f'Flag("{attr}", ...) or fix the name'))
     return findings
